@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark: MobileNet-v2 224x224 single-chip streaming FPS.
+
+The BASELINE.md north-star config: the reference's gst-launch MobileNet-v2
+image-labeling pipeline, rebuilt TPU-native — uint8 frames in, logits out,
+normalization fused into the jitted model, frames streamed with async
+dispatch-ahead. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "fps", "vs_baseline": N, ...}
+vs_baseline is against the 1000 FPS/chip target (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nnstreamer_tpu.models import zoo
+
+    batch = 1
+    iters = 200
+    warmup = 20
+    depth = 16  # dispatch-ahead window (frames in flight)
+
+    m = zoo.get("mobilenet_v2", batch=str(batch), compute_dtype="bfloat16")
+    fn = jax.jit(m.fn)
+    rng = np.random.default_rng(0)
+    frames = [
+        jnp.asarray(rng.integers(0, 255, (batch, 224, 224, 3), np.uint8))
+        for _ in range(8)
+    ]
+
+    # warmup / compile
+    out = None
+    for i in range(warmup):
+        out = fn(frames[i % len(frames)])
+    jax.block_until_ready(out)
+
+    # throughput: stream with bounded dispatch-ahead (the pipeline
+    # executor's steady-state pattern)
+    t0 = time.perf_counter()
+    inflight = []
+    for i in range(iters):
+        inflight.append(fn(frames[i % len(frames)]))
+        if len(inflight) > depth:
+            inflight.pop(0).block_until_ready()
+    jax.block_until_ready(inflight)
+    dt = time.perf_counter() - t0
+    fps = iters * batch / dt
+
+    # p50 frame latency: synchronous single-frame round trips
+    lat = []
+    for i in range(50):
+        t = time.perf_counter()
+        fn(frames[i % len(frames)]).block_until_ready()
+        lat.append((time.perf_counter() - t) * 1000)
+    p50 = statistics.median(lat)
+
+    dev = jax.devices()[0]
+    print(
+        json.dumps(
+            {
+                "metric": "mobilenet_v2_224_bs1_fps_per_chip",
+                "value": round(fps, 1),
+                "unit": "fps",
+                "vs_baseline": round(fps / 1000.0, 3),
+                "p50_latency_ms": round(p50, 3),
+                "platform": dev.platform,
+                "device": str(dev.device_kind),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""nnstreamer-tpu: a TPU-native tensor stream pipeline framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of NNStreamer
+(reference: Jhuni0123/nnstreamer @ /root/reference): a typed tensor stream
+type system (``other/tensors`` with static/flexible/sparse formats), a
+pipeline of composable elements (converters, transforms, a pluggable
+inference filter, decoders, routing/sync/aggregation/branching combinators),
+a single-shot invoke API, and an among-device layer that shards pipelines
+across a multi-chip TPU slice over ICI/DCN and serves external clients over
+the network.
+
+Design (TPU-first, not a port):
+
+- Tensors are device-resident ``jax.Array``s between stages; host copies only
+  at ingress/egress boundaries (unlike the reference's per-frame
+  map/alloc/unmap, gst/nnstreamer/tensor_filter/tensor_filter.c:566-826).
+- Spec negotiation happens once at pipeline build time (the reference's
+  GstCaps negotiation, done per-pad at PAUSED), producing static shapes XLA
+  can compile.
+- Chains of pure-tensor elements are fused into single jitted XLA programs;
+  the executor streams frames through with async dispatch-ahead.
+- Multi-chip = jax.sharding.Mesh + jit shardings over ICI, replacing the
+  reference's host TCP/MQTT "among-device" layer for intra-slice traffic.
+"""
+
+__version__ = "0.1.0"
+
+from nnstreamer_tpu.tensors.spec import (  # noqa: F401
+    DType,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+)
+from nnstreamer_tpu.tensors.frame import Frame  # noqa: F401
+
+__all__ = [
+    "DType",
+    "TensorFormat",
+    "TensorSpec",
+    "TensorsSpec",
+    "Frame",
+    "__version__",
+]

@@ -1,0 +1,31 @@
+"""Filter backends ("subplugins" in reference terms).
+
+Importing this package registers the built-in backends, the analogue of the
+reference's per-backend .so constructors calling nnstreamer_filter_probe
+(nnstreamer_plugin_api_filter.h:505). Optional heavy backends (tflite) are
+gated on their imports.
+"""
+
+from nnstreamer_tpu.backends.base import (  # noqa: F401
+    Backend,
+    BackendError,
+    FilterProps,
+    InvokeStats,
+)
+from nnstreamer_tpu.backends import fakes  # noqa: F401  (registers)
+from nnstreamer_tpu.backends import custom  # noqa: F401  (registers)
+from nnstreamer_tpu.backends.custom import (  # noqa: F401
+    register_custom_easy,
+    unregister_custom_easy,
+)
+from nnstreamer_tpu.backends import jax_backend  # noqa: F401  (registers)
+
+try:  # torch is optional (cpu parity backend)
+    from nnstreamer_tpu.backends import torch_backend  # noqa: F401
+except Exception:  # pragma: no cover
+    pass
+
+try:  # tflite is optional; absent in the base image
+    from nnstreamer_tpu.backends import tflite_backend  # noqa: F401
+except Exception:  # pragma: no cover
+    pass

@@ -1,0 +1,173 @@
+"""Backend interface: the pluggable inference engine contract.
+
+TPU-native redesign of the reference's subplugin ABI
+(gst/nnstreamer/include/nnstreamer_plugin_api_filter.h —
+GstTensorFilterFramework v0/v1, and the C++ class variant
+nnstreamer_cppplugin_api_filter.hh:67-187). The lifecycle maps 1:1:
+
+    fw->open / close            → Backend.open / close
+    getModelInfo(GET_IN_OUT)    → Backend.get_model_info
+    getModelInfo(SET_INPUT)     → Backend.set_input_info
+    fw->invoke                  → Backend.invoke
+    RELOAD_MODEL event          → Backend.reload  (is-updatable hot swap)
+
+The TPU-first addition is :meth:`Backend.traceable_fn`: a backend that can
+express its computation as a pure jax function returns it so the pipeline
+compiler can fuse it with adjacent transform/decoder stages into ONE XLA
+program — the whole point of keeping tensors device-resident (SURVEY.md §7
+"hard parts"). Backends that wrap host libraries (tflite, torch) return
+None and act as fusion barriers with explicit host transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@dataclass
+class FilterProps:
+    """Filter properties shared by the element and single-shot API
+    (reference property engine: tensor_filter_common.c:103-128)."""
+
+    framework: str = "auto"
+    model: Tuple[str, ...] = ()  # 1..N model files (caffe2-style pairs allowed)
+    input_spec: Optional[TensorsSpec] = None  # user override (input/inputtype props)
+    output_spec: Optional[TensorsSpec] = None
+    custom: str = ""  # backend-specific option string (custom= prop)
+    accelerator: str = ""  # e.g. "true:tpu", parsed leniently
+    invoke_dynamic: bool = False  # output shape may vary per frame
+    options: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def model_path(self) -> str:
+        return self.model[0] if self.model else ""
+
+    def custom_dict(self) -> Dict[str, str]:
+        """Parse ``key:value,key2:value2`` custom strings (the convention of
+        reference subplugins, e.g. edgetpu's device_type:dummy)."""
+        out: Dict[str, str] = {}
+        for part in self.custom.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                k, v = part.split(":", 1)
+                out[k.strip()] = v.strip()
+            else:
+                out[part] = "true"
+        return out
+
+
+class BackendError(RuntimeError):
+    pass
+
+
+class Backend(ABC):
+    """One loaded model instance inside a filter stage."""
+
+    #: subplugin name (set by the registry decorator)
+    name: str = "base"
+    #: whether outputs may change shape per-invoke (flexible output)
+    invoke_dynamic: bool = False
+
+    def __init__(self) -> None:
+        self.props: Optional[FilterProps] = None
+        self.stats = InvokeStats()
+
+    # -- lifecycle ---------------------------------------------------------
+    @abstractmethod
+    def open(self, props: FilterProps) -> None:
+        """Load the model / init the device. Reference fw->open."""
+
+    def close(self) -> None:
+        """Release resources. Reference fw->close."""
+
+    def reload(self, model_paths: Sequence[str]) -> None:
+        """Zero-downtime model swap (reference RELOAD_MODEL,
+        nnstreamer_plugin_api_filter.h:204,377-383). Default: close+open with
+        new paths; backends may double-buffer instead."""
+        assert self.props is not None, "reload before open"
+        self.close()
+        self.open(dataclasses.replace(self.props, model=tuple(model_paths)))
+
+    # -- negotiation -------------------------------------------------------
+    @abstractmethod
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        """(input_spec, output_spec) after open. Reference
+        getModelInfo(GET_IN_OUT_INFO)."""
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Renegotiate for a different input shape; returns the new output
+        spec. Reference getModelInfo(SET_INPUT_INFO) trial negotiation
+        (nnstreamer_plugin_api_filter.h:351-368). Default: reject unless the
+        input already matches."""
+        cur_in, cur_out = self.get_model_info()
+        if cur_in.is_compatible(in_spec):
+            return cur_out
+        raise BackendError(
+            f"{self.name}: cannot renegotiate input {cur_in} -> {in_spec}"
+        )
+
+    # -- execution ---------------------------------------------------------
+    @abstractmethod
+    def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        """Run inference on one frame's tensors. Reference fw->invoke
+        (the hot call, tensor_filter.c:721)."""
+
+    def traceable_fn(self) -> Optional[Callable[[Tuple[Any, ...]], Tuple[Any, ...]]]:
+        """Pure jax function equivalent to invoke(), or None if this backend
+        is host-bound (fusion barrier)."""
+        return None
+
+    # -- instrumented invoke (reference latency/throughput props,
+    #    tensor_filter.c:334-433) ----------------------------------------
+    def invoke_timed(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        t0 = time.perf_counter_ns()
+        out = self.invoke(tensors)
+        self.stats.record(time.perf_counter_ns() - t0)
+        return out
+
+
+class InvokeStats:
+    """Sliding-window latency/throughput, mirroring the reference's
+    10-invoke window (GST_TF_STAT_MAX_RECENT, tensor_filter_common.h:57) and
+    cumulative per-framework stats (nnstreamer_plugin_api_filter.h:169-174)."""
+
+    WINDOW = 10
+
+    def __init__(self) -> None:
+        self.total_invoke_num = 0
+        self.total_invoke_latency_ns = 0
+        self._recent: List[Tuple[int, int]] = []  # (wall_ns_when, latency_ns)
+
+    def record(self, latency_ns: int) -> None:
+        self.total_invoke_num += 1
+        self.total_invoke_latency_ns += latency_ns
+        self._recent.append((time.monotonic_ns(), latency_ns))
+        if len(self._recent) > self.WINDOW:
+            self._recent.pop(0)
+
+    @property
+    def latency_us(self) -> float:
+        """Average latency over the recent window, µs (reference 'latency'
+        read-only property)."""
+        if not self._recent:
+            return 0.0
+        return sum(l for _, l in self._recent) / len(self._recent) / 1000.0
+
+    @property
+    def throughput_fps(self) -> float:
+        """Recent throughput, frames/sec (reference 'throughput' property,
+        reported ×1000 there; plain fps here)."""
+        if len(self._recent) < 2:
+            return 0.0
+        span = self._recent[-1][0] - self._recent[0][0]
+        if span <= 0:
+            return 0.0
+        return (len(self._recent) - 1) * 1e9 / span

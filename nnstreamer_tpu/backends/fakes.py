@@ -1,0 +1,151 @@
+"""Deterministic fake backends for tests and examples.
+
+Reference: tests/nnstreamer_example/ builds custom_example_{passthrough,
+scaler,average,framecounter,...} .so stand-ins used wherever a real model is
+not the point (SURVEY.md §4). These are the same stand-ins, as jax-traceable
+backends so they also exercise the fusion path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+@registry.filter_backend("passthrough")
+class PassthroughBackend(Backend):
+    """Identity filter (custom_example_passthrough). Accepts any static
+    input spec; output spec == input spec."""
+
+    name = "passthrough"
+
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        self._spec = props.input_spec
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._spec is None:
+            raise BackendError("passthrough: input spec unknown until set")
+        return self._spec, self._spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._spec = in_spec
+        return in_spec
+
+    def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        return tensors
+
+    def traceable_fn(self) -> Callable:
+        return lambda tensors: tensors
+
+
+@registry.filter_backend("scaler")
+class ScalerBackend(Backend):
+    """Multiply-by-constant (custom_example_scaler). custom="factor:2.0"."""
+
+    name = "scaler"
+
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        self._factor = float(props.custom_dict().get("factor", "2.0"))
+        self._spec = props.input_spec
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._spec is None:
+            raise BackendError("scaler: input spec unknown until set")
+        return self._spec, self._spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._spec = in_spec
+        return in_spec
+
+    def invoke(self, tensors):
+        return self.traceable_fn()(tensors)
+
+    def traceable_fn(self) -> Callable:
+        f = self._factor
+        return lambda tensors: tuple(
+            (jnp.asarray(t) * jnp.asarray(f, dtype=jnp.asarray(t).dtype)) for t in tensors
+        )
+
+
+@registry.filter_backend("average")
+class AverageBackend(Backend):
+    """Spatial average per tensor (custom_example_average): NHWC → N11C."""
+
+    name = "average"
+
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        self._in_spec = props.input_spec
+        self._out_spec = self._derive_out(self._in_spec) if self._in_spec else None
+
+    @staticmethod
+    def _derive_out(in_spec: TensorsSpec) -> TensorsSpec:
+        outs = []
+        for t in in_spec:
+            if t.rank < 3:
+                raise BackendError(f"average: rank>=3 required, got {t}")
+            shape = list(t.shape)
+            shape[-3] = 1
+            shape[-2] = 1
+            outs.append(TensorSpec(tuple(shape), t.dtype))
+        return TensorsSpec(tuple(outs), in_spec.format, in_spec.rate)
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._in_spec is None:
+            raise BackendError("average: input spec unknown until set")
+        return self._in_spec, self._out_spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._in_spec = in_spec
+        self._out_spec = self._derive_out(in_spec)
+        return self._out_spec
+
+    def invoke(self, tensors):
+        return self.traceable_fn()(tensors)
+
+    def traceable_fn(self) -> Callable:
+        def fn(tensors):
+            out = []
+            for t in tensors:
+                a = jnp.asarray(t)
+                m = jnp.mean(
+                    a.astype(jnp.float32), axis=(-3, -2), keepdims=True
+                )
+                out.append(m.astype(a.dtype))
+            return tuple(out)
+
+        return fn
+
+
+@registry.filter_backend("framecounter")
+class FrameCounterBackend(Backend):
+    """Emits a running uint32 frame count (custom_example_framecounter) —
+    stateful, so host-bound (no traceable fn)."""
+
+    name = "framecounter"
+
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        self._count = 0
+        self._in_spec = props.input_spec
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        out = TensorsSpec.of(TensorSpec((1,), DType.UINT32))
+        return (self._in_spec or TensorsSpec()), out
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._in_spec = in_spec
+        return self.get_model_info()[1]
+
+    def invoke(self, tensors):
+        out = np.array([self._count], dtype=np.uint32)
+        self._count += 1
+        return (out,)

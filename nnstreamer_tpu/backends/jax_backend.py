@@ -1,0 +1,193 @@
+"""The primary TPU backend: models as pure jax functions compiled by XLA.
+
+This is the analogue slot of the reference's tensorflow-lite subplugin (its
+default CPU engine, ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_
+lite.cc) — but TPU-first: a model is a pure function + params pytree, jitted
+once at open (the reference's fw->open = "model load, device init",
+SURVEY.md §3.1), with shapes fixed by negotiation so XLA compiles exactly
+one executable. The un-jitted function is exposed for fusion with adjacent
+transform/decoder stages.
+
+Model sources (by ``model=`` value):
+
+- ``zoo:<name>`` — built-in model zoo (nnstreamer_tpu/models/zoo.py), e.g.
+  ``zoo:mobilenet_v2``. Options via custom string
+  (``custom="num_classes:1001,width:1.0"``).
+- ``<path>.py`` — user script defining
+  ``get_model(options: dict) -> (fn, input_spec | None)`` where ``fn`` is a
+  pure traceable callable ``(*tensors) -> tensor | tuple``.
+- ``<path>.jaxexport`` / ``<path>.stablehlo`` — a serialized
+  ``jax.export.Exported`` artifact (StableHLO); the TPU equivalent of
+  loading a .tflite flatbuffer.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+_log = get_logger("backends.jax")
+
+
+def _spec_from_avals(avals) -> TensorsSpec:
+    return TensorsSpec(
+        tuple(
+            TensorSpec(tuple(int(d) for d in a.shape), DType.from_any(a.dtype))
+            for a in avals
+        )
+    )
+
+
+def _as_tuple(x) -> Tuple[Any, ...]:
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+@registry.filter_backend("jax")
+class JaxBackend(Backend):
+    """framework=jax: jitted pure-function inference on the default device
+    (TPU when present), optionally sharded over a mesh (see parallel/)."""
+
+    name = "jax"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._fn: Optional[Callable] = None
+        self._jitted: Optional[Callable] = None
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
+        self._device = None
+        self._shardings = None  # (in_shardings, out_shardings) when sharded
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        path = props.model_path
+        options = props.custom_dict()
+        if path.startswith("zoo:"):
+            self._open_zoo(path[len("zoo:"):], options)
+        elif path.endswith(".py"):
+            self._open_script(path, options)
+        elif path.endswith((".jaxexport", ".stablehlo", ".hlo")):
+            self._open_exported(path)
+        else:
+            raise BackendError(f"jax: unsupported model source {path!r}")
+        if self._in_spec is None:
+            self._in_spec = props.input_spec
+        if self._in_spec is not None and self._in_spec.is_static:
+            self._compile()
+
+    def _open_zoo(self, name: str, options) -> None:
+        from nnstreamer_tpu.models import zoo
+
+        m = zoo.get(name, **options)
+        self._fn = m.fn
+        self._in_spec = m.input_spec
+
+    def _open_script(self, path: str, options) -> None:
+        if not os.path.isfile(path):
+            raise BackendError(f"jax: model script not found: {path}")
+        spec = importlib.util.spec_from_file_location(
+            f"nns_tpu_jaxmodel_{abs(hash(path))}", path
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        if not hasattr(module, "get_model"):
+            raise BackendError(f"jax: {path} defines no get_model(options)")
+        fn, in_spec = module.get_model(options)
+        self._fn = fn
+        self._in_spec = in_spec
+
+    def _open_exported(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = f.read()
+        exported = jax.export.deserialize(bytearray(blob))
+        self._fn = lambda *tensors: exported.call(*tensors)
+        self._in_spec = _spec_from_avals(exported.in_avals)
+
+    # -- compile -----------------------------------------------------------
+    def _compile(self) -> None:
+        assert self._fn is not None and self._in_spec is not None
+        fn = self._fn
+        wrapped = lambda *tensors: _as_tuple(fn(*tensors))  # noqa: E731
+        jit_kwargs = {}
+        if self._shardings is not None:
+            jit_kwargs = dict(
+                in_shardings=self._shardings[0], out_shardings=self._shardings[1]
+            )
+        self._jitted = jax.jit(wrapped, **jit_kwargs)
+        # shape inference without running (reference getModelInfo): one
+        # abstract evaluation of the jitted function
+        dummies = [
+            jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype) for t in self._in_spec
+        ]
+        outs = jax.eval_shape(wrapped, *dummies)
+        self._out_spec = _spec_from_avals(_as_tuple(outs))
+
+    def set_shardings(self, in_shardings, out_shardings) -> None:
+        """Install jit shardings (used by the parallel layer before open
+        completes or on renegotiation)."""
+        self._shardings = (in_shardings, out_shardings)
+        if self._in_spec is not None and self._in_spec.is_static:
+            self._compile()
+
+    # -- negotiation -------------------------------------------------------
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._in_spec is None:
+            raise BackendError("jax: input spec unknown (shape-polymorphic "
+                               "model needs set_input_info)")
+        if self._out_spec is None:
+            if not self._in_spec.is_static:
+                raise BackendError(f"jax: input spec not static: {self._in_spec}")
+            self._compile()
+        return self._in_spec, self._out_spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        if not in_spec.is_static:
+            raise BackendError(f"jax: spec must be static, got {in_spec}")
+        self._in_spec = in_spec
+        self._compile()
+        return self._out_spec
+
+    # -- execution ---------------------------------------------------------
+    def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        if self._jitted is None:
+            self.get_model_info()
+        # validate against the negotiated spec (reference tensor_filter.c:592)
+        # — a silent mismatch would retrace/recompile per frame.
+        if len(tensors) != self._in_spec.num_tensors:
+            raise BackendError(
+                f"jax: expected {self._in_spec.num_tensors} tensors, got {len(tensors)}"
+            )
+        for t, s in zip(tensors, self._in_spec):
+            if tuple(t.shape) != s.shape:
+                raise BackendError(
+                    f"jax: input shape {tuple(t.shape)} != negotiated {s.shape}"
+                )
+        return self._jitted(*tensors)
+
+    def traceable_fn(self):
+        fn = self._fn
+        if fn is None:
+            return None
+        return lambda tensors: _as_tuple(fn(*tensors))
+
+    def warmup(self) -> None:
+        """Compile + run once on zeros (first compile is slow on TPU; do it
+        before streaming starts, like the reference loads the model at
+        PAUSED, not on the first frame)."""
+        in_spec, _ = self.get_model_info()
+        zeros = [jnp.zeros(t.shape, t.dtype.np_dtype) for t in in_spec]
+        out = self._jitted(*zeros)
+        jax.block_until_ready(out)

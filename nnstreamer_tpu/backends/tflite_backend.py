@@ -1,0 +1,99 @@
+"""TFLite backend (optional): parity path for .tflite models.
+
+Reference: ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc —
+the reference's default engine. Gated on a TFLite interpreter being
+importable (tflite_runtime, ai_edge_litert, or tensorflow); absent in the
+base image, in which case this module's import fails and the backend simply
+isn't registered (same as a missing .so in the reference).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+def _load_interpreter():
+    """Resolve a TFLite Interpreter class lazily — importing tensorflow
+    costs seconds, so it must not happen at registry-load time, only when a
+    tflite model is actually opened (the reference dlopens the subplugin .so
+    lazily for the same reason, nnstreamer_subplugin.c:157-166)."""
+    try:  # pragma: no cover - depends on image contents
+        from tflite_runtime.interpreter import Interpreter  # type: ignore
+
+        return Interpreter
+    except ImportError:
+        pass
+    try:
+        from ai_edge_litert.interpreter import Interpreter  # type: ignore
+
+        return Interpreter
+    except ImportError:
+        pass
+    from tensorflow.lite import Interpreter  # type: ignore
+
+    return Interpreter
+
+
+def _spec_from_details(details) -> TensorsSpec:
+    return TensorsSpec(
+        tuple(
+            TensorSpec(
+                tuple(int(x) for x in d["shape"]),
+                DType.from_any(np.dtype(d["dtype"]).name),
+                d.get("name"),
+            )
+            for d in details
+        )
+    )
+
+
+@registry.filter_backend("tflite")
+class TFLiteBackend(Backend):
+    """framework=tflite model=m.tflite — host CPU interpreter."""
+
+    name = "tflite"
+
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        path = props.model_path
+        if not os.path.isfile(path):
+            raise BackendError(f"tflite: model not found: {path}")
+        threads = int(props.custom_dict().get("num_threads", "0")) or None
+        Interpreter = _load_interpreter()
+        self._interp = Interpreter(model_path=path, num_threads=threads)
+        self._interp.allocate_tensors()
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        return (
+            _spec_from_details(self._interp.get_input_details()),
+            _spec_from_details(self._interp.get_output_details()),
+        )
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        details = self._interp.get_input_details()
+        if len(details) != in_spec.num_tensors:
+            raise BackendError("tflite: tensor count mismatch")
+        for d, t in zip(details, in_spec):
+            self._interp.resize_tensor_input(d["index"], list(t.shape))
+        self._interp.allocate_tensors()
+        return self.get_model_info()[1]
+
+    def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        details = self._interp.get_input_details()
+        if len(tensors) != len(details):
+            raise BackendError(
+                f"tflite: expected {len(details)} input tensors, got {len(tensors)}"
+            )
+        for d, t in zip(details, tensors):
+            self._interp.set_tensor(d["index"], np.asarray(t, dtype=d["dtype"]))
+        self._interp.invoke()
+        return tuple(
+            self._interp.get_tensor(d["index"])
+            for d in self._interp.get_output_details()
+        )

@@ -1,0 +1,81 @@
+"""CPU torch backend: the output-parity reference path.
+
+Reference: ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc (TorchScript
+via libtorch). Here: torch.jit.load on CPU. This backend exists for parity
+testing (BASELINE.md: "output parity vs CPU path") and as an example of a
+host-bound backend that acts as a fusion barrier (traceable_fn → None).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+@registry.filter_backend("torch")
+class TorchBackend(Backend):
+    """framework=torch model=script.pt — TorchScript on CPU."""
+
+    name = "torch"
+
+    def open(self, props: FilterProps) -> None:
+        try:
+            import torch
+        except ImportError as exc:  # pragma: no cover
+            raise BackendError("torch not available") from exc
+        self.props = props
+        path = props.model_path
+        if not os.path.isfile(path):
+            raise BackendError(f"torch: model not found: {path}")
+        self._torch = torch
+        self._module = torch.jit.load(path, map_location="cpu")
+        self._module.eval()
+        self._in_spec = props.input_spec
+        self._out_spec = props.output_spec
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._in_spec is None:
+            raise BackendError("torch: set input spec (TorchScript carries no "
+                               "static shapes)")
+        if self._out_spec is None:
+            self._out_spec = self._probe_output(self._in_spec)
+        return self._in_spec, self._out_spec
+
+    def _probe_output(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Shape inference by a zero-input trial run (the reference's
+        trial-negotiation fallback, nnstreamer_plugin_api_filter.h:351-368)."""
+        zeros = [
+            self._torch.zeros(tuple(t.shape), dtype=self._torch_dtype(t.dtype))
+            for t in in_spec
+        ]
+        with self._torch.no_grad():
+            out = self._module(*zeros)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return TensorsSpec(
+            tuple(
+                TensorSpec(tuple(int(d) for d in o.shape), DType.from_any(str(o.numpy().dtype)))
+                for o in outs
+            )
+        )
+
+    def _torch_dtype(self, dt: DType):
+        return getattr(self._torch, dt.value if dt is not DType.BFLOAT16 else "bfloat16")
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._in_spec = in_spec
+        self._out_spec = self._probe_output(in_spec)
+        return self._out_spec
+
+    def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        torch = self._torch
+        ins = [torch.from_numpy(np.ascontiguousarray(np.asarray(t))) for t in tensors]
+        with torch.no_grad():
+            out = self._module(*ins)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o.numpy() for o in outs)

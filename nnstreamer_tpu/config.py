@@ -1,0 +1,142 @@
+"""Layered configuration: env vars > ini file > hardcoded defaults.
+
+Reference: gst/nnstreamer/nnstreamer_conf.{c,h} — priority "env-var >
+/etc/nnstreamer.ini > hardcoded" (nnstreamer_conf.h:26-29), controlling
+subplugin search paths, framework auto-detect priority per model extension,
+and per-backend bool/string knobs (template nnstreamer.ini.in).
+
+Env mapping: section ``filter`` key ``framework_priority`` is overridden by
+``NNS_TPU_FILTER_FRAMEWORK_PRIORITY``. The ini path itself comes from
+``NNS_TPU_CONF`` (default ``~/.config/nnstreamer_tpu.ini``, then
+``/etc/nnstreamer_tpu.ini``). ``enable_envvar`` (default on) can disable the
+env layer, mirroring the reference's meson option (meson_options.txt:36).
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import threading
+from typing import Dict, List, Optional
+
+_DEFAULTS: Dict[str, Dict[str, str]] = {
+    "common": {
+        "enable_envvar": "true",
+    },
+    "filter": {
+        # search paths for out-of-tree backend plugins (python files defining
+        # register()); colon separated
+        "plugin_paths": "",
+        # model-extension → backend auto-detection priority
+        # (reference nnstreamer.ini.in:14-17 framework_priority_*)
+        "framework_priority_stablehlo": "jax",
+        "framework_priority_mlir": "jax",
+        "framework_priority_pkl": "jax",
+        "framework_priority_msgpack": "jax",
+        "framework_priority_py": "custom",
+        "framework_priority_tflite": "tflite,jax",
+    },
+    "decoder": {"plugin_paths": ""},
+    "converter": {"plugin_paths": ""},
+    "jax": {
+        # default compute dtype for fused segments on TPU
+        "compute_dtype": "bfloat16",
+        "persistent_cache": "",
+    },
+    "edge": {
+        "default_port": "3000",  # reference edge_common.h:36-37
+        "timeout_sec": "10",  # reference tensor_query_common.h:28
+    },
+}
+
+_ENV_PREFIX = "NNS_TPU_"
+
+
+class Config:
+    """Thread-safe layered config with the reference's 3-level priority."""
+
+    def __init__(self, ini_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._parser = configparser.ConfigParser()
+        self._loaded_path: Optional[str] = None
+        self.load(ini_path)
+
+    def load(self, ini_path: Optional[str] = None) -> None:
+        with self._lock:
+            self._parser = configparser.ConfigParser()
+            candidates = [
+                ini_path,
+                os.environ.get(_ENV_PREFIX + "CONF"),
+                os.path.expanduser("~/.config/nnstreamer_tpu.ini"),
+                "/etc/nnstreamer_tpu.ini",
+            ]
+            for c in candidates:
+                if c and os.path.isfile(c):
+                    self._parser.read(c)
+                    self._loaded_path = c
+                    break
+
+    @property
+    def env_enabled(self) -> bool:
+        raw = self._layered("common", "enable_envvar", use_env=False)
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+
+    def _layered(self, section: str, key: str, use_env: bool = True) -> str:
+        if use_env:
+            env_key = f"{_ENV_PREFIX}{section.upper()}_{key.upper()}"
+            if env_key in os.environ:
+                return os.environ[env_key]
+        if self._parser.has_option(section, key):
+            return self._parser.get(section, key)
+        return _DEFAULTS.get(section, {}).get(key, "")
+
+    def get(self, section: str, key: str, default: str = "") -> str:
+        val = self._layered(section, key, use_env=self.env_enabled)
+        return val if val != "" else default
+
+    def get_bool(self, section: str, key: str, default: bool = False) -> bool:
+        raw = self.get(section, key, "")
+        if raw == "":
+            return default
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+
+    def get_int(self, section: str, key: str, default: int = 0) -> int:
+        raw = self.get(section, key, "")
+        try:
+            return int(raw)
+        except ValueError:
+            return default
+
+    def get_list(self, section: str, key: str, sep: str = ",") -> List[str]:
+        raw = self.get(section, key, "")
+        return [p.strip() for p in raw.split(sep) if p.strip()]
+
+    def plugin_paths(self, kind: str) -> List[str]:
+        """Search paths for out-of-tree subplugins of a kind
+        (reference nnsconf_get_fullpath search-path machinery)."""
+        return self.get_list(kind, "plugin_paths", sep=":")
+
+    def framework_priority(self, model_ext: str) -> List[str]:
+        """Backend priority list for a model file extension
+        (reference tensor_filter_common.c:1155-1218 auto-detection)."""
+        return self.get_list("filter", f"framework_priority_{model_ext.lstrip('.')}")
+
+
+_global: Optional[Config] = None
+_global_lock = threading.Lock()
+
+
+def conf() -> Config:
+    """Global config singleton (reference nnsconf_loadconf lazy-load)."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = Config()
+        return _global
+
+
+def reload_conf(ini_path: Optional[str] = None) -> Config:
+    global _global
+    with _global_lock:
+        _global = Config(ini_path)
+        return _global

@@ -1,0 +1,32 @@
+"""Logging shim (reference: gst/nnstreamer/nnstreamer_log.{c,h}).
+
+The reference maps ml_log{i,w,e,d,f} onto platform loggers and attaches C
+backtraces on fatal paths (nnstreamer_log.c:29-45). Here: stdlib logging
+with one framework-wide logger tree and a fatal helper that captures the
+Python traceback.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import traceback
+
+_ROOT = logging.getLogger("nnstreamer_tpu")
+if not _ROOT.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(
+        logging.Formatter("%(asctime)s %(name)s %(levelname).1s: %(message)s")
+    )
+    _ROOT.addHandler(_h)
+    _ROOT.setLevel(os.environ.get("NNS_TPU_LOG_LEVEL", "WARNING").upper())
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return _ROOT.getChild(name) if name else _ROOT
+
+
+def logf_stacktrace(logger: logging.Logger, msg: str, *args) -> None:
+    """Fatal log with stack trace (ml_logf_stacktrace analogue)."""
+    logger.critical(msg, *args)
+    logger.critical("".join(traceback.format_stack()))

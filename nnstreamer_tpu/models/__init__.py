@@ -1,0 +1,1 @@
+"""Built-in model zoo (pure-jnp models for framework=jax)."""

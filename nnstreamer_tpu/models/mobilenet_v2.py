@@ -1,0 +1,135 @@
+"""MobileNet-v2 (1001-class, 224x224) — the flagship benchmark model.
+
+The reference treats mobilenet_v2_1.0_224_quant.tflite as its canonical test
+model (tests/test_models/models/, used by the image-labeling example and the
+BASELINE.md north-star pipeline). This is a from-scratch jnp implementation
+of the same architecture (Sandler et al. 2018, arXiv:1801.04381): stem conv
++ 17 inverted-residual bottlenecks (expansion/depthwise/projection) + 1x1
+conv to 1280 + global average pool + classifier; ReLU6 activations; NHWC.
+
+Model fn signature: ``fn(image_uint8_nhwc) -> logits[f32 N,1001]`` with
+normalization fused in, so a pipeline can feed raw uint8 frames and the
+whole pre+model graph compiles to one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import nn
+
+# (expansion t, out channels c, repeats n, first stride s) — table 2 of the
+# paper; matches the reference tflite model topology.
+_INVERTED_RESIDUAL_CFG: Tuple[Tuple[int, int, int, int], ...] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def init_params(
+    key, num_classes: int = 1001, width: float = 1.0
+) -> Dict:
+    keys = iter(jax.random.split(key, 64))
+    p: Dict = {}
+    c_stem = _make_divisible(32 * width)
+    p["stem"] = {"w": nn.init_conv(next(keys), 3, 3, 3, c_stem), "bn": nn.init_bn(c_stem)}
+    cin = c_stem
+    blocks = []
+    for t, c, n, s in _INVERTED_RESIDUAL_CFG:
+        cout = _make_divisible(c * width)
+        for i in range(n):
+            stride = s if i == 0 else 1
+            hidden = cin * t
+            blk: Dict = {}
+            if t != 1:
+                blk["expand"] = {
+                    "w": nn.init_conv(next(keys), 1, 1, cin, hidden),
+                    "bn": nn.init_bn(hidden),
+                }
+            blk["dw"] = {
+                "w": nn.init_conv(next(keys), 3, 3, hidden, hidden, groups=hidden),
+                "bn": nn.init_bn(hidden),
+            }
+            blk["project"] = {
+                "w": nn.init_conv(next(keys), 1, 1, hidden, cout),
+                "bn": nn.init_bn(cout),
+            }
+            blocks.append(blk)
+            cin = cout
+    p["blocks"] = blocks
+    c_head = _make_divisible(1280 * width) if width > 1.0 else 1280
+    p["head"] = {"w": nn.init_conv(next(keys), 1, 1, cin, c_head), "bn": nn.init_bn(c_head)}
+    p["classifier"] = nn.init_dense(next(keys), c_head, num_classes)
+    return p
+
+
+def _block_strides() -> Tuple[int, ...]:
+    """Static per-block stride plan from the cfg table (params hold only
+    arrays so the pytree is grad-able; the plan is trace-time static)."""
+    strides = []
+    for _, _, n, s in _INVERTED_RESIDUAL_CFG:
+        strides.extend([s if i == 0 else 1 for i in range(n)])
+    return tuple(strides)
+
+
+def _block(x, blk: Dict, stride: int, train: bool):
+    y = x
+    if "expand" in blk:
+        y = nn.relu6(nn.batch_norm(nn.conv2d(y, blk["expand"]["w"]), blk["expand"]["bn"], train))
+    groups = y.shape[-1]
+    y = nn.relu6(
+        nn.batch_norm(
+            nn.conv2d(y, blk["dw"]["w"], stride=stride, groups=groups),
+            blk["dw"]["bn"],
+            train,
+        )
+    )
+    y = nn.batch_norm(nn.conv2d(y, blk["project"]["w"]), blk["project"]["bn"], train)
+    # residual iff same spatial + channels (shape check is static at trace)
+    if stride == 1 and y.shape[-1] == x.shape[-1]:
+        y = y + x
+    return y
+
+
+def features(params: Dict, x, train: bool = False):
+    """Backbone: normalized f32/bf16 NHWC → final 7x7x1280 feature map.
+    Exposed separately for SSD/DeepLab heads."""
+    y = nn.relu6(nn.batch_norm(nn.conv2d(x, params["stem"]["w"], stride=2), params["stem"]["bn"], train))
+    for blk, stride in zip(params["blocks"], _block_strides()):
+        y = _block(y, blk, stride, train)
+    y = nn.relu6(nn.batch_norm(nn.conv2d(y, params["head"]["w"]), params["head"]["bn"], train))
+    return y
+
+
+def normalize_uint8(x, compute_dtype=jnp.float32):
+    """uint8 [0,255] → [-1,1] (the tflite mobilenet preprocessing; the
+    reference pipeline does this in tensor_transform arithmetic mode)."""
+    return (x.astype(compute_dtype) - 127.5) / 127.5
+
+
+def apply(params: Dict, x, train: bool = False, compute_dtype=jnp.float32):
+    """uint8/float NHWC image batch → logits [N, num_classes]."""
+    if x.dtype == jnp.uint8:
+        x = normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    params = nn.cast_params(params, compute_dtype) if compute_dtype != jnp.float32 else params
+    y = features(params, x, train)
+    y = jnp.mean(y, axis=(1, 2))  # global average pool
+    logits = nn.dense(y, params["classifier"])
+    return logits.astype(jnp.float32)

@@ -1,0 +1,97 @@
+"""Minimal functional NN primitives shared by the model zoo.
+
+Pure-jnp layers with explicit params pytrees — no flax dependency in the
+product path, so models are plain (fn, params) pairs the jax backend can jit
+and the pipeline compiler can fuse. NHWC layout throughout (TPU-native conv
+layout; channels-last keeps the lane dimension = channels for the MXU).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def relu6(x):
+    return jnp.minimum(jnp.maximum(x, 0.0), 6.0)
+
+
+def conv2d(x, w, stride: int = 1, groups: int = 1, padding="SAME"):
+    """NHWC conv; w is HWIO (I = in_channels // groups)."""
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+
+
+def batch_norm(x, p: Dict, train: bool = False, eps: float = 1e-3):
+    """Functional batchnorm. Inference uses stored moments; train mode uses
+    batch moments (sufficient for the dryrun/training-step path; moment EMA
+    updates are the optimizer loop's concern)."""
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = p["mean"], p["var"]
+    inv = jax.lax.rsqrt(var + eps) * p["scale"]
+    return x * inv + (p["bias"] - mean * inv)
+
+
+def dense(x, p: Dict):
+    return x @ p["w"] + p["b"]
+
+
+# -- initializers ---------------------------------------------------------
+
+def _fan_in_out(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    rf = math.prod(shape[:-2])
+    return shape[-2] * rf, shape[-1] * rf
+
+
+def init_conv(key, h, w, cin, cout, groups: int = 1):
+    shape = (h, w, cin // groups, cout)
+    fan_in, _ = _fan_in_out(shape)
+    std = math.sqrt(2.0 / max(fan_in, 1))
+    return jax.random.normal(key, shape, jnp.float32) * std
+
+
+def init_bn(c: int) -> Dict:
+    return {
+        "scale": jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def init_dense(key, cin: int, cout: int) -> Dict:
+    std = math.sqrt(1.0 / max(cin, 1))
+    return {
+        "w": jax.random.normal(key, (cin, cout), jnp.float32) * std,
+        "b": jnp.zeros((cout,), jnp.float32),
+    }
+
+
+def cast_params(params, dtype):
+    """Cast float leaves of a params pytree (bfloat16 serving)."""
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, params)
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))

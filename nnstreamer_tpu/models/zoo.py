@@ -1,0 +1,99 @@
+"""Built-in model zoo: named (fn, params, spec) bundles for framework=jax.
+
+The analogue of the reference's tests/test_models/models/ fixture set
+(add.tflite, mobilenet_v2_..., deeplabv3_...), but as constructively seeded
+jax models: ``model=zoo:<name>`` always works offline with deterministic
+params (seed via custom option ``seed:N``). Weight files can be layered in
+via ``params:<path.npz>``.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+@dataclass
+class ZooModel:
+    name: str
+    fn: Callable  # (*tensors) -> tensor | tuple, pure & traceable
+    input_spec: Optional[TensorsSpec]
+    params: Optional[Dict] = None
+
+
+_FACTORIES: Dict[str, Callable[..., ZooModel]] = {}
+
+
+def model_factory(name: str):
+    def deco(fn):
+        _FACTORIES[name] = fn
+        return fn
+
+    return deco
+
+
+def get(name: str, **options: str) -> ZooModel:
+    if name not in _FACTORIES:
+        raise KeyError(f"unknown zoo model {name!r}; known: {sorted(_FACTORIES)}")
+    return _FACTORIES[name](**options)
+
+
+def available():
+    return sorted(_FACTORIES)
+
+
+def _load_params_overlay(params, options):
+    path = options.get("params")
+    if not path:
+        return params
+    blob = np.load(path, allow_pickle=True)
+    flat = {k: jnp.asarray(v) for k, v in blob.items()}
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    new_leaves = [flat[f"p{i}"] if f"p{i}" in flat else l for i, l in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+@model_factory("add")
+def _add(**options) -> ZooModel:
+    """y = x + const (the reference's add.tflite test model)."""
+    const = float(options.get("const", 2.0))
+    dims = options.get("dims", "1")
+    spec = TensorsSpec.of(TensorSpec.from_dim_string(dims, "float32"))
+
+    def fn(x):
+        return x + jnp.asarray(const, x.dtype)
+
+    return ZooModel("add", fn, spec)
+
+
+@model_factory("mobilenet_v2")
+def _mobilenet_v2(**options) -> ZooModel:
+    from nnstreamer_tpu.models import mobilenet_v2
+
+    seed = int(options.get("seed", 0))
+    num_classes = int(options.get("num_classes", 1001))
+    width = float(options.get("width", 1.0))
+    batch = int(options.get("batch", 1))
+    size = int(options.get("size", 224))
+    compute = options.get("compute_dtype", "float32")
+    in_dtype = options.get("input_dtype", "uint8")
+    params = mobilenet_v2.init_params(
+        jax.random.PRNGKey(seed), num_classes=num_classes, width=width
+    )
+    params = _load_params_overlay(params, options)
+    compute_dtype = jnp.dtype(compute) if compute != "bfloat16" else jnp.bfloat16
+
+    def fn(image):
+        return mobilenet_v2.apply(params, image, compute_dtype=compute_dtype)
+
+    spec = TensorsSpec.of(
+        TensorSpec((batch, size, size, 3), DType.from_any(in_dtype), name="image")
+    )
+    return ZooModel("mobilenet_v2", fn, spec, params)

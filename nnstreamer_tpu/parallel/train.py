@@ -1,0 +1,112 @@
+"""Sharded training step for the flagship model (dp × tp over a Mesh).
+
+The reference is inference-only (SURVEY.md §5.3-5.4: no training story); the
+TPU build adds a genuinely new capability: the flagship classifier trains
+data-parallel × tensor-parallel over a device mesh via jit shardings — XLA
+inserts the psum/all-gather collectives over ICI (scaling-book recipe: pick
+a mesh, annotate shardings, let XLA do the rest).
+
+Sharding layout for MobileNet-v2:
+- batch: P('dp') on the leading dim (pure DP).
+- params: channel-sharded P(..., 'tp') on the big trailing-channel tensors
+  (head conv HWIO on O, classifier W on its input row dim to match the
+  sharded 1280-feature activations); everything else replicated. XLA's SPMD
+  propagation shards the intermediate activations to match.
+- optimizer state inherits the param shardings (optax states mirror the
+  param pytree).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.models import mobilenet_v2
+
+
+def param_shardings(mesh: Mesh, params) -> Any:
+    """NamedSharding pytree for MobileNet-v2 params: TP on the classifier
+    and head channels, replicated elsewhere."""
+    repl = NamedSharding(mesh, P())
+
+    def assign(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+        if "classifier" in keys:
+            if keys[-1] == "w":  # (1280, classes): shard the feature rows
+                return NamedSharding(mesh, P("tp", None))
+            return repl  # bias: small, replicated
+        if "head" in keys:
+            if keys[-1] == "w":  # HWIO: shard output channels
+                return NamedSharding(mesh, P(None, None, None, "tp"))
+            return NamedSharding(mesh, P("tp"))  # bn vectors over 1280
+        return repl
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def loss_fn(params, images, labels, compute_dtype=jnp.float32):
+    logits = mobilenet_v2.apply(
+        params, images, train=True, compute_dtype=compute_dtype
+    )
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(loss)
+
+
+def make_train_step(
+    mesh: Mesh,
+    params,
+    learning_rate: float = 0.05,
+    compute_dtype=jnp.float32,
+) -> Tuple[Any, Any, Any]:
+    """Returns (jitted_step, sharded_params, sharded_opt_state).
+
+    jitted_step(params, opt_state, images, labels) -> (params, opt_state,
+    loss); images sharded P('dp'), loss replicated.
+    """
+    tx = optax.sgd(learning_rate, momentum=0.9)
+    p_shard = param_shardings(mesh, params)
+    params = jax.device_put(params, p_shard)
+    opt_state = jax.jit(
+        tx.init, out_shardings=_opt_shardings(tx, params, p_shard)
+    )(params)
+    batch_shard = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(p_shard, _opt_shardings(tx, params, p_shard), batch_shard, batch_shard),
+        out_shardings=(p_shard, _opt_shardings(tx, params, p_shard), repl),
+        donate_argnums=(0, 1),
+    )
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, images, labels, compute_dtype
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, params, opt_state
+
+
+def _opt_shardings(tx, params, p_shard):
+    """Optimizer-state shardings: mirror the param pytree inside each
+    optax state leaf (momentum buffers shard like their params)."""
+    state_shape = jax.eval_shape(tx.init, params)
+
+    # optax.sgd+momentum: state is (TraceState(trace=params-like), EmptyState)
+    import optax as _o
+
+    def map_state(s):
+        if isinstance(s, _o.TraceState):
+            return _o.TraceState(trace=p_shard)
+        return s
+
+    return jax.tree_util.tree_map(
+        map_state, state_shape, is_leaf=lambda x: isinstance(x, _o.TraceState)
+    )

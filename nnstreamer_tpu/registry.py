@@ -1,0 +1,183 @@
+"""Subplugin registry: name → implementation per subplugin kind.
+
+Reference: gst/nnstreamer/nnstreamer_subplugin.{c,h} — a per-type name→vtable
+registry (register_subplugin :80 / get_subplugin :61) with lazy dlopen of
+``libnnstreamer_{filter,decoder,converter}_NAME.so`` from configured search
+paths (nnstreamer_subplugin.c:138-166).
+
+TPU-native equivalents of the lazy-load paths, tried in order on a miss:
+1. built-in modules (imported on demand from ``nnstreamer_tpu.backends`` /
+   ``.decoders`` / ``.converters`` / ``.elements``),
+2. Python entry points (group ``nnstreamer_tpu.<kind>``),
+3. ``*.py`` files named ``nns_<kind>_<name>.py`` on the config search paths,
+   executed and expected to call :func:`register`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from nnstreamer_tpu.config import conf
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("registry")
+
+# Subplugin kinds (reference enum nnstreamer_subplugin.h:40-50)
+KIND_FILTER = "filter"
+KIND_DECODER = "decoder"
+KIND_CONVERTER = "converter"
+KIND_ELEMENT = "element"
+KINDS = (KIND_FILTER, KIND_DECODER, KIND_CONVERTER, KIND_ELEMENT)
+
+# Built-in lazy import table: kind → module that registers its members on
+# import. Split per kind so importing the filter registry does not pull in
+# decoder deps, mirroring one-.so-per-subplugin in the reference.
+_BUILTIN_MODULES: Dict[str, List[str]] = {
+    KIND_FILTER: ["nnstreamer_tpu.backends"],
+    KIND_DECODER: ["nnstreamer_tpu.decoders"],
+    KIND_CONVERTER: ["nnstreamer_tpu.converters"],
+    KIND_ELEMENT: ["nnstreamer_tpu.elements"],
+}
+
+_lock = threading.RLock()
+_registry: Dict[str, Dict[str, Any]] = {k: {} for k in KINDS}
+_builtins_loaded: Dict[str, bool] = {k: False for k in KINDS}
+
+
+def register(kind: str, name: str, impl: Any, *, replace: bool = False) -> Any:
+    """register_subplugin analogue. Returns impl so it works as a decorator
+    helper. Double registration is an error unless replace=True."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown subplugin kind {kind!r}")
+    name = name.lower()
+    with _lock:
+        if name in _registry[kind] and not replace:
+            existing = _registry[kind][name]
+            if existing is impl:
+                return impl
+            raise ValueError(f"{kind} subplugin {name!r} already registered")
+        _registry[kind][name] = impl
+    return impl
+
+
+def unregister(kind: str, name: str) -> bool:
+    with _lock:
+        return _registry[kind].pop(name.lower(), None) is not None
+
+
+def _load_builtins(kind: str) -> None:
+    if _builtins_loaded[kind]:
+        return
+    _builtins_loaded[kind] = True
+    for mod in _BUILTIN_MODULES.get(kind, []):
+        try:
+            importlib.import_module(mod)
+        except ImportError as exc:  # pragma: no cover - missing optional dep
+            _log.warning("builtin subplugin module %s failed to import: %s", mod, exc)
+
+
+def _load_entry_points(kind: str, name: str) -> bool:
+    try:
+        from importlib.metadata import entry_points
+
+        eps = entry_points(group=f"nnstreamer_tpu.{kind}")
+    except Exception:  # pragma: no cover
+        return False
+    for ep in eps:
+        if ep.name.lower() == name:
+            impl = ep.load()
+            register(kind, name, impl, replace=True)
+            return True
+    return False
+
+
+def _load_from_search_paths(kind: str, name: str) -> bool:
+    """Reference nnsconf_get_fullpath + dlopen, for python plugin files."""
+    fname = f"nns_{kind}_{name}.py"
+    for path in conf().plugin_paths(kind):
+        full = os.path.join(path, fname)
+        if os.path.isfile(full):
+            spec = importlib.util.spec_from_file_location(
+                f"nns_tpu_plugin_{kind}_{name}", full
+            )
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)  # plugin calls register() on import
+            return name in _registry[kind]
+    return False
+
+
+def get(kind: str, name: str) -> Any:
+    """get_subplugin analogue with lazy loading; raises KeyError on miss."""
+    name = name.lower()
+    with _lock:
+        if name not in _registry[kind]:
+            _load_builtins(kind)
+        if name not in _registry[kind]:
+            if not _load_entry_points(kind, name):
+                _load_from_search_paths(kind, name)
+        if name not in _registry[kind]:
+            raise KeyError(
+                f"no {kind} subplugin named {name!r}; known: {sorted(_registry[kind])}"
+            )
+        return _registry[kind][name]
+
+
+def available(kind: str) -> List[str]:
+    with _lock:
+        _load_builtins(kind)
+        return sorted(_registry[kind])
+
+
+def detect_filter_framework(model_path: str) -> Optional[str]:
+    """framework=auto detection from model extension + priority config
+    (reference tensor_filter_common.c:1155-1218)."""
+    ext = os.path.splitext(model_path)[1].lstrip(".").lower()
+    if not ext:
+        return None
+    for candidate in conf().framework_priority(ext):
+        try:
+            get(KIND_FILTER, candidate)
+            return candidate
+        except KeyError:
+            continue
+    return None
+
+
+def filter_backend(name: str):
+    """Decorator: @filter_backend("jax") on a Backend class."""
+
+    def deco(cls):
+        return register(KIND_FILTER, name, cls)
+
+    return deco
+
+
+def decoder_plugin(name: str):
+    def deco(obj):
+        return register(KIND_DECODER, name, obj)
+
+    return deco
+
+
+def converter_plugin(name: str):
+    def deco(obj):
+        return register(KIND_CONVERTER, name, obj)
+
+    return deco
+
+
+def element(name: str):
+    """Decorator registering a pipeline element class under its factory name
+    (the analogue of GST_PLUGIN_DEFINE + element_register,
+    registerer/nnstreamer.c:88-121)."""
+
+    def deco(cls):
+        register(KIND_ELEMENT, name, cls)
+        cls.FACTORY_NAME = name
+        return cls
+
+    return deco

@@ -1,0 +1,141 @@
+"""Single-shot invoke API: open a model, invoke it, no pipeline.
+
+Reference: gst/nnstreamer/tensor_filter/tensor_filter_single.c — the
+GStreamer-free GObject underlying the ML C-API's ml_single_invoke
+(SURVEY.md §3.5). Lifecycle parity:
+
+    g_object_new + set_property   → SingleShot(framework=, model=, ...)
+    klass->start (open_fw)        → SingleShot.open() / context-manager enter
+    klass->invoke (:321)          → SingleShot.invoke(...)
+    set-input-info                → SingleShot.set_input_info(...)
+    klass->stop                   → SingleShot.close()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_log = get_logger("single")
+
+
+class SingleShot:
+    """Open → invoke → close, with framework auto-detection.
+
+    >>> with SingleShot(framework="scaler", custom="factor:3",
+    ...                 input_spec=TensorsSpec.from_strings("4", "float32")) as s:
+    ...     (out,) = s.invoke(np.ones(4, np.float32))
+    """
+
+    def __init__(
+        self,
+        framework: str = "auto",
+        model: Union[str, Sequence[str]] = (),
+        input_spec: Optional[TensorsSpec] = None,
+        output_spec: Optional[TensorsSpec] = None,
+        custom: str = "",
+        accelerator: str = "",
+        **options: str,
+    ) -> None:
+        models = (model,) if isinstance(model, str) else tuple(model)
+        models = tuple(m for m in models if m)
+        if framework == "auto":
+            # extension-based detection (tensor_filter_common.c:1155-1218)
+            detected = registry.detect_filter_framework(models[0]) if models else None
+            if detected is None:
+                raise BackendError(
+                    f"cannot auto-detect framework for model {models[:1]}"
+                )
+            framework = detected
+        self.props = FilterProps(
+            framework=framework,
+            model=models,
+            input_spec=input_spec,
+            output_spec=output_spec,
+            custom=custom,
+            accelerator=accelerator,
+            options=dict(options),
+        )
+        self._backend: Optional[Backend] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def backend(self) -> Backend:
+        if self._backend is None:
+            raise BackendError("SingleShot not opened")
+        return self._backend
+
+    @property
+    def is_open(self) -> bool:
+        return self._backend is not None
+
+    def open(self) -> "SingleShot":
+        if self._backend is not None:
+            return self
+        cls = registry.get(registry.KIND_FILTER, self.props.framework)
+        backend: Backend = cls()
+        backend.open(self.props)
+        if self.props.input_spec is not None:
+            try:
+                cur_in, _ = backend.get_model_info()
+                need_set = not cur_in.is_compatible(self.props.input_spec)
+            except BackendError:
+                need_set = True
+            if need_set:
+                backend.set_input_info(self.props.input_spec)
+        self._backend = backend
+        return self
+
+    def close(self) -> None:
+        if self._backend is not None:
+            self._backend.close()
+            self._backend = None
+
+    def __enter__(self) -> "SingleShot":
+        return self.open()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- negotiation -------------------------------------------------------
+    @property
+    def input_spec(self) -> TensorsSpec:
+        return self.backend.get_model_info()[0]
+
+    @property
+    def output_spec(self) -> TensorsSpec:
+        return self.backend.get_model_info()[1]
+
+    def set_input_info(self, spec: TensorsSpec) -> TensorsSpec:
+        return self.backend.set_input_info(spec)
+
+    # -- execution ---------------------------------------------------------
+    def invoke(self, *tensors: Any) -> Tuple[Any, ...]:
+        """Invoke on raw arrays (device or host); returns tuple of outputs.
+        A single Frame argument is unwrapped and rewrapped."""
+        if len(tensors) == 1 and isinstance(tensors[0], Frame):
+            frame = tensors[0]
+            out = self.backend.invoke_timed(frame.tensors)
+            return frame.with_tensors(out)
+        return tuple(self.backend.invoke_timed(tuple(tensors)))
+
+    def reload_model(self, model: Union[str, Sequence[str]]) -> None:
+        """Hot model swap (reference is-updatable / RELOAD_MODEL)."""
+        models = (model,) if isinstance(model, str) else tuple(model)
+        self.backend.reload(models)
+
+    # -- stats (reference latency/throughput read-only props) -------------
+    @property
+    def latency_us(self) -> float:
+        return self.backend.stats.latency_us
+
+    @property
+    def throughput_fps(self) -> float:
+        return self.backend.stats.throughput_fps

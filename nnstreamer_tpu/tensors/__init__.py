@@ -1,0 +1,17 @@
+"""Tensor type system: specs, dim strings, flexible meta headers, sparse codec.
+
+TPU-native analogue of the reference's L1 layer
+(gst/nnstreamer/include/tensor_typedef.h and
+nnstreamer_plugin_api_util_impl.c).
+"""
+
+from nnstreamer_tpu.tensors.spec import (  # noqa: F401
+    DType,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+    NNS_TENSOR_SIZE_LIMIT,
+    NNS_TENSOR_RANK_LIMIT,
+)
+from nnstreamer_tpu.tensors.frame import Frame  # noqa: F401
+from nnstreamer_tpu.tensors.meta import FlexTensorMeta  # noqa: F401

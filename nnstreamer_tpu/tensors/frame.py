@@ -1,0 +1,119 @@
+"""Frame: the unit of data flowing through a pipeline.
+
+TPU-native replacement for the reference's GstBuffer of 1..16 GstMemory
+chunks (tensor_typedef.h:50-56, 220-224). Where the reference's
+GstTensorMemory is a host pointer + size that every element maps/unmaps per
+frame (tensor_filter.c:608-714), a Frame holds *device-resident*
+``jax.Array``s directly — host copies happen only at converter/decoder
+edges, and consecutive tensor-pure elements pass arrays without any copy.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.tensors.spec import DType, TensorsSpec
+
+_frame_seq = itertools.count()
+
+# Timestamps are integer nanoseconds (GStreamer GstClockTime convention).
+NS = 1
+US = 1_000
+MS = 1_000_000
+SECOND = 1_000_000_000
+CLOCK_NONE: Optional[int] = None
+
+
+@dataclass
+class Frame:
+    """One multi-tensor frame with stream timing and per-frame metadata.
+
+    - ``tensors``: tuple of arrays (jax.Array on device in the hot path;
+      numpy at host boundaries). Max 16, mirroring NNS_TENSOR_SIZE_LIMIT.
+    - ``pts``/``duration``: presentation time in ns (None = unknown), used
+      by mux/merge sync policies, aggregator, and rate elements.
+    - ``meta``: free-form per-frame metadata. Key ``client_id`` mirrors the
+      reference's GstMetaQuery (tensor_meta.h:26-31) for query-server
+      demultiplexing; decoders/converters may add others.
+    """
+
+    tensors: Tuple[Any, ...]
+    pts: Optional[int] = None
+    duration: Optional[int] = None
+    meta: Dict[str, Any] = field(default_factory=dict)
+    seq: int = field(default_factory=lambda: next(_frame_seq))
+
+    def __post_init__(self):
+        self.tensors = tuple(self.tensors)
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    def spec(self, **kw) -> TensorsSpec:
+        return TensorsSpec.from_arrays(self.tensors, **kw)
+
+    def with_tensors(self, tensors: Sequence[Any]) -> "Frame":
+        """New frame with same timing/meta but different payload (the common
+        element output path — timing metadata rides along unchanged)."""
+        return replace(self, tensors=tuple(tensors))
+
+    def with_meta(self, **kw) -> "Frame":
+        m = dict(self.meta)
+        m.update(kw)
+        return replace(self, meta=m)
+
+    def with_pts(self, pts: Optional[int], duration: Optional[int] = None) -> "Frame":
+        return replace(self, pts=pts, duration=duration if duration is not None else self.duration)
+
+    def to_host(self) -> "Frame":
+        """Materialize all tensors as numpy (egress boundary only)."""
+        return self.with_tensors([np.asarray(t) for t in self.tensors])
+
+    def to_device(self, device=None, sharding=None) -> "Frame":
+        """Place all tensors on a device/sharding (ingress boundary)."""
+        import jax
+
+        target = sharding if sharding is not None else device
+        if target is None:
+            return self.with_tensors([jax.numpy.asarray(t) for t in self.tensors])
+        return self.with_tensors([jax.device_put(t, target) for t in self.tensors])
+
+    def block_until_ready(self) -> "Frame":
+        for t in self.tensors:
+            if hasattr(t, "block_until_ready"):
+                t.block_until_ready()
+        return self
+
+    def __getitem__(self, i):
+        return self.tensors[i]
+
+    def __len__(self):
+        return len(self.tensors)
+
+    def __repr__(self):
+        shapes = ",".join(
+            f"{tuple(t.shape)}:{np.dtype(t.dtype).name}" for t in self.tensors
+        )
+        return f"Frame(seq={self.seq}, pts={self.pts}, [{shapes}])"
+
+
+class EOS:
+    """End-of-stream sentinel pushed through queues (GStreamer EOS event)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "EOS"
+
+
+EOS_FRAME = EOS()

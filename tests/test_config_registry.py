@@ -1,0 +1,86 @@
+"""Config layering and subplugin registry tests.
+
+Mirrors reference coverage of nnstreamer_conf (env > ini > default) and
+nnstreamer_subplugin register/get.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.config import Config, conf, reload_conf
+
+
+class TestConfig:
+    def test_defaults(self):
+        c = Config(ini_path="/nonexistent")
+        assert c.get("edge", "default_port") == "3000"
+        assert c.get_int("edge", "timeout_sec") == 10
+
+    def test_ini_overrides_default(self, tmp_path):
+        ini = tmp_path / "conf.ini"
+        ini.write_text("[edge]\ndefault_port = 4000\n")
+        c = Config(ini_path=str(ini))
+        assert c.get_int("edge", "default_port") == 4000
+
+    def test_env_overrides_ini(self, tmp_path, monkeypatch):
+        ini = tmp_path / "conf.ini"
+        ini.write_text("[edge]\ndefault_port = 4000\n")
+        monkeypatch.setenv("NNS_TPU_EDGE_DEFAULT_PORT", "5000")
+        c = Config(ini_path=str(ini))
+        assert c.get_int("edge", "default_port") == 5000
+
+    def test_env_disabled(self, tmp_path, monkeypatch):
+        ini = tmp_path / "conf.ini"
+        ini.write_text("[common]\nenable_envvar = false\n[edge]\ndefault_port = 4000\n")
+        monkeypatch.setenv("NNS_TPU_EDGE_DEFAULT_PORT", "5000")
+        c = Config(ini_path=str(ini))
+        assert c.get_int("edge", "default_port") == 4000
+
+    def test_bool_and_list(self, tmp_path):
+        ini = tmp_path / "conf.ini"
+        ini.write_text("[jax]\nflagx = yes\nitems = a, b ,c\n")
+        c = Config(ini_path=str(ini))
+        assert c.get_bool("jax", "flagx") is True
+        assert c.get_list("jax", "items") == ["a", "b", "c"]
+
+
+class TestRegistry:
+    def test_register_get_unregister(self):
+        sentinel = object()
+        registry.register("filter", "TmpTest", sentinel)
+        assert registry.get("filter", "tmptest") is sentinel
+        with pytest.raises(ValueError):
+            registry.register("filter", "tmptest", object())
+        assert registry.unregister("filter", "tmptest")
+        with pytest.raises(KeyError):
+            registry.get("filter", "tmptest_gone_xyz")
+
+    def test_bad_kind(self):
+        with pytest.raises(ValueError):
+            registry.register("nope", "x", object())
+
+    def test_search_path_loading(self, tmp_path, monkeypatch):
+        plugin = tmp_path / "nns_filter_fromdisk.py"
+        plugin.write_text(
+            textwrap.dedent(
+                """
+                from nnstreamer_tpu import registry
+                registry.register("filter", "fromdisk", "DISK_IMPL", replace=True)
+                """
+            )
+        )
+        monkeypatch.setenv("NNS_TPU_FILTER_PLUGIN_PATHS", str(tmp_path))
+        reload_conf("/nonexistent")
+        try:
+            assert registry.get("filter", "fromdisk") == "DISK_IMPL"
+        finally:
+            registry.unregister("filter", "fromdisk")
+            reload_conf()
+
+    def test_builtin_backends_available(self):
+        names = registry.available("filter")
+        assert "passthrough" in names
+        assert "jax" in names

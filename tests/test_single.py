@@ -1,0 +1,177 @@
+"""Single-shot API tests (reference: tests/nnstreamer_filter_single/
+unittest_filter_single.cc and custom filter tests)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends import register_custom_easy, unregister_custom_easy
+from nnstreamer_tpu.backends.base import BackendError
+from nnstreamer_tpu.single import SingleShot
+from nnstreamer_tpu.tensors.spec import DType, TensorsSpec
+
+
+def spec(dims, types):
+    return TensorsSpec.from_strings(dims, types)
+
+
+class TestFakeBackends:
+    def test_passthrough(self):
+        with SingleShot(framework="passthrough", input_spec=spec("4:3", "float32")) as s:
+            x = np.arange(12, dtype=np.float32).reshape(3, 4)
+            (out,) = s.invoke(x)
+            np.testing.assert_array_equal(np.asarray(out), x)
+            assert s.input_spec == s.output_spec
+
+    def test_scaler(self):
+        with SingleShot(
+            framework="scaler", custom="factor:3", input_spec=spec("4", "float32")
+        ) as s:
+            (out,) = s.invoke(np.ones(4, np.float32))
+            np.testing.assert_allclose(np.asarray(out), 3 * np.ones(4))
+
+    def test_average(self):
+        with SingleShot(
+            framework="average", input_spec=spec("3:8:8:1", "float32")
+        ) as s:
+            x = np.random.default_rng(0).random((1, 8, 8, 3)).astype(np.float32)
+            (out,) = s.invoke(x)
+            assert out.shape == (1, 1, 1, 3)
+            np.testing.assert_allclose(
+                np.asarray(out)[0, 0, 0], x.mean(axis=(0, 1, 2)), rtol=1e-5
+            )
+
+    def test_framecounter_stateful(self):
+        with SingleShot(
+            framework="framecounter", input_spec=spec("2", "float32")
+        ) as s:
+            for i in range(3):
+                (out,) = s.invoke(np.zeros(2, np.float32))
+                assert out[0] == i
+
+    def test_stats_recorded(self):
+        with SingleShot(framework="passthrough", input_spec=spec("2", "float32")) as s:
+            for _ in range(5):
+                s.invoke(np.zeros(2, np.float32))
+            assert s.backend.stats.total_invoke_num == 5
+            assert s.latency_us >= 0.0
+
+
+class TestCustomEasy:
+    def test_roundtrip(self):
+        register_custom_easy(
+            "negate", lambda ts: tuple(-t for t in ts), traceable=True
+        )
+        try:
+            with SingleShot(
+                framework="custom-easy",
+                model="negate",
+                input_spec=spec("3", "float32"),
+            ) as s:
+                (out,) = s.invoke(np.array([1.0, -2.0, 3.0], np.float32))
+                np.testing.assert_allclose(np.asarray(out), [-1.0, 2.0, -3.0])
+                assert s.backend.traceable_fn() is not None
+        finally:
+            assert unregister_custom_easy("negate")
+
+    def test_unregistered_raises(self):
+        with pytest.raises(BackendError):
+            SingleShot(framework="custom-easy", model="nope_xyz").open()
+
+
+class TestCustomScript:
+    def test_script_filter(self, tmp_path):
+        script = tmp_path / "doubler.py"
+        script.write_text(
+            "from nnstreamer_tpu.tensors.spec import TensorsSpec\n"
+            "class CustomFilter:\n"
+            "    TRACEABLE = False\n"
+            "    def setInputDim(self, in_spec):\n"
+            "        return in_spec\n"
+            "    def invoke(self, tensors):\n"
+            "        return tuple(t * 2 for t in tensors)\n"
+        )
+        with SingleShot(
+            framework="custom", model=str(script), input_spec=spec("4", "float32")
+        ) as s:
+            (out,) = s.invoke(np.ones(4, np.float32))
+            np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_auto_detect_py_is_custom(self, tmp_path):
+        script = tmp_path / "ident.py"
+        script.write_text(
+            "class CustomFilter:\n"
+            "    def setInputDim(self, s):\n"
+            "        return s\n"
+            "    def invoke(self, ts):\n"
+            "        return ts\n"
+        )
+        s = SingleShot(model=str(script), input_spec=spec("2", "float32"))
+        assert s.props.framework == "custom"
+        with s:
+            s.invoke(np.zeros(2, np.float32))
+
+    def test_bad_protocol(self, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("class CustomFilter:\n    pass\n")
+        with pytest.raises(BackendError):
+            SingleShot(framework="custom", model=str(script)).open()
+
+
+class TestJaxBackend:
+    def test_zoo_add(self):
+        with SingleShot(framework="jax", model="zoo:add", custom="const:5,dims:3") as s:
+            (out,) = s.invoke(np.zeros(3, np.float32))
+            np.testing.assert_allclose(np.asarray(out), 5.0)
+
+    def test_script_model(self, tmp_path):
+        script = tmp_path / "model.py"
+        script.write_text(
+            "import jax.numpy as jnp\n"
+            "from nnstreamer_tpu.tensors.spec import TensorsSpec\n"
+            "def get_model(options):\n"
+            "    def fn(x):\n"
+            "        return jnp.stack([x.sum(), x.max()])\n"
+            "    return fn, TensorsSpec.from_strings('4', 'float32')\n"
+        )
+        with SingleShot(framework="jax", model=str(script)) as s:
+            assert s.output_spec[0].shape == (2,)
+            (out,) = s.invoke(np.array([1, 2, 3, 4], np.float32))
+            np.testing.assert_allclose(np.asarray(out), [10.0, 4.0])
+
+    def test_shape_inference_no_execution(self):
+        s = SingleShot(framework="jax", model="zoo:add", custom="dims:7:2").open()
+        assert s.input_spec[0].shape == (2, 7)
+        assert s.output_spec[0].shape == (2, 7)
+        s.close()
+
+    def test_reload(self):
+        with SingleShot(framework="jax", model="zoo:add", custom="const:1,dims:2") as s:
+            s.reload_model("zoo:add")
+            (out,) = s.invoke(np.zeros(2, np.float32))
+            np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+class TestMobileNetV2:
+    def test_forward_shapes(self):
+        with SingleShot(
+            framework="jax", model="zoo:mobilenet_v2", custom="size:64"
+        ) as s:
+            assert s.input_spec[0].shape == (1, 64, 64, 3)
+            img = np.random.default_rng(0).integers(
+                0, 255, (1, 64, 64, 3), dtype=np.uint8
+            )
+            (logits,) = s.invoke(img)
+            assert logits.shape == (1, 1001)
+            assert np.isfinite(np.asarray(logits)).all()
+
+    def test_deterministic_params(self):
+        from nnstreamer_tpu.models import zoo
+
+        a = zoo.get("mobilenet_v2", size="32")
+        b = zoo.get("mobilenet_v2", size="32")
+        import jax
+
+        la = jax.tree_util.tree_leaves(a.params)
+        lb = jax.tree_util.tree_leaves(b.params)
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -1,0 +1,111 @@
+"""Flexible-header codec, sparse codec, scalar data op tests.
+
+Mirrors reference coverage of GstTensorMetaInfo
+(tensor_typedef.h:279-294) and gsttensor_sparseutil.c.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tensors.meta import (
+    FlexTensorMeta,
+    HEADER_SIZE,
+    decode_frame_tensors,
+    encode_frame_tensors,
+)
+from nnstreamer_tpu.tensors.sparse import sparse_decode, sparse_density, sparse_encode
+from nnstreamer_tpu.tensors import data
+from nnstreamer_tpu.tensors.spec import DType, TensorFormat
+
+
+class TestFlexMeta:
+    def test_roundtrip_header(self):
+        m = FlexTensorMeta(DType.FLOAT32, (1, 224, 224, 3), payload_size=100)
+        buf = m.pack()
+        assert len(buf) == HEADER_SIZE
+        m2 = FlexTensorMeta.unpack(buf)
+        assert m2 == m
+
+    def test_roundtrip_array(self):
+        a = np.arange(24, dtype=np.int16).reshape(2, 3, 4)
+        buf = FlexTensorMeta.encode_array(a)
+        b, used = FlexTensorMeta.decode_array(buf)
+        assert used == len(buf)
+        np.testing.assert_array_equal(a, b)
+
+    def test_bfloat16_roundtrip(self):
+        a = np.arange(8).astype(DType.BFLOAT16.np_dtype)
+        b, _ = FlexTensorMeta.decode_array(FlexTensorMeta.encode_array(a))
+        np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+    def test_bad_magic(self):
+        buf = bytearray(FlexTensorMeta(DType.UINT8, (2,)).pack())
+        buf[0] = 0xFF
+        with pytest.raises(ValueError, match="magic"):
+            FlexTensorMeta.unpack(bytes(buf))
+
+    def test_truncated(self):
+        a = np.zeros(10, np.float32)
+        buf = FlexTensorMeta.encode_array(a)[:-4]
+        with pytest.raises(ValueError, match="truncated"):
+            FlexTensorMeta.decode_array(buf)
+
+    def test_multi_tensor_frame(self):
+        arrays = [np.ones((2, 2), np.uint8), np.zeros((5,), np.float64)]
+        out = decode_frame_tensors(encode_frame_tensors(arrays))
+        assert len(out) == 2
+        for a, b in zip(arrays, out):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestSparse:
+    def test_roundtrip(self):
+        a = np.zeros((4, 8), np.float32)
+        a[1, 2] = 3.5
+        a[3, 7] = -1.0
+        buf = sparse_encode(a)
+        dense, used = sparse_decode(buf)
+        assert used == len(buf)
+        np.testing.assert_array_equal(a, dense)
+
+    def test_compression_wins_when_sparse(self):
+        a = np.zeros((100, 100), np.float32)
+        a[0, 0] = 1
+        assert len(sparse_encode(a)) < a.nbytes
+
+    def test_density(self):
+        a = np.zeros(10)
+        a[:3] = 1
+        assert sparse_density(a) == pytest.approx(0.3)
+
+    def test_format_tag(self):
+        buf = sparse_encode(np.ones(4, np.int32))
+        meta = FlexTensorMeta.unpack(buf)
+        assert meta.format is TensorFormat.SPARSE
+
+    def test_decode_rejects_non_sparse(self):
+        buf = FlexTensorMeta.encode_array(np.ones(4, np.int32))
+        with pytest.raises(ValueError, match="not a sparse"):
+            sparse_decode(buf)
+
+
+class TestScalarData:
+    def test_typecast(self):
+        v = data.typecast(3.9, "int32")
+        assert v == 3 and v.dtype == np.int32
+
+    def test_average(self):
+        assert data.tensor_average(np.array([1, 2, 3, 4])) == 2.5
+
+    def test_per_channel_average(self):
+        a = np.arange(12).reshape(2, 2, 3)
+        pc = data.tensor_average_per_channel(a, axis=-1)
+        assert pc.shape == (3,)
+        np.testing.assert_allclose(pc, np.mean(a.reshape(-1, 3), axis=0))
+
+    def test_compare_ops(self):
+        assert data.compare(1, "LT", 2)
+        assert data.compare(2, "GE", 2)
+        assert not data.compare(1, "EQ", 2)
+        with pytest.raises(ValueError):
+            data.compare(1, "XX", 2)

@@ -1,0 +1,141 @@
+"""Tensor spec / dim-string unit tests.
+
+Mirrors the reference's tests/common/unittest_common.cc coverage of
+gst_tensor_parse_dimension / gst_tensors_info_* utilities.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tensors.spec import (
+    DType,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+    format_dimension,
+    parse_dimension,
+    NNS_TENSOR_SIZE_LIMIT,
+)
+
+
+class TestParseDimension:
+    def test_innermost_first_reversal(self):
+        # reference syntax "3:224:224:1" = ch-3 224x224 batch-1 → NHWC
+        assert parse_dimension("3:224:224:1") == (1, 224, 224, 3)
+
+    def test_single(self):
+        assert parse_dimension("5") == (5,)
+
+    def test_wildcard(self):
+        assert parse_dimension("3:0:0:1") == (1, None, None, 3)
+        assert parse_dimension("3:?:?:1") == (1, None, None, 3)
+
+    def test_roundtrip(self):
+        for s in ["3:224:224:1", "1001:1", "7", "2:3:4:5:6"]:
+            assert format_dimension(parse_dimension(s)) == s
+
+    def test_rank_limit(self):
+        with pytest.raises(ValueError):
+            parse_dimension(":".join(["2"] * 9))
+
+    def test_bad_strings(self):
+        with pytest.raises(ValueError):
+            parse_dimension("")
+        with pytest.raises(ValueError):
+            parse_dimension("-3:2")
+        with pytest.raises(ValueError):
+            parse_dimension("a:b")
+
+
+class TestDType:
+    def test_from_any(self):
+        assert DType.from_any("uint8") is DType.UINT8
+        assert DType.from_any(np.float32) is DType.FLOAT32
+        assert DType.from_any(np.dtype("int64")) is DType.INT64
+        assert DType.from_any(DType.BFLOAT16) is DType.BFLOAT16
+
+    def test_bfloat16_numpy(self):
+        assert DType.BFLOAT16.itemsize == 2
+        a = np.zeros(3, DType.BFLOAT16.np_dtype)
+        assert a.dtype.name == "bfloat16"
+
+    def test_itemsize(self):
+        assert DType.UINT8.itemsize == 1
+        assert DType.FLOAT64.itemsize == 8
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            DType.from_any("float128xyz")
+
+
+class TestTensorSpec:
+    def test_sizes(self):
+        t = TensorSpec.from_dim_string("3:224:224:1", "uint8")
+        assert t.element_count == 3 * 224 * 224
+        assert t.byte_size == 3 * 224 * 224
+        assert t.dim_string == "3:224:224:1"
+
+    def test_not_static(self):
+        t = TensorSpec((None, 3), DType.FLOAT32)
+        assert not t.is_static
+        with pytest.raises(ValueError):
+            _ = t.element_count
+
+    def test_compat_wildcard(self):
+        a = TensorSpec((None, 224, 224, 3), DType.UINT8)
+        b = TensorSpec((1, 224, 224, 3), DType.UINT8)
+        assert a.is_compatible(b)
+        assert a.merge(b).shape == (1, 224, 224, 3)
+
+    def test_compat_rank_padding(self):
+        # rank mismatch handled by leading-1 padding like uint32[4] dims
+        a = TensorSpec((224, 224, 3), DType.UINT8)
+        b = TensorSpec((1, 224, 224, 3), DType.UINT8)
+        assert a.is_compatible(b)
+
+    def test_incompatible_dtype(self):
+        a = TensorSpec((3,), DType.UINT8)
+        b = TensorSpec((3,), DType.FLOAT32)
+        assert not a.is_compatible(b)
+
+
+class TestTensorsSpec:
+    def test_from_strings(self):
+        s = TensorsSpec.from_strings(
+            "3:224:224:1,1001:1", "uint8,float32", names="image,logits"
+        )
+        assert s.num_tensors == 2
+        assert s[0].dtype is DType.UINT8
+        assert s[1].shape == (1, 1001)
+        assert s[0].name == "image"
+        assert s.dimensions_string == "3:224:224:1,1001:1"
+        assert s.types_string == "uint8,float32"
+
+    def test_type_broadcast(self):
+        s = TensorsSpec.from_strings("3:4,5:6", "float32")
+        assert all(t.dtype is DType.FLOAT32 for t in s)
+
+    def test_size_limit(self):
+        with pytest.raises(ValueError):
+            TensorsSpec(
+                tuple(TensorSpec((1,)) for _ in range(NNS_TENSOR_SIZE_LIMIT + 1))
+            )
+
+    def test_caps_string(self):
+        s = TensorsSpec.from_strings("3:4:5:1", "uint8", rate=30)
+        caps = s.to_caps_string()
+        assert "other/tensors" in caps
+        assert "format=static" in caps
+        assert "framerate=30/1" in caps
+
+    def test_from_arrays(self):
+        s = TensorsSpec.from_arrays([np.zeros((2, 3), np.int16)])
+        assert s[0].shape == (2, 3) and s[0].dtype is DType.INT16
+
+    def test_flexible_compat(self):
+        a = TensorsSpec(format=TensorFormat.FLEXIBLE)
+        b = TensorsSpec(
+            (TensorSpec((5,)),), format=TensorFormat.FLEXIBLE
+        )
+        assert a.is_compatible(b)
+        assert not a.is_compatible(TensorsSpec(format=TensorFormat.STATIC))
