@@ -1,0 +1,92 @@
+"""nns-launch: run pipeline descriptions from the command line.
+
+The reference's CLI is GStreamer's gst-launch-1.0 / gst-inspect-1.0
+(SURVEY.md §1 L6). Usage:
+
+    python -m nnstreamer_tpu.cli "videotestsrc num-frames=10 ! \\
+        tensor_converter ! tensor_transform mode=typecast option=float32 ! \\
+        tensor_sink name=out"
+
+    python -m nnstreamer_tpu.cli --inspect                # list elements
+    python -m nnstreamer_tpu.cli --inspect tensor_filter  # element detail
+    python -m nnstreamer_tpu.cli --dot "..." > graph.dot  # graph dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _inspect(name: str | None) -> int:
+    from nnstreamer_tpu import registry
+
+    if not name:
+        print("Available elements:")
+        for n in registry.available(registry.KIND_ELEMENT):
+            cls = registry.get(registry.KIND_ELEMENT, n)
+            doc = (cls.__doc__ or "").strip().splitlines()
+            print(f"  {n:24s} {doc[0] if doc else ''}")
+        for kind, label in (
+            (registry.KIND_FILTER, "filter backends"),
+            (registry.KIND_DECODER, "decoder subplugins"),
+            (registry.KIND_CONVERTER, "converter subplugins"),
+        ):
+            names = registry.available(kind)
+            if names:
+                print(f"\nAvailable {label}: {', '.join(names)}")
+        return 0
+    cls = registry.get(registry.KIND_ELEMENT, name)
+    print(f"Element: {name}\n")
+    print(cls.__doc__ or "(no documentation)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nns-launch", description=__doc__)
+    ap.add_argument("description", nargs="?", help="pipeline description")
+    ap.add_argument("--inspect", nargs="?", const="", default=None, metavar="ELEMENT")
+    ap.add_argument("--dot", action="store_true", help="print graphviz, don't run")
+    ap.add_argument("--timeout", type=float, default=None, help="run timeout (s)")
+    ap.add_argument("--stats", action="store_true", help="print per-node stats JSON")
+    ap.add_argument("--quiet", "-q", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.inspect is not None:
+        return _inspect(args.inspect or None)
+    if not args.description:
+        ap.error("pipeline description required")
+
+    from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+    pipeline = parse_pipeline(args.description)
+    pipeline.negotiate()
+    if args.dot:
+        print(pipeline.dump_dot())
+        return 0
+    if not args.quiet:
+        print(f"Setting pipeline PLAYING ({len(pipeline.elements)} elements)", file=sys.stderr)
+    t0 = time.perf_counter()
+    timed_out = False
+    try:
+        ex = pipeline.run(timeout=args.timeout)
+    except TimeoutError:
+        # operator-requested bound on an endless pipeline: a stop, not a bug
+        ex = pipeline._executor
+        timed_out = True
+    dt = time.perf_counter() - t0
+    if not args.quiet:
+        msg = "Timeout reached" if timed_out else "EOS"
+        print(f"{msg} after {dt:.3f}s", file=sys.stderr)
+        for e in pipeline.elements:
+            if hasattr(e, "rendered"):
+                print(f"  {e.name}: rendered {e.rendered} frames", file=sys.stderr)
+    if args.stats:
+        print(json.dumps(ex.stats(), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""Converter subplugins (reference ext/nnstreamer/tensor_converter/).
+
+Protocol: negotiate(in_spec, props) -> TensorsSpec; convert(frame, props)
+-> Frame. Registered under registry kind "converter"; used by
+tensor_converter mode=NAME. Built-ins: flexbuf (see wire codec in
+tensors/meta.py used directly by the edge layer).
+"""
+
+from nnstreamer_tpu.converters import flexbuf  # noqa: F401,E402

@@ -1,0 +1,37 @@
+"""flexbuf converter subplugin: serialized flex-tensor bytes → tensors.
+
+Reference: ext/nnstreamer/tensor_converter/tensor_converter_flexbuf.cc —
+turns a self-describing binary buffer into other/tensors. The wire format
+here is the framework's own flex-tensor header codec (tensors/meta.py),
+which is also the edge layer's network format, so
+``filesrc ! tensor_converter mode=flexbuf`` round-trips anything
+``tensor_decoder mode=flexbuf`` (or the edge sender) produced.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import NegotiationError
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.meta import decode_frame_tensors
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+
+@registry.converter_plugin("flexbuf")
+class FlexbufConverter:
+    def negotiate(self, in_spec, props: dict) -> TensorsSpec:
+        # input is an opaque byte stream; per-frame headers carry shapes, so
+        # the output is format=flexible (self-describing frames)
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def convert(self, frame: Frame, props: dict) -> Frame:
+        data = np.asarray(frame.tensors[0], dtype=np.uint8).tobytes()
+        try:
+            tensors = decode_frame_tensors(data)
+        except ValueError as exc:
+            raise ValueError(f"flexbuf: undecodable frame: {exc}") from exc
+        if not tensors:
+            raise ValueError("flexbuf: empty frame")
+        return frame.with_tensors(tensors)

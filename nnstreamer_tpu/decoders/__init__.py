@@ -1,0 +1,10 @@
+"""Decoder subplugins (reference ext/nnstreamer/tensor_decoder/).
+
+Importing registers the built-ins. Protocol:
+    negotiate(in_spec: TensorsSpec, options: dict) -> Spec
+    decode(frame: Frame, options: dict) -> Frame
+"""
+
+from nnstreamer_tpu.decoders import direct_video  # noqa: F401
+from nnstreamer_tpu.decoders import image_labeling  # noqa: F401
+from nnstreamer_tpu.decoders import flexbuf  # noqa: F401

@@ -1,0 +1,43 @@
+"""direct_video decoder: tensor → raw video media.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-directvideo.c (377 LoC):
+uint8 tensor with canonical (N,H,W,C) layout back to video frames.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import MediaSpec, NegotiationError
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorsSpec
+
+
+@registry.decoder_plugin("direct_video")
+class DirectVideoDecoder:
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        if in_spec.num_tensors != 1:
+            raise NegotiationError("direct_video: exactly one tensor expected")
+        t = in_spec[0]
+        if t.dtype is not DType.UINT8 or t.rank != 4:
+            raise NegotiationError(f"direct_video: need uint8 NHWC, got {t}")
+        n, h, w, c = t.shape
+        fmt = {1: "GRAY8", 3: "RGB", 4: "RGBA"}.get(c)
+        if fmt is None:
+            raise NegotiationError(f"direct_video: {c} channels unsupported")
+        return MediaSpec("video", width=w, height=h, format=fmt, rate=in_spec.rate)
+
+    def decode(self, frame: Frame, options: dict):
+        batch = np.asarray(frame.tensors[0])
+        # one media frame per batch element (a batched tensor came from
+        # frames-per-tensor aggregation; un-batch on egress)
+        out = []
+        n = batch.shape[0]
+        for i in range(n):
+            f = frame.with_tensors((batch[i],)).with_meta(media_type="video")
+            if frame.pts is not None and frame.duration is not None and n > 1:
+                per = frame.duration // n
+                f = f.with_pts(frame.pts + i * per, per)
+            out.append(f)
+        return out if len(out) > 1 else out[0]

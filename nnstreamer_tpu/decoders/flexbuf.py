@@ -1,0 +1,29 @@
+"""flexbuf decoder subplugin: tensors → serialized flex-tensor bytes.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-flexbuf.cc. Inverse of
+the flexbuf converter; output is one uint8 tensor holding the serialized
+frame (feed to filesink / network sinks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import MediaSpec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.meta import encode_frame_tensors
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("flexbuf")
+class FlexbufDecoder:
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        return MediaSpec("octet")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        frame = frame.to_host()
+        blob = encode_frame_tensors(frame.tensors)
+        return frame.with_tensors(
+            (np.frombuffer(blob, dtype=np.uint8),)
+        ).with_meta(media_type="octet")

@@ -1,0 +1,68 @@
+"""image_labeling decoder: logits → argmax class index + label string.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-labeling.c (271 LoC):
+argmax over the score tensor, label text looked up from the option1 labels
+file (one label per line; shared loader tensordecutil.c).
+
+Output: one uint32 tensor [N] of class indices; label strings ride in
+frame.meta["labels"] (egress metadata, the analogue of the text overlay the
+reference renders with the font decoder).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import NegotiationError
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+def load_labels(path: str) -> List[str]:
+    """One label per line (reference tensordecutil.c loadImageLabels)."""
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+@registry.decoder_plugin("image_labeling")
+class ImageLabelingDecoder:
+    def __init__(self) -> None:
+        self._labels: Optional[List[str]] = None
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> TensorsSpec:
+        if in_spec.num_tensors != 1:
+            raise NegotiationError("image_labeling: exactly one score tensor")
+        t = in_spec[0]
+        if t.rank < 1:
+            raise NegotiationError(f"image_labeling: bad score tensor {t}")
+        labels_path = options.get("option1", "")
+        if labels_path:
+            if not os.path.isfile(labels_path):
+                raise NegotiationError(
+                    f"image_labeling: labels file not found: {labels_path}"
+                )
+            self._labels = load_labels(labels_path)
+        batch = t.shape[0] if t.rank > 1 else 1
+        return TensorsSpec.of(
+            TensorSpec((batch,), DType.UINT32, name="label_index"),
+            rate=in_spec.rate,
+        )
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        scores = np.asarray(frame.tensors[0])
+        if scores.ndim == 1:
+            scores = scores[None, :]
+        flat = scores.reshape(scores.shape[0], -1)
+        idx = np.argmax(flat, axis=-1).astype(np.uint32)
+        out = frame.with_tensors((idx,))
+        if self._labels:
+            out = out.with_meta(
+                labels=[
+                    self._labels[i] if i < len(self._labels) else str(i) for i in idx
+                ]
+            )
+        return out

@@ -1,0 +1,20 @@
+"""Pipeline elements. Importing registers every built-in element factory
+(the analogue of GST_PLUGIN_DEFINE in registerer/nnstreamer.c:88-121)."""
+
+from nnstreamer_tpu.elements.base import (  # noqa: F401
+    Element,
+    HostElement,
+    MediaSpec,
+    NegotiationError,
+    Routing,
+    Sink,
+    Source,
+    TensorOp,
+)
+from nnstreamer_tpu.elements import sources  # noqa: F401
+from nnstreamer_tpu.elements import converter  # noqa: F401
+from nnstreamer_tpu.elements import transform  # noqa: F401
+from nnstreamer_tpu.elements import filter as filter_elem  # noqa: F401
+from nnstreamer_tpu.elements import decoder  # noqa: F401
+from nnstreamer_tpu.elements import sink  # noqa: F401
+from nnstreamer_tpu.elements import flow  # noqa: F401

@@ -1,0 +1,234 @@
+"""Element model: the composable stages of a pipeline.
+
+TPU-native redesign of GStreamer elements (reference L3, SURVEY.md §1).
+Where GStreamer elements negotiate caps pad-to-pad at PAUSED and then run
+chain functions per buffer, here:
+
+- ``negotiate(in_specs) -> out_specs`` runs once at pipeline build time over
+  the whole graph (topological order), producing fully static specs;
+- execution is classified so the pipeline compiler can FUSE maximal chains
+  of pure-tensor elements into single jitted XLA programs:
+
+  * :class:`TensorOp` — 1→1, pure tensor function; contributes a traceable
+    jax fn (tensor_transform modes, jax-backed tensor_filter, tensor-math
+    decoders). Fusable.
+  * :class:`HostElement` — 1→1 but host-bound (stateful backends, python
+    callbacks, network). Fusion barrier.
+  * :class:`Source` / :class:`Sink` — stream endpoints.
+  * :class:`Routing` — N→M elements with their own buffering/sync logic
+    (mux, demux, tee, aggregator, rate, if, ...).
+
+Media (non-tensor) links carry :class:`MediaSpec`; converters translate
+between MediaSpec and TensorsSpec edges, mirroring the reference's
+video/x-raw ↔ other/tensors boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_log = get_logger("elements")
+
+
+@dataclass(frozen=True)
+class MediaSpec:
+    """Spec of a raw-media link (reference caps video/x-raw, audio/x-raw,
+    text/x-raw, application/octet-stream)."""
+
+    media_type: str  # "video" | "audio" | "text" | "octet"
+    # video
+    width: Optional[int] = None
+    height: Optional[int] = None
+    format: str = "RGB"  # RGB | BGR | RGBA | BGRx | GRAY8
+    # audio
+    channels: Optional[int] = None
+    sample_rate: Optional[int] = None
+    sample_format: str = "S16LE"
+    rate: Optional[Fraction] = None  # frames per second
+
+    @property
+    def channels_per_pixel(self) -> int:
+        return {"RGB": 3, "BGR": 3, "RGBA": 4, "BGRx": 4, "GRAY8": 1}[self.format]
+
+
+Spec = Union[TensorsSpec, MediaSpec]
+
+
+class NegotiationError(ValueError):
+    """Spec mismatch at pipeline build (reference: caps negotiation failure)."""
+
+
+class ElementError(RuntimeError):
+    pass
+
+
+class Element:
+    """Base element. Subclasses set N_SINKS/N_SRCS (None = request pads,
+    decided at link time) and implement negotiate()."""
+
+    FACTORY_NAME = "element"
+    N_SINKS: Optional[int] = 1
+    N_SRCS: Optional[int] = 1
+
+    _instance_counters: Dict[str, int] = {}
+
+    def __init__(self, name: Optional[str] = None, **props: Any) -> None:
+        if name is None:
+            # deterministic per-factory numbering (gst element0, element1, ...)
+            n = Element._instance_counters.get(self.FACTORY_NAME, 0)
+            Element._instance_counters[self.FACTORY_NAME] = n + 1
+            name = f"{self.FACTORY_NAME}{n}"
+        self.name = name
+        self.props: Dict[str, Any] = {}
+        self.in_specs: List[Spec] = []
+        self.out_specs: List[Spec] = []
+        # queue size for this element's input pads (the reference's
+        # queue-element analogue; see executor)
+        self.queue_size = int(props.pop("queue-size", props.pop("queue_size", 4)))
+        self.silent = _parse_bool(props.pop("silent", True))
+        for k, v in props.items():
+            self.set_property(k, v)
+
+    # -- properties (GObject property analogue) ---------------------------
+    def set_property(self, key: str, value: Any) -> None:
+        self.props[key.replace("_", "-")] = value
+
+    def get_property(self, key: str, default: Any = None) -> Any:
+        return self.props.get(key.replace("_", "-"), default)
+
+    # -- negotiation -------------------------------------------------------
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        """Given upstream specs (one per sink pad), return src-pad specs.
+        Raise NegotiationError on mismatch. Called once at build."""
+        raise NotImplementedError
+
+    def fix_negotiation(self, in_specs: List[Spec]) -> List[Spec]:
+        self.in_specs = list(in_specs)
+        self.out_specs = self.negotiate(list(in_specs))
+        return self.out_specs
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Transition to streaming (open devices/models). Idempotent."""
+
+    def stop(self) -> None:
+        """Release streaming resources. Idempotent."""
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+class TensorOp(Element):
+    """1→1 pure tensor element: contributes a traceable fn over the frame's
+    tensor tuple. These fuse with neighbors into one XLA program."""
+
+    N_SINKS = 1
+    N_SRCS = 1
+
+    def make_fn(self) -> Callable[[Tuple[Any, ...]], Tuple[Any, ...]]:
+        """Return the pure fn (tensors) -> tensors for the negotiated specs.
+        Called after negotiation; must be traceable by jax when
+        is_traceable() is True."""
+        raise NotImplementedError
+
+    def is_traceable(self) -> bool:
+        """False → run as a host node (fusion barrier) instead of fusing
+        (e.g. tensor_filter with a host-library backend)."""
+        return True
+
+    def host_process(self, frame: Frame) -> Frame:
+        """Host-path execution for non-traceable TensorOps."""
+        out = self.make_fn()(frame.tensors)
+        return self.transform_meta(frame.with_tensors(out))
+
+    def transform_meta(self, frame: Frame) -> Frame:
+        """Optional per-frame metadata/timestamp adjustment applied outside
+        the fused program (default: passthrough)."""
+        return frame
+
+
+class HostElement(Element):
+    """1→1 host-bound element (fusion barrier)."""
+
+    N_SINKS = 1
+    N_SRCS = 1
+
+    def process(self, frame: Frame) -> Union[Frame, List[Frame], None]:
+        """Process one frame; return 0..n output frames."""
+        raise NotImplementedError
+
+    def flush(self) -> List[Frame]:
+        """Called at EOS; emit any buffered frames."""
+        return []
+
+
+class Source(Element):
+    """Stream source: drives the pipeline from its own thread."""
+
+    N_SINKS = 0
+    N_SRCS = 1
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        return [self.output_spec()]
+
+    def output_spec(self) -> Spec:
+        raise NotImplementedError
+
+    def generate(self):
+        """Return the next Frame, EOS_FRAME when exhausted, or None for
+        "no data yet" (the executor re-polls, checking its stop event, so a
+        blocking source must use a bounded wait and return None)."""
+        raise NotImplementedError
+
+
+class Sink(Element):
+    """Stream sink."""
+
+    N_SINKS = 1
+    N_SRCS = 0
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        return []
+
+    def render(self, frame: Frame) -> None:
+        raise NotImplementedError
+
+    def on_eos(self) -> None:
+        """EOS notification (reference tensor_sink 'eos' signal)."""
+
+
+class Routing(Element):
+    """N→M element owning its buffering/sync semantics (mux, demux, tee,
+    aggregator, if, rate, ...). The executor feeds it per-pad and collects
+    (src_pad, frame) emissions."""
+
+    N_SINKS: Optional[int] = None
+    N_SRCS: Optional[int] = None
+
+    def set_pad_counts(self, n_sinks: int, n_srcs: int) -> None:
+        """Called at build time once actual link counts are known (request
+        pads)."""
+        self._n_sinks = n_sinks
+        self._n_srcs = n_srcs
+
+    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+        """Handle one input frame on `pad`; return list of (src_pad, frame)
+        to emit now."""
+        raise NotImplementedError
+
+    def eos(self, pad: int) -> List[Tuple[int, Frame]]:
+        """Handle EOS on `pad`; return final emissions. The executor
+        forwards EOS downstream once all sink pads saw EOS."""
+        return []

@@ -1,0 +1,76 @@
+"""tensor_decoder: tensor → media/result egress.
+
+Reference: gst/nnstreamer/elements/gsttensor_decoder.c — dispatches to
+decoder subplugins by ``mode=`` + generic ``option1..option9`` strings
+(:67-76), subplugin API include/nnstreamer_plugin_api_decoder.h:38-97.
+
+Decoder subplugins here are objects with:
+    negotiate(in_spec: TensorsSpec, options: dict) -> Spec
+    decode(frame: Frame, options: dict) -> Frame
+registered under registry kind "decoder" (see nnstreamer_tpu/decoders/).
+Custom in-process decoders (reference tensor_decoder_custom.h) register a
+callable via register_custom_decoder().
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import HostElement, NegotiationError, Spec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_custom_lock = threading.Lock()
+_custom_decoders: Dict[str, Callable] = {}
+
+
+def register_custom_decoder(name: str, fn: Callable[[Frame, dict], Frame]) -> None:
+    """nnstreamer_decoder_custom_register analogue."""
+    with _custom_lock:
+        _custom_decoders[name] = fn
+
+
+def unregister_custom_decoder(name: str) -> bool:
+    with _custom_lock:
+        return _custom_decoders.pop(name, None) is not None
+
+
+@registry.element("tensor_decoder")
+class TensorDecoder(HostElement):
+    FACTORY_NAME = "tensor_decoder"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.mode = str(self.get_property("mode", ""))
+        if not self.mode:
+            raise ValueError(f"{self.name}: tensor_decoder needs mode=")
+        self.options = {
+            f"option{i}": str(self.get_property(f"option{i}", "")) for i in range(1, 10)
+        }
+        self._sub = None
+        self._custom_fn = None
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec):
+            raise NegotiationError(f"{self.name}: needs tensor input, got {spec}")
+        if self.mode == "custom-code":
+            name = self.options["option1"]
+            with _custom_lock:
+                fn = _custom_decoders.get(name)
+            if fn is None:
+                raise NegotiationError(
+                    f"{self.name}: custom decoder {name!r} not registered"
+                )
+            self._custom_fn = fn
+            return [spec]  # custom decoders declare no static out spec
+        sub = registry.get(registry.KIND_DECODER, self.mode)
+        self._sub = sub() if isinstance(sub, type) else sub
+        return [self._sub.negotiate(spec, self.options)]
+
+    def process(self, frame: Frame):
+        if self._custom_fn is not None:
+            return self._custom_fn(frame, self.options)
+        return self._sub.decode(frame, self.options)

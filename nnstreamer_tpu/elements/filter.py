@@ -1,0 +1,212 @@
+"""tensor_filter: THE inference element.
+
+Reference: gst/nnstreamer/tensor_filter/tensor_filter.c (+ the shared
+property engine tensor_filter_common.c). Dispatches to a Backend subplugin
+(backends/). TPU-first differences from the reference's per-frame
+map→invoke→unmap (SURVEY.md §3.2):
+
+- a jax-traceable backend contributes its fn to the surrounding fused XLA
+  segment, so transform→filter→decode chains become ONE program and tensors
+  never leave HBM between elements;
+- host-library backends (torch/tflite) run as host nodes — explicit fusion
+  barriers, device transfer only at their edges.
+
+Properties (reference tensor_filter_common.c:103-128 parity): framework,
+model, input/inputtype (spec override), output/outputtype, custom,
+accelerator, input-combination (select a subset/reorder of input tensors
+for the model), output-combination (compose output frame from model outputs
+``o#`` and passthrough inputs ``i#``), invoke-dynamic, is-updatable (model
+reload via reload_model()). Read-only: latency, throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import Backend, FilterProps
+from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+def _parse_combination(s: str, prefix_ok=("i", "o")) -> Optional[List[Tuple[str, int]]]:
+    """'i0,o1,i2' → [('i',0),('o',1),('i',2)]; plain ints mean 'i' for
+    input-combination and 'o' for output-combination (resolved by caller)."""
+    s = (s or "").strip()
+    if not s:
+        return None
+    out = []
+    for part in s.split(","):
+        part = part.strip().lower()
+        if not part:
+            raise ValueError(f"empty token in combination string {s!r}")
+        if part[0] in prefix_ok and part[1:].isdigit():
+            out.append((part[0], int(part[1:])))
+        elif part.isdigit():
+            out.append(("", int(part)))
+        else:
+            raise ValueError(f"bad combination token {part!r}")
+    return out
+
+
+@registry.element("tensor_filter")
+class TensorFilter(TensorOp):
+    FACTORY_NAME = "tensor_filter"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        models = str(self.get_property("model", ""))
+        model_list = tuple(m for m in models.split(",") if m)
+        framework = str(self.get_property("framework", "auto"))
+        if framework == "auto":
+            detected = (
+                registry.detect_filter_framework(model_list[0]) if model_list else None
+            )
+            if detected is None:
+                raise ValueError(f"{self.name}: cannot auto-detect framework")
+            framework = detected
+        in_override = None
+        if self.get_property("input"):
+            in_override = TensorsSpec.from_strings(
+                str(self.get_property("input")),
+                str(self.get_property("inputtype", "float32")),
+                str(self.get_property("inputname", "")),
+            )
+        out_override = None
+        if self.get_property("output"):
+            out_override = TensorsSpec.from_strings(
+                str(self.get_property("output")),
+                str(self.get_property("outputtype", "float32")),
+                str(self.get_property("outputname", "")),
+            )
+        self.fprops = FilterProps(
+            framework=framework,
+            model=model_list,
+            input_spec=in_override,
+            output_spec=out_override,
+            custom=str(self.get_property("custom", "")),
+            accelerator=str(self.get_property("accelerator", "")),
+            invoke_dynamic=bool(self.get_property("invoke-dynamic", False)),
+        )
+        self.in_combination = _parse_combination(
+            str(self.get_property("input-combination", ""))
+        )
+        self.out_combination = _parse_combination(
+            str(self.get_property("output-combination", ""))
+        )
+        self.backend: Optional[Backend] = None
+        self._traceable: Optional[Callable] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_open(self) -> Backend:
+        if self.backend is None:
+            cls = registry.get(registry.KIND_FILTER, self.fprops.framework)
+            b: Backend = cls()
+            b.open(self.fprops)
+            self.backend = b
+        return self.backend
+
+    def stop(self) -> None:
+        if self.backend is not None:
+            self.backend.close()
+            self.backend = None
+            self._traceable = None
+
+    def reload_model(self, model: str) -> None:
+        """Hot swap (reference is-updatable + RELOAD_MODEL event)."""
+        self._ensure_open().reload(tuple(m for m in model.split(",") if m))
+        self._traceable = None
+
+    # -- negotiation -------------------------------------------------------
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec):
+            raise NegotiationError(
+                f"{self.name}: needs other/tensors input (add tensor_converter), got {spec}"
+            )
+        b = self._ensure_open()
+        model_in = self._select_model_inputs_spec(spec)
+        try:
+            cur_in, cur_out = b.get_model_info()
+            if not cur_in.is_compatible(model_in):
+                cur_out = b.set_input_info(model_in)
+        except Exception:
+            cur_out = b.set_input_info(model_in)
+        self._model_out_spec = cur_out
+        out = self._compose_output_spec(spec, cur_out)
+        return [out.with_rate(spec.rate)]
+
+    def _select_model_inputs_spec(self, spec: TensorsSpec) -> TensorsSpec:
+        if self.in_combination is None:
+            return spec
+        picks = []
+        for kind, idx in self.in_combination:
+            if kind == "o":
+                raise NegotiationError(f"{self.name}: 'o' not valid in input-combination")
+            if idx >= spec.num_tensors:
+                raise NegotiationError(
+                    f"{self.name}: input-combination index {idx} out of range"
+                )
+            picks.append(spec[idx])
+        return TensorsSpec(tuple(picks), spec.format, spec.rate)
+
+    def _compose_output_spec(
+        self, in_spec: TensorsSpec, model_out: TensorsSpec
+    ) -> TensorsSpec:
+        if self.out_combination is None:
+            return model_out
+        outs = []
+        for kind, idx in self.out_combination:
+            src = in_spec if kind == "i" else model_out
+            if idx >= src.num_tensors:
+                raise NegotiationError(
+                    f"{self.name}: output-combination index {idx} out of range"
+                )
+            outs.append(src[idx])
+        return TensorsSpec(tuple(outs), model_out.format, in_spec.rate)
+
+    # -- execution ---------------------------------------------------------
+    def is_traceable(self) -> bool:
+        b = self._ensure_open()
+        return b.traceable_fn() is not None
+
+    def _apply_combinations(self, invoke: Callable) -> Callable:
+        in_comb, out_comb = self.in_combination, self.out_combination
+
+        def fn(tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+            model_in = (
+                tensors
+                if in_comb is None
+                else tuple(tensors[i] for _, i in in_comb)
+            )
+            model_out = tuple(invoke(model_in))
+            if out_comb is None:
+                return model_out
+            return tuple(
+                tensors[i] if kind == "i" else model_out[i]
+                for kind, i in out_comb
+            )
+
+        return fn
+
+    def make_fn(self) -> Callable:
+        b = self._ensure_open()
+        traced = b.traceable_fn()
+        if traced is None:
+            raise RuntimeError(f"{self.name}: backend not traceable")
+        return self._apply_combinations(traced)
+
+    def host_process(self, frame: Frame) -> Frame:
+        b = self._ensure_open()
+        fn = self._apply_combinations(b.invoke_timed)
+        return frame.with_tensors(fn(frame.tensors))
+
+    # -- stats (reference read-only latency/throughput props) -------------
+    @property
+    def latency_us(self) -> float:
+        return self.backend.stats.latency_us if self.backend else 0.0
+
+    @property
+    def throughput_fps(self) -> float:
+        return self.backend.stats.throughput_fps if self.backend else 0.0

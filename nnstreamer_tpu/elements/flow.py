@@ -1,0 +1,120 @@
+"""Flow elements: tee, queue, capsfilter.
+
+(The remaining routing/sync elements — mux/demux/merge/split/aggregator/
+rate/if/crop/repo/sparse/join — live in routing.py / sync.py.)
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import (
+    NegotiationError,
+    Routing,
+    Spec,
+    TensorOp,
+)
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.element("tee")
+class Tee(Routing):
+    """1→N fan-out. Device arrays are immutable, so branching is free —
+    no buffer copy-on-write like GStreamer refcounting needs."""
+
+    FACTORY_NAME = "tee"
+    N_SINKS = 1
+    N_SRCS = None  # request pads
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        return [spec] * self._n_srcs
+
+    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+        return [(i, frame) for i in range(self._n_srcs)]
+
+
+@registry.element("queue")
+class Queue(TensorOp):
+    """Explicit buffering boundary. In this runtime every element already
+    has bounded input queues (executor), so queue only tunes the downstream
+    element's depth via max-size-buffers and forces a segment split (it is
+    intentionally NOT fused so its two sides pipeline on separate threads,
+    exactly the reference's use of queue for parallelism)."""
+
+    FACTORY_NAME = "queue"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.queue_size = int(self.get_property("max-size-buffers", 4))
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        return list(in_specs)
+
+    def is_traceable(self) -> bool:
+        return False  # fusion barrier by design
+
+    def host_process(self, frame: Frame) -> Frame:
+        return frame
+
+
+@registry.element("capsfilter")
+class CapsFilter(TensorOp):
+    """Constrain/refine the negotiated spec (gst capsfilter / the caps
+    string between ! in a description).
+
+    Tensor links: props dimensions/types/format/framerate are matched and
+    merged into the upstream spec. Media links: props media/width/height/
+    format are validated against the MediaSpec. Identity at runtime — fused
+    to zero cost on tensor links, host passthrough on media links."""
+
+    FACTORY_NAME = "capsfilter"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        dims = self.get_property("dimensions")
+        self._constraint = None
+        if dims:
+            self._constraint = TensorsSpec.from_strings(
+                str(dims),
+                str(self.get_property("types", "float32")),
+                format=str(self.get_property("format", "static")),
+                rate=self.get_property("framerate"),
+            )
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if isinstance(spec, TensorsSpec):
+            if self._constraint is None:
+                return [spec]
+            if not spec.is_compatible(self._constraint):
+                raise NegotiationError(
+                    f"{self.name}: caps mismatch {spec} vs {self._constraint}"
+                )
+            return [spec.merge(self._constraint)]
+        # media link: validate the declared fields
+        for key, attr in (("width", "width"), ("height", "height")):
+            want = self.get_property(key)
+            if want is not None and getattr(spec, attr) != int(want):
+                raise NegotiationError(
+                    f"{self.name}: media {key} {getattr(spec, attr)} != {want}"
+                )
+        want_fmt = self.get_property("format")
+        if want_fmt and spec.format != want_fmt:
+            raise NegotiationError(
+                f"{self.name}: media format {spec.format} != {want_fmt}"
+            )
+        return [spec]
+
+    def is_traceable(self) -> bool:
+        from nnstreamer_tpu.tensors.spec import TensorsSpec as _TS
+
+        return bool(self.in_specs) and isinstance(self.in_specs[0], _TS)
+
+    def make_fn(self):
+        return lambda tensors: tensors
+
+    def host_process(self, frame: Frame) -> Frame:
+        return frame

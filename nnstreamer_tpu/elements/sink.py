@@ -1,0 +1,144 @@
+"""Sink elements: tensor_sink (signal/callback), appsink, filesink, fakesink.
+
+Reference: gst/nnstreamer/elements/gsttensor_sink.c — appsink-like element
+emitting new-data/stream-start/eos signals with signal-rate limiting;
+filesink/multifilesink are what the SSAT golden tests dump through.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import Sink, Spec
+from nnstreamer_tpu.tensors.frame import Frame
+
+
+@registry.element("tensor_sink")
+class TensorSink(Sink):
+    """Collects frames and fires callbacks.
+
+    Props: max-stored (ring of retained frames, default unlimited),
+    signal-rate (max new-data callbacks/sec, 0 = every frame; reference
+    'signal-rate' property), sync (unused placeholder for clock sync).
+    Callback registration: ``sink.connect("new-data", fn)`` / "eos".
+    """
+
+    FACTORY_NAME = "tensor_sink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.max_stored = int(self.get_property("max-stored", 0))
+        self.signal_rate = float(self.get_property("signal-rate", 0))
+        self.frames: List[Frame] = []
+        self.eos_seen = False
+        self._callbacks = {"new-data": [], "eos": []}
+        self._last_signal_t = 0.0
+        self.rendered = 0
+
+    def connect(self, signal: str, fn: Callable) -> None:
+        self._callbacks[signal].append(fn)
+
+    def render(self, frame: Frame) -> None:
+        frame = frame.to_host()
+        self.rendered += 1
+        self.frames.append(frame)
+        if self.max_stored > 0 and len(self.frames) > self.max_stored:
+            self.frames.pop(0)
+        now = time.monotonic()
+        if self.signal_rate > 0 and (now - self._last_signal_t) < 1.0 / self.signal_rate:
+            return  # rate-limited: store but skip signal (reference behavior)
+        self._last_signal_t = now
+        for fn in self._callbacks["new-data"]:
+            fn(frame)
+
+    def on_eos(self) -> None:
+        self.eos_seen = True
+        for fn in self._callbacks["eos"]:
+            fn()
+
+
+@registry.element("appsink")
+class AppSink(Sink):
+    """Blocking pop() interface for application threads."""
+
+    FACTORY_NAME = "appsink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._queue: queue_mod.Queue = queue_mod.Queue(
+            maxsize=int(self.get_property("max-buffers", 0)) or 0
+        )
+        self.eos_seen = False
+
+    def render(self, frame: Frame) -> None:
+        self._queue.put(frame.to_host())
+
+    def on_eos(self) -> None:
+        self.eos_seen = True
+        self._queue.put(None)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Frame]:
+        """Next frame, or None at EOS."""
+        return self._queue.get(timeout=timeout)
+
+
+@registry.element("filesink")
+class FileSink(Sink):
+    """Dump raw tensor bytes. location with ``%d`` → one file per frame
+    (multifilesink parity, what SSAT golden tests compare)."""
+
+    FACTORY_NAME = "filesink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.location = str(self.get_property("location", ""))
+        if not self.location:
+            raise ValueError(f"{self.name}: filesink needs location=")
+        self._multi = "%" in self.location
+        self._file = None
+        self._index = 0
+
+    def start(self) -> None:
+        if not self._multi:
+            self._file = open(self.location, "wb")
+        self._index = 0
+
+    def render(self, frame: Frame) -> None:
+        frame = frame.to_host()
+        payload = b"".join(
+            np.ascontiguousarray(t).tobytes() for t in frame.tensors
+        )
+        if self._multi:
+            with open(self.location % self._index, "wb") as f:
+                f.write(payload)
+        else:
+            self._file.write(payload)
+        self._index += 1
+
+    def stop(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+@registry.element("fakesink")
+class FakeSink(Sink):
+    """Discard frames (keeps a count). Completes device futures so
+    backpressure reflects real compute."""
+
+    FACTORY_NAME = "fakesink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.rendered = 0
+        self.sync_device = bool(self.get_property("sync-device", True))
+
+    def render(self, frame: Frame) -> None:
+        if self.sync_device:
+            frame.block_until_ready()
+        self.rendered += 1

@@ -1,0 +1,280 @@
+"""Source elements: deterministic test sources, app push source, file source.
+
+Reference parity: videotestsrc/audiotestsrc (GStreamer base elements the
+reference's SSAT golden tests drive, SURVEY.md §4), appsrc, filesrc, and
+tensor-native sources. Deterministic patterns make golden-file tests
+reproducible, exactly like videotestsrc patterns do for the reference.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import MediaSpec, Source, Spec
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame, SECOND
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+def _frame_pts(index: int, rate: Optional[Fraction]):
+    if not rate:
+        return None, None
+    dur = int(SECOND / rate)
+    return index * dur, dur
+
+
+@registry.element("videotestsrc")
+@registry.element("testsrc")
+class VideoTestSrc(Source):
+    """Deterministic video source.
+
+    Props: width, height, format (RGB/BGR/RGBA/GRAY8), num-frames (-1 =
+    endless), framerate ("30/1"), pattern:
+    - ``smpte``/``gradient``: per-frame shifted gradient (default)
+    - ``solid``: constant fill (``foreground-color``)
+    - ``random``: seeded rng (``seed``)
+    - ``counter``: every pixel = frame index % 256 (golden-test friendly)
+    """
+
+    FACTORY_NAME = "videotestsrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.width = int(self.get_property("width", 320))
+        self.height = int(self.get_property("height", 240))
+        self.format = str(self.get_property("format", "RGB"))
+        self.num_frames = int(
+            self.get_property("num-frames", self.get_property("num-buffers", 10))
+        )
+        self.pattern = str(self.get_property("pattern", "gradient"))
+        self.rate = Fraction(str(self.get_property("framerate", "30/1")))
+        self.seed = int(self.get_property("seed", 0))
+        self._i = 0
+        self._rng = np.random.default_rng(self.seed)
+
+    def output_spec(self) -> Spec:
+        return MediaSpec(
+            "video",
+            width=self.width,
+            height=self.height,
+            format=self.format,
+            rate=self.rate,
+        )
+
+    def start(self) -> None:
+        self._i = 0
+        self._rng = np.random.default_rng(self.seed)
+
+    def generate(self):
+        if 0 <= self.num_frames <= self._i:
+            return EOS_FRAME
+        c = MediaSpec("video", format=self.format).channels_per_pixel
+        h, w = self.height, self.width
+        if self.pattern in ("smpte", "gradient"):
+            yy, xx = np.meshgrid(
+                np.arange(h, dtype=np.uint16), np.arange(w, dtype=np.uint16), indexing="ij"
+            )
+            base = (xx + yy + self._i)[..., None] + np.arange(c, dtype=np.uint16) * 37
+            img = (base % 256).astype(np.uint8)
+        elif self.pattern == "solid":
+            color = int(self.get_property("foreground-color", 128))
+            img = np.full((h, w, c), color, np.uint8)
+        elif self.pattern == "random":
+            img = self._rng.integers(0, 256, (h, w, c), dtype=np.uint8)
+        elif self.pattern == "counter":
+            img = np.full((h, w, c), self._i % 256, np.uint8)
+        else:
+            raise ValueError(f"unknown pattern {self.pattern!r}")
+        pts, dur = _frame_pts(self._i, self.rate)
+        self._i += 1
+        return Frame((img,), pts=pts, duration=dur, meta={"media_type": "video"})
+
+
+@registry.element("audiotestsrc")
+class AudioTestSrc(Source):
+    """Deterministic audio source: sine wave chunks of `samples-per-buffer`
+    S16LE samples, `channels` interleaved."""
+
+    FACTORY_NAME = "audiotestsrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.sample_rate = int(self.get_property("rate", 16000))
+        self.channels = int(self.get_property("channels", 1))
+        self.spb = int(self.get_property("samples-per-buffer", 1024))
+        self.num_buffers = int(self.get_property("num-buffers", 10))
+        self.freq = float(self.get_property("freq", 440.0))
+        self._i = 0
+
+    def output_spec(self) -> Spec:
+        return MediaSpec(
+            "audio",
+            channels=self.channels,
+            sample_rate=self.sample_rate,
+            sample_format="S16LE",
+        )
+
+    def start(self) -> None:
+        self._i = 0
+
+    def generate(self):
+        if 0 <= self.num_buffers <= self._i:
+            return EOS_FRAME
+        t0 = self._i * self.spb
+        t = (np.arange(self.spb) + t0) / self.sample_rate
+        wave = np.sin(2 * np.pi * self.freq * t) * 0.5
+        samples = (wave * 32767).astype(np.int16)
+        chunk = np.repeat(samples[:, None], self.channels, axis=1)
+        pts = int(t0 * SECOND / self.sample_rate)
+        dur = int(self.spb * SECOND / self.sample_rate)
+        self._i += 1
+        return Frame((chunk,), pts=pts, duration=dur, meta={"media_type": "audio"})
+
+
+@registry.element("appsrc")
+class AppSrc(Source):
+    """Push frames (or raw arrays) from application code.
+
+    Use ``appsrc(iterable=...)`` for pull-from-iterator, or call
+    ``push(frame)`` + ``end_of_stream()`` from any thread.
+    """
+
+    FACTORY_NAME = "appsrc"
+
+    def __init__(self, name=None, iterable: Optional[Iterable] = None, spec: Optional[Spec] = None, **props):
+        super().__init__(name, **props)
+        self._iter: Optional[Iterator] = iter(iterable) if iterable is not None else None
+        self._spec = spec
+        import queue as _q
+
+        self._queue: "_q.Queue" = _q.Queue(maxsize=16)
+
+    def output_spec(self) -> Spec:
+        if self._spec is not None:
+            return self._spec
+        dims = self.get_property("dimensions")
+        if dims:
+            return TensorsSpec.from_strings(dims, self.get_property("types", "float32"))
+        raise ValueError(f"{self.name}: appsrc needs spec= or dimensions= property")
+
+    def push(self, frame, timeout: Optional[float] = None) -> None:
+        if not isinstance(frame, Frame):
+            frame = Frame(tuple(frame) if isinstance(frame, (tuple, list)) else (frame,))
+        self._queue.put(frame, timeout=timeout)
+
+    def end_of_stream(self) -> None:
+        self._queue.put(EOS_FRAME)
+
+    def generate(self):
+        if self._iter is not None:
+            try:
+                item = next(self._iter)
+            except StopIteration:
+                return EOS_FRAME
+            if not isinstance(item, Frame):
+                item = Frame(tuple(item) if isinstance(item, (tuple, list)) else (item,))
+            return item
+        import queue as _q
+
+        try:
+            # bounded wait so the executor's stop event stays responsive
+            return self._queue.get(timeout=0.1)
+        except _q.Empty:
+            return None
+
+
+@registry.element("filesrc")
+class FileSrc(Source):
+    """Read a file as one octet buffer (or fixed ``blocksize`` chunks),
+    feeding tensor_converter's application/octet-stream path."""
+
+    FACTORY_NAME = "filesrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.location = str(self.get_property("location", ""))
+        self.blocksize = int(self.get_property("blocksize", 0))
+        self._file = None
+        self._done = False
+
+    def output_spec(self) -> Spec:
+        return MediaSpec("octet")
+
+    def start(self) -> None:
+        if not self.location:
+            raise ValueError(f"{self.name}: filesrc needs location=")
+        self._file = open(self.location, "rb")
+        self._done = False
+
+    def stop(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def generate(self):
+        if self._done:
+            return EOS_FRAME
+        if self.blocksize > 0:
+            data = self._file.read(self.blocksize)
+            if not data:
+                self._done = True
+                return EOS_FRAME
+        else:
+            data = self._file.read()
+            self._done = True
+            if not data:
+                return EOS_FRAME
+        arr = np.frombuffer(data, dtype=np.uint8)
+        return Frame((arr,), meta={"media_type": "octet"})
+
+
+@registry.element("tensorsrc")
+class TensorSrc(Source):
+    """Pure tensor source: deterministic tensors straight in `other/tensors`
+    (no converter needed). Props: dimensions, types, pattern
+    (zeros/ones/counter/random), num-frames, framerate."""
+
+    FACTORY_NAME = "tensorsrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.spec = TensorsSpec.from_strings(
+            str(self.get_property("dimensions", "1")),
+            str(self.get_property("types", "float32")),
+            rate=self.get_property("framerate"),
+        )
+        self.num_frames = int(self.get_property("num-frames", 10))
+        self.pattern = str(self.get_property("pattern", "counter"))
+        self.seed = int(self.get_property("seed", 0))
+        self._i = 0
+        self._rng = np.random.default_rng(self.seed)
+
+    def output_spec(self) -> Spec:
+        return self.spec
+
+    def start(self) -> None:
+        self._i = 0
+        self._rng = np.random.default_rng(self.seed)
+
+    def generate(self):
+        if 0 <= self.num_frames <= self._i:
+            return EOS_FRAME
+        tensors = []
+        for t in self.spec:
+            if self.pattern == "zeros":
+                a = np.zeros(t.shape, t.dtype.np_dtype)
+            elif self.pattern == "ones":
+                a = np.ones(t.shape, t.dtype.np_dtype)
+            elif self.pattern == "counter":
+                a = np.full(t.shape, self._i, dtype=np.float64).astype(t.dtype.np_dtype)
+            elif self.pattern == "random":
+                a = self._rng.random(t.shape).astype(t.dtype.np_dtype)
+            else:
+                raise ValueError(f"unknown pattern {self.pattern!r}")
+            tensors.append(a)
+        pts, dur = _frame_pts(self._i, self.spec.rate)
+        self._i += 1
+        return Frame(tuple(tensors), pts=pts, duration=dur)

@@ -1,0 +1,271 @@
+"""tensor_transform: elementwise ops on tensor streams — fused into XLA.
+
+Reference: gst/nnstreamer/elements/gsttensor_transform.c (modes
+gsttensor_transform.h:57-67, option regexes :73-77). The reference needs a
+runtime SIMD compiler (ORC) for speed (:459-530); here every mode is a jnp
+expression that the pipeline compiler fuses into the adjacent XLA program —
+preprocessing costs zero extra HBM round-trips when followed by a filter.
+
+Option-string syntax is reference-compatible (dim indices are the
+reference's innermost-first; translated to canonical axes internally):
+
+- mode=typecast option=TYPE
+- mode=arithmetic option=[typecast:TYPE,][per-channel:true@DIM,]
+    {add|sub|mul|div}:NUM[@CH_IDX][,...]
+- mode=transpose option=D1:D2:D3:D4   (innermost-first permutation)
+- mode=dimchg option=FROM:TO          (move innermost-first dim FROM to TO)
+- mode=clamp option=MIN:MAX
+- mode=stand option={default|dc-average}[:TYPE][,per-channel:true]
+
+Applied to every tensor in the frame (multi-tensor parity).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+_ARITH_OP = re.compile(
+    r"^(typecast:(?P<cast>[a-z0-9]+)|per-channel:(?P<pc>true|false)(@(?P<pcdim>\d+))?|"
+    r"(?P<op>add|sub|mul|div):(?P<num>-?[0-9.eE+-]+)(@(?P<ch>\d+))?)$"
+)
+
+
+def _ref_axis(canonical_rank: int, ref_dim: int) -> int:
+    """Reference innermost-first dim index → canonical axis."""
+    if ref_dim >= canonical_rank:
+        raise NegotiationError(
+            f"dim index {ref_dim} out of range for rank {canonical_rank}"
+        )
+    return canonical_rank - 1 - ref_dim
+
+
+@registry.element("tensor_transform")
+class TensorTransform(TensorOp):
+    FACTORY_NAME = "tensor_transform"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.mode = str(self.get_property("mode", "")).lower()
+        self.option = str(self.get_property("option", ""))
+        if self.mode not in (
+            "typecast",
+            "arithmetic",
+            "transpose",
+            "dimchg",
+            "clamp",
+            "stand",
+        ):
+            raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
+
+    # -- negotiation -------------------------------------------------------
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec):
+            raise NegotiationError(f"{self.name}: needs tensor input, got {spec}")
+        outs = [self._transform_spec(t) for t in spec]
+        return [TensorsSpec(tuple(outs), spec.format, spec.rate)]
+
+    def _transform_spec(self, t: TensorSpec) -> TensorSpec:
+        m = self.mode
+        if m == "typecast":
+            return t.with_dtype(DType.from_any(self.option))
+        if m == "arithmetic":
+            cast, _, _, _ = self._parse_arith()
+            return t.with_dtype(cast) if cast else t
+        if m == "transpose":
+            perm = self._canonical_perm(t.rank)
+            return t.with_shape(tuple(t.shape[a] for a in perm))
+        if m == "dimchg":
+            src, dst = self._parse_dimchg(t.rank)
+            shape = list(t.shape)
+            shape.insert(dst, shape.pop(src))
+            return t.with_shape(tuple(shape))
+        if m == "clamp":
+            self._parse_clamp()
+            return t
+        if m == "stand":
+            _, _, out_type = self._parse_stand()
+            return t.with_dtype(out_type) if out_type else t.with_dtype(DType.FLOAT32) if not t.dtype.is_float else t
+        raise AssertionError(m)
+
+    # -- option parsing ----------------------------------------------------
+    def _parse_arith(self):
+        cast: Optional[DType] = None
+        per_channel = False
+        pc_axis_ref = 0
+        ops: List[Tuple[str, float, Optional[int]]] = []
+        for part in self.option.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            m = _ARITH_OP.match(part)
+            if not m:
+                raise NegotiationError(f"{self.name}: bad arithmetic option {part!r}")
+            if m.group("cast"):
+                cast = DType.from_any(m.group("cast"))
+            elif m.group("pc"):
+                per_channel = m.group("pc") == "true"
+                if m.group("pcdim"):
+                    pc_axis_ref = int(m.group("pcdim"))
+            else:
+                ch = int(m.group("ch")) if m.group("ch") else None
+                ops.append((m.group("op"), float(m.group("num")), ch))
+        return cast, per_channel, pc_axis_ref, ops
+
+    def _canonical_perm(self, rank: int) -> Tuple[int, ...]:
+        ref_perm = [int(p) for p in self.option.split(":") if p != ""]
+        if sorted(ref_perm) != list(range(len(ref_perm))):
+            raise NegotiationError(f"{self.name}: bad transpose {self.option!r}")
+        while len(ref_perm) < rank:
+            ref_perm.append(len(ref_perm))
+        # out canonical axis a = in canonical axis rank-1-ref_perm[rank-1-a]
+        return tuple(rank - 1 - ref_perm[rank - 1 - a] for a in range(rank))
+
+    def _parse_dimchg(self, rank: int) -> Tuple[int, int]:
+        try:
+            frm, to = (int(x) for x in self.option.split(":"))
+        except ValueError as exc:
+            raise NegotiationError(f"{self.name}: bad dimchg {self.option!r}") from exc
+        return _ref_axis(rank, frm), _ref_axis(rank, to)
+
+    def _parse_clamp(self) -> Tuple[float, float]:
+        try:
+            lo, hi = (float(x) for x in self.option.split(":"))
+        except ValueError as exc:
+            raise NegotiationError(f"{self.name}: bad clamp {self.option!r}") from exc
+        if lo > hi:
+            raise NegotiationError(f"{self.name}: clamp min {lo} > max {hi}")
+        return lo, hi
+
+    def _parse_stand(self):
+        mode, per_channel, out_type = "default", False, None
+        for i, part in enumerate(p.strip() for p in self.option.split(",")):
+            if not part:
+                continue
+            if part.startswith("per-channel:"):
+                per_channel = part.split(":", 1)[1] == "true"
+                continue
+            bits = part.split(":")
+            mode = bits[0] or "default"
+            if len(bits) > 1:
+                out_type = DType.from_any(bits[1])
+        if mode not in ("default", "dc-average"):
+            raise NegotiationError(f"{self.name}: bad stand mode {mode!r}")
+        return mode, per_channel, out_type
+
+    # -- fused fn ----------------------------------------------------------
+    def make_fn(self) -> Callable:
+        mode = self.mode
+        in_spec: TensorsSpec = self.in_specs[0]
+        out_spec: TensorsSpec = self.out_specs[0]
+
+        if mode == "typecast":
+            dt = DType.from_any(self.option).np_dtype
+
+            def fn(tensors):
+                return tuple(jnp.asarray(t).astype(dt) for t in tensors)
+
+        elif mode == "arithmetic":
+            cast, per_channel, pc_axis_ref, ops = self._parse_arith()
+
+            def apply_one(x, rank):
+                y = jnp.asarray(x)
+                if cast is not None:
+                    y = y.astype(cast.np_dtype)
+                elif not jnp.issubdtype(y.dtype, jnp.floating):
+                    # integer arithmetic without explicit cast follows the
+                    # input dtype (reference semantics)
+                    pass
+                axis = _ref_axis(rank, pc_axis_ref) if per_channel else None
+                for op, num, ch in ops:
+                    if ch is not None and axis is not None:
+                        # per-channel constant applied to one channel index
+                        sel = [slice(None)] * rank
+                        sel[axis] = ch
+                        upd = y[tuple(sel)]
+                        upd = _arith(upd, op, num)
+                        y = y.at[tuple(sel)].set(upd)
+                    else:
+                        y = _arith(y, op, num)
+                return y
+
+            def fn(tensors):
+                return tuple(
+                    apply_one(t, s.rank) for t, s in zip(tensors, in_spec)
+                )
+
+        elif mode == "transpose":
+            perms = [self._canonical_perm(s.rank) for s in in_spec]
+
+            def fn(tensors):
+                return tuple(
+                    jnp.transpose(jnp.asarray(t), p) for t, p in zip(tensors, perms)
+                )
+
+        elif mode == "dimchg":
+            moves = [self._parse_dimchg(s.rank) for s in in_spec]
+
+            def fn(tensors):
+                return tuple(
+                    jnp.moveaxis(jnp.asarray(t), s, d)
+                    for t, (s, d) in zip(tensors, moves)
+                )
+
+        elif mode == "clamp":
+            lo, hi = self._parse_clamp()
+
+            def fn(tensors):
+                return tuple(
+                    jnp.clip(jnp.asarray(t), *_clamp_bounds(t, lo, hi)) for t in tensors
+                )
+
+        elif mode == "stand":
+            smode, per_channel, out_type = self._parse_stand()
+
+            def stand_one(x, out_dtype):
+                y = jnp.asarray(x).astype(jnp.float32)
+                axes = tuple(range(y.ndim - 1)) if per_channel else None
+                mean = jnp.mean(y, axis=axes, keepdims=per_channel)
+                if smode == "default":
+                    std = jnp.std(y, axis=axes, keepdims=per_channel)
+                    y = (y - mean) / (std + 1e-10)
+                else:  # dc-average
+                    y = y - mean
+                return y.astype(out_dtype)
+
+            def fn(tensors):
+                return tuple(
+                    stand_one(t, s.dtype.np_dtype)
+                    for t, s in zip(tensors, out_spec)
+                )
+
+        else:
+            raise AssertionError(mode)
+        return fn
+
+
+def _arith(y, op: str, num: float):
+    const = jnp.asarray(num, dtype=y.dtype)
+    if op == "add":
+        return y + const
+    if op == "sub":
+        return y - const
+    if op == "mul":
+        return y * const
+    if op == "div":
+        return y / const
+    raise AssertionError(op)
+
+
+def _clamp_bounds(t, lo: float, hi: float):
+    # integer clamps round the bounds like the reference's typed clamp
+    if jnp.issubdtype(jnp.asarray(t).dtype, jnp.integer):
+        return int(lo), int(hi)
+    return lo, hi

@@ -1,0 +1,353 @@
+"""Async executor: one streaming thread per node, bounded queues between.
+
+The runtime analogue of GStreamer's streaming threads + queue elements
+(reference parallelism construct #1, SURVEY.md §2.6): every node runs
+concurrently, queues give backpressure, and frame-level pipelining across
+stages is automatic. On TPU the win is larger than on CPU: a fused segment's
+jitted call *dispatches* asynchronously (jax async dispatch), so while one
+frame computes on device, the next frame's host-side work overlaps.
+
+Node kinds (from the compile plan):
+- SourceNode: drives generate() until EOS or stop.
+- FusedNode: a FusedSegment (1..n TensorOps) → one jitted call per frame.
+- HostNode: HostElement.process per frame (fusion barrier).
+- RoutingNode: feeds Routing.receive/eos with per-pad frames.
+- SinkNode: Sink.render per frame.
+
+EOS: a sentinel flows through every queue. Multi-input nodes forward EOS
+downstream only after ALL sink pads saw it. Errors capture into
+Executor.errors and poison the pipeline (stop event) so threads unwind.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.elements.base import (
+    Element,
+    HostElement,
+    Routing,
+    Sink,
+    Source,
+    TensorOp,
+)
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline.graph import ExecPlan, FusedSegment, Link
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+
+_log = get_logger("executor")
+
+
+class _Stop(Exception):
+    pass
+
+
+class Node:
+    def __init__(self, ex: "Executor", name: str) -> None:
+        self.ex = ex
+        self.name = name
+        self.in_queues: List[queue_mod.Queue] = []
+        # out pad -> (dst node, dst pad)
+        self.outs: Dict[int, Tuple["Node", int]] = {}
+        self.thread: Optional[threading.Thread] = None
+        self.frames_processed = 0
+        self.proc_time_ema_ms = 0.0
+
+    def add_in_queue(self, size: int) -> int:
+        self.in_queues.append(queue_mod.Queue(maxsize=max(1, size)))
+        return len(self.in_queues) - 1
+
+    # -- data movement ----------------------------------------------------
+    def push_out(self, pad: int, item) -> None:
+        dst, dst_pad = self.outs[pad]
+        q = dst.in_queues[dst_pad]
+        while True:
+            if self.ex.stop_event.is_set():
+                raise _Stop()
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue_mod.Full:
+                continue
+
+    def broadcast_eos(self) -> None:
+        for pad in self.outs:
+            try:
+                self.push_out(pad, EOS_FRAME)
+            except _Stop:
+                pass
+
+    def pop(self, pad: int = 0):
+        q = self.in_queues[pad]
+        while True:
+            if self.ex.stop_event.is_set():
+                raise _Stop()
+            try:
+                return q.get(timeout=0.1)
+            except queue_mod.Empty:
+                continue
+
+    # -- thread ------------------------------------------------------------
+    def start(self) -> None:
+        self.thread = threading.Thread(target=self._run_safe, name=self.name, daemon=True)
+        self.thread.start()
+
+    def _run_safe(self) -> None:
+        try:
+            self.run()
+        except _Stop:
+            pass
+        except Exception as exc:  # capture and poison
+            _log.error("node %s failed: %s", self.name, exc)
+            self.ex.record_error(exc)
+            self.broadcast_eos()
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def stat(self, t0: float) -> None:
+        dt = (time.perf_counter() - t0) * 1000.0
+        self.frames_processed += 1
+        a = 0.2
+        self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+
+
+class SourceNode(Node):
+    def __init__(self, ex, elem: Source) -> None:
+        super().__init__(ex, elem.name)
+        self.elem = elem
+
+    def run(self) -> None:
+        while not self.ex.stop_event.is_set():
+            t0 = time.perf_counter()
+            item = self.elem.generate()
+            if item is EOS_FRAME:
+                break
+            if item is None:  # no data yet — re-poll (bounded-wait sources)
+                continue
+            self.stat(t0)
+            self.push_out(0, item)
+        self.broadcast_eos()
+
+
+class FusedNode(Node):
+    def __init__(self, ex, seg: FusedSegment) -> None:
+        super().__init__(ex, seg.name)
+        self.seg = seg
+
+    def run(self) -> None:
+        self.seg.build()  # compile before first frame (PAUSED-state parity)
+        while True:
+            item = self.pop(0)
+            if item is EOS_FRAME:
+                break
+            t0 = time.perf_counter()
+            out = self.seg.process(item)
+            self.stat(t0)
+            self.push_out(0, out)
+        self.broadcast_eos()
+
+
+class TensorOpHostNode(Node):
+    """Host-path adapter for non-traceable TensorOps (e.g. tensor_filter
+    with a torch/tflite backend) — a fusion barrier."""
+
+    def __init__(self, ex, elem: TensorOp) -> None:
+        super().__init__(ex, elem.name)
+        self.elem = elem
+
+    def run(self) -> None:
+        while True:
+            item = self.pop(0)
+            if item is EOS_FRAME:
+                break
+            t0 = time.perf_counter()
+            out = self.elem.host_process(item)
+            self.stat(t0)
+            self.push_out(0, out)
+        self.broadcast_eos()
+
+
+class HostNode(Node):
+    def __init__(self, ex, elem: HostElement) -> None:
+        super().__init__(ex, elem.name)
+        self.elem = elem
+
+    def run(self) -> None:
+        while True:
+            item = self.pop(0)
+            if item is EOS_FRAME:
+                for f in self.elem.flush():
+                    self.push_out(0, f)
+                break
+            t0 = time.perf_counter()
+            out = self.elem.process(item)
+            self.stat(t0)
+            if out is None:
+                continue
+            for f in out if isinstance(out, list) else [out]:
+                self.push_out(0, f)
+        self.broadcast_eos()
+
+
+class RoutingNode(Node):
+    def __init__(self, ex, elem: Routing) -> None:
+        super().__init__(ex, elem.name)
+        self.elem = elem
+
+    def run(self) -> None:
+        n = len(self.in_queues)
+        eos_seen = [False] * n
+        # round-robin service of pads; Routing elements that need timestamp
+        # sync buffer internally and emit when policy satisfied
+        while not all(eos_seen):
+            progressed = False
+            for pad in range(n):
+                if eos_seen[pad]:
+                    continue
+                try:
+                    item = self.in_queues[pad].get(timeout=0.02)
+                except queue_mod.Empty:
+                    if self.ex.stop_event.is_set():
+                        raise _Stop()
+                    continue
+                progressed = True
+                if item is EOS_FRAME:
+                    eos_seen[pad] = True
+                    for out_pad, f in self.elem.eos(pad):
+                        self.push_out(out_pad, f)
+                    continue
+                t0 = time.perf_counter()
+                for out_pad, f in self.elem.receive(pad, item):
+                    self.push_out(out_pad, f)
+                self.stat(t0)
+            if not progressed and self.ex.stop_event.is_set():
+                raise _Stop()
+        self.broadcast_eos()
+
+
+class SinkNode(Node):
+    def __init__(self, ex, elem: Sink) -> None:
+        super().__init__(ex, elem.name)
+        self.elem = elem
+
+    def run(self) -> None:
+        while True:
+            item = self.pop(0)
+            if item is EOS_FRAME:
+                self.elem.on_eos()
+                break
+            t0 = time.perf_counter()
+            self.elem.render(item)
+            self.stat(t0)
+        self.ex.sink_done(self)
+
+
+class Executor:
+    def __init__(self, plan: ExecPlan) -> None:
+        self.plan = plan
+        self.stop_event = threading.Event()
+        self.errors: List[Exception] = []
+        self._err_lock = threading.Lock()
+        self.nodes: List[Node] = []
+        self._node_of: Dict[Element, Node] = {}
+        self._pending_sinks = 0
+        self._sinks_cv = threading.Condition()
+        self._started = False
+        self.finished = False
+        self._build()
+
+    # -- construction ------------------------------------------------------
+    def _build(self) -> None:
+        p = self.plan.pipeline
+        # create nodes
+        for e in p.elements:
+            if isinstance(e, TensorOp):
+                seg = self.plan.seg_of.get(e)
+                if seg is None:  # non-traceable: host-path adapter
+                    self._node_of[e] = TensorOpHostNode(self, e)
+                elif seg.first is e:
+                    node = FusedNode(self, seg)
+                    for op in seg.ops:
+                        self._node_of[op] = node
+                continue
+            if isinstance(e, Source):
+                node = SourceNode(self, e)
+            elif isinstance(e, Sink):
+                node = SinkNode(self, e)
+                self._pending_sinks += 1
+            elif isinstance(e, Routing):
+                node = RoutingNode(self, e)
+            elif isinstance(e, HostElement):
+                node = HostNode(self, e)
+            else:
+                raise TypeError(f"cannot execute element {e!r}")
+            self._node_of[e] = node
+        self.nodes = list(dict.fromkeys(self._node_of.values()))
+        # wire queues: only links that cross node boundaries materialize
+        for l in p.links:
+            src_node = self._node_of[l.src]
+            dst_node = self._node_of[l.dst]
+            if src_node is dst_node:
+                continue  # intra-segment link (fused away)
+            # node-level pad indices: fused nodes expose single in/out pad
+            src_pad = 0 if isinstance(src_node, FusedNode) else l.src_pad
+            dst_pad = 0 if isinstance(dst_node, FusedNode) else l.dst_pad
+            while len(dst_node.in_queues) <= dst_pad:
+                dst_node.add_in_queue(l.dst.queue_size)
+            src_node.outs[src_pad] = (dst_node, dst_pad)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for e in self.plan.pipeline.elements:
+            e.start()
+        for n in self.nodes:
+            n.start()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every sink saw EOS (or error). True if completed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._sinks_cv:
+            while self._pending_sinks > 0 and not self.errors:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._sinks_cv.wait(timeout=0.1 if remaining is None else min(0.1, remaining))
+        return self._pending_sinks == 0
+
+    def sink_done(self, node: SinkNode) -> None:
+        with self._sinks_cv:
+            self._pending_sinks -= 1
+            self._sinks_cv.notify_all()
+
+    def record_error(self, exc: Exception) -> None:
+        with self._err_lock:
+            self.errors.append(exc)
+        self.stop_event.set()
+        with self._sinks_cv:
+            self._sinks_cv.notify_all()
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        for n in self.nodes:
+            if n.thread is not None:
+                n.thread.join(timeout=5.0)
+        for e in self.plan.pipeline.elements:
+            e.stop()
+        self.finished = True
+
+    # -- introspection (per-element proctime, §5.1 parity) ----------------
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        return {
+            n.name: {
+                "frames": n.frames_processed,
+                "proc_ms_ema": round(n.proc_time_ema_ms, 3),
+            }
+            for n in self.nodes
+        }

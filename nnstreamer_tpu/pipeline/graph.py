@@ -1,0 +1,306 @@
+"""Pipeline graph: build → negotiate → compile (fuse) → execute.
+
+The reference's pipeline bring-up (SURVEY.md §3.1: parse description,
+create elements, negotiate caps at PAUSED, stream at PLAYING) becomes:
+
+    Pipeline.add/link (or pipeline/parse.py from a description string)
+    → negotiate(): one topological pass propagating TensorsSpec/MediaSpec
+    → compile(): partition the graph into execution nodes, FUSING maximal
+      linear chains of TensorOp elements into single jitted XLA programs
+      (the TPU-first move: the reference runs one chain function per
+      element per frame with map/unmap; we run one XLA program for the
+      whole chain with tensors resident in HBM)
+    → Executor (pipeline/executor.py): one streaming thread per node with
+      bounded queues (GStreamer streaming-thread parity → pipeline
+      parallelism and backpressure).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from nnstreamer_tpu.elements.base import (
+    Element,
+    HostElement,
+    NegotiationError,
+    Routing,
+    Sink,
+    Source,
+    Spec,
+    TensorOp,
+)
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_log = get_logger("pipeline")
+
+
+@dataclass(frozen=True)
+class Link:
+    src: Element
+    src_pad: int
+    dst: Element
+    dst_pad: int
+
+
+class Pipeline:
+    def __init__(self, name: str = "pipeline") -> None:
+        self.name = name
+        self.elements: List[Element] = []
+        self.links: List[Link] = []
+        self._by_name: Dict[str, Element] = {}
+        self._negotiated = False
+        self._executor = None
+
+    # -- build -------------------------------------------------------------
+    def add(self, *elements: Element) -> "Pipeline":
+        for e in elements:
+            if e in self.elements:
+                continue
+            if e.name in self._by_name:
+                raise ValueError(f"duplicate element name {e.name!r}")
+            self.elements.append(e)
+            self._by_name[e.name] = e
+        return self
+
+    def __getitem__(self, name: str) -> Element:
+        return self._by_name[name]
+
+    def link(
+        self,
+        src: Element,
+        dst: Element,
+        src_pad: Optional[int] = None,
+        dst_pad: Optional[int] = None,
+    ) -> "Pipeline":
+        self.add(src, dst)
+        if src_pad is None:
+            src_pad = self._next_free_src_pad(src)
+        if dst_pad is None:
+            dst_pad = self._next_free_dst_pad(dst)
+        for l in self.links:
+            if l.src is src and l.src_pad == src_pad:
+                raise ValueError(f"{src.name} src pad {src_pad} already linked")
+            if l.dst is dst and l.dst_pad == dst_pad:
+                raise ValueError(f"{dst.name} sink pad {dst_pad} already linked")
+        if src.N_SRCS is not None and src_pad >= src.N_SRCS:
+            raise ValueError(f"{src.name} has no src pad {src_pad}")
+        if dst.N_SINKS is not None and dst_pad >= dst.N_SINKS:
+            raise ValueError(f"{dst.name} has no sink pad {dst_pad}")
+        self.links.append(Link(src, src_pad, dst, dst_pad))
+        return self
+
+    def chain(self, *elements: Element) -> "Pipeline":
+        """Link a linear chain e1 ! e2 ! ... (gst-launch `!`)."""
+        for a, b in zip(elements, elements[1:]):
+            self.link(a, b)
+        return self
+
+    def _next_free_src_pad(self, e: Element) -> int:
+        used = {l.src_pad for l in self.links if l.src is e}
+        pad = 0
+        while pad in used:
+            pad += 1
+        return pad
+
+    def _next_free_dst_pad(self, e: Element) -> int:
+        used = {l.dst_pad for l in self.links if l.dst is e}
+        pad = 0
+        while pad in used:
+            pad += 1
+        return pad
+
+    # -- introspection -----------------------------------------------------
+    def out_links(self, e: Element) -> List[Link]:
+        return sorted(
+            (l for l in self.links if l.src is e), key=lambda l: l.src_pad
+        )
+
+    def in_links(self, e: Element) -> List[Link]:
+        return sorted(
+            (l for l in self.links if l.dst is e), key=lambda l: l.dst_pad
+        )
+
+    def n_srcs(self, e: Element) -> int:
+        return e.N_SRCS if e.N_SRCS is not None else len(self.out_links(e))
+
+    def n_sinks(self, e: Element) -> int:
+        return e.N_SINKS if e.N_SINKS is not None else len(self.in_links(e))
+
+    # -- negotiation -------------------------------------------------------
+    def _toposort(self) -> List[Element]:
+        indeg = {e: len(self.in_links(e)) for e in self.elements}
+        ready = [e for e in self.elements if indeg[e] == 0]
+        order: List[Element] = []
+        while ready:
+            e = ready.pop(0)
+            order.append(e)
+            for l in self.out_links(e):
+                indeg[l.dst] -= 1
+                if indeg[l.dst] == 0:
+                    ready.append(l.dst)
+        if len(order) != len(self.elements):
+            cyclic = [e.name for e in self.elements if e not in order]
+            raise NegotiationError(
+                f"pipeline has a cycle through {cyclic}; use tensor_repo "
+                "(reposink/reposrc) for feedback loops"
+            )
+        return order
+
+    def negotiate(self) -> "Pipeline":
+        """One topological pass: propagate specs, validate links
+        (the reference's PAUSED-state caps negotiation)."""
+        for e in self.elements:
+            ins, outs = self.n_sinks(e), self.n_srcs(e)
+            if isinstance(e, Routing):
+                e.set_pad_counts(ins, outs)
+            if ins != len(self.in_links(e)) and ins > 0:
+                raise NegotiationError(
+                    f"{e.name}: {len(self.in_links(e))}/{ins} sink pads linked"
+                )
+        for e in self._toposort():
+            in_specs: List[Spec] = [None] * self.n_sinks(e)  # type: ignore
+            for l in self.in_links(e):
+                in_specs[l.dst_pad] = l.src.out_specs[l.src_pad]
+            try:
+                e.fix_negotiation(in_specs)
+            except NegotiationError:
+                raise
+            except Exception as exc:
+                raise NegotiationError(f"{e.name}: {exc}") from exc
+            if len(e.out_specs) != self.n_srcs(e):
+                raise NegotiationError(
+                    f"{e.name}: negotiated {len(e.out_specs)} specs for "
+                    f"{self.n_srcs(e)} src pads"
+                )
+        self._negotiated = True
+        return self
+
+    # -- compile: fuse linear TensorOp chains ------------------------------
+    def compile_plan(self) -> "ExecPlan":
+        if not self._negotiated:
+            self.negotiate()
+        # group consecutive TensorOps with 1:1 linkage into segments
+        seg_of: Dict[Element, "FusedSegment"] = {}
+        segments: List[FusedSegment] = []
+        for e in self._toposort():
+            # non-traceable TensorOps (host-bound backends) execute as host
+            # nodes; they are fusion barriers like HostElement
+            if not isinstance(e, TensorOp) or not e.is_traceable():
+                continue
+            ups = self.in_links(e)
+            up = ups[0].src if len(ups) == 1 else None
+            if (
+                up is not None
+                and isinstance(up, TensorOp)
+                and up in seg_of
+                and len(self.out_links(up)) == 1
+            ):
+                seg = seg_of[up]
+                seg.ops.append(e)
+                seg_of[e] = seg
+            else:
+                seg = FusedSegment(ops=[e])
+                segments.append(seg)
+                seg_of[e] = seg
+        return ExecPlan(self, segments, seg_of)
+
+    # -- run ---------------------------------------------------------------
+    def start(self):
+        from nnstreamer_tpu.pipeline.executor import Executor
+
+        if self._executor is not None and self._executor.finished:
+            raise RuntimeError(
+                f"pipeline {self.name!r} already ran to completion; build a "
+                "fresh Pipeline to run again"
+            )
+        if self._executor is None:
+            self._executor = Executor(self.compile_plan())
+        self._executor.start()
+        return self._executor
+
+    def run(self, timeout: Optional[float] = None):
+        """Start, wait for EOS (or error), stop. Returns the executor for
+        inspecting sink results. Raises TimeoutError if `timeout` elapses
+        before EOS."""
+        ex = self.start()
+        completed = ex.wait(timeout)
+        ex.stop()
+        if ex.errors:
+            raise ex.errors[0]
+        if not completed:
+            raise TimeoutError(
+                f"pipeline {self.name!r} did not reach EOS within {timeout}s"
+            )
+        return ex
+
+    def stop(self) -> None:
+        if self._executor is not None:
+            self._executor.stop()
+
+    def dump_dot(self) -> str:
+        """Graphviz dump (reference GST_DEBUG_DUMP_DOT_DIR parity)."""
+        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;"]
+        for e in self.elements:
+            spec = ""
+            if e.out_specs:
+                s = e.out_specs[0]
+                spec = f"\\n{s}" if s is not None else ""
+            lines.append(f'  "{e.name}" [label="{e.FACTORY_NAME}\\n{e.name}{spec}", shape=box];')
+        for l in self.links:
+            lines.append(f'  "{l.src.name}" -> "{l.dst.name}" [label="{l.src_pad}→{l.dst_pad}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+class FusedSegment:
+    """A maximal linear chain of TensorOps compiled into ONE jitted fn."""
+
+    def __init__(self, ops: List[TensorOp]) -> None:
+        self.ops = ops
+        self._jitted: Optional[Callable] = None
+
+    @property
+    def first(self) -> TensorOp:
+        return self.ops[0]
+
+    @property
+    def last(self) -> TensorOp:
+        return self.ops[-1]
+
+    @property
+    def name(self) -> str:
+        return "+".join(o.name for o in self.ops)
+
+    def build(self) -> Callable:
+        if self._jitted is not None:
+            return self._jitted
+        fns = [op.make_fn() for op in self.ops]
+
+        def composed(*tensors):
+            t = tuple(tensors)
+            for f in fns:
+                t = tuple(f(t))
+            return t
+
+        self._jitted = jax.jit(composed)
+        return self._jitted
+
+    def process(self, frame: Frame) -> Frame:
+        out = self.build()(*frame.tensors)
+        f = frame.with_tensors(out)
+        for op in self.ops:
+            f = op.transform_meta(f)
+        return f
+
+
+@dataclass
+class ExecPlan:
+    pipeline: Pipeline
+    segments: List[FusedSegment]
+    seg_of: Dict[Element, FusedSegment]

@@ -1,0 +1,175 @@
+"""Pipeline description parser: gst-launch syntax → Pipeline.
+
+The reference's user interface is gst-launch-1.0 pipeline strings
+(SURVEY.md §1 L6; the flex/bison parser in tools/development/parser/).
+This parser covers the practically-used grammar:
+
+    chain    := node ( '!' node )*
+    node     := element | caps | ref
+    element  := NAME (key=value)*          # value may be 'quoted'
+    caps     := media/type[,key=value...]  # becomes a capsfilter
+    ref      := NAME. | NAME.src_N | NAME.sink_N | NAME.N
+
+Branches: a chain starting with ``name.`` continues from that named
+element (tee/demux fan-out), a chain ending in ``name.sink_N`` terminates
+into it (mux fan-in) — gst-launch semantics:
+
+    videotestsrc num-frames=8 ! tee name=t
+        t. ! queue ! tensor_converter ! tensor_sink name=a
+        t. ! queue ! tensor_converter ! tensor_sink name=b
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from typing import Dict, List, Optional, Tuple
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import Element
+from nnstreamer_tpu.pipeline.graph import Pipeline
+
+_REF_RE = re.compile(r"^([A-Za-z_][\w-]*)\.(?:(src|sink)_(\d+)|(\d+))?$")
+_PROP_RE = re.compile(r"^([A-Za-z_][\w-]*)=(.*)$", re.S)
+_CAPS_RE = re.compile(r"^[a-z]+/[\w.+-]+(,.*)?$")
+
+
+class ParseError(ValueError):
+    pass
+
+
+def _tokenize(description: str) -> List[str]:
+    lex = shlex.shlex(description, posix=True)
+    lex.whitespace_split = True
+    lex.commenters = "#"
+    return list(lex)
+
+
+def _parse_caps(token: str) -> Tuple[str, Dict[str, str]]:
+    parts = token.split(",")
+    media = parts[0]
+    fields: Dict[str, str] = {}
+    for p in parts[1:]:
+        if "=" not in p:
+            raise ParseError(f"bad caps field {p!r} in {token!r}")
+        k, v = p.split("=", 1)
+        v = re.sub(r"^\((string|int|fraction)\)", "", v.strip())
+        fields[k.strip()] = v
+    return media, fields
+
+
+def _make_caps_element(media: str, fields: Dict[str, str]) -> Element:
+    cls = registry.get(registry.KIND_ELEMENT, "capsfilter")
+    props: Dict[str, str] = {}
+    if media == "other/tensors" or media == "other/tensor":
+        if "dimensions" in fields:
+            props["dimensions"] = fields["dimensions"]
+        elif "dimension" in fields:
+            props["dimensions"] = fields["dimension"]
+        if "types" in fields:
+            props["types"] = fields["types"]
+        elif "type" in fields:
+            props["types"] = fields["type"]
+        if "format" in fields:
+            props["format"] = fields["format"]
+        if "framerate" in fields:
+            props["framerate"] = fields["framerate"]
+    else:
+        props["media"] = media.split("/", 1)[0]
+        props.update(fields)
+    return cls(**props)
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.pipeline = Pipeline()
+        self.prev: Optional[Element] = None
+        self.prev_src_pad: Optional[int] = None
+        self.expect_link = False
+
+    def element_token(self, name: str, props: Dict[str, str]) -> None:
+        cls = registry.get(registry.KIND_ELEMENT, name)
+        elem_name = props.pop("name", None)
+        elem = cls(name=elem_name, **props)
+        self.pipeline.add(elem)
+        self._attach(elem, None)
+
+    def caps_token(self, token: str) -> None:
+        media, fields = _parse_caps(token)
+        elem = _make_caps_element(media, fields)
+        self.pipeline.add(elem)
+        self._attach(elem, None)
+
+    def ref_token(self, name: str, pad_kind: Optional[str], pad: Optional[int]) -> None:
+        try:
+            elem = self.pipeline[name]
+        except KeyError as exc:
+            raise ParseError(f"reference to unknown element {name!r}") from exc
+        if self.expect_link:
+            # link target: '... ! mux.sink_0' — chain terminates here
+            dst_pad = pad if pad_kind in (None, "sink") else None
+            self.pipeline.link(self.prev, elem, src_pad=self.prev_src_pad, dst_pad=dst_pad)
+            self.prev = None
+            self.prev_src_pad = None
+            self.expect_link = False
+        else:
+            # branch start: 't. ! ...' — continue from named element
+            self.prev = elem
+            self.prev_src_pad = pad if pad_kind in (None, "src") else None
+
+    def _attach(self, elem: Element, dst_pad: Optional[int]) -> None:
+        if self.expect_link:
+            if self.prev is None:
+                raise ParseError("dangling '!'")
+            self.pipeline.link(self.prev, elem, src_pad=self.prev_src_pad, dst_pad=dst_pad)
+            self.expect_link = False
+        self.prev = elem
+        self.prev_src_pad = None
+
+    def bang(self) -> None:
+        if self.prev is None:
+            raise ParseError("'!' with nothing to link from")
+        if self.expect_link:
+            raise ParseError("duplicate '!'")
+        self.expect_link = True
+
+
+def parse_pipeline(description: str) -> Pipeline:
+    tokens = _tokenize(description)
+    if not tokens:
+        raise ParseError("empty pipeline description")
+    b = _Builder()
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "!":
+            b.bang()
+            i += 1
+            continue
+        ref = _REF_RE.match(tok)
+        if ref and "=" not in tok:
+            name, kind, pad_s, pad2 = ref.groups()
+            pad = int(pad_s) if pad_s is not None else (int(pad2) if pad2 else None)
+            b.ref_token(name, kind, pad)
+            i += 1
+            continue
+        if _CAPS_RE.match(tok) and "=" not in tok.split(",")[0]:
+            b.caps_token(tok)
+            i += 1
+            continue
+        # element: NAME followed by key=value props
+        if not re.match(r"^[A-Za-z_][\w-]*$", tok):
+            raise ParseError(f"unexpected token {tok!r}")
+        props: Dict[str, str] = {}
+        j = i + 1
+        while j < len(tokens):
+            m = _PROP_RE.match(tokens[j])
+            if not m or tokens[j] == "!":
+                break
+            props[m.group(1)] = m.group(2)
+            j += 1
+        b.element_token(tok, props)
+        i = j
+    if b.expect_link:
+        raise ParseError("pipeline ends with '!'")
+    return b.pipeline

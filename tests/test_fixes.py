@@ -1,0 +1,94 @@
+"""Regression tests for review findings: timeouts, re-run guard, flexbuf
+roundtrip, audio batching, appsrc shutdown, decoder un-batching."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.converter import TensorConverter
+from nnstreamer_tpu.elements.decoder import TensorDecoder
+from nnstreamer_tpu.elements.sink import FakeSink, TensorSink
+from nnstreamer_tpu.elements.sources import AppSrc, AudioTestSrc, TensorSrc, VideoTestSrc
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+def test_run_timeout_raises():
+    src = VideoTestSrc(width=8, height=8, **{"num-frames": -1})
+    p = Pipeline().chain(src, TensorConverter(), FakeSink())
+    with pytest.raises(TimeoutError):
+        p.run(timeout=0.3)
+
+
+def test_rerun_completed_pipeline_raises():
+    p = Pipeline().chain(TensorSrc(dimensions="2", **{"num-frames": 1}), TensorSink())
+    p.run(timeout=30)
+    with pytest.raises(RuntimeError, match="already ran"):
+        p.run(timeout=30)
+
+
+def test_appsrc_stop_without_eos_does_not_hang():
+    src = AppSrc(spec=TensorsSpec.from_strings("2", "float32"))
+    sink = TensorSink()
+    p = Pipeline().chain(src, sink)
+    p.start()
+    src.push(np.zeros(2, np.float32))
+    import time
+
+    deadline = time.monotonic() + 10
+    while sink.rendered < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    p.stop()  # no end_of_stream() sent; must not hang
+    assert sink.rendered == 1
+
+
+def test_flexbuf_roundtrip_through_pipeline(tmp_path):
+    # encode: tensors → flexbuf bytes file
+    p1 = parse_pipeline(
+        f"tensorsrc dimensions=3:2 types=float32 num-frames=1 pattern=ones ! "
+        f"tensor_decoder mode=flexbuf ! filesink location={tmp_path}/f.flex"
+    )
+    p1.run(timeout=30)
+    # decode: flexbuf bytes → tensors
+    p2 = parse_pipeline(
+        f"filesrc location={tmp_path}/f.flex ! tensor_converter mode=flexbuf ! "
+        f"tensor_sink name=out"
+    )
+    p2.run(timeout=30)
+    out = p2["out"].frames[0]
+    assert out.tensors[0].shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(out.tensors[0]), 1.0)
+
+
+def test_audio_frames_per_tensor_batches():
+    src = AudioTestSrc(**{"num-buffers": 4, "samples-per-buffer": 100})
+    conv = TensorConverter(**{"frames-per-tensor": 2})
+    sink = TensorSink()
+    Pipeline().chain(src, conv, sink).run(timeout=30)
+    assert sink.rendered == 2
+    assert sink.frames[0].tensors[0].shape == (200, 1)
+
+
+def test_direct_video_unbatches():
+    src = VideoTestSrc(width=8, height=8, **{"num-frames": 4})
+    conv = TensorConverter(**{"frames-per-tensor": 2})
+    dec = TensorDecoder(mode="direct_video")
+    sink = TensorSink()
+    Pipeline().chain(src, conv, dec, sink).run(timeout=30)
+    assert sink.rendered == 4  # 2 batched tensors → 4 media frames
+    assert sink.frames[0].tensors[0].shape == (8, 8, 3)
+
+
+def test_combination_empty_token_clean_error():
+    from nnstreamer_tpu.elements.filter import _parse_combination
+
+    with pytest.raises(ValueError, match="empty token"):
+        _parse_combination("o0,,i1")
+
+
+def test_deterministic_element_names():
+    from nnstreamer_tpu.elements.flow import Queue
+
+    a, b = Queue(), Queue()
+    assert a.name != b.name
+    assert a.name.startswith("queue")

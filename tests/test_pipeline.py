@@ -1,0 +1,265 @@
+"""Pipeline runtime tests: graph building, negotiation, fusion, executor.
+
+Mirrors reference coverage in tests/nnstreamer_plugins/unittest_plugins.cc
+(programmatic pipelines with appsrc/appsink) and the SSAT pipeline tests.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import NegotiationError
+from nnstreamer_tpu.elements.sources import AppSrc, TensorSrc, VideoTestSrc
+from nnstreamer_tpu.elements.converter import TensorConverter
+from nnstreamer_tpu.elements.transform import TensorTransform
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import AppSink, FakeSink, TensorSink
+from nnstreamer_tpu.elements.flow import Queue, Tee
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.tensors.spec import DType, TensorsSpec
+
+
+def run_chain(*elems, timeout=30):
+    p = Pipeline().chain(*elems)
+    p.run(timeout=timeout)
+    return p
+
+
+class TestBasicChain:
+    def test_video_to_sink(self):
+        src = VideoTestSrc(width=32, height=24, **{"num-frames": 5})
+        conv = TensorConverter()
+        sink = TensorSink()
+        run_chain(src, conv, sink)
+        assert sink.rendered == 5
+        assert sink.eos_seen
+        assert sink.frames[0].tensors[0].shape == (1, 24, 32, 3)
+        assert sink.frames[0].tensors[0].dtype == np.uint8
+
+    def test_deterministic_source(self):
+        def collect():
+            src = VideoTestSrc(width=8, height=8, **{"num-frames": 3})
+            conv = TensorConverter()
+            sink = TensorSink()
+            run_chain(src, conv, sink)
+            return [np.asarray(f.tensors[0]) for f in sink.frames]
+
+        a, b = collect(), collect()
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_pts_synthesized(self):
+        src = VideoTestSrc(width=8, height=8, **{"num-frames": 3}, framerate="10/1")
+        conv = TensorConverter()
+        sink = TensorSink()
+        run_chain(src, conv, sink)
+        pts = [f.pts for f in sink.frames]
+        assert pts == [0, 100_000_000, 200_000_000]
+
+    def test_frames_per_tensor_batching(self):
+        src = VideoTestSrc(width=8, height=8, **{"num-frames": 6})
+        conv = TensorConverter(**{"frames-per-tensor": 3})
+        sink = TensorSink()
+        run_chain(src, conv, sink)
+        assert sink.rendered == 2
+        assert sink.frames[0].tensors[0].shape == (3, 8, 8, 3)
+
+    def test_partial_batch_dropped(self):
+        src = VideoTestSrc(width=8, height=8, **{"num-frames": 5})
+        conv = TensorConverter(**{"frames-per-tensor": 3})
+        sink = TensorSink()
+        run_chain(src, conv, sink)
+        assert sink.rendered == 1
+
+
+class TestTransform:
+    def _run(self, mode, option, data, dims="4", types="float32"):
+        src = AppSrc(iterable=[(data,)], spec=TensorsSpec.from_strings(dims, types))
+        tr = TensorTransform(mode=mode, option=option)
+        sink = TensorSink()
+        run_chain(src, tr, sink)
+        return np.asarray(sink.frames[0].tensors[0])
+
+    def test_typecast(self):
+        out = self._run("typecast", "uint8", np.array([1.7, 2.2, 3.9, 4.0], np.float32))
+        assert out.dtype == np.uint8
+        np.testing.assert_array_equal(out, [1, 2, 3, 4])
+
+    def test_arithmetic_chain(self):
+        out = self._run(
+            "arithmetic",
+            "typecast:float32,add:-127.5,div:127.5",
+            np.array([0, 127.5, 255, 51], np.float32),
+        )
+        np.testing.assert_allclose(out, [-1.0, 0.0, 1.0, -0.6], atol=1e-6)
+
+    def test_transpose(self):
+        # reference option 1:0:2:3 swaps the two innermost dims
+        data = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+        src = AppSrc(iterable=[(data,)], spec=TensorsSpec.from_strings("4:3:2:1", "float32"))
+        tr = TensorTransform(mode="transpose", option="1:0:2:3")
+        sink = TensorSink()
+        run_chain(src, tr, sink)
+        out = np.asarray(sink.frames[0].tensors[0])
+        np.testing.assert_array_equal(out, data.transpose(0, 1, 3, 2))
+
+    def test_dimchg(self):
+        # dimchg 0:2 moves innermost (channels) to position 2: NHWC→NCHW-ish
+        data = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+        src = AppSrc(iterable=[(data,)], spec=TensorsSpec.from_strings("4:3:2:1", "float32"))
+        tr = TensorTransform(mode="dimchg", option="0:2")
+        sink = TensorSink()
+        run_chain(src, tr, sink)
+        out = np.asarray(sink.frames[0].tensors[0])
+        assert out.shape == (1, 4, 2, 3)
+
+    def test_clamp(self):
+        out = self._run("clamp", "0:1", np.array([-2.0, 0.5, 3.0, 1.0], np.float32))
+        np.testing.assert_allclose(out, [0.0, 0.5, 1.0, 1.0])
+
+    def test_stand_default(self):
+        x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        out = self._run("stand", "default", x)
+        np.testing.assert_allclose(out.mean(), 0.0, atol=1e-6)
+        np.testing.assert_allclose(out.std(), 1.0, atol=1e-4)
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError):
+            TensorTransform(mode="nonsense")
+
+
+class TestFilterInPipeline:
+    def test_fused_chain_filter(self):
+        src = VideoTestSrc(width=16, height=16, **{"num-frames": 4})
+        conv = TensorConverter()
+        tr = TensorTransform(mode="typecast", option="float32")
+        filt = TensorFilter(framework="scaler", custom="factor:0.5")
+        sink = TensorSink()
+        p = Pipeline().chain(src, conv, tr, filt, sink)
+        plan = p.compile_plan()
+        # transform + filter fuse into one segment
+        assert any(len(seg.ops) == 2 for seg in plan.segments)
+        p.run(timeout=60)
+        assert sink.rendered == 4
+
+    def test_filter_output_parity_with_single(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        data = np.random.default_rng(0).random((1, 8, 8, 3)).astype(np.float32)
+        src = AppSrc(iterable=[(data,)], spec=TensorsSpec.from_strings("3:8:8:1", "float32"))
+        filt = TensorFilter(framework="average")
+        sink = TensorSink()
+        run_chain(src, filt, sink)
+        with SingleShot(
+            framework="average",
+            input_spec=TensorsSpec.from_strings("3:8:8:1", "float32"),
+        ) as s:
+            (want,) = s.invoke(data)
+        np.testing.assert_allclose(
+            np.asarray(sink.frames[0].tensors[0]), np.asarray(want), rtol=1e-6
+        )
+
+    def test_input_output_combination(self):
+        data = np.ones((1, 4), np.float32)
+        extra = np.full((1, 2), 7.0, np.float32)
+        src = AppSrc(
+            iterable=[(data, extra)],
+            spec=TensorsSpec.from_strings("4:1,2:1", "float32,float32"),
+        )
+        filt = TensorFilter(
+            framework="scaler",
+            custom="factor:2",
+            **{"input-combination": "i0", "output-combination": "o0,i1"},
+        )
+        sink = TensorSink()
+        run_chain(src, filt, sink)
+        f = sink.frames[0]
+        assert f.num_tensors == 2
+        np.testing.assert_allclose(np.asarray(f.tensors[0]), 2.0)
+        np.testing.assert_allclose(np.asarray(f.tensors[1]), 7.0)
+
+
+class TestTeeAndQueue:
+    def test_tee_two_branches(self):
+        src = TensorSrc(dimensions="4", **{"num-frames": 5})
+        tee = Tee(name="t")
+        s1, s2 = TensorSink(name="s1"), TensorSink(name="s2")
+        q1, q2 = Queue(), Queue()
+        p = Pipeline()
+        p.chain(src, tee)
+        p.link(tee, q1).link(q1, s1)
+        p.link(tee, q2).link(q2, s2)
+        p.run(timeout=30)
+        assert s1.rendered == 5 and s2.rendered == 5
+
+    def test_queue_splits_fusion(self):
+        src = TensorSrc(dimensions="4", **{"num-frames": 2})
+        t1 = TensorTransform(mode="arithmetic", option="add:1")
+        q = Queue()
+        t2 = TensorTransform(mode="arithmetic", option="mul:3")
+        sink = TensorSink()
+        p = Pipeline().chain(src, t1, q, t2, sink)
+        plan = p.compile_plan()
+        assert all(len(seg.ops) == 1 for seg in plan.segments)
+        p.run(timeout=30)
+        np.testing.assert_allclose(np.asarray(sink.frames[0].tensors[0]), 3.0)
+        np.testing.assert_allclose(np.asarray(sink.frames[1].tensors[0]), 6.0)
+
+
+class TestNegotiationErrors:
+    def test_filter_on_media_link(self):
+        src = VideoTestSrc(width=8, height=8)
+        filt = TensorFilter(framework="passthrough")
+        p = Pipeline().chain(src, filt, FakeSink())
+        with pytest.raises(NegotiationError, match="tensor_converter"):
+            p.negotiate()
+
+    def test_unlinked_pad(self):
+        p = Pipeline()
+        p.add(TensorTransform(mode="typecast", option="uint8"))
+        with pytest.raises(NegotiationError):
+            p.negotiate()
+
+    def test_cycle_detected(self):
+        a = TensorTransform(mode="typecast", option="float32")
+        b = TensorTransform(mode="typecast", option="float32")
+        p = Pipeline().link(a, b).link(b, a)
+        with pytest.raises(NegotiationError, match="cycle"):
+            p.negotiate()
+
+
+class TestErrorPropagation:
+    def test_runtime_error_surfaces(self):
+        def boom(frame, options):
+            raise RuntimeError("decoder exploded")
+
+        from nnstreamer_tpu.elements.decoder import (
+            TensorDecoder,
+            register_custom_decoder,
+            unregister_custom_decoder,
+        )
+
+        register_custom_decoder("boom", boom)
+        try:
+            src = TensorSrc(dimensions="2", **{"num-frames": 2})
+            dec = TensorDecoder(mode="custom-code", option1="boom")
+            p = Pipeline().chain(src, dec, FakeSink())
+            with pytest.raises(RuntimeError, match="decoder exploded"):
+                p.run(timeout=30)
+        finally:
+            unregister_custom_decoder("boom")
+
+
+class TestAppSink:
+    def test_pop_api(self):
+        src = TensorSrc(dimensions="3", **{"num-frames": 3})
+        sink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.start()
+        seen = 0
+        while True:
+            f = sink.pop(timeout=30)
+            if f is None:
+                break
+            seen += 1
+        p.stop()
+        assert seen == 3
