@@ -18,3 +18,7 @@ from nnstreamer_tpu.elements import filter as filter_elem  # noqa: F401
 from nnstreamer_tpu.elements import decoder  # noqa: F401
 from nnstreamer_tpu.elements import sink  # noqa: F401
 from nnstreamer_tpu.elements import flow  # noqa: F401
+from nnstreamer_tpu.elements import routing  # noqa: F401
+from nnstreamer_tpu.elements import windowing  # noqa: F401
+from nnstreamer_tpu.elements import control  # noqa: F401
+from nnstreamer_tpu.elements import sparse_elems  # noqa: F401

@@ -1,0 +1,369 @@
+"""Control-flow elements: tensor_if (data-dependent branch), tensor_crop
+(crop by a detection stream), tensor_repo{sink,src} (feedback loops).
+
+Reference: gsttensor_if.c (compared-value/operator/actions,
+gsttensor_if.h:79-90 + custom cb include/tensor_if.h), gsttensor_crop.c
+(crop raw stream by another stream's region tensors, flexible output),
+gsttensor_repo{,sink,src}.c (slot-indexed global repository enabling
+RNN/LSTM cycles outside the pad graph).
+
+TPU note: tensor_if and crop force device→host syncs on *small* tensors
+(the condition scalar / the crop boxes) — the big payload stays device-
+resident; this matches SURVEY.md §7's guidance on data-dependent control.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import (
+    HostElement,
+    NegotiationError,
+    Routing,
+    Sink,
+    Source,
+    Spec,
+)
+from nnstreamer_tpu.tensors import data as tdata
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorSpec, TensorsSpec
+
+# ---------------------------------------------------------------------------
+# tensor_if
+
+_if_custom_lock = threading.Lock()
+_if_custom: Dict[str, Callable] = {}
+
+
+def register_if_condition(name: str, fn: Callable[[Frame], bool]) -> None:
+    """nnstreamer_if_custom_register analogue (include/tensor_if.h:30-37)."""
+    with _if_custom_lock:
+        _if_custom[name] = fn
+
+
+def unregister_if_condition(name: str) -> bool:
+    with _if_custom_lock:
+        return _if_custom.pop(name, None) is not None
+
+
+_OPERATORS = (
+    "EQ", "NE", "GT", "GE", "LT", "LE",
+    "RANGE_INCLUSIVE", "RANGE_EXCLUSIVE",
+    "NOT_IN_RANGE_INCLUSIVE", "NOT_IN_RANGE_EXCLUSIVE",
+)
+_ACTIONS = (
+    "PASSTHROUGH", "SKIP", "FILL_ZERO", "FILL_VALUES",
+    "REPEAT_PREVIOUS_FRAME", "TENSORPICK",
+)
+
+
+@registry.element("tensor_if")
+class TensorIf(HostElement):
+    """Per-frame predicate with then/else actions (single src pad; build
+    exclusive branches with two complementary tensor_if + join, as the
+    reference does).
+
+    Props: compared-value {A_VALUE, TENSOR_AVERAGE_VALUE, CUSTOM},
+    compared-value-option (A_VALUE: 'D1:D2:D3:D4,N' innermost-first coords
+    + tensor index; TENSOR_AVERAGE_VALUE: tensor index; CUSTOM: registered
+    name), operator (10 ops), supplied-value 'V' or 'V1:V2' (ranges),
+    then / then-option, else / else-option.
+    """
+
+    FACTORY_NAME = "tensor_if"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.cv = str(self.get_property("compared-value", "A_VALUE")).upper()
+        self.cv_option = str(self.get_property("compared-value-option", "0,0"))
+        self.operator = str(self.get_property("operator", "GT")).upper()
+        sv = str(self.get_property("supplied-value", "0"))
+        self.supplied = [float(x) for x in sv.split(":") if x != ""]
+        self.then_action = str(self.get_property("then", "PASSTHROUGH")).upper()
+        self.then_option = str(self.get_property("then-option", ""))
+        self.else_action = str(self.get_property("else", "SKIP")).upper()
+        self.else_option = str(self.get_property("else-option", ""))
+        if self.operator not in _OPERATORS:
+            raise ValueError(f"{self.name}: unknown operator {self.operator}")
+        for a in (self.then_action, self.else_action):
+            if a not in _ACTIONS:
+                raise ValueError(f"{self.name}: unknown action {a}")
+        self._prev: Optional[Frame] = None
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec):
+            raise NegotiationError(f"{self.name}: needs tensor input")
+        # TENSORPICK changes the output tensor list; both branches must
+        # agree on the spec, so TENSORPICK output spec = picked subset and
+        # the other branch must be SKIP (reference restriction)
+        then_a, else_a = self.then_action, self.else_action
+        if "TENSORPICK" in (then_a, else_a):
+            if then_a == "TENSORPICK" and else_a == "TENSORPICK":
+                if self.then_option != self.else_option:
+                    raise NegotiationError(
+                        f"{self.name}: then/else TENSORPICK options must match "
+                        "(both branches share one output spec)"
+                    )
+            else:
+                other = else_a if then_a == "TENSORPICK" else then_a
+                if other != "SKIP":
+                    raise NegotiationError(
+                        f"{self.name}: TENSORPICK pairs only with SKIP or an "
+                        "identical TENSORPICK"
+                    )
+            option = self.then_option if then_a == "TENSORPICK" else self.else_option
+            picks = [int(x) for x in option.split(",") if x != ""]
+            return [
+                TensorsSpec(tuple(spec[i] for i in picks), spec.format, spec.rate)
+            ]
+        return [spec]
+
+    # -- predicate ---------------------------------------------------------
+    def _compared_value(self, frame: Frame) -> float:
+        if self.cv == "A_VALUE":
+            bits = self.cv_option.split(",")
+            coords_ref = [int(x) for x in bits[0].split(":")] if bits[0] else [0]
+            nth = int(bits[1]) if len(bits) > 1 else 0
+            a = np.asarray(frame.tensors[nth])
+            coords = tuple(reversed(coords_ref))  # innermost-first → canonical
+            # pad missing leading coords with 0
+            while len(coords) < a.ndim:
+                coords = (0,) + coords
+            return float(a[coords])
+        if self.cv == "TENSOR_AVERAGE_VALUE":
+            nth = int(self.cv_option or 0)
+            return tdata.tensor_average(frame.tensors[nth])
+        if self.cv == "CUSTOM":
+            with _if_custom_lock:
+                fn = _if_custom.get(self.cv_option)
+            if fn is None:
+                raise RuntimeError(
+                    f"{self.name}: custom condition {self.cv_option!r} not registered"
+                )
+            return fn(frame)
+        raise RuntimeError(f"{self.name}: unknown compared-value {self.cv}")
+
+    def _test(self, v: float) -> bool:
+        op = self.operator
+        s = self.supplied
+        if op in ("EQ", "NE", "GT", "GE", "LT", "LE"):
+            return tdata.compare(v, op, s[0])
+        if len(s) < 2:
+            raise RuntimeError(f"{self.name}: range operator needs 'V1:V2'")
+        lo, hi = min(s[0], s[1]), max(s[0], s[1])
+        if op == "RANGE_INCLUSIVE":
+            return lo <= v <= hi
+        if op == "RANGE_EXCLUSIVE":
+            return lo < v < hi
+        if op == "NOT_IN_RANGE_INCLUSIVE":
+            return not (lo <= v <= hi)
+        if op == "NOT_IN_RANGE_EXCLUSIVE":
+            return not (lo < v < hi)
+        raise AssertionError(op)
+
+    # -- actions -----------------------------------------------------------
+    def _apply(self, frame: Frame, action: str, option: str) -> Optional[Frame]:
+        if action == "PASSTHROUGH":
+            out = frame
+        elif action == "SKIP":
+            return None
+        elif action == "FILL_ZERO":
+            out = frame.with_tensors(
+                [np.zeros_like(np.asarray(t)) for t in frame.tensors]
+            )
+        elif action == "FILL_VALUES":
+            val = float(option or 0)
+            out = frame.with_tensors(
+                [np.full_like(np.asarray(t), val) for t in frame.tensors]
+            )
+        elif action == "REPEAT_PREVIOUS_FRAME":
+            out = (
+                self._prev.with_pts(frame.pts, frame.duration)
+                if self._prev is not None
+                else frame.with_tensors(
+                    [np.zeros_like(np.asarray(t)) for t in frame.tensors]
+                )
+            )
+        elif action == "TENSORPICK":
+            picks = [int(x) for x in option.split(",") if x != ""]
+            out = frame.with_tensors([frame.tensors[i] for i in picks])
+        else:
+            raise AssertionError(action)
+        return out
+
+    def process(self, frame: Frame) -> Optional[Frame]:
+        cond = self._test(self._compared_value(frame)) if self.cv != "CUSTOM" else bool(
+            self._compared_value(frame)
+        )
+        action, option = (
+            (self.then_action, self.then_option)
+            if cond
+            else (self.else_action, self.else_option)
+        )
+        out = self._apply(frame, action, option)
+        self._prev = frame
+        return out
+
+
+# ---------------------------------------------------------------------------
+# tensor_crop
+
+@registry.element("tensor_crop")
+class TensorCrop(Routing):
+    """Crop a raw tensor stream by a region stream.
+
+    sink 0 = raw (N,H,W,C); sink 1 = regions, flexible or static tensor of
+    shape (num_objects, 4) with [x, y, w, h] per object (reference
+    gsttensor_crop.c info format). Output: format=flexible frames with one
+    cropped tensor per object. Frames pair by arrival order (the reference
+    pairs corresponding buffers the same way).
+    """
+
+    FACTORY_NAME = "tensor_crop"
+    N_SINKS = 2
+    N_SRCS = 1
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._raw: deque = deque()
+        self._info: deque = deque()
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        raw, info = in_specs
+        if not isinstance(raw, TensorsSpec) or raw.num_tensors != 1:
+            raise NegotiationError(f"{self.name}: raw input must be one tensor")
+        if raw[0].rank != 4:
+            raise NegotiationError(f"{self.name}: raw must be NHWC, got {raw[0]}")
+        return [TensorsSpec(format=TensorFormat.FLEXIBLE, rate=raw.rate)]
+
+    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+        (self._raw if pad == 0 else self._info).append(frame)
+        out = []
+        while self._raw and self._info:
+            rf = self._raw.popleft()
+            inf = self._info.popleft()
+            out.append((0, self._crop(rf, inf)))
+        return out
+
+    def _crop(self, raw: Frame, info: Frame) -> Frame:
+        img = np.asarray(raw.tensors[0])  # NHWC
+        boxes = np.asarray(info.tensors[0]).reshape(-1, 4).astype(np.int64)
+        _, h, w, _ = img.shape
+        crops = []
+        for x, y, bw, bh in boxes[:16]:  # max 16 tensors per frame
+            x0, y0 = max(0, int(x)), max(0, int(y))
+            x1, y1 = min(w, int(x) + int(bw)), min(h, int(y) + int(bh))
+            if x1 <= x0 or y1 <= y0:
+                continue
+            crops.append(img[:, y0:y1, x0:x1, :])
+        return Frame(
+            tuple(crops), pts=raw.pts, duration=raw.duration, meta=dict(raw.meta)
+        )
+
+
+# ---------------------------------------------------------------------------
+# tensor_repo: feedback loops
+
+class _RepoSlot:
+    def __init__(self) -> None:
+        self.cond = threading.Condition()
+        self.frame: Optional[Frame] = None
+        self.eos = False
+
+
+class _TensorRepo:
+    """Global slot-indexed frame repository (gsttensor_repo.c)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slots: Dict[int, _RepoSlot] = {}
+
+    def slot(self, index: int) -> _RepoSlot:
+        with self._lock:
+            if index not in self._slots:
+                self._slots[index] = _RepoSlot()
+            return self._slots[index]
+
+    def set(self, index: int, frame: Optional[Frame], eos: bool = False) -> None:
+        s = self.slot(index)
+        with s.cond:
+            if frame is not None:
+                s.frame = frame
+            if eos:
+                s.eos = True
+            s.cond.notify_all()
+
+    def get(self, index: int, timeout: float) -> Tuple[Optional[Frame], bool]:
+        s = self.slot(index)
+        with s.cond:
+            if s.frame is None and not s.eos:
+                s.cond.wait(timeout)
+            f, s.frame = s.frame, None
+            return f, s.eos
+
+    def reset(self, index: int) -> None:
+        with self._lock:
+            self._slots.pop(index, None)
+
+
+REPO = _TensorRepo()
+
+
+@registry.element("tensor_reposink")
+class TensorRepoSink(Sink):
+    """Write frames into a repo slot (slot-index=N)."""
+
+    FACTORY_NAME = "tensor_reposink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.slot_index = int(self.get_property("slot-index", 0))
+
+    def render(self, frame: Frame) -> None:
+        REPO.set(self.slot_index, frame)
+
+    def on_eos(self) -> None:
+        REPO.set(self.slot_index, None, eos=True)
+
+
+@registry.element("tensor_reposrc")
+class TensorRepoSrc(Source):
+    """Read frames from a repo slot. Emits one zero frame first when the
+    slot is empty (bootstrap for RNN-style cycles, reference reposrc dummy
+    buffer). Props: slot-index, dimensions, types."""
+
+    FACTORY_NAME = "tensor_reposrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.slot_index = int(self.get_property("slot-index", 0))
+        self.spec = TensorsSpec.from_strings(
+            str(self.get_property("dimensions", "1")),
+            str(self.get_property("types", "float32")),
+        )
+        self._bootstrapped = False
+
+    def output_spec(self) -> Spec:
+        return self.spec
+
+    def start(self) -> None:
+        self._bootstrapped = False
+
+    def generate(self):
+        if not self._bootstrapped:
+            self._bootstrapped = True
+            return Frame(
+                tuple(np.zeros(t.shape, t.dtype.np_dtype) for t in self.spec)
+            )
+        frame, eos = REPO.get(self.slot_index, timeout=0.1)
+        if frame is not None:
+            return frame
+        if eos:
+            return EOS_FRAME
+        return None  # poll again (keeps stop event responsive)

@@ -1,0 +1,67 @@
+"""tensor_sparse_enc / tensor_sparse_dec: static ↔ sparse stream format.
+
+Reference: gsttensor_sparseenc.c / gsttensor_sparsedec.c /
+gsttensor_sparseutil.c — COO wire compression for sparse tensors (header +
+nnz values + uint32 flat indices). Encode/decode run on host (it is a wire
+format for files/network, not a compute format; dense static tensors feed
+XLA), mirroring the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import HostElement, NegotiationError, Spec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.sparse import sparse_decode, sparse_encode
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+
+@registry.element("tensor_sparse_enc")
+class TensorSparseEnc(HostElement):
+    FACTORY_NAME = "tensor_sparse_enc"
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec) or spec.format is not TensorFormat.STATIC:
+            raise NegotiationError(f"{self.name}: needs static tensor input")
+        return [TensorsSpec(format=TensorFormat.SPARSE, rate=spec.rate)]
+
+    def process(self, frame: Frame) -> Frame:
+        frame = frame.to_host()
+        encoded = tuple(
+            np.frombuffer(sparse_encode(np.asarray(t)), dtype=np.uint8)
+            for t in frame.tensors
+        )
+        return frame.with_tensors(encoded)
+
+
+@registry.element("tensor_sparse_dec")
+class TensorSparseDec(HostElement):
+    FACTORY_NAME = "tensor_sparse_dec"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.dims = self.get_property("dimensions")
+        self.types = self.get_property("types", "float32")
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec) or spec.format is not TensorFormat.SPARSE:
+            raise NegotiationError(f"{self.name}: needs sparse input")
+        if self.dims:
+            out = TensorsSpec.from_strings(str(self.dims), str(self.types))
+            return [out.with_rate(spec.rate)]
+        # sparse chunks are self-describing; without declared dims the
+        # output is flexible (per-frame shapes)
+        return [TensorsSpec(format=TensorFormat.FLEXIBLE, rate=spec.rate)]
+
+    def process(self, frame: Frame) -> Frame:
+        tensors = []
+        for t in frame.tensors:
+            dense, _ = sparse_decode(np.asarray(t, dtype=np.uint8).tobytes())
+            tensors.append(dense)
+        return frame.with_tensors(tensors)

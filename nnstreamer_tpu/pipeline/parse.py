@@ -87,17 +87,7 @@ class _Builder:
         self.prev_src_pad: Optional[int] = None
         self.expect_link = False
 
-    def element_token(self, name: str, props: Dict[str, str]) -> None:
-        cls = registry.get(registry.KIND_ELEMENT, name)
-        elem_name = props.pop("name", None)
-        elem = cls(name=elem_name, **props)
-        self.pipeline.add(elem)
-        self._attach(elem, None)
-
-    def caps_token(self, token: str) -> None:
-        media, fields = _parse_caps(token)
-        elem = _make_caps_element(media, fields)
-        self.pipeline.add(elem)
+    def attach(self, elem: Element) -> None:
         self._attach(elem, None)
 
     def ref_token(self, name: str, pad_kind: Optional[str], pad: Optional[int]) -> None:
@@ -134,30 +124,28 @@ class _Builder:
         self.expect_link = True
 
 
-def parse_pipeline(description: str) -> Pipeline:
-    tokens = _tokenize(description)
-    if not tokens:
-        raise ParseError("empty pipeline description")
-    b = _Builder()
+def _scan(tokens: List[str]):
+    """Token stream → item list: ('bang',), ('ref', name, kind, pad),
+    ('caps', token), ('element', factory, props)."""
+    items = []
     i = 0
     while i < len(tokens):
         tok = tokens[i]
         if tok == "!":
-            b.bang()
+            items.append(("bang",))
             i += 1
             continue
         ref = _REF_RE.match(tok)
         if ref and "=" not in tok:
             name, kind, pad_s, pad2 = ref.groups()
             pad = int(pad_s) if pad_s is not None else (int(pad2) if pad2 else None)
-            b.ref_token(name, kind, pad)
+            items.append(("ref", name, kind, pad))
             i += 1
             continue
         if _CAPS_RE.match(tok) and "=" not in tok.split(",")[0]:
-            b.caps_token(tok)
+            items.append(("caps", tok))
             i += 1
             continue
-        # element: NAME followed by key=value props
         if not re.match(r"^[A-Za-z_][\w-]*$", tok):
             raise ParseError(f"unexpected token {tok!r}")
         props: Dict[str, str] = {}
@@ -168,8 +156,45 @@ def parse_pipeline(description: str) -> Pipeline:
                 break
             props[m.group(1)] = m.group(2)
             j += 1
-        b.element_token(tok, props)
+        items.append(("element", tok, props))
         i = j
+    return items
+
+
+def parse_pipeline(description: str) -> Pipeline:
+    tokens = _tokenize(description)
+    if not tokens:
+        raise ParseError("empty pipeline description")
+    items = _scan(tokens)
+    # pass 1: instantiate all elements so forward references ('! mux.sink_0'
+    # before 'tensor_mux name=mux' appears, gst-launch-legal) resolve
+    b = _Builder()
+    instances: List[Optional[Element]] = []
+    for item in items:
+        if item[0] == "element":
+            _, factory, props = item
+            cls = registry.get(registry.KIND_ELEMENT, factory)
+            props = dict(props)
+            elem_name = props.pop("name", None)
+            elem = cls(name=elem_name, **props)
+            b.pipeline.add(elem)
+            instances.append(elem)
+        elif item[0] == "caps":
+            media, fields = _parse_caps(item[1])
+            elem = _make_caps_element(media, fields)
+            b.pipeline.add(elem)
+            instances.append(elem)
+        else:
+            instances.append(None)
+    # pass 2: wire links
+    for item, inst in zip(items, instances):
+        if item[0] == "bang":
+            b.bang()
+        elif item[0] == "ref":
+            _, name, kind, pad = item
+            b.ref_token(name, kind, pad)
+        else:
+            b.attach(inst)
     if b.expect_link:
         raise ParseError("pipeline ends with '!'")
     return b.pipeline

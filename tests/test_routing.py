@@ -1,0 +1,332 @@
+"""Routing/sync element tests (reference: tests/nnstreamer_mux, _demux,
+_merge, _split, nnstreamer_repo*, tensor_if, tensor_rate, _sparse,
+nnstreamer_aggregator SSAT suites)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import NegotiationError
+from nnstreamer_tpu.elements.sources import AppSrc, TensorSrc
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.routing import (
+    Join,
+    SyncCombiner,
+    TensorDemux,
+    TensorMerge,
+    TensorMux,
+    TensorSplit,
+)
+from nnstreamer_tpu.elements.windowing import TensorAggregator, TensorRate
+from nnstreamer_tpu.elements.control import (
+    TensorCrop,
+    TensorIf,
+    TensorRepoSink,
+    TensorRepoSrc,
+    register_if_condition,
+    unregister_if_condition,
+)
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+def tsrc(dims, n, pattern="counter", rate=None, name=None, types="float32"):
+    props = {"num-frames": n, "pattern": pattern}
+    if rate:
+        props["framerate"] = rate
+    return TensorSrc(name=name, dimensions=dims, types=types, **props)
+
+
+class TestMux:
+    def test_two_pads(self):
+        a, b = tsrc("2", 3), tsrc("3", 3)
+        mux = TensorMux(**{"sync-mode": "nosync"})
+        sink = TensorSink()
+        p = Pipeline()
+        p.link(a, mux).link(b, mux).link(mux, sink)
+        p.run(timeout=30)
+        assert sink.rendered == 3
+        f = sink.frames[0]
+        assert f.num_tensors == 2
+        assert f.tensors[0].shape == (2,) and f.tensors[1].shape == (3,)
+
+    def test_slowest_policy_drops_fast_pad(self):
+        comb = SyncCombiner("slowest", "", 2)
+        # pad0 at 10Hz (100ms), pad1 at 20Hz (50ms)
+        f = lambda pts: Frame((np.zeros(1),), pts=pts * 1_000_000)
+        assert comb.push(1, f(0)) == []
+        assert comb.push(1, f(50)) == []
+        # base=100 but pad1's head (50) might still be bettered → waits
+        assert comb.push(0, f(100)) == []
+        # once pad1 shows a successor newer than base, 0 is dropped and 50
+        # (closest-not-newer) pairs with 100
+        groups = comb.push(1, f(150))
+        assert len(groups) == 1
+        assert [fr.pts for fr in groups[0]] == [100_000_000, 50_000_000]
+
+    def test_refresh_policy(self):
+        comb = SyncCombiner("refresh", "", 2)
+        f = lambda pts: Frame((np.zeros(1),), pts=pts)
+        assert comb.push(0, f(0)) == []
+        g = comb.push(1, f(0))
+        assert len(g) == 1
+        # new frame on pad1 only → reuses last of pad0
+        g = comb.push(1, f(10))
+        assert len(g) == 1
+
+    def test_mux_in_description(self):
+        p = parse_pipeline(
+            "tensorsrc name=s1 dimensions=2 num-frames=2 ! mux.sink_0 "
+            "tensorsrc name=s2 dimensions=2 num-frames=2 ! mux.sink_1 "
+            "tensor_mux name=mux sync-mode=nosync ! tensor_sink name=out"
+        )
+        p.run(timeout=30)
+        assert p["out"].rendered == 2
+        assert p["out"].frames[0].num_tensors == 2
+
+
+class TestDemux:
+    def test_default_split(self):
+        src = tsrc("2,3", 2, types="float32,float32")
+        demux = TensorDemux()
+        s1, s2 = TensorSink(name="d1"), TensorSink(name="d2")
+        p = Pipeline()
+        p.chain(src, demux)
+        p.link(demux, s1, src_pad=0).link(demux, s2, src_pad=1)
+        p.run(timeout=30)
+        assert s1.frames[0].tensors[0].shape == (2,)
+        assert s2.frames[0].tensors[0].shape == (3,)
+
+    def test_tensorpick_reorder_group(self):
+        src = tsrc("2,3,4", 1, types="float32,float32,float32")
+        demux = TensorDemux(tensorpick="2,0:1")
+        s1, s2 = TensorSink(), TensorSink()
+        p = Pipeline()
+        p.chain(src, demux)
+        p.link(demux, s1, src_pad=0).link(demux, s2, src_pad=1)
+        p.run(timeout=30)
+        assert s1.frames[0].tensors[0].shape == (4,)
+        assert s2.frames[0].num_tensors == 2
+
+
+class TestMergeSplit:
+    def test_merge_linear(self):
+        a, b = tsrc("2:4", 2), tsrc("2:4", 2)
+        merge = TensorMerge(mode="linear", option="1")  # ref dim 1 of 2:4
+        sink = TensorSink()
+        p = Pipeline()
+        p.link(a, merge).link(b, merge).link(merge, sink)
+        p.run(timeout=30)
+        # dims "2:4" → canonical (4,2); ref dim 1 → canonical axis 0
+        assert sink.frames[0].tensors[0].shape == (8, 2)
+
+    def test_split_roundtrip(self):
+        src = tsrc("4:2", 1)  # canonical (2,4)
+        split = TensorSplit(tensorseg="1:2,3:2")  # canonical (2,1),(2,3) split axis 1
+        s1, s2 = TensorSink(), TensorSink()
+        p = Pipeline()
+        p.chain(src, split)
+        p.link(split, s1, src_pad=0).link(split, s2, src_pad=1)
+        p.run(timeout=30)
+        assert s1.frames[0].tensors[0].shape == (2, 1)
+        assert s2.frames[0].tensors[0].shape == (2, 3)
+
+    def test_split_bad_seg(self):
+        src = tsrc("4:2", 1)
+        split = TensorSplit(tensorseg="1:2,1:2")
+        p = Pipeline()
+        p.chain(src, split)
+        p.link(split, TensorSink(), src_pad=0).link(split, TensorSink(), src_pad=1)
+        with pytest.raises(NegotiationError, match="tile"):
+            p.negotiate()
+
+
+class TestJoin:
+    def test_forwards_everything(self):
+        a, b = tsrc("2", 2), tsrc("2", 3)
+        join = Join()
+        sink = TensorSink()
+        p = Pipeline()
+        p.link(a, join).link(b, join).link(join, sink)
+        p.run(timeout=30)
+        assert sink.rendered == 5
+
+
+class TestAggregator:
+    def test_tumbling_window(self):
+        src = tsrc("3:1", 6)  # canonical (1,3)
+        agg = TensorAggregator(**{"frames-out": 3})
+        sink = TensorSink()
+        Pipeline().chain(src, agg, sink).run(timeout=30)
+        assert sink.rendered == 2
+        assert sink.frames[0].tensors[0].shape == (3, 3)
+        np.testing.assert_array_equal(
+            np.asarray(sink.frames[0].tensors[0])[:, 0], [0, 1, 2]
+        )
+
+    def test_sliding_window(self):
+        src = tsrc("1:1", 5)
+        agg = TensorAggregator(**{"frames-out": 3, "frames-flush": 1})
+        sink = TensorSink()
+        Pipeline().chain(src, agg, sink).run(timeout=30)
+        assert sink.rendered == 3  # windows [0-2],[1-3],[2-4]
+        np.testing.assert_array_equal(
+            np.asarray(sink.frames[1].tensors[0]).ravel(), [1, 2, 3]
+        )
+
+    def test_frames_dim(self):
+        src = tsrc("4:1", 4)  # canonical (1,4)
+        agg = TensorAggregator(**{"frames-out": 2, "frames-dim": "0"})
+        sink = TensorSink()
+        Pipeline().chain(src, agg, sink).run(timeout=30)
+        # ref dim 0 = innermost = canonical last axis
+        assert sink.frames[0].tensors[0].shape == (1, 8)
+
+
+class TestRate:
+    def test_downsample(self):
+        src = tsrc("1", 10, rate="10/1")
+        rate = TensorRate(framerate="5/1")
+        sink = TensorSink()
+        Pipeline().chain(src, rate, sink).run(timeout=30)
+        assert sink.rendered == 5
+        assert sink.frames[0].duration == 200_000_000
+
+    def test_upsample_duplicates(self):
+        src = tsrc("1", 4, rate="5/1")
+        rate = TensorRate(framerate="10/1")
+        sink = TensorSink()
+        Pipeline().chain(src, rate, sink).run(timeout=30)
+        assert sink.rendered == 8
+        assert rate.dup == 4
+
+
+class TestIf:
+    def test_average_value_branch(self):
+        frames = [np.full((4,), v, np.float32) for v in (1.0, 5.0, 2.0, 9.0)]
+        src = AppSrc(iterable=[(f,) for f in frames], spec=TensorsSpec.from_strings("4", "float32"))
+        tif = TensorIf(
+            **{
+                "compared-value": "TENSOR_AVERAGE_VALUE",
+                "compared-value-option": "0",
+                "operator": "GT",
+                "supplied-value": "3",
+                "then": "PASSTHROUGH",
+                "else": "SKIP",
+            }
+        )
+        sink = TensorSink()
+        Pipeline().chain(src, tif, sink).run(timeout=30)
+        assert sink.rendered == 2
+        vals = [float(np.asarray(f.tensors[0])[0]) for f in sink.frames]
+        assert vals == [5.0, 9.0]
+
+    def test_fill_zero_and_range(self):
+        frames = [np.full((2,), v, np.float32) for v in (1.0, 5.0)]
+        src = AppSrc(iterable=[(f,) for f in frames], spec=TensorsSpec.from_strings("2", "float32"))
+        tif = TensorIf(
+            **{
+                "compared-value": "A_VALUE",
+                "compared-value-option": "0,0",
+                "operator": "RANGE_INCLUSIVE",
+                "supplied-value": "0:3",
+                "then": "PASSTHROUGH",
+                "else": "FILL_ZERO",
+            }
+        )
+        sink = TensorSink()
+        Pipeline().chain(src, tif, sink).run(timeout=30)
+        np.testing.assert_array_equal(np.asarray(sink.frames[0].tensors[0]), 1.0)
+        np.testing.assert_array_equal(np.asarray(sink.frames[1].tensors[0]), 0.0)
+
+    def test_custom_condition(self):
+        register_if_condition("even_seq", lambda f: float(np.asarray(f.tensors[0])[0]) % 2 == 0)
+        try:
+            frames = [np.full((1,), v, np.float32) for v in (0, 1, 2, 3)]
+            src = AppSrc(iterable=[(f,) for f in frames], spec=TensorsSpec.from_strings("1", "float32"))
+            tif = TensorIf(
+                **{"compared-value": "CUSTOM", "compared-value-option": "even_seq"}
+            )
+            sink = TensorSink()
+            Pipeline().chain(src, tif, sink).run(timeout=30)
+            assert sink.rendered == 2
+        finally:
+            unregister_if_condition("even_seq")
+
+
+class TestCrop:
+    def test_crop_by_boxes(self):
+        img = np.arange(1 * 8 * 8 * 1, dtype=np.float32).reshape(1, 8, 8, 1)
+        boxes = np.array([[0, 0, 4, 4], [2, 2, 3, 3]], np.uint32)
+        raw = AppSrc(iterable=[(img,)], spec=TensorsSpec.from_strings("1:8:8:1", "float32"))
+        info = AppSrc(iterable=[(boxes,)], spec=TensorsSpec.from_strings("4:2", "uint32"))
+        crop = TensorCrop()
+        sink = TensorSink()
+        p = Pipeline()
+        p.link(raw, crop, dst_pad=0).link(info, crop, dst_pad=1).link(crop, sink)
+        p.run(timeout=30)
+        f = sink.frames[0]
+        assert f.num_tensors == 2
+        assert f.tensors[0].shape == (1, 4, 4, 1)
+        assert f.tensors[1].shape == (1, 3, 3, 1)
+        np.testing.assert_array_equal(np.asarray(f.tensors[0]), img[:, 0:4, 0:4, :])
+
+
+class TestRepo:
+    def test_feedback_loop(self):
+        # reposrc → scaler(add via custom-easy) → reposink closes the loop
+        from nnstreamer_tpu.backends import register_custom_easy, unregister_custom_easy
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        register_custom_easy("inc", lambda ts: tuple(np.asarray(t) + 1 for t in ts))
+        try:
+            src = TensorRepoSrc(dimensions="1", types="float32", **{"slot-index": 7})
+            filt = TensorFilter(framework="custom-easy", model="inc")
+            tee = __import__("nnstreamer_tpu.elements.flow", fromlist=["Tee"]).Tee()
+            reposink = TensorRepoSink(**{"slot-index": 7})
+            out = TensorSink(**{"max-stored": 10})
+            p = Pipeline()
+            p.chain(src, filt, tee)
+            p.link(tee, reposink)
+            p.link(tee, out)
+            p.start()
+            import time
+
+            deadline = time.monotonic() + 15
+            while out.rendered < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            p.stop()
+            vals = [int(np.asarray(f.tensors[0])[0]) for f in out.frames[:5]]
+            # state threads through the loop: strictly consecutive increments
+            assert len(vals) >= 2
+            assert all(b - a == 1 for a, b in zip(vals, vals[1:]))
+        finally:
+            unregister_custom_easy("inc")
+            from nnstreamer_tpu.elements.control import REPO
+
+            REPO.reset(7)
+
+
+class TestSparseElements:
+    def test_enc_dec_roundtrip(self):
+        data = np.zeros((4, 4), np.float32)
+        data[1, 2] = 7.0
+        src = AppSrc(iterable=[(data,)], spec=TensorsSpec.from_strings("4:4", "float32"))
+        p = Pipeline()
+        from nnstreamer_tpu.elements.sparse_elems import TensorSparseDec, TensorSparseEnc
+
+        enc, dec, sink = TensorSparseEnc(), TensorSparseDec(), TensorSink()
+        p.chain(src, enc, dec, sink)
+        p.run(timeout=30)
+        np.testing.assert_array_equal(np.asarray(sink.frames[0].tensors[0]), data)
+
+    def test_enc_compresses(self):
+        data = np.zeros((64, 64), np.float32)
+        data[0, 0] = 1
+        src = AppSrc(iterable=[(data,)], spec=TensorsSpec.from_strings("64:64", "float32"))
+        from nnstreamer_tpu.elements.sparse_elems import TensorSparseEnc
+
+        enc, sink = TensorSparseEnc(), TensorSink()
+        Pipeline().chain(src, enc, sink).run(timeout=30)
+        assert sink.frames[0].tensors[0].nbytes < data.nbytes
