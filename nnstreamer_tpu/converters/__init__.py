@@ -6,4 +6,7 @@ tensor_converter mode=NAME. Built-ins: flexbuf (see wire codec in
 tensors/meta.py used directly by the edge layer).
 """
 
+from nnstreamer_tpu.converters import flatbuf  # noqa: F401,E402
 from nnstreamer_tpu.converters import flexbuf  # noqa: F401,E402
+from nnstreamer_tpu.converters import protobuf  # noqa: F401,E402
+from nnstreamer_tpu.converters import python_script  # noqa: F401,E402

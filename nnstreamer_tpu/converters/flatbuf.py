@@ -1,0 +1,146 @@
+"""flatbuf converter subplugin + the flatbuffer tensor-frame codec.
+
+Reference: ext/nnstreamer/tensor_converter/tensor_converter_flatbuf.cc with
+the nnstreamer.fbs schema (Tensors{num_tensor, fr, tensor[], format},
+Tensor{name, type, dimension, data}). The image has no ``flatc``, so the
+codec is written directly against the flatbuffers runtime Builder/Table API
+with the same table layout (slot order + enum values as the reference
+schema), keeping the wire format interoperable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorFormat, TensorsSpec
+
+# enum Tensor_type (nnstreamer.fbs order); NNS_END = 10 is the default slot
+FB_TO_DTYPE = {
+    0: DType.INT32, 1: DType.UINT32, 2: DType.INT16, 3: DType.UINT16,
+    4: DType.INT8, 5: DType.UINT8, 6: DType.FLOAT64, 7: DType.FLOAT32,
+    8: DType.INT64, 9: DType.UINT64,
+}
+DTYPE_TO_FB = {v: k for k, v in FB_TO_DTYPE.items()}
+FB_TYPE_END = 10
+
+_FORMAT_TO_FB = {
+    TensorFormat.STATIC: 0, TensorFormat.FLEXIBLE: 1, TensorFormat.SPARSE: 2
+}
+
+
+def encode_flatbuf(
+    tensors: Sequence[np.ndarray],
+    rate: Optional[Tuple[int, int]] = None,
+    fmt: TensorFormat = TensorFormat.STATIC,
+) -> bytes:
+    import flatbuffers
+
+    b = flatbuffers.Builder(1024)
+    tensor_offs = []
+    for arr in tensors:
+        arr = np.ascontiguousarray(np.asarray(arr))
+        dtype = DType.from_any(arr.dtype)
+        if dtype not in DTYPE_TO_FB:
+            raise ValueError(f"flatbuf: dtype {dtype} not representable")
+        data_off = b.CreateByteVector(arr.tobytes())
+        # dimension: innermost-first uint32s, reference convention
+        dims = list(reversed(arr.shape))
+        b.StartVector(4, len(dims), 4)
+        for d in reversed(dims):
+            b.PrependUint32(int(d))
+        dim_off = b.EndVector()
+        name_off = b.CreateString("")
+        b.StartObject(4)
+        b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+        b.PrependInt32Slot(1, DTYPE_TO_FB[dtype], FB_TYPE_END)
+        b.PrependUOffsetTRelativeSlot(2, dim_off, 0)
+        b.PrependUOffsetTRelativeSlot(3, data_off, 0)
+        tensor_offs.append(b.EndObject())
+    b.StartVector(4, len(tensor_offs), 4)
+    for off in reversed(tensor_offs):
+        b.PrependUOffsetTRelative(off)
+    vec_off = b.EndVector()
+    b.StartObject(4)
+    b.PrependInt32Slot(0, len(tensor_offs), 0)
+    rn, rd = rate if rate else (0, 0)
+    # inline struct frame_rate{rate_n, rate_d}
+    b.Prep(4, 8)
+    b.PrependInt32(int(rd))
+    b.PrependInt32(int(rn))
+    b.PrependStructSlot(1, b.Offset(), 0)
+    b.PrependUOffsetTRelativeSlot(2, vec_off, 0)
+    b.PrependInt32Slot(3, _FORMAT_TO_FB[fmt], 0)
+    b.Finish(b.EndObject())
+    return bytes(b.Output())
+
+
+def decode_flatbuf(data: bytes):
+    """→ (tensors tuple, (rate_n, rate_d))."""
+    import flatbuffers
+    from flatbuffers import encode as fb_encode
+    from flatbuffers import number_types as NT
+    from flatbuffers.table import Table
+
+    buf = bytearray(data)
+    root = fb_encode.Get(NT.UOffsetTFlags.packer_type, buf, 0)
+    tab = Table(buf, root)
+
+    rate = (0, 0)
+    o = tab.Offset(6)  # fr struct, slot 1
+    if o:
+        pos = o + tab.Pos
+        rate = (
+            fb_encode.Get(NT.Int32Flags.packer_type, buf, pos),
+            fb_encode.Get(NT.Int32Flags.packer_type, buf, pos + 4),
+        )
+    tensors = []
+    o = tab.Offset(8)  # tensor vector, slot 2
+    if o:
+        n = tab.VectorLen(o)
+        base = tab.Vector(o)
+        for j in range(n):
+            t = Table(buf, tab.Indirect(base + j * 4))
+            to = t.Offset(6)
+            ftype = (
+                t.Get(NT.Int32Flags, to + t.Pos) if to else FB_TYPE_END
+            )
+            dtype = FB_TO_DTYPE.get(int(ftype), DType.UINT8)
+            dims = []
+            do = t.Offset(8)
+            if do:
+                dbase = t.Vector(do)
+                for k in range(t.VectorLen(do)):
+                    dims.append(t.Get(NT.Uint32Flags, dbase + k * 4))
+            vo = t.Offset(10)
+            raw = b""
+            if vo:
+                vbase = t.Vector(vo)
+                raw = bytes(buf[vbase : vbase + t.VectorLen(vo)])
+            arr = np.frombuffer(raw, dtype=dtype.np_dtype)
+            shape = tuple(reversed([int(d) for d in dims]))
+            if shape and int(np.prod(shape)) == arr.size:
+                arr = arr.reshape(shape)
+            tensors.append(arr)
+    return tuple(tensors), rate
+
+
+@registry.converter_plugin("flatbuf")
+class FlatbufConverter:
+    def negotiate(self, in_spec, props: dict) -> TensorsSpec:
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def convert(self, frame: Frame, props: dict) -> Frame:
+        from fractions import Fraction
+
+        data = np.asarray(frame.tensors[0], dtype=np.uint8).tobytes()
+        tensors, (rn, rd) = decode_flatbuf(data)
+        if not tensors:
+            raise ValueError("flatbuf: empty Tensors frame")
+        out = frame.with_tensors(tensors)
+        if rn and rd:  # stream cadence survives the serialize hop
+            out = out.with_meta(rate=Fraction(rn, rd))
+        return out

@@ -1,0 +1,80 @@
+"""protobuf converter subplugin: serialized Tensors message → tensors.
+
+Reference: ext/nnstreamer/tensor_converter/tensor_converter_protobuf.cc with
+the nnstreamer.proto schema — our schema (proto/nns_tensors.proto) is
+wire-compatible (same field numbers/enum values).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorFormat, TensorsSpec
+
+# enum value ↔ dtype (proto Tensor_type, mirroring the reference's order)
+PB_TO_DTYPE = {
+    0: DType.INT32, 1: DType.UINT32, 2: DType.INT16, 3: DType.UINT16,
+    4: DType.INT8, 5: DType.UINT8, 6: DType.FLOAT64, 7: DType.FLOAT32,
+    8: DType.INT64, 9: DType.UINT64, 10: DType.FLOAT16, 11: DType.BFLOAT16,
+}
+DTYPE_TO_PB = {v: k for k, v in PB_TO_DTYPE.items()}
+
+
+def frame_to_message(
+    frame: Frame, fmt: TensorFormat = TensorFormat.STATIC, rate=None
+):
+    from nnstreamer_tpu.proto import nns_tensors_pb2 as pb
+
+    msg = pb.Tensors()
+    msg.num_tensor = frame.num_tensors
+    rate = rate or frame.meta.get("rate")
+    if rate:
+        msg.fr.rate_n = rate.numerator
+        msg.fr.rate_d = rate.denominator
+    msg.format = {
+        TensorFormat.STATIC: 0, TensorFormat.FLEXIBLE: 1, TensorFormat.SPARSE: 2
+    }[fmt]
+    for i, t in enumerate(frame.tensors):
+        arr = np.asarray(t)
+        entry = msg.tensor.add()
+        entry.name = str(frame.meta.get("names", {}).get(i, ""))
+        entry.type = DTYPE_TO_PB[DType.from_any(arr.dtype)]
+        # reference dimension order: innermost-first uint32s
+        entry.dimension.extend(int(d) for d in reversed(arr.shape))
+        entry.data = np.ascontiguousarray(arr).tobytes()
+    return msg
+
+
+def message_to_tensors(msg) -> tuple:
+    out = []
+    for entry in msg.tensor:
+        dtype = PB_TO_DTYPE.get(entry.type, DType.UINT8)
+        shape = tuple(reversed([int(d) for d in entry.dimension]))
+        arr = np.frombuffer(entry.data, dtype=dtype.np_dtype)
+        if shape and int(np.prod(shape)) == arr.size:
+            arr = arr.reshape(shape)
+        out.append(arr)
+    return tuple(out)
+
+
+@registry.converter_plugin("protobuf")
+class ProtobufConverter:
+    def negotiate(self, in_spec, props: dict) -> TensorsSpec:
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def convert(self, frame: Frame, props: dict) -> Frame:
+        from fractions import Fraction
+
+        from nnstreamer_tpu.proto import nns_tensors_pb2 as pb
+
+        data = np.asarray(frame.tensors[0], dtype=np.uint8).tobytes()
+        msg = pb.Tensors.FromString(data)
+        tensors = message_to_tensors(msg)
+        if not tensors:
+            raise ValueError("protobuf: empty Tensors message")
+        out = frame.with_tensors(tensors)
+        if msg.fr.rate_n and msg.fr.rate_d:  # cadence survives the hop
+            out = out.with_meta(rate=Fraction(msg.fr.rate_n, msg.fr.rate_d))
+        return out

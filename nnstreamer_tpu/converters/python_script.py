@@ -1,0 +1,42 @@
+"""python3 converter subplugin: user script converts media → tensors.
+
+Reference: ext/nnstreamer/tensor_converter/tensor_converter_python3.cc —
+the script defines ``CustomConverter`` with ``convert(tensors) -> tensors``
+and optionally ``negotiate(in_spec) -> TensorsSpec``. Script path comes
+from the element's ``script`` (or ``option1``) property.
+"""
+
+from __future__ import annotations
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.script import load_script_object
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+
+@registry.converter_plugin("python3")
+class PythonScriptConverter:
+    def __init__(self) -> None:
+        self._obj = None
+
+    def _load(self, props: dict):
+        if self._obj is None:
+            path = props.get("script") or props.get("option1")
+            if not path:
+                raise ValueError("python3 converter: script=/path/to.py required")
+            self._obj = load_script_object(
+                path, ("CustomConverter", "converter_class")
+            )
+            if not hasattr(self._obj, "convert"):
+                raise ValueError("python3 converter: script has no convert()")
+        return self._obj
+
+    def negotiate(self, in_spec, props: dict) -> TensorsSpec:
+        obj = self._load(props)
+        if hasattr(obj, "negotiate"):
+            return obj.negotiate(in_spec)
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def convert(self, frame: Frame, props: dict) -> Frame:
+        out = self._load(props).convert(frame.tensors)
+        return frame.with_tensors(tuple(out))

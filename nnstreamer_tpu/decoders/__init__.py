@@ -5,6 +5,13 @@ Importing registers the built-ins. Protocol:
     decode(frame: Frame, options: dict) -> Frame
 """
 
+from nnstreamer_tpu.decoders import bounding_box  # noqa: F401
 from nnstreamer_tpu.decoders import direct_video  # noqa: F401
-from nnstreamer_tpu.decoders import image_labeling  # noqa: F401
+from nnstreamer_tpu.decoders import flatbuf  # noqa: F401
 from nnstreamer_tpu.decoders import flexbuf  # noqa: F401
+from nnstreamer_tpu.decoders import image_labeling  # noqa: F401
+from nnstreamer_tpu.decoders import image_segment  # noqa: F401
+from nnstreamer_tpu.decoders import octet_stream  # noqa: F401
+from nnstreamer_tpu.decoders import pose  # noqa: F401
+from nnstreamer_tpu.decoders import protobuf  # noqa: F401
+from nnstreamer_tpu.decoders import python_script  # noqa: F401

@@ -1,0 +1,229 @@
+"""bounding_box decoder: detection tensors → RGBA overlay video.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c (1771 LoC).
+Modes (option1, tensordec-boundingbox.c:143-186):
+  - ``mobilenet-ssd``          (priors file + scales, logit threshold)
+  - ``mobilenet-ssd-postprocess`` (model-side NMS, 4 tensors + tensor map)
+  - ``ov-person-detection`` / ``ov-face-detection`` ([N,7] descriptors)
+  - ``yolov5``                 ([N, 5+C], scaled or raw)
+  - ``mp-palm-detection``      (anchors generated from option3 scheme)
+Options (same scheme as the reference :30-58):
+  option1=mode, option2=labels file, option3=mode-specific,
+  option4=WIDTH:HEIGHT video output size, option5=WIDTH:HEIGHT model input.
+
+TPU-first split: thresholding/decode/NMS are jitted device ops
+(ops/detection.py) producing a fixed [max,6] detections tensor; only the
+RGBA rasterization runs on host. The detections tensor also rides in
+``frame.meta["detections"]`` so downstream elements (tensor_crop, query
+serialization) can consume structured results without re-parsing pixels —
+the reference has no such structured path (it only emits pixels).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.decoders import render
+from nnstreamer_tpu.elements.base import MediaSpec, NegotiationError
+from nnstreamer_tpu.ops import detection as det
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_MODES = (
+    "mobilenet-ssd",
+    "mobilenet-ssd-postprocess",
+    "ov-person-detection",
+    "ov-face-detection",
+    "yolov5",
+    "mp-palm-detection",
+    # backward-compat aliases (reference OLDNAME_/deprecated modes :150-155)
+    "tflite-ssd",
+    "tf-ssd",
+)
+_ALIASES = {"tflite-ssd": "mobilenet-ssd", "tf-ssd": "mobilenet-ssd-postprocess"}
+
+
+def load_box_priors(path: str) -> np.ndarray:
+    """Reference box-priors.txt: 4 lines (ycenter, xcenter, h, w) × N values
+    (tensordec-boundingbox.c:195,box_priors load)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            vals = [float(v) for v in line.replace(",", " ").split()]
+            if vals:
+                rows.append(vals)
+    if len(rows) < 4:
+        raise ValueError(f"box priors file needs 4 rows, got {len(rows)}: {path}")
+    n = min(len(r) for r in rows[:4])
+    return np.asarray([r[:n] for r in rows[:4]], np.float32)
+
+
+@registry.decoder_plugin("bounding_boxes")
+class BoundingBoxDecoder:
+    def __init__(self) -> None:
+        self._mode = "mobilenet-ssd"
+        self._labels: Optional[List[str]] = None
+        self._priors: Optional[np.ndarray] = None
+        self._anchors: Optional[np.ndarray] = None
+        self._params: dict = {}
+        self._out_wh = (640, 480)
+        self._in_wh = (300, 300)
+        self._tensor_map = (0, 1, 2, 3)
+        self._pp_threshold = det.SSD_THRESHOLD
+
+    # -- option parsing (reference scheme, option3 per mode :39-80) -------
+    def _parse_options(self, options: dict) -> None:
+        mode = options.get("option1", self._mode) or "mobilenet-ssd"
+        mode = _ALIASES.get(mode, mode)
+        if mode not in _MODES:
+            raise NegotiationError(f"bounding_box: unknown mode {mode!r}")
+        self._mode = mode
+        labels_path = options.get("option2", "")
+        if labels_path:
+            self._labels = render.load_labels(labels_path)
+        if options.get("option4"):
+            self._out_wh = render.parse_wh(options["option4"], "bounding_box option4")
+        if options.get("option5"):
+            self._in_wh = render.parse_wh(options["option5"], "bounding_box option5")
+        opt3 = options.get("option3", "")
+        if mode == "mobilenet-ssd":
+            parts = (opt3 or "").split(":")
+            if not parts or not parts[0]:
+                raise NegotiationError(
+                    "bounding_box: mobilenet-ssd needs option3=box-priors-file[:...]"
+                )
+            self._priors = load_box_priors(parts[0])
+            defaults = [det.SSD_THRESHOLD, det.SSD_Y_SCALE, det.SSD_X_SCALE,
+                        det.SSD_H_SCALE, det.SSD_W_SCALE, det.SSD_IOU_THRESHOLD]
+            vals = []
+            for i, d in enumerate(defaults):
+                p = parts[i + 1] if i + 1 < len(parts) else ""
+                vals.append(float(p) if p else d)
+            self._params = dict(
+                threshold=vals[0], y_scale=vals[1], x_scale=vals[2],
+                h_scale=vals[3], w_scale=vals[4], iou_threshold=vals[5],
+            )
+        elif mode == "mobilenet-ssd-postprocess":
+            # "%i:%i:%i:%i,%i" — tensor index map + threshold percent (:60-67)
+            if opt3:
+                head, _, thr = opt3.partition(",")
+                idx = [int(v) for v in head.split(":") if v != ""]
+                if len(idx) == 4:
+                    self._tensor_map = tuple(idx)
+                if thr:
+                    self._pp_threshold = int(thr) / 100.0
+        elif mode == "mp-palm-detection":
+            parts = [p for p in (opt3 or "").split(":")]
+            score = float(parts[0]) if parts and parts[0] else 0.5
+            num_layers = int(parts[1]) if len(parts) > 1 and parts[1] else 4
+            min_scale = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
+            max_scale = float(parts[3]) if len(parts) > 3 and parts[3] else 1.0
+            x_off = float(parts[4]) if len(parts) > 4 and parts[4] else 0.5
+            y_off = float(parts[5]) if len(parts) > 5 and parts[5] else 0.5
+            strides = [int(v) for v in parts[6:] if v] or [8, 16, 16, 16]
+            self._params = dict(score_threshold=score)
+            try:
+                self._anchors = det.generate_mp_palm_anchors(
+                    num_layers, min_scale, max_scale, x_off, y_off,
+                    tuple(strides), input_size=self._in_wh[0],
+                )
+            except ValueError as exc:
+                raise NegotiationError(f"bounding_box: {exc}") from exc
+        elif mode == "yolov5":
+            # Reference yolov5 has no option3 and expects normalized [0,1]
+            # coords (tensordec-boundingbox.c:1675 multiplies by i_width).
+            # Extension: option3=CONF[:IOU[:pixel]] — "pixel" marks models
+            # emitting pixel-unit coords (normalized by option5 size here).
+            parts = (opt3 or "").split(":")
+            self._params = dict(
+                conf_threshold=float(parts[0]) if parts and parts[0]
+                else det.YOLOV5_CONF_THRESHOLD,
+                iou_threshold=float(parts[1]) if len(parts) > 1 and parts[1]
+                else det.YOLOV5_IOU_THRESHOLD,
+                pixel_coords=len(parts) > 2 and parts[2] == "pixel",
+            )
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        self._parse_options(options)
+        mode = self._mode
+        n = in_spec.num_tensors
+        need = {
+            "mobilenet-ssd": 2, "mobilenet-ssd-postprocess": 4,
+            "ov-person-detection": 1, "ov-face-detection": 1,
+            "yolov5": 1, "mp-palm-detection": 2,
+        }[mode]
+        if n != need:
+            raise NegotiationError(
+                f"bounding_box[{mode}]: expected {need} tensors, got {n}"
+            )
+        w, h = self._out_wh
+        return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    # -- per-frame decode --------------------------------------------------
+    def _detections(self, frame: Frame) -> np.ndarray:
+        mode = self._mode
+        ts = [np.squeeze(np.asarray(t)) for t in frame.tensors]
+        if mode == "mobilenet-ssd":
+            loc, scores = ts[0], ts[1]
+            if not (loc.ndim == 2 and loc.shape[-1] == 4):
+                loc, scores = scores, loc  # tensors may arrive either order
+            p = self._params
+            loc = loc.reshape(-1, 4)
+            return np.asarray(det.ssd_postprocess(
+                loc, scores.reshape(loc.shape[0], -1),
+                self._priors,
+                threshold=p["threshold"], iou_threshold=p["iou_threshold"],
+                y_scale=p["y_scale"], x_scale=p["x_scale"],
+                h_scale=p["h_scale"], w_scale=p["w_scale"],
+            ))
+        if mode == "mobilenet-ssd-postprocess":
+            m = self._tensor_map
+            loc = np.asarray(ts[m[0]], np.float32).reshape(-1, 4)
+            cls = np.asarray(ts[m[1]], np.float32).reshape(-1)
+            sco = np.asarray(ts[m[2]], np.float32).reshape(-1)
+            num = np.asarray(ts[m[3]], np.float32).reshape(-1)[0]
+            return np.asarray(det.ssd_pp_postprocess(
+                loc, cls, sco, num, threshold=self._pp_threshold
+            ))
+        if mode in ("ov-person-detection", "ov-face-detection"):
+            return np.asarray(det.ov_detection_postprocess(ts[0].reshape(-1, 7)))
+        if mode == "yolov5":
+            pred = ts[0].reshape(-1, ts[0].shape[-1]).astype(np.float32)
+            p = self._params
+            if p["pixel_coords"]:  # normalize pixel-unit outputs first
+                iw, ih = self._in_wh
+                pred = pred.copy()
+                pred[:, 0] /= iw
+                pred[:, 1] /= ih
+                pred[:, 2] /= iw
+                pred[:, 3] /= ih
+            return np.asarray(det.yolov5_postprocess(
+                pred, conf_threshold=p["conf_threshold"],
+                iou_threshold=p["iou_threshold"], scaled=True,
+            ))
+        if mode == "mp-palm-detection":
+            boxes = ts[0].reshape(-1, ts[0].shape[-1])
+            scores = ts[1].reshape(-1)
+            return np.asarray(det.mp_palm_postprocess(
+                boxes, scores, self._anchors,
+                score_threshold=self._params["score_threshold"],
+                input_size=self._in_wh[0],
+            ))
+        raise NegotiationError(f"bounding_box: unhandled mode {mode}")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        d = self._detections(frame)
+        w, h = self._out_wh
+        canvas = render.render_detections(d, w, h, self._labels)
+        valid = d[d[:, 5] > 0]
+        return frame.with_tensors((canvas,)).with_meta(
+            media_type="video", detections=valid
+        )
+
+
+# Reference registers this decoder under mode name "bounding_boxes"; keep a
+# hyphenless alias for pipeline-string convenience.
+registry.register(registry.KIND_DECODER, "bounding-boxes", BoundingBoxDecoder)

@@ -1,0 +1,36 @@
+"""flatbuf decoder subplugin: tensors → serialized flatbuffer Tensors.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-flatbuf.cc. Inverse of
+converters/flatbuf.py (shared codec there).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.converters.flatbuf import encode_flatbuf
+from nnstreamer_tpu.elements.base import MediaSpec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("flatbuf")
+class FlatbufDecoder:
+    def __init__(self) -> None:
+        self._rate = None
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        self._rate = in_spec.rate  # stream rate rides in the wire header
+        return MediaSpec("octet")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        frame = frame.to_host()
+        rate = frame.meta.get("rate") or self._rate
+        blob = encode_flatbuf(
+            frame.tensors,
+            rate=(rate.numerator, rate.denominator) if rate else None,
+        )
+        return frame.with_tensors(
+            (np.frombuffer(blob, dtype=np.uint8),)
+        ).with_meta(media_type="octet")

@@ -22,10 +22,7 @@ from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
 
 
-def load_labels(path: str) -> List[str]:
-    """One label per line (reference tensordecutil.c loadImageLabels)."""
-    with open(path) as f:
-        return [line.strip() for line in f if line.strip()]
+from nnstreamer_tpu.decoders.render import load_labels  # shared loader
 
 
 @registry.decoder_plugin("image_labeling")

@@ -1,0 +1,72 @@
+"""image_segment decoder: per-pixel class maps → RGBA overlay.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-imagesegment.c (660 LoC).
+Modes (option1, :118-122): ``tflite-deeplab`` ([1,H,W,C] scores → argmax),
+``snpe-deeplab`` ([H,W] already-argmaxed label map), ``snpe-depth``
+([H,W] float depth → grayscale). The argmax/normalize runs jitted on device
+(ops/heatmap.py); palette application is host egress.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.decoders import render
+from nnstreamer_tpu.elements.base import MediaSpec, NegotiationError
+from nnstreamer_tpu.ops import heatmap as hm
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_MODES = ("tflite-deeplab", "snpe-deeplab", "snpe-depth")
+_DEFAULT_LABELS = 21  # Pascal-VOC classes of deeplab-v3 (reference :95)
+
+
+@registry.decoder_plugin("image_segment")
+class ImageSegmentDecoder:
+    def __init__(self) -> None:
+        self._mode = "tflite-deeplab"
+        self._num_labels = _DEFAULT_LABELS
+        self._wh = None
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        mode = options.get("option1", self._mode) or "tflite-deeplab"
+        if mode not in _MODES:
+            raise NegotiationError(f"image_segment: unknown mode {mode!r}")
+        self._mode = mode
+        if options.get("option2"):
+            self._num_labels = int(options["option2"])
+        if in_spec.num_tensors != 1:
+            raise NegotiationError("image_segment: exactly one tensor expected")
+        shape = [d for d in in_spec[0].shape if d != 1]
+        if mode == "tflite-deeplab":
+            if len(shape) != 3:
+                raise NegotiationError(
+                    f"image_segment[tflite-deeplab]: need [H,W,C], got {in_spec[0]}"
+                )
+            h, w, c = shape
+            self._num_labels = c
+        else:
+            if len(shape) != 2:
+                raise NegotiationError(
+                    f"image_segment[{mode}]: need [H,W], got {in_spec[0]}"
+                )
+            h, w = shape
+        self._wh = (w, h)
+        return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        t = frame.tensors[0]
+        arr = np.squeeze(np.asarray(t))
+        if self._mode == "snpe-depth":
+            gray = np.asarray(hm.depth_normalize(arr))
+            rgba = np.stack(
+                [gray, gray, gray, np.full_like(gray, 255)], axis=-1
+            )
+            labels = gray
+        else:
+            labels = np.asarray(hm.segment_argmax(arr, num_labels=self._num_labels))
+            rgba = render.render_segmentation(labels, self._num_labels)
+        return frame.with_tensors((rgba,)).with_meta(
+            media_type="video", label_map=labels
+        )

@@ -1,0 +1,27 @@
+"""octet_stream decoder: tensors → raw application/octet-stream bytes.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-octetstream.c (130 LoC):
+concatenates the raw bytes of all tensors in order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import MediaSpec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("octet_stream")
+class OctetStreamDecoder:
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        return MediaSpec("octet")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        frame = frame.to_host()
+        blob = b"".join(np.ascontiguousarray(t).tobytes() for t in frame.tensors)
+        return frame.with_tensors(
+            (np.frombuffer(blob, dtype=np.uint8),)
+        ).with_meta(media_type="octet")

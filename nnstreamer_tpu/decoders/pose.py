@@ -1,0 +1,112 @@
+"""pose_estimation decoder: heatmaps → keypoints + RGBA skeleton overlay.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-pose.c (824 LoC).
+Options (same scheme, :29-60):
+  option1=WIDTH:HEIGHT video output size
+  option2=WIDTH:HEIGHT model input size
+  option3=labels file ("<name> <connected-id>..." per keypoint line)
+  option4=mode: ``heatmap-only`` (default) | ``heatmap-offset``
+
+Input: 1 tensor [1,H,W,K] score maps (heatmap-only) or 2 tensors adding
+[1,H,W,2K] offsets (posenet convention). The grid argmax + offset gather are
+jitted device ops (ops/heatmap.py); skeleton rasterization is host egress.
+Keypoints also ride in ``frame.meta["keypoints"]`` as [K,3] (x,y,score) in
+output-pixel units.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.decoders import render
+from nnstreamer_tpu.elements.base import MediaSpec, NegotiationError
+from nnstreamer_tpu.ops import heatmap as hm
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+def load_pose_labels(path: str) -> Tuple[List[str], List[List[int]]]:
+    """"<label> <id> <id>..." per line → (names, connection lists)
+    (tensordec-pose.c:31-56 syntax)."""
+    names, conns = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            names.append(parts[0])
+            conns.append([int(p) for p in parts[1:]])
+    return names, conns
+
+
+@registry.decoder_plugin("pose_estimation")
+class PoseDecoder:
+    def __init__(self) -> None:
+        self._out_wh = (640, 480)
+        self._in_wh = (257, 257)
+        self._names: Optional[List[str]] = None
+        self._conns: Optional[List[List[int]]] = None
+        self._offset_mode = False
+        self._score_threshold = 0.3
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        if options.get("option1"):
+            self._out_wh = render.parse_wh(options["option1"], "pose option1")
+        if options.get("option2"):
+            self._in_wh = render.parse_wh(options["option2"], "pose option2")
+        if options.get("option3"):
+            self._names, self._conns = load_pose_labels(options["option3"])
+        mode = options.get("option4", "heatmap-only") or "heatmap-only"
+        if mode not in ("heatmap-only", "heatmap-offset"):
+            raise NegotiationError(f"pose_estimation: unknown option4 {mode!r}")
+        self._offset_mode = mode == "heatmap-offset"
+        need = 2 if self._offset_mode else 1
+        if in_spec.num_tensors != need:
+            raise NegotiationError(
+                f"pose_estimation[{mode}]: expected {need} tensors, got "
+                f"{in_spec.num_tensors}"
+            )
+        w, h = self._out_wh
+        return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        heat = np.asarray(frame.tensors[0])
+        grid = heat.reshape(heat.shape[-3:])  # drop leading batch dims
+        gh, gw, k = grid.shape
+        ow, oh = self._out_wh
+        if self._offset_mode:
+            o = np.asarray(frame.tensors[1])
+            offs = o.reshape(o.shape[-3:])
+            raw = np.asarray(hm.pose_keypoints_with_offsets(grid, offs))
+            # posenet: pos = grid_idx/(grid-1)*(input-1) + offset (pixels in
+            # model-input units), then scale to output size
+            iw, ih = self._in_wh
+            x_in = raw[:, 0] / max(gw - 1, 1) * (iw - 1) + raw[:, 3]
+            y_in = raw[:, 1] / max(gh - 1, 1) * (ih - 1) + raw[:, 4]
+            xs = x_in / iw * ow
+            ys = y_in / ih * oh
+            score = raw[:, 2]
+        else:
+            raw = np.asarray(hm.pose_keypoints_from_heatmap(grid))
+            xs = raw[:, 0] / max(gw - 1, 1) * ow
+            ys = raw[:, 1] / max(gh - 1, 1) * oh
+            score = raw[:, 2]
+        kpts = np.stack([xs, ys, score], axis=-1).astype(np.float32)
+
+        canvas = render.new_canvas(ow, oh)
+        ok = score >= self._score_threshold
+        for i in range(k):
+            if not ok[i]:
+                continue
+            render.draw_point(canvas, xs[i], ys[i])
+            if self._names and i < len(self._names):
+                render.draw_text(canvas, self._names[i], xs[i] + 3, ys[i] + 3)
+            for j in (self._conns[i] if self._conns and i < len(self._conns) else ()):
+                if 0 <= j < k and ok[j]:
+                    render.draw_line(canvas, xs[i], ys[i], xs[j], ys[j])
+        return frame.with_tensors((canvas,)).with_meta(
+            media_type="video", keypoints=kpts
+        )

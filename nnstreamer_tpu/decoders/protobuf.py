@@ -1,0 +1,32 @@
+"""protobuf decoder subplugin: tensors → serialized Tensors message.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-protobuf.cc. Inverse of
+converters/protobuf.py; output is one uint8 tensor holding the message.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.converters.protobuf import frame_to_message
+from nnstreamer_tpu.elements.base import MediaSpec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("protobuf")
+class ProtobufDecoder:
+    def __init__(self) -> None:
+        self._rate = None
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        self._rate = in_spec.rate  # stream rate rides in the wire header
+        return MediaSpec("octet")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        frame = frame.to_host()
+        blob = frame_to_message(frame, rate=self._rate).SerializeToString()
+        return frame.with_tensors(
+            (np.frombuffer(blob, dtype=np.uint8),)
+        ).with_meta(media_type="octet")

@@ -1,0 +1,43 @@
+"""python3 decoder subplugin: user script decodes tensors → media/tensors.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-python3.cc — the script
+defines ``CustomDecoder`` with ``decode(tensors) -> tensors`` and
+optionally ``negotiate(in_spec, options) -> MediaSpec|TensorsSpec``.
+Script path comes from ``option1``.
+"""
+
+from __future__ import annotations
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import MediaSpec
+from nnstreamer_tpu.script import load_script_object
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("python3")
+class PythonScriptDecoder:
+    def __init__(self) -> None:
+        self._obj = None
+
+    def _load(self, options: dict):
+        if self._obj is None:
+            path = options.get("script") or options.get("option1")
+            if not path:
+                raise ValueError("python3 decoder: option1=/path/to.py required")
+            self._obj = load_script_object(
+                path, ("CustomDecoder", "decoder_class")
+            )
+            if not hasattr(self._obj, "decode"):
+                raise ValueError("python3 decoder: script has no decode()")
+        return self._obj
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict):
+        obj = self._load(options)
+        if hasattr(obj, "negotiate"):
+            return obj.negotiate(in_spec, options)
+        return MediaSpec("octet")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        out = self._load(options).decode(frame.tensors)
+        return frame.with_tensors(tuple(out))

@@ -135,6 +135,16 @@ class TensorIf(HostElement):
             # pad missing leading coords with 0
             while len(coords) < a.ndim:
                 coords = (0,) + coords
+            if len(coords) > a.ndim:
+                # reference pipelines always pass 4 coords (fixed uint32[4]
+                # dims); excess *leading* (outermost) coords address the
+                # padded 1-sized dims — valid only when 0
+                extra, coords = coords[: len(coords) - a.ndim], coords[-a.ndim:]
+                if any(c != 0 for c in extra):
+                    raise RuntimeError(
+                        f"{self.name}: compared-value-option coords "
+                        f"{coords_ref} out of range for rank-{a.ndim} tensor"
+                    )
             return float(a[coords])
         if self.cv == "TENSOR_AVERAGE_VALUE":
             nth = int(self.cv_option or 0)
@@ -207,7 +217,11 @@ class TensorIf(HostElement):
             else (self.else_action, self.else_option)
         )
         out = self._apply(frame, action, option)
-        self._prev = frame
+        # Reference semantics (gsttensor_if.h): REPEAT_PREVIOUS_FRAME resends
+        # the previous *output* frame, so remember what was emitted, not what
+        # arrived.
+        if out is not None:
+            self._prev = out
         return out
 
 

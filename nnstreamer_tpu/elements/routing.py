@@ -100,7 +100,15 @@ class SyncCombiner:
             while len(q) > 1 and q[1].pts is not None and q[1].pts <= base_ts:
                 q.popleft()
             head = q[0]
-            if head.pts is not None and head.pts < base_ts and len(q) <= 1:
+            # basepad's DURATION option widens the match window: a head
+            # within [base_ts - slack, base_ts] pairs immediately instead of
+            # waiting for a closer frame (reference
+            # gst_tensor_time_sync_buffer duration-window matching).
+            if (
+                head.pts is not None
+                and head.pts < base_ts - self.base_slack
+                and len(q) <= 1
+            ):
                 # not enough data to know if a closer frame is coming
                 return None
         # phase 2: all pads viable — pop the group atomically

@@ -32,7 +32,9 @@ class TensorAggregator(HostElement):
     default 1), frames-out (frames per outgoing buffer), frames-flush
     (window advance, default frames-out → tumbling; < frames-out →
     overlapping sliding window), frames-dim (reference innermost-first dim
-    index to concat along), concat (false → stack without concat checking).
+    index to concat along), concat (reference gsttensor_aggregator.c:221-226;
+    false → don't merge along frames-dim, stack the window on a new leading
+    axis instead).
     """
 
     FACTORY_NAME = "tensor_aggregator"
@@ -43,6 +45,9 @@ class TensorAggregator(HostElement):
         self.frames_out = int(self.get_property("frames-out", 1))
         self.frames_flush = int(self.get_property("frames-flush", 0)) or self.frames_out
         self.ref_dim = self.get_property("frames-dim")
+        self.concat = str(self.get_property("concat", "true")).lower() not in (
+            "false", "0", "no",
+        )
         if self.frames_in <= 0 or self.frames_out <= 0 or self.frames_flush <= 0:
             raise ValueError(f"{self.name}: frames-* must be positive")
         self._window: List[Frame] = []
@@ -73,8 +78,11 @@ class TensorAggregator(HostElement):
         for t in spec:
             if t.rank != rank:
                 raise NegotiationError(f"{self.name}: mixed ranks unsupported")
-            shape = list(t.shape)
-            shape[self._axis] = shape[self._axis] * factor
+            if self.concat:
+                shape = list(t.shape)
+                shape[self._axis] = shape[self._axis] * factor
+            else:
+                shape = [factor] + list(t.shape)
             outs.append(TensorSpec(tuple(shape), t.dtype))
         rate = spec.rate * Fraction(self.frames_in, self.frames_flush) if spec.rate else None
         return [TensorsSpec(tuple(outs), spec.format, rate)]
@@ -89,8 +97,11 @@ class TensorAggregator(HostElement):
         group = self._window[:need]
         tensors = []
         for ti in range(group[0].num_tensors):
+            parts = [f.tensors[ti] for f in group]
             tensors.append(
-                jnp.concatenate([f.tensors[ti] for f in group], axis=self._axis)
+                jnp.concatenate(parts, axis=self._axis)
+                if self.concat
+                else jnp.stack(parts, axis=0)
             )
         first = group[0]
         out = Frame(

@@ -1,0 +1,70 @@
+"""Jittable heatmap post-processing: pose keypoints + segmentation argmax.
+
+TPU-native counterparts of the reference's pose decoder keypoint scan
+(ext/nnstreamer/tensor_decoder/tensordec-pose.c, modes heatmap-only /
+heatmap-offset) and the image-segment decoder's per-pixel argmax
+(ext/nnstreamer/tensor_decoder/tensordec-imagesegment.c, tflite-deeplab).
+The reference walks the heatmap grid per keypoint in C; here the reductions
+are single XLA ops that can fuse with the model's last layers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pose_keypoints_from_heatmap(heatmap: jax.Array) -> jax.Array:
+    """heatmap-only mode: [H, W, K] score maps → [K, 3] (x, y, score) in
+    heatmap-grid units. Scores pass through sigmoid as in the reference
+    (posenet emits logits)."""
+    h, w, k = heatmap.shape
+    hm = heatmap.astype(jnp.float32).reshape(h * w, k)
+    idx = jnp.argmax(hm, axis=0)
+    score = jax.nn.sigmoid(jnp.max(hm, axis=0))
+    y = (idx // w).astype(jnp.float32)
+    x = (idx % w).astype(jnp.float32)
+    return jnp.stack([x, y, score], axis=-1)
+
+
+@jax.jit
+def pose_keypoints_with_offsets(
+    heatmap: jax.Array, offsets: jax.Array
+) -> jax.Array:
+    """heatmap-offset mode: refine grid argmax with the offset tensor
+    [H, W, 2K] (first K channels = y offsets, last K = x offsets, posenet
+    convention). Returns [K, 3] (x, y, score) in *input-pixel* units
+    assuming stride = (input-1)/(grid-1), which the caller applies; here we
+    return grid coords + fractional offsets in grid units scaled by the
+    caller."""
+    h, w, k = heatmap.shape
+    base = pose_keypoints_from_heatmap(heatmap)
+    ys = base[:, 1].astype(jnp.int32)
+    xs = base[:, 0].astype(jnp.int32)
+    koff = jnp.arange(k)
+    off_y = offsets.astype(jnp.float32)[ys, xs, koff]
+    off_x = offsets.astype(jnp.float32)[ys, xs, koff + k]
+    return jnp.stack([base[:, 0], base[:, 1], base[:, 2], off_x, off_y], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_labels",))
+def segment_argmax(seg: jax.Array, num_labels: int = 21) -> jax.Array:
+    """tflite-deeplab: [H, W, C] class scores → [H, W] uint8 label map.
+    A [H, W] map (already argmaxed, snpe-deeplab mode) passes through."""
+    s = seg
+    if s.ndim == 3 and s.shape[-1] > 1:
+        return jnp.argmax(s.astype(jnp.float32), axis=-1).astype(jnp.uint8)
+    return s.reshape(s.shape[0], s.shape[1]).astype(jnp.uint8)
+
+
+@jax.jit
+def depth_normalize(depth: jax.Array) -> jax.Array:
+    """snpe-depth: [H, W] float depth → uint8 grayscale via min-max
+    normalization (reference MODE_SNPE_DEPTH rendering)."""
+    d = depth.astype(jnp.float32).reshape(depth.shape[0], depth.shape[1])
+    lo = jnp.min(d)
+    hi = jnp.max(d)
+    return ((d - lo) / jnp.maximum(hi - lo, 1e-9) * 255.0).astype(jnp.uint8)

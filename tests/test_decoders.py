@@ -1,0 +1,325 @@
+"""Decoder subplugin tests (reference: tests/nnstreamer_decoder*,
+nnstreamer_decoder_boundingbox, _pose, _image_segment SSAT suites +
+unittest_plugins.cc decoder cases)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import NegotiationError
+from nnstreamer_tpu.ops import detection as det
+from nnstreamer_tpu.ops import heatmap as hm
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+def _dec(name):
+    cls = registry.get(registry.KIND_DECODER, name)
+    return cls()
+
+
+# ---------------------------------------------------------------- ops level
+def test_nms_suppresses_overlaps():
+    boxes = np.array(
+        [[0.0, 0.0, 0.5, 0.5], [0.01, 0.01, 0.51, 0.51], [0.6, 0.6, 0.9, 0.9]],
+        np.float32,
+    )
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    idx, kept = det.nms(boxes, scores, iou_threshold=0.5, max_out=3)
+    idx = np.asarray(idx)
+    assert idx[0] == 0  # best kept
+    assert 1 not in idx.tolist()  # overlap suppressed
+    assert 2 in idx.tolist()  # disjoint kept
+
+
+def test_nms_keeps_all_below_iou():
+    boxes = np.array([[0, 0, 0.1, 0.1], [0.5, 0.5, 0.6, 0.6]], np.float32)
+    scores = np.array([0.5, 0.9], np.float32)
+    idx, kept = det.nms(boxes, scores, 0.5, max_out=4)
+    assert sorted(i for i in np.asarray(idx).tolist() if i >= 0) == [0, 1]
+    assert np.asarray(kept)[0] == pytest.approx(0.9)  # ranked by score
+
+
+def test_ssd_decode_boxes_identity_prior():
+    # zero offsets → box equals the prior
+    priors = np.array([[0.5], [0.5], [0.2], [0.4]], np.float32)  # yc,xc,h,w
+    loc = np.zeros((1, 4), np.float32)
+    out = np.asarray(det.ssd_decode_boxes(loc, priors))
+    np.testing.assert_allclose(out[0], [0.3, 0.4, 0.7, 0.6], atol=1e-6)
+
+
+def test_pose_heatmap_argmax():
+    heat = np.full((9, 9, 2), -5.0, np.float32)
+    heat[3, 4, 0] = 5.0
+    heat[7, 1, 1] = 5.0
+    kp = np.asarray(hm.pose_keypoints_from_heatmap(heat))
+    assert (kp[0, 0], kp[0, 1]) == (4, 3)
+    assert (kp[1, 0], kp[1, 1]) == (1, 7)
+    assert kp[0, 2] > 0.9  # sigmoid(5)
+
+
+def test_segment_argmax_and_depth():
+    seg = np.zeros((4, 4, 3), np.float32)
+    seg[..., 1] = 1.0
+    lab = np.asarray(hm.segment_argmax(seg, num_labels=3))
+    assert lab.dtype == np.uint8 and (lab == 1).all()
+    depth = np.linspace(0, 1, 16, dtype=np.float32).reshape(4, 4)
+    gray = np.asarray(hm.depth_normalize(depth))
+    assert gray[0, 0] == 0 and gray[-1, -1] == 255
+
+
+# -------------------------------------------------------------- bounding box
+def _priors_file(tmp_path, n=16):
+    yc = np.linspace(0.1, 0.9, n)
+    xc = np.linspace(0.1, 0.9, n)
+    rows = [yc, xc, np.full(n, 0.2), np.full(n, 0.2)]
+    p = tmp_path / "box-priors.txt"
+    p.write_text("\n".join(" ".join(f"{v:.6f}" for v in r) for r in rows))
+    return str(p), np.asarray(rows, np.float32)
+
+
+def test_bbox_mobilenet_ssd(tmp_path):
+    path, priors = _priors_file(tmp_path)
+    n = priors.shape[1]
+    labels = tmp_path / "labels.txt"
+    labels.write_text("background\ncat\ndog\n")
+    d = _dec("bounding_boxes")
+    spec = TensorsSpec.from_strings(f"4:{n}:1,3:{n}:1", "float32,float32")
+    opts = {
+        "option1": "mobilenet-ssd",
+        "option2": str(labels),
+        "option3": f"{path}:0.5",
+        "option4": "64:64",
+        "option5": "300:300",
+    }
+    media = d.negotiate(spec, opts)
+    assert (media.width, media.height, media.format) == (64, 64, "RGBA")
+    # one hot detection at prior 5, class 1 ("cat")
+    loc = np.zeros((n, 4), np.float32)
+    scores = np.full((n, 3), -10.0, np.float32)
+    scores[5, 1] = 8.0
+    out = d.decode(Frame((loc, scores)), opts)
+    dets = out.meta["detections"]
+    assert dets.shape[0] == 1
+    assert int(dets[0, 4]) == 1 and dets[0, 5] > 0.9
+    assert out.tensors[0].shape == (64, 64, 4)
+    assert out.tensors[0].any()  # something was drawn
+
+
+def test_bbox_ssd_postprocess():
+    d = _dec("bounding_boxes")
+    spec = TensorsSpec.from_strings("4:10:1,10:1,10:1,1:1")
+    opts = {"option1": "mobilenet-ssd-postprocess", "option3": "0:1:2:3,50",
+            "option4": "32:32"}
+    d.negotiate(spec, opts)
+    loc = np.zeros((10, 4), np.float32)
+    loc[0] = [0.1, 0.2, 0.5, 0.6]  # ymin,xmin,ymax,xmax
+    cls = np.zeros(10, np.float32)
+    sco = np.zeros(10, np.float32)
+    sco[0] = 0.9
+    out = d.decode(Frame((loc, cls, sco, np.array([1.0], np.float32))), opts)
+    dets = out.meta["detections"]
+    assert dets.shape[0] == 1
+    np.testing.assert_allclose(dets[0, :4], [0.2, 0.1, 0.6, 0.5], atol=1e-6)
+
+
+def test_bbox_yolov5_normalized_default():
+    # reference convention: coords already normalized [0,1]
+    d = _dec("bounding_boxes")
+    n, c = 12, 7  # 2 classes
+    spec = TensorsSpec.from_strings(f"{c}:{n}:1")
+    opts = {"option1": "yolov5", "option4": "32:32", "option5": "320:320"}
+    d.negotiate(spec, opts)
+    pred = np.zeros((n, c), np.float32)
+    pred[3] = [0.5, 0.5, 0.2, 0.2, 0.99, 0.1, 0.95]  # class 1
+    out = d.decode(Frame((pred,)), opts)
+    dets = out.meta["detections"]
+    assert dets.shape[0] == 1
+    assert int(dets[0, 4]) == 1
+    np.testing.assert_allclose(dets[0, :4], [0.4, 0.4, 0.6, 0.6], atol=1e-3)
+
+
+def test_bbox_yolov5_pixel_mode():
+    d = _dec("bounding_boxes")
+    n, c = 12, 7
+    spec = TensorsSpec.from_strings(f"{c}:{n}:1")
+    opts = {"option1": "yolov5", "option3": "0.3:0.6:pixel",
+            "option4": "32:32", "option5": "320:320"}
+    d.negotiate(spec, opts)
+    pred = np.zeros((n, c), np.float32)
+    pred[3] = [160, 160, 64, 64, 0.99, 0.1, 0.95]  # pixel coords
+    out = d.decode(Frame((pred,)), opts)
+    dets = out.meta["detections"]
+    assert dets.shape[0] == 1
+    np.testing.assert_allclose(dets[0, :4], [0.4, 0.4, 0.6, 0.6], atol=1e-3)
+
+
+def test_bbox_ov_person():
+    d = _dec("bounding_boxes")
+    spec = TensorsSpec.from_strings("7:8:1:1")
+    opts = {"option1": "ov-person-detection", "option4": "32:32"}
+    d.negotiate(spec, opts)
+    pred = np.zeros((8, 7), np.float32)
+    pred[2] = [0, 1, 0.95, 0.1, 0.1, 0.4, 0.5]
+    out = d.decode(Frame((pred,)), opts)
+    dets = out.meta["detections"]
+    assert dets.shape[0] == 1 and dets[0, 5] == pytest.approx(0.95)
+
+
+def test_bbox_mp_palm_anchors():
+    a = det.generate_mp_palm_anchors(input_size=64, strides=(8, 16, 16, 16))
+    assert a.shape[1] == 4
+    assert ((a >= 0) & (a <= 1)).all()
+
+
+def test_bbox_bad_mode():
+    d = _dec("bounding_boxes")
+    with pytest.raises(NegotiationError):
+        d.negotiate(TensorsSpec.from_strings("4:4:1"), {"option1": "nope"})
+
+
+def test_bbox_tensor_count_mismatch():
+    d = _dec("bounding_boxes")
+    with pytest.raises(NegotiationError):
+        d.negotiate(
+            TensorsSpec.from_strings("4:4:1"),
+            {"option1": "mobilenet-ssd-postprocess"},
+        )
+
+
+# ---------------------------------------------------------------------- pose
+def test_pose_decoder(tmp_path):
+    lab = tmp_path / "pose.txt"
+    lab.write_text("nose 1\nleftEye 0\n")
+    d = _dec("pose_estimation")
+    spec = TensorsSpec.from_strings("2:9:9:1")
+    opts = {"option1": "64:48", "option2": "257:257", "option3": str(lab)}
+    media = d.negotiate(spec, opts)
+    assert (media.width, media.height) == (64, 48)
+    heat = np.full((1, 9, 9, 2), -5.0, np.float32)
+    heat[0, 4, 4, 0] = 5.0
+    heat[0, 2, 6, 1] = 5.0
+    out = d.decode(Frame((heat,)), opts)
+    kp = out.meta["keypoints"]
+    assert kp.shape == (2, 3)
+    assert kp[0, 0] == pytest.approx(4 / 8 * 64)
+    assert kp[0, 1] == pytest.approx(4 / 8 * 48)
+    assert out.tensors[0].shape == (48, 64, 4)
+
+
+def test_pose_offset_mode():
+    d = _dec("pose_estimation")
+    spec = TensorsSpec.from_strings("1:9:9:1,2:9:9:1")
+    opts = {"option1": "90:90", "option2": "90:90", "option4": "heatmap-offset"}
+    d.negotiate(spec, opts)
+    heat = np.full((1, 9, 9, 1), -5.0, np.float32)
+    heat[0, 4, 4, 0] = 5.0
+    offs = np.zeros((1, 9, 9, 2), np.float32)
+    offs[0, 4, 4, 0] = 2.0  # y offset px
+    offs[0, 4, 4, 1] = 3.0  # x offset px
+    out = d.decode(Frame((heat, offs)), opts)
+    kp = out.meta["keypoints"]
+    # grid 4/8 * 89 + offset
+    assert kp[0, 0] == pytest.approx((4 / 8 * 89 + 3), rel=1e-3)
+    assert kp[0, 1] == pytest.approx((4 / 8 * 89 + 2), rel=1e-3)
+
+
+# ------------------------------------------------------------- image segment
+def test_image_segment_deeplab():
+    d = _dec("image_segment")
+    spec = TensorsSpec.from_strings("21:16:16:1")
+    opts = {"option1": "tflite-deeplab"}
+    media = d.negotiate(spec, opts)
+    assert (media.width, media.height) == (16, 16)
+    seg = np.zeros((1, 16, 16, 21), np.float32)
+    seg[0, :8, :, 15] = 9.0  # top half = class 15
+    out = d.decode(Frame((seg,)), opts)
+    lab = out.meta["label_map"]
+    assert (lab[:8] == 15).all() and (lab[8:] == 0).all()
+    rgba = out.tensors[0]
+    assert rgba.shape == (16, 16, 4)
+    assert (rgba[:8, :, 3] == 255).all() and (rgba[8:, :, 3] == 0).all()
+
+
+def test_image_segment_snpe_depth():
+    d = _dec("image_segment")
+    spec = TensorsSpec.from_strings("8:8", types="float32")
+    opts = {"option1": "snpe-depth"}
+    d.negotiate(spec, opts)
+    depth = np.linspace(0, 2, 64, dtype=np.float32).reshape(8, 8)
+    out = d.decode(Frame((depth,)), opts)
+    assert out.tensors[0][0, 0, 0] == 0 and out.tensors[0][-1, -1, 0] == 255
+
+
+# --------------------------------------------------------- byte-stream codecs
+def test_octet_stream_decoder():
+    d = _dec("octet_stream")
+    a = np.arange(4, dtype=np.uint8)
+    b = np.arange(2, dtype=np.float32)
+    out = d.decode(Frame((a, b)), {})
+    assert out.tensors[0].tobytes() == a.tobytes() + b.tobytes()
+
+
+def _roundtrip(dec_name, conv_name, tensors):
+    d = _dec(dec_name)
+    blob_frame = d.decode(Frame(tuple(tensors)), {})
+    conv = registry.get(registry.KIND_CONVERTER, conv_name)()
+    back = conv.convert(Frame((blob_frame.tensors[0],)), {})
+    assert len(back.tensors) == len(tensors)
+    for orig, got in zip(tensors, back.tensors):
+        assert got.dtype == orig.dtype
+        np.testing.assert_array_equal(np.asarray(got), orig)
+
+
+def test_protobuf_roundtrip():
+    _roundtrip(
+        "protobuf", "protobuf",
+        [np.arange(12, dtype=np.float32).reshape(3, 4),
+         np.arange(6, dtype=np.uint8).reshape(2, 3)],
+    )
+
+
+def test_flatbuf_roundtrip():
+    _roundtrip(
+        "flatbuf", "flatbuf",
+        [np.arange(12, dtype=np.int16).reshape(4, 3),
+         np.linspace(0, 1, 5).astype(np.float64)],
+    )
+
+
+def test_decoder_inventory_complete():
+    """Every decoder subplugin the reference ships has a counterpart
+    (SURVEY.md §2.3 decoder list)."""
+    have = set(registry.available(registry.KIND_DECODER))
+    for name in (
+        "bounding_boxes", "direct_video", "flatbuf", "flexbuf",
+        "image_labeling", "image_segment", "octet_stream",
+        "pose_estimation", "protobuf",
+    ):
+        assert name in have, name
+
+
+def test_flatbuf_carries_stream_rate():
+    from fractions import Fraction
+
+    d = _dec("flatbuf")
+    spec = TensorsSpec.from_strings("4:1", "float32").with_rate(Fraction(30, 1))
+    d.negotiate(spec, {})
+    out = d.decode(Frame((np.zeros(4, np.float32),)), {})
+    from nnstreamer_tpu.converters.flatbuf import decode_flatbuf
+
+    _, rate = decode_flatbuf(out.tensors[0].tobytes())
+    assert rate == (30, 1)
+
+
+def test_protobuf_carries_stream_rate():
+    from fractions import Fraction
+
+    from nnstreamer_tpu.proto import nns_tensors_pb2 as pb
+
+    d = _dec("protobuf")
+    spec = TensorsSpec.from_strings("4:1", "float32").with_rate(Fraction(25, 1))
+    d.negotiate(spec, {})
+    out = d.decode(Frame((np.zeros(4, np.float32),)), {})
+    msg = pb.Tensors.FromString(out.tensors[0].tobytes())
+    assert (msg.fr.rate_n, msg.fr.rate_d) == (25, 1)

@@ -330,3 +330,56 @@ class TestSparseElements:
         enc, sink = TensorSparseEnc(), TensorSink()
         Pipeline().chain(src, enc, sink).run(timeout=30)
         assert sink.frames[0].tensors[0].nbytes < data.nbytes
+
+
+def test_tensor_if_repeats_previous_output_not_input():
+    """REPEAT_PREVIOUS_FRAME resends the previous *output* frame
+    (gsttensor_if.h action semantics)."""
+    elem = TensorIf(
+        "if0",
+        **{
+            "compared-value": "A_VALUE",
+            "compared-value-option": "0:0:0:0,0",
+            "operator": "GE",
+            "supplied-value": "10",
+            "then": "PASSTHROUGH",
+            "else": "REPEAT_PREVIOUS_FRAME",
+        },
+    )
+    a = Frame((np.full((1, 1, 1, 1), 20.0, np.float32),))  # passes
+    b = Frame((np.full((1, 1, 1, 1), 5.0, np.float32),))  # fails → repeat A
+    c = Frame((np.full((1, 1, 1, 1), 1.0, np.float32),))  # fails → repeat A
+    out_a = elem.process(a)
+    out_b = elem.process(b)
+    out_c = elem.process(c)
+    assert float(np.asarray(out_a.tensors[0]).ravel()[0]) == 20.0
+    assert float(np.asarray(out_b.tensors[0]).ravel()[0]) == 20.0
+    # C must re-emit the last *output* (A), not the failed input B
+    assert float(np.asarray(out_c.tensors[0]).ravel()[0]) == 20.0
+
+
+def test_aggregator_concat_false_stacks():
+    agg = TensorAggregator("agg0", **{"frames-out": 3, "concat": "false"})
+    spec = TensorsSpec.from_strings("4:2:1", "float32")
+    (out_spec,) = agg.negotiate([spec])
+    assert out_spec[0].shape == (3, 1, 2, 4)
+    outs = []
+    for i in range(3):
+        r = agg.process(Frame((np.full((1, 2, 4), float(i), np.float32),)))
+        if r is not None:
+            outs.append(r)
+    assert len(outs) == 1
+    assert outs[0].tensors[0].shape == (3, 1, 2, 4)
+    assert float(np.asarray(outs[0].tensors[0])[2, 0, 0, 0]) == 2.0
+
+
+def test_basepad_slack_window():
+    """basepad's DURATION option pairs frames within the slack window
+    instead of waiting (synchronization-policies-at-mux-merge.md)."""
+    comb = SyncCombiner("basepad", "0:10", 2)
+    base = Frame((np.zeros(1, np.float32),), pts=100)
+    near = Frame((np.zeros(1, np.float32),), pts=95)  # within slack 10
+    comb.push(1, near)
+    groups = comb.push(0, base)
+    assert len(groups) == 1
+    assert groups[0][0].pts == 100 and groups[0][1].pts == 95

@@ -1,0 +1,48 @@
+"""Python-script subplugin tests (reference: python converter/decoder/filter
+tests with scripts under tests/test_models/models/*.py)."""
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.tensors.frame import Frame
+
+
+CONVERTER_SCRIPT = """
+import numpy as np
+
+class CustomConverter:
+    def convert(self, tensors):
+        # raw bytes -> two uint8 tensors split in half
+        data = np.asarray(tensors[0], np.uint8).reshape(-1)
+        h = data.size // 2
+        return (data[:h], data[h:])
+"""
+
+DECODER_SCRIPT = """
+import numpy as np
+
+class CustomDecoder:
+    def decode(self, tensors):
+        return (np.concatenate([np.asarray(t).reshape(-1) for t in tensors]),)
+"""
+
+
+def test_python_script_converter(tmp_path):
+    p = tmp_path / "conv.py"
+    p.write_text(CONVERTER_SCRIPT)
+    conv = registry.get(registry.KIND_CONVERTER, "python3")()
+    props = {"script": str(p)}
+    out = conv.convert(Frame((np.arange(10, dtype=np.uint8),)), props)
+    assert out.num_tensors == 2
+    np.testing.assert_array_equal(out.tensors[0], np.arange(5, dtype=np.uint8))
+
+
+def test_python_script_decoder(tmp_path):
+    p = tmp_path / "dec.py"
+    p.write_text(DECODER_SCRIPT)
+    dec = registry.get(registry.KIND_DECODER, "python3")()
+    opts = {"option1": str(p)}
+    out = dec.decode(
+        Frame((np.ones(3, np.float32), np.zeros(2, np.float32))), opts
+    )
+    assert out.tensors[0].shape == (5,)
