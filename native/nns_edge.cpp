@@ -1,0 +1,302 @@
+// TCP tensor transport — the native core of the distributed edge layer.
+//
+// Role model: the external nnstreamer-edge C library the reference's
+// tensor_query/edge elements call (nns_edge_create_handle/start/connect/
+// send + event callbacks; see SURVEY.md §2.4/§5.8). Like the reference's,
+// this is plain native code with no framework dependency: a handle is
+// either a listening server (many clients, demultiplexed by client id) or
+// a connected client, moving opaque length-prefixed blobs. Framing:
+//
+//     uint64_le payload_length | payload bytes
+//
+// The payload is the framework's flexible-tensor wire encoding plus a
+// small frame header, both applied by the Python layer — the native layer
+// is deliberately payload-agnostic.
+//
+// Threading: one acceptor thread per server, one reader thread per
+// connection; received messages land in a mutex+condvar queue drained by
+// nns_edge_recv (the Python side runs its event callbacks off that).
+//
+// C ABI (ctypes-friendly):
+//   nns_edge_create/listen/connect/get_port/send/recv/free_buf/close
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Msg {
+  uint64_t client_id;
+  std::vector<uint8_t> data;
+};
+
+// Read exactly n bytes; false on EOF/error.
+bool read_exact(int fd, void *buf, size_t n) {
+  auto *p = static_cast<uint8_t *>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void *buf, size_t n) {
+  auto *p = static_cast<const uint8_t *>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Handle {
+  std::atomic<bool> running{false};
+  std::atomic<int> recv_inflight{0};  // close() waits for these to drain
+  bool is_server = false;
+  int listen_fd = -1;
+  int bound_port = 0;
+
+  std::thread acceptor;
+  std::mutex conn_mu;  // guards conns + next_id + conn threads vector
+  std::map<uint64_t, int> conns;  // client_id -> fd
+  std::vector<std::thread> readers;
+  uint64_t next_id = 1;
+
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<Msg> queue;
+  size_t max_queue = 4096;  // backpressure bound, reference edge queues are
+                            // bounded the same way (drop-oldest)
+
+  std::mutex send_mu;
+
+  void enqueue(uint64_t id, std::vector<uint8_t> &&data) {
+    std::lock_guard<std::mutex> lk(q_mu);
+    if (queue.size() >= max_queue) queue.pop_front();
+    queue.push_back(Msg{id, std::move(data)});
+    q_cv.notify_one();
+  }
+
+  void reader_loop(uint64_t id, int fd) {
+    for (;;) {
+      uint64_t len_le = 0;
+      if (!read_exact(fd, &len_le, sizeof(len_le))) break;
+      uint64_t len = le64toh(len_le);
+      if (len > (1ull << 33)) break;  // 8 GiB sanity cap
+      std::vector<uint8_t> data(len);
+      if (len > 0 && !read_exact(fd, data.data(), len)) break;
+      enqueue(id, std::move(data));
+    }
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      auto it = conns.find(id);
+      if (it != conns.end()) {
+        ::close(it->second);
+        conns.erase(it);
+      }
+    }
+    // empty message signals connection-closed to the event layer
+    if (running.load()) enqueue(id, std::vector<uint8_t>());
+  }
+
+  void acceptor_loop() {
+    while (running.load()) {
+      sockaddr_in peer {};
+      socklen_t plen = sizeof(peer);
+      int fd = ::accept(listen_fd, reinterpret_cast<sockaddr *>(&peer), &plen);
+      if (fd < 0) {
+        if (!running.load()) break;
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint64_t id;
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        id = next_id++;
+        conns[id] = fd;
+        readers.emplace_back(&Handle::reader_loop, this, id, fd);
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Handle *nns_edge_create() { return new Handle(); }
+
+// Bind + listen; port 0 = ephemeral. Returns 0 on success.
+int nns_edge_listen(Handle *h, const char *host, int port) {
+  h->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (h->listen_fd < 0) return -1;
+  auto fail = [h](int rc) {  // error paths must not leak the fd
+    ::close(h->listen_fd);
+    h->listen_fd = -1;
+    return rc;
+  };
+  int one = 1;
+  setsockopt(h->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr {};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return fail(-2);
+  if (::bind(h->listen_fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)))
+    return fail(-3);
+  socklen_t alen = sizeof(addr);
+  getsockname(h->listen_fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  h->bound_port = ntohs(addr.sin_port);
+  if (::listen(h->listen_fd, 64)) return fail(-4);
+  h->is_server = true;
+  h->running.store(true);
+  h->acceptor = std::thread(&Handle::acceptor_loop, h);
+  return 0;
+}
+
+int nns_edge_get_port(Handle *h) { return h->bound_port; }
+
+// Connect to a server. Returns 0 on success.
+int nns_edge_connect(Handle *h, const char *host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr {};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -2;
+  if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)))
+    return -3;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  h->running.store(true);
+  {
+    std::lock_guard<std::mutex> lk(h->conn_mu);
+    h->conns[0] = fd;  // client side: single connection, id 0
+    h->readers.emplace_back(&Handle::reader_loop, h, 0, fd);
+  }
+  return 0;
+}
+
+// Send a blob. Server: client_id selects the destination connection
+// (client_id 0 broadcasts best-effort to every connected client — the
+// pub/sub path; a dead subscriber is skipped, its reader thread prunes
+// the connection). Client: client_id is ignored. Returns 0 on success.
+int nns_edge_send(Handle *h, uint64_t client_id, const uint8_t *data,
+                  uint64_t len) {
+  bool broadcast = h->is_server && client_id == 0;
+  std::vector<int> fds;
+  {
+    std::lock_guard<std::mutex> lk(h->conn_mu);
+    if (broadcast) {
+      for (auto &kv : h->conns) fds.push_back(kv.second);
+    } else {
+      uint64_t key = h->is_server ? client_id : 0;
+      auto it = h->conns.find(key);
+      if (it == h->conns.end()) return -1;
+      fds.push_back(it->second);
+    }
+  }
+  uint64_t len_le = htole64(len);
+  std::lock_guard<std::mutex> lk(h->send_mu);
+  int rc = 0;
+  for (int fd : fds) {
+    if (!write_all(fd, &len_le, sizeof(len_le)) ||
+        (len > 0 && !write_all(fd, data, len))) {
+      if (!broadcast) rc = -2;
+    }
+  }
+  return rc;
+}
+
+// Number of currently connected peers.
+int nns_edge_peer_count(Handle *h) {
+  std::lock_guard<std::mutex> lk(h->conn_mu);
+  return static_cast<int>(h->conns.size());
+}
+
+// Dequeue the next message, waiting up to timeout_ms (<0 = forever).
+// On success returns byte length (>= 0), fills *client_id and *out with a
+// malloc'd buffer the caller releases via nns_edge_free_buf. Returns -1 on
+// timeout. A 0-length message with *out == nullptr is a connection-closed
+// event for that client.
+int64_t nns_edge_recv(Handle *h, uint64_t *client_id, uint8_t **out,
+                      int timeout_ms) {
+  struct InflightGuard {  // close() waits for in-flight recv to finish
+    std::atomic<int> &c;
+    explicit InflightGuard(std::atomic<int> &c_) : c(c_) { ++c; }
+    ~InflightGuard() { --c; }
+  } guard(h->recv_inflight);
+  std::unique_lock<std::mutex> lk(h->q_mu);
+  auto ready = [h] { return !h->queue.empty() || !h->running.load(); };
+  if (timeout_ms < 0) {
+    h->q_cv.wait(lk, ready);
+  } else if (!h->q_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                               ready)) {
+    return -1;
+  }
+  if (h->queue.empty()) return -1;
+  Msg m = std::move(h->queue.front());
+  h->queue.pop_front();
+  lk.unlock();
+  *client_id = m.client_id;
+  if (m.data.empty()) {
+    *out = nullptr;
+    return 0;
+  }
+  *out = static_cast<uint8_t *>(std::malloc(m.data.size()));
+  std::memcpy(*out, m.data.data(), m.data.size());
+  return static_cast<int64_t>(m.data.size());
+}
+
+void nns_edge_free_buf(uint8_t *buf) { std::free(buf); }
+
+void nns_edge_close(Handle *h) {
+  h->running.store(false);
+  if (h->listen_fd >= 0) {
+    ::shutdown(h->listen_fd, SHUT_RDWR);
+    ::close(h->listen_fd);
+  }
+  {
+    std::lock_guard<std::mutex> lk(h->conn_mu);
+    for (auto &kv : h->conns) {
+      ::shutdown(kv.second, SHUT_RDWR);
+      ::close(kv.second);
+    }
+    h->conns.clear();
+  }
+  h->q_cv.notify_all();
+  if (h->acceptor.joinable()) h->acceptor.join();
+  // join outside conn_mu: a reader may be blocked on conn_mu erasing itself
+  std::vector<std::thread> readers;
+  {
+    std::lock_guard<std::mutex> lk(h->conn_mu);
+    readers.swap(h->readers);
+  }
+  for (auto &t : readers)
+    if (t.joinable()) t.join();
+  // a concurrent nns_edge_recv may still be unwinding after the wake-up;
+  // deleting under it would be a use-after-free
+  while (h->recv_inflight.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  delete h;
+}
+
+}  // extern "C"

@@ -1,0 +1,60 @@
+"""On-demand g++ build of the native edge transport.
+
+The reference links a prebuilt external nnstreamer-edge .so discovered via
+pkg-config; here the native source ships in-tree (native/nns_edge.cpp) and
+compiles once into a cached .so keyed on source mtime. A missing toolchain
+degrades to the pure-python transport (transport.py), the way the
+reference's meson options degrade features — never a hard failure.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("edge.build")
+_lock = threading.Lock()
+_cached: Optional[str] = None
+_failed = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SOURCE = os.path.join(_REPO_ROOT, "native", "nns_edge.cpp")
+BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+SO_PATH = os.path.join(BUILD_DIR, "libnns_edge.so")
+
+
+def native_lib_path() -> Optional[str]:
+    """Compile (if stale) and return the .so path, or None if unavailable."""
+    global _cached, _failed
+    with _lock:
+        if _cached:
+            return _cached
+        if _failed:
+            return None
+        if not os.path.isfile(SOURCE):
+            _failed = True
+            return None
+        try:
+            if not (
+                os.path.isfile(SO_PATH)
+                and os.path.getmtime(SO_PATH) >= os.path.getmtime(SOURCE)
+            ):
+                os.makedirs(BUILD_DIR, exist_ok=True)
+                cmd = [
+                    "g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                    "-pthread", SOURCE, "-o", SO_PATH,
+                ]
+                subprocess.run(
+                    cmd, check=True, capture_output=True, timeout=120
+                )
+                _log.info("built native edge transport: %s", SO_PATH)
+        except (subprocess.SubprocessError, OSError) as exc:
+            _log.warning("native edge build failed (%s); using python transport", exc)
+            _failed = True
+            return None
+        _cached = SO_PATH
+        return _cached

@@ -1,0 +1,138 @@
+"""edgesink / edgesrc: publish/subscribe tensor streams between pipelines.
+
+Reference: gst/edge/edge_{sink,src}.c — thin wrappers over nnstreamer-edge
+pub/sub (TCP default, port 3000, edge_common.h:36-37). edgesink listens and
+broadcasts every rendered frame to all connected subscribers; edgesrc
+connects and emits whatever arrives. Unlike the query pair there is no
+reply path and no client demux.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.edge.serialize import decode_message, encode_message
+from nnstreamer_tpu.edge.transport import TransportError, make_transport
+from nnstreamer_tpu.elements.base import (
+    ElementError,
+    NegotiationError,
+    Sink,
+    Source,
+    Spec,
+)
+from nnstreamer_tpu.tensors.frame import EOS, EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+DEFAULT_PORT = 3000  # reference edge_common.h:36-37
+
+
+@registry.element("edgesink")
+class EdgeSink(Sink):
+    """Broadcast frames to all subscribers.
+
+    Props: host (default 127.0.0.1), port (default 3000; 0 = ephemeral,
+    read back via ``bound_port``), wait-connection (block first frame until
+    a subscriber arrives, default false), connection-timeout (s).
+    """
+
+    FACTORY_NAME = "edgesink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.host = str(self.get_property("host", "127.0.0.1"))
+        self.port = int(self.get_property("port", DEFAULT_PORT))
+        self.wait_connection = str(
+            self.get_property("wait-connection", "false")
+        ).lower() in ("true", "1", "yes")
+        self.conn_timeout = float(self.get_property("connection-timeout", 10.0))
+        self.bound_port: Optional[int] = None
+        self._transport = None
+
+    def start(self) -> None:
+        self._transport = make_transport()
+        self.bound_port = self._transport.listen(self.host, self.port)
+
+    def stop(self) -> None:
+        if self._transport is not None:
+            # subscribers see the stream end explicitly
+            try:
+                self._transport.send(0, encode_message(EOS_FRAME))
+            except (TransportError, OSError):
+                pass
+            self._transport.close()
+            self._transport = None
+
+    def render(self, frame: Frame) -> None:
+        if self.wait_connection and self._transport.peer_count() == 0:
+            import time
+
+            deadline = time.monotonic() + self.conn_timeout
+            while (
+                self._transport.peer_count() == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            if self._transport.peer_count() == 0:
+                raise ElementError(
+                    f"{self.name}: no subscriber within {self.conn_timeout}s"
+                )
+        try:
+            self._transport.send(0, encode_message(frame))  # 0 = broadcast
+        except (TransportError, OSError):
+            pass  # best-effort: one dead subscriber must not kill the stream
+
+    def on_eos(self) -> None:
+        if self._transport is not None:
+            try:
+                self._transport.send(0, encode_message(EOS_FRAME))
+            except (TransportError, OSError):
+                pass
+
+
+@registry.element("edgesrc")
+class EdgeSrc(Source):
+    """Subscribe to an edgesink and emit its frames.
+
+    Props: dest-host (default 127.0.0.1), dest-port (default 3000),
+    connect-type=TCP.
+    """
+
+    FACTORY_NAME = "edgesrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.host = str(self.get_property("dest-host", "127.0.0.1"))
+        self.port = int(self.get_property("dest-port", DEFAULT_PORT))
+        self._transport = None
+
+    def output_spec(self) -> Spec:
+        ct = str(self.get_property("connect-type", "TCP")).upper()
+        if ct != "TCP":
+            raise NegotiationError(f"{self.name}: connect-type={ct} not built in")
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def start(self) -> None:
+        self._transport = make_transport()
+        try:
+            self._transport.connect(self.host, self.port)
+        except (TransportError, OSError) as exc:
+            raise ElementError(
+                f"{self.name}: cannot reach edgesink {self.host}:{self.port}: "
+                f"{exc}"
+            ) from exc
+
+    def stop(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def generate(self):
+        got = self._transport.recv(timeout=0.1)
+        if got is None:
+            return None
+        _, payload = got
+        if not payload:
+            return EOS_FRAME  # publisher went away
+        msg = decode_message(payload)
+        return EOS_FRAME if isinstance(msg, EOS) else msg

@@ -1,0 +1,203 @@
+"""Query elements: request/response pipeline offload across hosts.
+
+Reference: gst/nnstreamer/tensor_query/ —
+- ``tensor_query_client`` (tensor_query_client.c:663-735): sink chain
+  serializes the frame, sends to the server, blocks for the reply, pushes
+  the reply downstream.
+- ``tensor_query_serversrc`` (tensor_query_serversrc.c:299-427): push
+  source emitting incoming requests tagged with their ``client_id`` meta
+  (the GstMetaQuery analogue, tensor_meta.h:26-31).
+- ``tensor_query_serversink`` (tensor_query_serversink.c:241-278): reads
+  the ``client_id`` meta and sends the result back to that client.
+- serversrc/sink pair through a global id table
+  (tensor_query_server.c, hdr :25-73) — here :data:`_server_table`.
+
+The transport is the in-tree native C++ edge library (python fallback);
+``connect-type`` accepts only TCP for now — the reference's MQTT/HYBRID/
+AITT transports are config-gated the same way its meson options gate them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.edge.serialize import decode_message, encode_message
+from nnstreamer_tpu.edge.transport import TransportError, make_transport
+from nnstreamer_tpu.elements.base import (
+    ElementError,
+    HostElement,
+    NegotiationError,
+    Sink,
+    Source,
+    Spec,
+)
+from nnstreamer_tpu.tensors.frame import EOS, EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+# reference QUERY_DEFAULT_TIMEOUT_SEC (tensor_query_common.h:28) is 10 s
+DEFAULT_TIMEOUT = 10.0
+
+# serversrc/serversink pairing: id → shared server transport
+_server_table: Dict[str, object] = {}
+_server_lock = threading.Lock()
+
+
+def _register_server(srv_id: str, transport) -> None:
+    with _server_lock:
+        _server_table[srv_id] = transport
+
+
+def _get_server(srv_id: str):
+    with _server_lock:
+        return _server_table.get(srv_id)
+
+
+def _unregister_server(srv_id: str, transport=None) -> None:
+    """Remove the pairing entry — but only if it still belongs to the
+    caller (a restarted serversrc may have re-registered the id)."""
+    with _server_lock:
+        if transport is None or _server_table.get(srv_id) is transport:
+            _server_table.pop(srv_id, None)
+
+
+def _check_connect_type(elem) -> None:
+    ct = str(elem.get_property("connect-type", "TCP")).upper()
+    if ct != "TCP":
+        raise NegotiationError(
+            f"{elem.name}: connect-type={ct} not built in (TCP only; "
+            "MQTT/HYBRID/AITT are gated like the reference's meson options)"
+        )
+
+
+@registry.element("tensor_query_client")
+class TensorQueryClient(HostElement):
+    """Offload frames to a remote pipeline and emit the replies.
+
+    Props: dest-host (default 127.0.0.1), dest-port, timeout (seconds),
+    connect-type=TCP. Requests are strictly synchronous request/reply per
+    frame (the reference's max-request pipelining knob does not apply).
+    """
+
+    FACTORY_NAME = "tensor_query_client"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.host = str(self.get_property("dest-host", "127.0.0.1"))
+        self.port = int(self.get_property("dest-port", 0))
+        self.timeout = float(self.get_property("timeout", DEFAULT_TIMEOUT))
+        self._transport = None
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        _check_connect_type(self)
+        if self.port <= 0:
+            raise NegotiationError(f"{self.name}: dest-port required")
+        # the reply's spec is the remote pipeline's business — flexible
+        # (caps compatibility is the user's responsibility, reference
+        # tensor_query/README.md)
+        return [TensorsSpec(format=TensorFormat.FLEXIBLE)]
+
+    def start(self) -> None:
+        self._transport = make_transport()
+        try:
+            self._transport.connect(self.host, self.port)
+        except (TransportError, OSError) as exc:
+            raise ElementError(
+                f"{self.name}: cannot reach query server "
+                f"{self.host}:{self.port}: {exc}"
+            ) from exc
+
+    def stop(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def process(self, frame: Frame) -> Optional[Frame]:
+        self._transport.send(0, encode_message(frame))
+        got = self._transport.recv(timeout=self.timeout)
+        if got is None:
+            raise ElementError(
+                f"{self.name}: query timeout after {self.timeout}s"
+            )
+        _, payload = got
+        if not payload:
+            raise ElementError(f"{self.name}: server closed the connection")
+        reply = decode_message(payload)
+        if isinstance(reply, EOS):
+            return None
+        return reply.with_pts(frame.pts, frame.duration)
+
+
+@registry.element("tensor_query_serversrc")
+class TensorQueryServerSrc(Source):
+    """Emit incoming query requests, tagged with client_id meta.
+
+    Props: host (default 127.0.0.1), port (0 = ephemeral; read back via
+    ``bound_port``), id (pairing key, default "0"), connect-type=TCP.
+    """
+
+    FACTORY_NAME = "tensor_query_serversrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.host = str(self.get_property("host", "127.0.0.1"))
+        self.port = int(self.get_property("port", 0))
+        self.srv_id = str(self.get_property("id", "0"))
+        self.bound_port: Optional[int] = None
+        self._transport = None
+
+    def output_spec(self) -> Spec:
+        _check_connect_type(self)
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def start(self) -> None:
+        self._transport = make_transport()
+        self.bound_port = self._transport.listen(self.host, self.port)
+        _register_server(self.srv_id, self._transport)
+
+    def stop(self) -> None:
+        _unregister_server(self.srv_id, self._transport)
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+    def generate(self):
+        got = self._transport.recv(timeout=0.1)
+        if got is None:
+            return None  # re-poll; executor loops until EOS/stop
+        cid, payload = got
+        if not payload:
+            return None  # client disconnect event; keep serving others
+        frame = decode_message(payload)
+        if isinstance(frame, EOS):
+            return None  # one client's EOS must not stop the server
+        return frame.with_meta(client_id=cid)
+
+
+@registry.element("tensor_query_serversink")
+class TensorQueryServerSink(Sink):
+    """Send results back to the requesting client (by client_id meta).
+
+    Props: id (pairing key matching the serversrc, default "0").
+    """
+
+    FACTORY_NAME = "tensor_query_serversink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.srv_id = str(self.get_property("id", "0"))
+
+    def render(self, frame: Frame) -> None:
+        transport = _get_server(self.srv_id)
+        if transport is None:
+            raise ElementError(
+                f"{self.name}: no tensor_query_serversrc with id={self.srv_id}"
+            )
+        cid = frame.meta.get("client_id")
+        if cid is None:
+            raise ElementError(
+                f"{self.name}: frame lacks client_id meta (did it pass "
+                "through tensor_query_serversrc?)"
+            )
+        transport.send(cid, encode_message(frame))

@@ -1,0 +1,57 @@
+"""Frame ↔ wire-message codec for the edge layer.
+
+The payload the transports carry: a small frame header followed by the
+flexible-tensor encoding (tensors/meta.py — the same self-describing header
+the reference uses for format=flexible streams and its edge serialization,
+SURVEY.md §5.8).
+
+Layout (little-endian):
+
+    uint8  version (1)
+    uint8  kind    (0 = DATA, 1 = EOS)
+    int64  pts     (ns; -1 = unknown)
+    int64  duration(ns; -1 = unknown)
+    uint32 reserved
+    [flex tensors...]
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from nnstreamer_tpu.tensors.frame import EOS, EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.meta import decode_frame_tensors, encode_frame_tensors
+
+_HDR = struct.Struct("<BBqqI")
+VERSION = 1
+KIND_DATA = 0
+KIND_EOS = 1
+
+
+def encode_message(frame) -> bytes:
+    if isinstance(frame, EOS):
+        return _HDR.pack(VERSION, KIND_EOS, -1, -1, 0)
+    pts = -1 if frame.pts is None else frame.pts
+    dur = -1 if frame.duration is None else frame.duration
+    host = frame.to_host()
+    return _HDR.pack(VERSION, KIND_DATA, pts, dur, 0) + encode_frame_tensors(
+        host.tensors
+    )
+
+
+def decode_message(data: bytes):
+    """→ Frame, or EOS_FRAME. Raises ValueError on malformed input."""
+    if len(data) < _HDR.size:
+        raise ValueError(f"edge message too short: {len(data)}")
+    version, kind, pts, dur, _ = _HDR.unpack_from(data)
+    if version != VERSION:
+        raise ValueError(f"unsupported edge message version {version}")
+    if kind == KIND_EOS:
+        return EOS_FRAME
+    tensors = decode_frame_tensors(data[_HDR.size :])
+    return Frame(
+        tensors,
+        pts=None if pts < 0 else pts,
+        duration=None if dur < 0 else dur,
+    )

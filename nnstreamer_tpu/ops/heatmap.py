@@ -36,10 +36,9 @@ def pose_keypoints_with_offsets(
 ) -> jax.Array:
     """heatmap-offset mode: refine grid argmax with the offset tensor
     [H, W, 2K] (first K channels = y offsets, last K = x offsets, posenet
-    convention). Returns [K, 3] (x, y, score) in *input-pixel* units
-    assuming stride = (input-1)/(grid-1), which the caller applies; here we
-    return grid coords + fractional offsets in grid units scaled by the
-    caller."""
+    convention). Returns [K, 5] rows (grid_x, grid_y, score, off_x, off_y):
+    grid coords plus raw pixel offsets — the caller applies
+    stride = (input-1)/(grid-1) and adds the offsets (see PoseDecoder)."""
     h, w, k = heatmap.shape
     base = pose_keypoints_from_heatmap(heatmap)
     ys = base[:, 1].astype(jnp.int32)
