@@ -9,11 +9,12 @@ dispatch-ahead. Prints ONE JSON line:
 vs_baseline is against the 1000 FPS/chip target (BASELINE.json).
 
 Measurement notes: jax dispatch is async; a streaming pipeline only
-synchronizes when a sink consumes results on host. We sync every SYNC_EVERY
-frames (bounded in-flight window — what the pipeline executor's sink does
-when batching host reads), which is the steady-state pattern, not a
-per-frame round-trip (the tunnelled device adds ~70ms per *sync*, not per
-dispatch, so per-frame blocking would measure the tunnel, not the TPU).
+synchronizes when a sink consumes results on host. We sync on a bounded
+in-flight window — the executor's sink path with ``sync-window=N``
+(elements/base.py Sink, executor.py SinkNode) — which is the steady-state
+pattern, not a per-frame round-trip (the tunnelled device adds ~70ms per
+*sync*, not per dispatch, so per-frame blocking would measure the tunnel,
+not the TPU).
 """
 
 from __future__ import annotations

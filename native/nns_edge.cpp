@@ -75,9 +75,10 @@ struct Handle {
   int bound_port = 0;
 
   std::thread acceptor;
-  std::mutex conn_mu;  // guards conns + next_id + conn threads vector
+  std::mutex conn_mu;  // guards conns + next_id + reader thread bookkeeping
   std::map<uint64_t, int> conns;  // client_id -> fd
   std::vector<std::thread> readers;
+  std::vector<std::thread::id> done_readers;  // exited, joinable immediately
   uint64_t next_id = 1;
 
   std::mutex q_mu;
@@ -87,6 +88,17 @@ struct Handle {
                             // bounded the same way (drop-oldest)
 
   std::mutex send_mu;
+  // fds of disconnected peers, kept OPEN (shutdown only) until no send can
+  // be writing to them: closing in the reader would let the kernel reuse
+  // the fd number while a concurrent send still holds a stale snapshot,
+  // delivering a frame to the wrong client. Guarded by send_mu.
+  std::vector<int> dead_fds;
+
+  // Caller holds send_mu (so no write_all is in flight on these fds).
+  void drain_dead_fds_locked() {
+    for (int fd : dead_fds) ::close(fd);
+    dead_fds.clear();
+  }
 
   void enqueue(uint64_t id, std::vector<uint8_t> &&data) {
     std::lock_guard<std::mutex> lk(q_mu);
@@ -109,12 +121,32 @@ struct Handle {
       std::lock_guard<std::mutex> lk(conn_mu);
       auto it = conns.find(id);
       if (it != conns.end()) {
-        ::close(it->second);
+        ::shutdown(it->second, SHUT_RDWR);
+        {
+          std::lock_guard<std::mutex> slk(send_mu);
+          dead_fds.push_back(it->second);
+        }
         conns.erase(it);
       }
+      done_readers.push_back(std::this_thread::get_id());
     }
     // empty message signals connection-closed to the event layer
     if (running.load()) enqueue(id, std::vector<uint8_t>());
+  }
+
+  // Join reader threads that have exited (client churn must not grow the
+  // readers vector without bound). Caller holds conn_mu.
+  void prune_readers_locked() {
+    for (auto tid : done_readers) {
+      for (auto it = readers.begin(); it != readers.end(); ++it) {
+        if (it->get_id() == tid) {
+          it->join();
+          readers.erase(it);
+          break;
+        }
+      }
+    }
+    done_readers.clear();
   }
 
   void acceptor_loop() {
@@ -129,8 +161,15 @@ struct Handle {
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       uint64_t id;
+      // opportunistic drain so a receive-only server with client churn
+      // doesn't accumulate dead fds waiting for a send
+      if (send_mu.try_lock()) {
+        drain_dead_fds_locked();
+        send_mu.unlock();
+      }
       {
         std::lock_guard<std::mutex> lk(conn_mu);
+        prune_readers_locked();
         id = next_id++;
         conns[id] = fd;
         readers.emplace_back(&Handle::reader_loop, this, id, fd);
@@ -178,12 +217,16 @@ int nns_edge_get_port(Handle *h) { return h->bound_port; }
 int nns_edge_connect(Handle *h, const char *host, int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
+  auto fail = [fd](int rc) {  // error paths must not leak the fd
+    ::close(fd);
+    return rc;
+  };
   sockaddr_in addr {};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -2;
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return fail(-2);
   if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)))
-    return -3;
+    return fail(-3);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   h->running.store(true);
@@ -223,6 +266,10 @@ int nns_edge_send(Handle *h, uint64_t client_id, const uint8_t *data,
       if (!broadcast) rc = -2;
     }
   }
+  // close after the writes: a snapshot fd that went dead mid-send stays a
+  // valid (shutdown) fd until here, so the write fails instead of hitting
+  // a kernel-reused fd number belonging to a new client
+  h->drain_dead_fds_locked();
   return rc;
 }
 
@@ -269,7 +316,12 @@ int64_t nns_edge_recv(Handle *h, uint64_t *client_id, uint8_t **out,
 void nns_edge_free_buf(uint8_t *buf) { std::free(buf); }
 
 void nns_edge_close(Handle *h) {
-  h->running.store(false);
+  {
+    // store under q_mu so a recv that just evaluated its predicate cannot
+    // miss the wake-up (lost-wakeup race would hang recv + this close)
+    std::lock_guard<std::mutex> lk(h->q_mu);
+    h->running.store(false);
+  }
   if (h->listen_fd >= 0) {
     ::shutdown(h->listen_fd, SHUT_RDWR);
     ::close(h->listen_fd);
@@ -292,6 +344,10 @@ void nns_edge_close(Handle *h) {
   }
   for (auto &t : readers)
     if (t.joinable()) t.join();
+  {
+    std::lock_guard<std::mutex> lk(h->send_mu);
+    h->drain_dead_fds_locked();
+  }
   // a concurrent nns_edge_recv may still be unwinding after the wake-up;
   // deleting under it would be a use-after-free
   while (h->recv_inflight.load() > 0)

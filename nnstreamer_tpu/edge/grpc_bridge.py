@@ -27,6 +27,7 @@ from nnstreamer_tpu.elements.base import (
     Sink,
     Source,
     Spec,
+    _parse_bool,
 )
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
 from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
@@ -74,6 +75,24 @@ def _frame_from_msg(msg) -> Frame:
     return Frame(message_to_tensors(msg))
 
 
+def _bounded_put(q: "queue_mod.Queue", item, should_abort) -> bool:
+    """Lossless bounded enqueue that can't wedge the producer thread: block
+    with a short timeout and re-check the abort predicate, so gRPC flow
+    control backpressures the sender while shutdown always unblocks.
+    Returns False if aborted before the item landed."""
+    while not should_abort():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue_mod.Full:
+            continue
+    return False
+
+
+def _put_unless_stopped(q: "queue_mod.Queue", item, stopped: threading.Event) -> None:
+    _bounded_put(q, item, stopped.is_set)
+
+
 @registry.element("tensor_src_grpc")
 class GrpcTensorSrc(Source):
     """Receive Tensors over gRPC and emit them as frames.
@@ -87,9 +106,7 @@ class GrpcTensorSrc(Source):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self.is_server = str(self.get_property("server", "true")).lower() in (
-            "true", "1", "yes",
-        )
+        self.is_server = _parse_bool(self.get_property("server", True))
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", 0))
         self.bound_port: Optional[int] = None
@@ -108,8 +125,13 @@ class GrpcTensorSrc(Source):
         src = self
 
         def send_tensors(request_iterator, context):
+            # a bare blocking put would wedge this grpc worker thread
+            # forever once the consumer stops (the pool is non-daemon,
+            # hanging interpreter exit)
             for msg in request_iterator:
-                src._queue.put(_frame_from_msg(msg))
+                if src._stopped.is_set():
+                    break
+                _put_unless_stopped(src._queue, _frame_from_msg(msg), src._stopped)
             return pb.Empty()
 
         self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
@@ -148,11 +170,11 @@ class GrpcTensorSrc(Source):
                 for msg in call(pb.Empty()):
                     if self._stopped.is_set():
                         break
-                    self._queue.put(_frame_from_msg(msg))
+                    _put_unless_stopped(self._queue, _frame_from_msg(msg), self._stopped)
             except grpc.RpcError as exc:
                 if not self._stopped.is_set():
                     self._error = f"stream broke: {exc.code()}"
-            self._queue.put(EOS_FRAME)
+            _put_unless_stopped(self._queue, EOS_FRAME, self._stopped)
 
         self._thread = threading.Thread(target=pull, daemon=True)
         self._thread.start()
@@ -196,9 +218,7 @@ class GrpcTensorSink(Sink):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self.is_server = str(self.get_property("server", "true")).lower() in (
-            "true", "1", "yes",
-        )
+        self.is_server = _parse_bool(self.get_property("server", True))
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", 0))
         self.bound_port: Optional[int] = None
@@ -208,6 +228,7 @@ class GrpcTensorSink(Sink):
         self._subscribers: List[queue_mod.Queue] = []
         self._sub_lock = threading.Lock()
         self._client_done = None
+        self._error: Optional[str] = None
 
     # -- server mode: subscribers pull a stream ----------------------------
     def _start_server(self, grpc, pb) -> None:
@@ -242,6 +263,17 @@ class GrpcTensorSink(Sink):
     # -- client mode: we push a stream to a remote src ---------------------
     def _start_client(self, grpc, pb) -> None:
         self._channel = grpc.insecure_channel(f"{self.host}:{self.port}")
+        try:  # fail fast on unreachable server, like GrpcTensorSrc
+            grpc.channel_ready_future(self._channel).result(
+                timeout=float(self.get_property("connection-timeout", 10.0))
+            )
+        except grpc.FutureTimeoutError as exc:
+            self._channel.close()
+            self._channel = None
+            raise ElementError(
+                f"{self.name}: cannot reach gRPC server "
+                f"{self.host}:{self.port}"
+            ) from exc
         call = self._channel.stream_unary(
             f"/{SERVICE}/SendTensors",
             request_serializer=pb.Tensors.SerializeToString,
@@ -260,8 +292,8 @@ class GrpcTensorSink(Sink):
         def run():
             try:
                 call(feed())
-            except grpc.RpcError:
-                pass
+            except grpc.RpcError as exc:
+                self._error = f"stream broke: {exc.code()}"
             self._client_done.set()
 
         threading.Thread(target=run, daemon=True).start()
@@ -296,7 +328,16 @@ class GrpcTensorSink(Sink):
                 except queue_mod.Full:
                     pass  # slow subscriber: drop (reference async mode)
         else:
-            self._push_queue.put(msg)
+            # bounded put that notices a dead stream: once run() exits the
+            # feed() generator stops draining and a bare put would block
+            # forever on the full queue with no error surfaced
+            done = self._client_done
+            if not _bounded_put(
+                self._push_queue, msg, lambda: done is not None and done.is_set()
+            ):
+                raise ElementError(
+                    f"{self.name}: {self._error or 'gRPC stream closed'}"
+                )
 
     def on_eos(self) -> None:
         if self.is_server:
@@ -315,4 +356,7 @@ class GrpcTensorSink(Sink):
                         except queue_mod.Empty:
                             pass
         else:
-            self._push_queue.put(None)
+            done = self._client_done
+            _bounded_put(
+                self._push_queue, None, lambda: done is not None and done.is_set()
+            )

@@ -15,6 +15,7 @@ from nnstreamer_tpu import registry
 from nnstreamer_tpu.edge.serialize import decode_message, encode_message
 from nnstreamer_tpu.edge.transport import TransportError, make_transport
 from nnstreamer_tpu.elements.base import (
+    _parse_bool,
     ElementError,
     NegotiationError,
     Sink,
@@ -42,9 +43,9 @@ class EdgeSink(Sink):
         super().__init__(name, **props)
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", DEFAULT_PORT))
-        self.wait_connection = str(
-            self.get_property("wait-connection", "false")
-        ).lower() in ("true", "1", "yes")
+        self.wait_connection = _parse_bool(
+            self.get_property("wait-connection", False)
+        )
         self.conn_timeout = float(self.get_property("connection-timeout", 10.0))
         self.bound_port: Optional[int] = None
         self._transport = None

@@ -114,9 +114,19 @@ class TensorQueryClient(HostElement):
             self._transport = None
 
     def process(self, frame: Frame) -> Optional[Frame]:
+        if self._transport is None:  # reconnect after a timeout-dropped conn
+            self.start()
         self._transport.send(0, encode_message(frame))
         got = self._transport.recv(timeout=self.timeout)
         if got is None:
+            # In a pipeline this error poisons the stream, matching the
+            # reference's GST_FLOW_ERROR on query timeout. For standalone
+            # (direct process()) callers who catch and continue, drop the
+            # connection first so a reply arriving *after* the timeout
+            # can't be returned for the NEXT frame (off-by-one desync);
+            # the next call reconnects.
+            self._transport.close()
+            self._transport = None
             raise ElementError(
                 f"{self.name}: query timeout after {self.timeout}s"
             )

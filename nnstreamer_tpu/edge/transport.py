@@ -191,6 +191,8 @@ class PyTransport:
                 break
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conn_lock:
+                # reap finished readers so client churn can't grow the list
+                self._threads = [t for t in self._threads if t.is_alive()]
                 cid = self._next_id
                 self._next_id += 1
                 self._conns[cid] = sock

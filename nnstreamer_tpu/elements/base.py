@@ -194,10 +194,22 @@ class Source(Element):
 
 
 class Sink(Element):
-    """Stream sink."""
+    """Stream sink.
+
+    ``sync-window`` (default 1): how many frames the sink may trail the
+    device stream. 1 = render immediately (per-frame device sync, the
+    reference's synchronous sink path). N>1 = the executor starts async
+    device→host copies and renders each frame N frames later, so one sync
+    round-trip is amortized over the window — the pattern bench.py
+    measures. Ordering and EOS-flush semantics are unchanged.
+    """
 
     N_SINKS = 1
     N_SRCS = 0
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.sync_window = max(1, int(self.get_property("sync-window", 1)))
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         return []

@@ -235,13 +235,23 @@ class SinkNode(Node):
         self.elem = elem
 
     def run(self) -> None:
+        window = getattr(self.elem, "sync_window", 1)
+        pending: List = []  # frames trailing the device stream (sync-window)
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
+                for f in pending:
+                    self.elem.render(f)
                 self.elem.on_eos()
                 break
             t0 = time.perf_counter()
-            self.elem.render(item)
+            if window > 1:
+                item.prefetch_host()
+                pending.append(item)
+                if len(pending) >= window:
+                    self.elem.render(pending.pop(0))
+            else:
+                self.elem.render(item)
             self.stat(t0)
         self.ex.sink_done(self)
 

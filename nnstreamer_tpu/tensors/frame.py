@@ -89,6 +89,15 @@ class Frame:
                 t.block_until_ready()
         return self
 
+    def prefetch_host(self) -> "Frame":
+        """Start async device→host copies without blocking — lets a sink
+        trail the device stream by a bounded window instead of paying a
+        full sync round-trip per frame (Sink sync-window)."""
+        for t in self.tensors:
+            if hasattr(t, "copy_to_host_async"):
+                t.copy_to_host_async()
+        return self
+
     def __getitem__(self, i):
         return self.tensors[i]
 

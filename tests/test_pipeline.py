@@ -263,3 +263,28 @@ class TestAppSink:
             seen += 1
         p.stop()
         assert seen == 3
+
+
+class TestSinkSyncWindow:
+    def test_sync_window_preserves_count_and_order(self):
+        def collect(window):
+            src = VideoTestSrc(width=8, height=8, **{"num-frames": 7})
+            conv = TensorConverter()
+            sink = TensorSink(**{"sync-window": window})
+            run_chain(src, conv, sink)
+            assert sink.eos_seen
+            return [np.asarray(f.tensors[0]) for f in sink.frames]
+
+        ref = collect(1)
+        windowed = collect(4)
+        assert len(windowed) == len(ref) == 7
+        for a, b in zip(ref, windowed):
+            np.testing.assert_array_equal(a, b)
+
+    def test_sync_window_flushes_partial_window_at_eos(self):
+        src = VideoTestSrc(width=8, height=8, **{"num-frames": 3})
+        conv = TensorConverter()
+        sink = TensorSink(**{"sync-window": 16})  # window larger than stream
+        run_chain(src, conv, sink)
+        assert sink.rendered == 3
+        assert sink.eos_seen
