@@ -246,19 +246,22 @@ int nns_edge_send(Handle *h, uint64_t client_id, const uint8_t *data,
                   uint64_t len) {
   bool broadcast = h->is_server && client_id == 0;
   std::vector<int> fds;
-  {
-    std::lock_guard<std::mutex> lk(h->conn_mu);
-    if (broadcast) {
-      for (auto &kv : h->conns) fds.push_back(kv.second);
-    } else {
-      uint64_t key = h->is_server ? client_id : 0;
-      auto it = h->conns.find(key);
-      if (it == h->conns.end()) return -1;
-      fds.push_back(it->second);
-    }
+  // send_mu must be held from snapshot time onward: every dead-fd close
+  // happens under send_mu, so a snapshotted fd cannot be closed (and its
+  // number kernel-reused by a new client) before our writes finish. Lock
+  // order conn_mu → send_mu matches reader_loop's disconnect path.
+  std::unique_lock<std::mutex> clk(h->conn_mu);
+  std::unique_lock<std::mutex> lk(h->send_mu);
+  if (broadcast) {
+    for (auto &kv : h->conns) fds.push_back(kv.second);
+  } else {
+    uint64_t key = h->is_server ? client_id : 0;
+    auto it = h->conns.find(key);
+    if (it == h->conns.end()) return -1;
+    fds.push_back(it->second);
   }
+  clk.unlock();
   uint64_t len_le = htole64(len);
-  std::lock_guard<std::mutex> lk(h->send_mu);
   int rc = 0;
   for (int fd : fds) {
     if (!write_all(fd, &len_le, sizeof(len_le)) ||

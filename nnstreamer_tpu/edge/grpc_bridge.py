@@ -228,7 +228,16 @@ class GrpcTensorSink(Sink):
         self._subscribers: List[queue_mod.Queue] = []
         self._sub_lock = threading.Lock()
         self._client_done = None
+        self._stopping = threading.Event()
         self._error: Optional[str] = None
+
+    def _push_abort(self):
+        """Abort predicate for client-mode queue puts: a dead stream OR an
+        element stop must unblock the producer — a stalled-but-alive stream
+        (server stops reading, queue full) never sets _client_done, so
+        stop() needs its own flag to avoid spinning forever."""
+        done = self._client_done
+        return self._stopping.is_set() or (done is not None and done.is_set())
 
     # -- server mode: subscribers pull a stream ----------------------------
     def _start_server(self, grpc, pb) -> None:
@@ -307,6 +316,7 @@ class GrpcTensorSink(Sink):
             self._start_client(grpc, pb)
 
     def stop(self) -> None:
+        self._stopping.set()
         self.on_eos()
         if self._server is not None:
             self._server.stop(grace=0.5)
@@ -328,13 +338,10 @@ class GrpcTensorSink(Sink):
                 except queue_mod.Full:
                     pass  # slow subscriber: drop (reference async mode)
         else:
-            # bounded put that notices a dead stream: once run() exits the
-            # feed() generator stops draining and a bare put would block
-            # forever on the full queue with no error surfaced
-            done = self._client_done
-            if not _bounded_put(
-                self._push_queue, msg, lambda: done is not None and done.is_set()
-            ):
+            # bounded put that notices a dead stream or element stop: once
+            # run() exits the feed() generator stops draining and a bare
+            # put would block forever on the full queue
+            if not _bounded_put(self._push_queue, msg, self._push_abort):
                 raise ElementError(
                     f"{self.name}: {self._error or 'gRPC stream closed'}"
                 )
@@ -356,7 +363,7 @@ class GrpcTensorSink(Sink):
                         except queue_mod.Empty:
                             pass
         else:
-            done = self._client_done
-            _bounded_put(
-                self._push_queue, None, lambda: done is not None and done.is_set()
-            )
+            try:  # healthy stream: sentinel lands and feed() ends cleanly
+                self._push_queue.put_nowait(None)
+            except queue_mod.Full:
+                _bounded_put(self._push_queue, None, self._push_abort)

@@ -1,0 +1,99 @@
+"""DeepLab-v3 (MobileNet-v2 backbone) — the segmentation benchmark model.
+
+The reference's segmentation fixture is deeplabv3_257_mv_gpu.tflite
+(tests/nnstreamer_decoder_image_segment/, decoder mode
+``tflite-deeplab``, tensordec-imagesegment.c:107-119): 257x257 input,
+[257,257,21] per-class score map output. Same topology from scratch in jnp:
+MobileNet-v2 backbone at output-stride 16 (last downsample made atrous,
+rate-2 depthwise convs — conv2d dilation), reduced mobile ASPP (1x1 branch +
+image-level pooling), 21-class 1x1 classifier, bilinear upsample back to
+input resolution — all one XLA program, resize included (the reference does
+the argmax on CPU per pixel; our image_segment decoder jits it).
+
+fn: uint8 NHWC [N,257,257,3] → seg scores [N,257,257,21] float32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import mobilenet_v2, nn
+
+NUM_CLASSES = 21
+INPUT_SIZE = 257
+_ASPP_CH = 256
+
+
+def init_params(key, num_classes: int = NUM_CLASSES) -> Dict:
+    keys = iter(jax.random.split(key, 8))
+    p: Dict = {"backbone": mobilenet_v2.init_params(next(keys))}
+    p["aspp_conv"] = {"w": nn.init_conv(next(keys), 1, 1, 320, _ASPP_CH), "bn": nn.init_bn(_ASPP_CH)}
+    p["aspp_pool"] = {"w": nn.init_conv(next(keys), 1, 1, 320, _ASPP_CH), "bn": nn.init_bn(_ASPP_CH)}
+    p["project"] = {"w": nn.init_conv(next(keys), 1, 1, 2 * _ASPP_CH, _ASPP_CH), "bn": nn.init_bn(_ASPP_CH)}
+    p["classifier"] = {
+        "w": nn.init_conv(next(keys), 1, 1, _ASPP_CH, num_classes),
+        "b": jnp.zeros((num_classes,), jnp.float32),
+    }
+    return p
+
+
+def _backbone_os16(bb: Dict, x, train: bool):
+    """MobileNet-v2 blocks with the stride-2 of the 160-channel group (block
+    13) removed and subsequent depthwise convs dilated — classic
+    output-stride-16 atrous surgery; returns the 320-channel map (no head)."""
+    y = nn.relu6(
+        nn.batch_norm(nn.conv2d(x, bb["stem"]["w"], stride=2), bb["stem"]["bn"], train)
+    )
+    strides = mobilenet_v2._block_strides()
+    for i, (blk, stride) in enumerate(zip(bb["blocks"], strides)):
+        eff_stride, dilation = stride, 1
+        if i >= 13:  # the stride-2 160 group and beyond run atrous
+            eff_stride, dilation = 1, 2
+        y = _block_atrous(y, blk, eff_stride, dilation, train)
+    return y
+
+
+def _block_atrous(x, blk: Dict, stride: int, dilation: int, train: bool):
+    y = x
+    if "expand" in blk:
+        y = nn.relu6(nn.batch_norm(nn.conv2d(y, blk["expand"]["w"]), blk["expand"]["bn"], train))
+    groups = y.shape[-1]
+    y = nn.relu6(
+        nn.batch_norm(
+            nn.conv2d(y, blk["dw"]["w"], stride=stride, groups=groups, dilation=dilation),
+            blk["dw"]["bn"],
+            train,
+        )
+    )
+    y = nn.batch_norm(nn.conv2d(y, blk["project"]["w"]), blk["project"]["bn"], train)
+    if stride == 1 and y.shape[-1] == x.shape[-1]:
+        y = y + x
+    return y
+
+
+def apply(params: Dict, x, train: bool = False, compute_dtype=jnp.float32):
+    n = x.shape[0]
+    size = x.shape[1]
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    feat = _backbone_os16(params["backbone"], x, train)  # [N, s/16, s/16, 320]
+    a = nn.relu6(nn.batch_norm(nn.conv2d(feat, params["aspp_conv"]["w"]), params["aspp_conv"]["bn"], train))
+    pooled = jnp.mean(feat, axis=(1, 2), keepdims=True)
+    pooled = nn.relu6(
+        nn.batch_norm(nn.conv2d(pooled, params["aspp_pool"]["w"]), params["aspp_pool"]["bn"], train)
+    )
+    pooled = jnp.broadcast_to(pooled, a.shape)
+    y = jnp.concatenate([a, pooled], axis=-1)
+    y = nn.relu6(nn.batch_norm(nn.conv2d(y, params["project"]["w"]), params["project"]["bn"], train))
+    logits = nn.conv2d(y, params["classifier"]["w"]) + params["classifier"]["b"]
+    logits = jax.image.resize(
+        logits.astype(jnp.float32), (n, size, size, logits.shape[-1]), "bilinear"
+    )
+    return logits

@@ -1,0 +1,142 @@
+"""Face detection + landmark models — the composite-pipeline benchmark pair.
+
+BASELINE.md's composite config is face→crop→landmark across chips: a face
+detector whose boxes drive ``tensor_crop``, with a landmark net on each crop
+— the reference builds the same cascades from its decoder modes
+(``ov-face-detection``, tensordec-boundingbox.c:121-127) plus tensor_crop
+(gsttensor_crop.c). Two zoo models:
+
+- ``face_detect``: uint8 [N,128,128,3] → either OV-style detection rows
+  [max_faces, 7] (image_id, label, conf, x1, y1, x2, y2 — normalized; feeds
+  the bounding-box decoder's ov-face-detection mode) or, with
+  ``output=regions``, pixel [max_faces, 4] (x, y, w, h) int32 regions that
+  feed tensor_crop directly. Anchor-free 8x8-grid head; box decode + top-k
+  run on device (fixed shapes, one XLA program).
+- ``face_landmark``: uint8 [N,112,112,3] crop → [N, 136] normalized (x,y)
+  pairs for 68 landmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import mobilenet_v2, nn
+
+MAX_FACES = 16
+DETECT_SIZE = 128
+LANDMARK_SIZE = 112
+NUM_LANDMARKS = 68
+
+# detector trunk: (out_channels, stride) sep-conv plan, 128 → 8 grid
+_DET_BLOCKS = ((32, 2), (64, 1), (64, 2), (128, 1), (128, 2), (128, 1))
+_GRID = 8
+
+# landmark trunk: 112 → 7
+_LMK_BLOCKS = ((32, 2), (64, 2), (128, 2), (128, 1))
+
+
+def init_detect_params(key) -> Dict:
+    keys = iter(jax.random.split(key, 16))
+    p: Dict = {"stem": {"w": nn.init_conv(next(keys), 3, 3, 3, 16), "bn": nn.init_bn(16)}}
+    cin = 16
+    blocks = []
+    for cout, _ in _DET_BLOCKS:
+        blocks.append(nn.init_sep_conv(next(keys), cin, cout))
+        cin = cout
+    p["blocks"] = blocks
+    # per-cell head: (objectness, dy, dx, dh, dw)
+    p["head"] = {
+        "w": nn.init_conv(next(keys), 3, 3, cin, 5),
+        "b": jnp.zeros((5,), jnp.float32),
+    }
+    return p
+
+
+def apply_detect(params: Dict, x, max_faces: int = MAX_FACES, compute_dtype=jnp.float32):
+    """→ [max_faces, 7] OV detection rows (batch-1 semantics like the
+    reference's OV face models)."""
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    y = nn.relu6(
+        nn.batch_norm(nn.conv2d(x, params["stem"]["w"], stride=2), params["stem"]["bn"], False)
+    )
+    for blk, (_, stride) in zip(params["blocks"], _DET_BLOCKS):
+        y = nn.sep_conv(y, blk, stride=stride)
+    head = (nn.conv2d(y, params["head"]["w"]) + params["head"]["b"]).astype(jnp.float32)
+    g = head.shape[1]
+    head = head.reshape(-1, g * g, 5)[0]  # batch-1
+    conf = jax.nn.sigmoid(head[:, 0])
+    # cell-anchored decode: center = cell center + tanh offset, size = sigmoid
+    rows = (jnp.arange(g * g) // g).astype(jnp.float32)
+    cols = (jnp.arange(g * g) % g).astype(jnp.float32)
+    cy = (rows + 0.5) / g + jnp.tanh(head[:, 1]) / g
+    cx = (cols + 0.5) / g + jnp.tanh(head[:, 2]) / g
+    bh = jax.nn.sigmoid(head[:, 3])
+    bw = jax.nn.sigmoid(head[:, 4])
+    x1 = jnp.clip(cx - bw / 2, 0.0, 1.0)
+    y1 = jnp.clip(cy - bh / 2, 0.0, 1.0)
+    x2 = jnp.clip(cx + bw / 2, 0.0, 1.0)
+    y2 = jnp.clip(cy + bh / 2, 0.0, 1.0)
+    top_conf, top_idx = jax.lax.top_k(conf, max_faces)
+    det = jnp.stack(
+        [
+            jnp.zeros((max_faces,), jnp.float32),  # image_id
+            jnp.ones((max_faces,), jnp.float32),  # label (face)
+            top_conf,
+            x1[top_idx],
+            y1[top_idx],
+            x2[top_idx],
+            y2[top_idx],
+        ],
+        axis=-1,
+    )
+    return det
+
+
+def detections_to_regions(det, frame_w: int, frame_h: int, threshold: float = 0.5):
+    """[max,7] OV rows → [max,4] int32 pixel (x, y, w, h) for tensor_crop;
+    below-threshold rows become zero-size regions (crop skips them)."""
+    keep = det[:, 2] >= threshold
+    x = det[:, 3] * frame_w
+    y = det[:, 4] * frame_h
+    w = (det[:, 5] - det[:, 3]) * frame_w
+    h = (det[:, 6] - det[:, 4]) * frame_h
+    out = jnp.stack([x, y, w, h], axis=-1)
+    return jnp.where(keep[:, None], out, 0.0).astype(jnp.int32)
+
+
+def init_landmark_params(key, num_landmarks: int = NUM_LANDMARKS) -> Dict:
+    keys = iter(jax.random.split(key, 12))
+    p: Dict = {"stem": {"w": nn.init_conv(next(keys), 3, 3, 3, 16), "bn": nn.init_bn(16)}}
+    cin = 16
+    blocks = []
+    for cout, _ in _LMK_BLOCKS:
+        blocks.append(nn.init_sep_conv(next(keys), cin, cout))
+        cin = cout
+    p["blocks"] = blocks
+    p["fc"] = nn.init_dense(next(keys), cin, 2 * num_landmarks)
+    return p
+
+
+def apply_landmark(params: Dict, x, compute_dtype=jnp.float32):
+    """uint8 NHWC crop (any HxW ≥ 16) → [N, 2*num_landmarks] in [0,1]."""
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    y = nn.relu6(
+        nn.batch_norm(nn.conv2d(x, params["stem"]["w"], stride=2), params["stem"]["bn"], False)
+    )
+    for blk, (_, stride) in zip(params["blocks"], _LMK_BLOCKS):
+        y = nn.sep_conv(y, blk, stride=stride)
+    y = jnp.mean(y, axis=(1, 2))  # global pool makes the net crop-size agnostic
+    return jax.nn.sigmoid(nn.dense(y, params["fc"])).astype(jnp.float32)

@@ -20,13 +20,15 @@ def relu6(x):
     return jnp.minimum(jnp.maximum(x, 0.0), 6.0)
 
 
-def conv2d(x, w, stride: int = 1, groups: int = 1, padding="SAME"):
-    """NHWC conv; w is HWIO (I = in_channels // groups)."""
+def conv2d(x, w, stride: int = 1, groups: int = 1, padding="SAME", dilation: int = 1):
+    """NHWC conv; w is HWIO (I = in_channels // groups). ``dilation`` is the
+    atrous rate (DeepLab output-stride control)."""
     return jax.lax.conv_general_dilated(
         x,
         w,
         window_strides=(stride, stride),
         padding=padding,
+        rhs_dilation=(dilation, dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
     )
@@ -48,6 +50,28 @@ def batch_norm(x, p: Dict, train: bool = False, eps: float = 1e-3):
 
 def dense(x, p: Dict):
     return x @ p["w"] + p["b"]
+
+
+def sep_conv(x, p: Dict, stride: int = 1, train: bool = False, dilation: int = 1):
+    """Depthwise 3x3 + pointwise 1x1, BN+ReLU6 after each (MobileNet-v1
+    block; also SSDLite head building block)."""
+    c = x.shape[-1]
+    y = relu6(
+        batch_norm(
+            conv2d(x, p["dw"]["w"], stride=stride, groups=c, dilation=dilation),
+            p["dw"]["bn"],
+            train,
+        )
+    )
+    return relu6(batch_norm(conv2d(y, p["pw"]["w"]), p["pw"]["bn"], train))
+
+
+def init_sep_conv(key, cin: int, cout: int) -> Dict:
+    k1, k2 = jax.random.split(key)
+    return {
+        "dw": {"w": init_conv(k1, 3, 3, cin, cin, groups=cin), "bn": init_bn(cin)},
+        "pw": {"w": init_conv(k2, 1, 1, cin, cout), "bn": init_bn(cout)},
+    }
 
 
 # -- initializers ---------------------------------------------------------

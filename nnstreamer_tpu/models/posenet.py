@@ -1,0 +1,86 @@
+"""PoseNet (MobileNet-v1 backbone) — the multi-output pose benchmark model.
+
+The reference's pose fixture is posenet_mobilenet_v1_100_257x257 (tflite,
+tests/nnstreamer_decoder_pose/runTest.sh): 257x257 input, four output maps at
+stride 32 (9x9 grid) — heatmaps[17], short-range offsets[34], forward and
+backward displacement fields[32] for multi-pose grouping. This is the same
+topology from scratch in jnp: MobileNet-v1 depthwise-separable backbone + four
+1x1 heads; output order matches the reference so the pose decoder's
+``mode=pose-estimation`` tensor mapping applies unchanged.
+
+fn: uint8 NHWC [N,257,257,3] → (heatmap [N,9,9,17], offsets [N,9,9,34],
+displacement_fwd [N,9,9,32], displacement_bwd [N,9,9,32]).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import mobilenet_v2, nn
+
+NUM_KEYPOINTS = 17
+INPUT_SIZE = 257
+OUTPUT_GRID = 9
+
+# MobileNet-v1 plan: (out_channels, stride) per depthwise-separable block
+_V1_BLOCKS: Tuple[Tuple[int, int], ...] = (
+    (64, 1),
+    (128, 2),
+    (128, 1),
+    (256, 2),
+    (256, 1),
+    (512, 2),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (1024, 2),
+    (1024, 1),
+)
+
+
+def init_params(key, num_keypoints: int = NUM_KEYPOINTS) -> Dict:
+    keys = iter(jax.random.split(key, 40))
+    p: Dict = {"stem": {"w": nn.init_conv(next(keys), 3, 3, 3, 32), "bn": nn.init_bn(32)}}
+    cin = 32
+    blocks = []
+    for cout, _ in _V1_BLOCKS:
+        blocks.append(nn.init_sep_conv(next(keys), cin, cout))
+        cin = cout
+    p["blocks"] = blocks
+    for head, c in (
+        ("heatmap", num_keypoints),
+        ("offsets", 2 * num_keypoints),
+        ("disp_fwd", 2 * (num_keypoints - 1)),
+        ("disp_bwd", 2 * (num_keypoints - 1)),
+    ):
+        p[head] = nn.init_dense(next(keys), cin, c)  # used as 1x1 conv
+    return p
+
+
+def _head(y, p: Dict):
+    return jnp.einsum("nhwc,cd->nhwd", y, p["w"]) + p["b"]
+
+
+def apply(params: Dict, x, train: bool = False, compute_dtype=jnp.float32):
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    y = nn.relu6(
+        nn.batch_norm(nn.conv2d(x, params["stem"]["w"], stride=2), params["stem"]["bn"], train)
+    )
+    for blk, (_, stride) in zip(params["blocks"], _V1_BLOCKS):
+        y = nn.sep_conv(y, blk, stride=stride, train=train)
+    return (
+        _head(y, params["heatmap"]).astype(jnp.float32),
+        _head(y, params["offsets"]).astype(jnp.float32),
+        _head(y, params["disp_fwd"]).astype(jnp.float32),
+        _head(y, params["disp_bwd"]).astype(jnp.float32),
+    )

@@ -1,0 +1,205 @@
+"""SSD-MobileNet-v2 detector — the bounding-box benchmark model.
+
+The reference's canonical detection fixture is ssd_mobilenet_v2_coco.tflite
+(tests/test_models/models/, used by tests/nnstreamer_decoder_boundingbox/ and
+the tensor_query object-detection example, tensor_query/README.md). This is a
+from-scratch jnp implementation of the same topology: MobileNet-v2 backbone
+(300x300 input), 6 SSD feature maps (19/10/5/3/2/1), 1917 prior boxes, and
+box/class heads producing the same two output tensors the reference decoder
+consumes in ``mobilenet-ssd`` mode (tensordec-boundingbox.c):
+
+    locations [N, 1917, 4]   (ycenter, xcenter, h, w offsets)
+    scores    [N, 1917, 91]  raw class logits, class 0 = background
+
+TPU-first notes: heads are 3x3 convs over NHWC maps (MXU-friendly), anchor
+decode + NMS for the ``_pp`` variant run **on device** as fixed-shape masked
+tensor ops (ops/detection.py) instead of the reference's per-object C loops,
+so the whole detect+postprocess graph is one XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models import mobilenet_v2, nn
+from nnstreamer_tpu.ops import detection as det
+
+# TF object-detection ssd_mobilenet anchor config: 6 layers, scales
+# interpolated in [0.2, 0.95], aspect ratios {1, 2, 1/2, 3, 1/3}, the lowest
+# layer reduced to 3 boxes, ratio-1 anchors get an extra interpolated scale.
+NUM_LAYERS = 6
+MIN_SCALE = 0.2
+MAX_SCALE = 0.95
+FEATURE_MAPS = (19, 10, 5, 3, 2, 1)
+ANCHORS_PER_CELL = (3, 6, 6, 6, 6, 6)
+NUM_ANCHORS = sum(a * f * f for a, f in zip(ANCHORS_PER_CELL, FEATURE_MAPS))  # 1917
+NUM_CLASSES = 91  # COCO + background
+
+# extra feature layers after the backbone: (mid 1x1 channels, out 3x3/s2 channels)
+_EXTRAS: Tuple[Tuple[int, int], ...] = ((256, 512), (128, 256), (128, 256), (64, 128))
+
+
+def generate_anchors() -> np.ndarray:
+    """Prior boxes as a [4, NUM_ANCHORS] array of rows (ycenter, xcenter,
+    h, w) — the exact layout of the reference's box-priors.txt consumed by
+    the bounding-box decoder (tensordec-boundingbox.c box-priors loading)."""
+    scales = [
+        MIN_SCALE + (MAX_SCALE - MIN_SCALE) * i / (NUM_LAYERS - 1)
+        for i in range(NUM_LAYERS)
+    ] + [1.0]
+    boxes: List[Tuple[float, float, float, float]] = []
+    for layer, fm in enumerate(FEATURE_MAPS):
+        if layer == 0:
+            # reduce_boxes_in_lowest_layer: fixed (scale, ratio) triple
+            layer_boxes = [(0.1, 1.0), (scales[0], 2.0), (scales[0], 0.5)]
+        else:
+            layer_boxes = [
+                (scales[layer], 1.0),
+                (scales[layer], 2.0),
+                (scales[layer], 0.5),
+                (scales[layer], 3.0),
+                (scales[layer], 1.0 / 3.0),
+                # interpolated scale anchor at ratio 1
+                (math.sqrt(scales[layer] * scales[layer + 1]), 1.0),
+            ]
+        for y in range(fm):
+            for x in range(fm):
+                yc = (y + 0.5) / fm
+                xc = (x + 0.5) / fm
+                for scale, ratio in layer_boxes:
+                    r = math.sqrt(ratio)
+                    boxes.append((yc, xc, scale / r, scale * r))
+    arr = np.asarray(boxes, np.float32).T  # [4, N]
+    assert arr.shape == (4, NUM_ANCHORS), arr.shape
+    return arr
+
+
+def write_box_priors(path: str) -> None:
+    """Write anchors in the reference box-priors.txt format: 4 lines
+    (ycenter / xcenter / h / w), NUM_ANCHORS space-separated values each."""
+    arr = generate_anchors()
+    with open(path, "w") as f:
+        for row in arr:
+            f.write(" ".join(f"{v:.8f}" for v in row) + "\n")
+
+
+def init_params(key, num_classes: int = NUM_CLASSES) -> Dict:
+    keys = iter(jax.random.split(key, 64))
+    p: Dict = {"backbone": mobilenet_v2.init_params(next(keys))}
+    # backbone taps: block 12 output (19x19x96) and head output (10x10x1280)
+    tap_channels = (96, 1280)
+    extras = []
+    cin = 1280
+    for mid, cout in _EXTRAS:
+        extras.append(
+            {
+                "squeeze": {"w": nn.init_conv(next(keys), 1, 1, cin, mid), "bn": nn.init_bn(mid)},
+                "expand": {"w": nn.init_conv(next(keys), 3, 3, mid, cout), "bn": nn.init_bn(cout)},
+            }
+        )
+        cin = cout
+    p["extras"] = extras
+    head_channels = tap_channels + tuple(c for _, c in _EXTRAS)
+    loc_heads, cls_heads = [], []
+    for c, a in zip(head_channels, ANCHORS_PER_CELL):
+        k1, k2 = next(keys), next(keys)
+        loc_heads.append(
+            {"w": nn.init_conv(k1, 3, 3, c, a * 4), "b": jnp.zeros((a * 4,), jnp.float32)}
+        )
+        cls_heads.append(
+            {
+                "w": nn.init_conv(k2, 3, 3, c, a * num_classes),
+                "b": jnp.zeros((a * num_classes,), jnp.float32),
+            }
+        )
+    p["loc_heads"] = loc_heads
+    p["cls_heads"] = cls_heads
+    return p
+
+
+def _feature_maps(params: Dict, x, train: bool):
+    """Run the backbone, tapping the SSD source maps."""
+    bb = params["backbone"]
+    y = nn.relu6(
+        nn.batch_norm(nn.conv2d(x, bb["stem"]["w"], stride=2), bb["stem"]["bn"], train)
+    )
+    strides = mobilenet_v2._block_strides()
+    taps = []
+    for i, (blk, stride) in enumerate(zip(bb["blocks"], strides)):
+        y = mobilenet_v2._block(y, blk, stride, train)
+        if i == 12:  # last 19x19 map (96ch) before the stride-2 160 group
+            taps.append(y)
+    y = nn.relu6(nn.batch_norm(nn.conv2d(y, bb["head"]["w"]), bb["head"]["bn"], train))
+    taps.append(y)  # 10x10x1280
+    for ex in params["extras"]:
+        y = nn.relu6(nn.batch_norm(nn.conv2d(y, ex["squeeze"]["w"]), ex["squeeze"]["bn"], train))
+        y = nn.relu6(
+            nn.batch_norm(nn.conv2d(y, ex["expand"]["w"], stride=2), ex["expand"]["bn"], train)
+        )
+        taps.append(y)
+    return taps
+
+
+def apply(
+    params: Dict, x, train: bool = False, compute_dtype=jnp.float32,
+    num_classes: int = NUM_CLASSES,
+):
+    """uint8/float NHWC [N,300,300,3] → (locations [N,1917,4],
+    scores [N,1917,num_classes])."""
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    maps = _feature_maps(params, x, train)
+    n = x.shape[0]
+    locs, scores = [], []
+    for fmap, lh, ch in zip(maps, params["loc_heads"], params["cls_heads"]):
+        l = nn.conv2d(fmap, lh["w"]) + lh["b"]
+        c = nn.conv2d(fmap, ch["w"]) + ch["b"]
+        locs.append(l.reshape(n, -1, 4))
+        scores.append(c.reshape(n, -1, num_classes))
+    loc = jnp.concatenate(locs, axis=1).astype(jnp.float32)
+    cls = jnp.concatenate(scores, axis=1).astype(jnp.float32)
+    return loc, cls
+
+
+def apply_postprocessed(
+    params: Dict,
+    x,
+    priors,
+    max_out: int = 10,
+    threshold: float = 0.001,
+    iou_threshold: float = det.SSD_IOU_THRESHOLD,
+    compute_dtype=jnp.float32,
+):
+    """Detector + on-device NMS → the 4-tensor TFLite detection-postprocess
+    layout the reference's ``mobilenet-ssd-postprocess`` decoder mode
+    expects: boxes [max,4] (ymin,xmin,ymax,xmax), classes [max], scores
+    [max], num [1]. All fixed-shape jax — one XLA program end to end."""
+    loc, cls = apply(params, x, compute_dtype=compute_dtype)
+    boxes = det.ssd_decode_boxes(loc[0], priors)  # [N,4] x1y1x2y2
+    probs = jax.nn.sigmoid(cls[0])
+    probs = probs.at[:, 0].set(0.0)
+    best = jnp.argmax(probs, axis=-1)
+    best_score = jnp.max(probs, axis=-1)
+    score = jnp.where(best_score >= threshold, best_score, 0.0)
+    keep_idx, keep_scores = det.nms(boxes, score, iou_threshold, max_out)
+    safe = jnp.maximum(keep_idx, 0)
+    kept = boxes[safe]  # x1,y1,x2,y2
+    valid = (keep_idx >= 0) & (keep_scores > 0)
+    out_boxes = jnp.where(
+        valid[:, None],
+        jnp.stack([kept[:, 1], kept[:, 0], kept[:, 3], kept[:, 2]], axis=-1),
+        0.0,
+    )
+    out_classes = jnp.where(valid, best[safe], 0).astype(jnp.float32)
+    out_scores = jnp.where(valid, keep_scores, 0.0)
+    num = jnp.sum(valid.astype(jnp.float32)).reshape(1)
+    return out_boxes, out_classes, out_scores, num

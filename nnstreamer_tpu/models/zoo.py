@@ -82,18 +82,161 @@ def _mobilenet_v2(**options) -> ZooModel:
     width = float(options.get("width", 1.0))
     batch = int(options.get("batch", 1))
     size = int(options.get("size", 224))
-    compute = options.get("compute_dtype", "float32")
-    in_dtype = options.get("input_dtype", "uint8")
+    compute_dtype = _compute_dtype(options)
     params = mobilenet_v2.init_params(
         jax.random.PRNGKey(seed), num_classes=num_classes, width=width
     )
     params = _load_params_overlay(params, options)
-    compute_dtype = jnp.dtype(compute) if compute != "bfloat16" else jnp.bfloat16
 
     def fn(image):
         return mobilenet_v2.apply(params, image, compute_dtype=compute_dtype)
 
-    spec = TensorsSpec.of(
+    spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
+    return ZooModel("mobilenet_v2", fn, spec, params)
+
+
+def _image_spec(batch: int, size: int, in_dtype: str) -> TensorsSpec:
+    return TensorsSpec.of(
         TensorSpec((batch, size, size, 3), DType.from_any(in_dtype), name="image")
     )
-    return ZooModel("mobilenet_v2", fn, spec, params)
+
+
+def _compute_dtype(options) -> "jnp.dtype":
+    compute = options.get("compute_dtype", "float32")
+    return jnp.bfloat16 if compute == "bfloat16" else jnp.dtype(compute)
+
+
+@model_factory("ssd_mobilenet_v2")
+def _ssd_mobilenet_v2(**options) -> ZooModel:
+    """Raw 2-tensor SSD (locations + class logits) for decoder
+    mode=mobilenet-ssd; the analogue of ssd_mobilenet_v2_coco.tflite."""
+    from nnstreamer_tpu.models import ssd_mobilenet
+
+    seed = int(options.get("seed", 0))
+    batch = int(options.get("batch", 1))
+    num_classes = int(options.get("num_classes", ssd_mobilenet.NUM_CLASSES))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        ssd_mobilenet.init_params(jax.random.PRNGKey(seed), num_classes), options
+    )
+
+    def fn(image):
+        return ssd_mobilenet.apply(
+            params, image, compute_dtype=dtype, num_classes=num_classes
+        )
+
+    spec = _image_spec(batch, 300, options.get("input_dtype", "uint8"))
+    return ZooModel("ssd_mobilenet_v2", fn, spec, params)
+
+
+@model_factory("ssd_mobilenet_v2_pp")
+def _ssd_mobilenet_v2_pp(**options) -> ZooModel:
+    """SSD + on-device NMS → the TFLite detection-postprocess 4-tensor
+    layout (decoder mode=mobilenet-ssd-postprocess). Batch-1."""
+    from nnstreamer_tpu.models import ssd_mobilenet
+
+    seed = int(options.get("seed", 0))
+    max_out = int(options.get("max_out", 10))
+    threshold = float(options.get("threshold", 0.001))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        ssd_mobilenet.init_params(jax.random.PRNGKey(seed)), options
+    )
+    priors = jnp.asarray(ssd_mobilenet.generate_anchors())
+
+    def fn(image):
+        return ssd_mobilenet.apply_postprocessed(
+            params, image, priors, max_out=max_out, threshold=threshold,
+            compute_dtype=dtype,
+        )
+
+    spec = _image_spec(1, 300, options.get("input_dtype", "uint8"))
+    return ZooModel("ssd_mobilenet_v2_pp", fn, spec, params)
+
+
+@model_factory("posenet")
+def _posenet(**options) -> ZooModel:
+    """PoseNet MobileNet-v1 257x257 multi-output (heatmap/offsets/
+    displacements) — decoder mode=pose-estimation."""
+    from nnstreamer_tpu.models import posenet
+
+    seed = int(options.get("seed", 0))
+    batch = int(options.get("batch", 1))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(posenet.init_params(jax.random.PRNGKey(seed)), options)
+
+    def fn(image):
+        return posenet.apply(params, image, compute_dtype=dtype)
+
+    spec = _image_spec(batch, posenet.INPUT_SIZE, options.get("input_dtype", "uint8"))
+    return ZooModel("posenet", fn, spec, params)
+
+
+@model_factory("deeplab_v3")
+def _deeplab_v3(**options) -> ZooModel:
+    """DeepLab-v3 MobileNet-v2 257x257x21 — decoder mode=image-segment
+    (tflite-deeplab)."""
+    from nnstreamer_tpu.models import deeplab_v3
+
+    seed = int(options.get("seed", 0))
+    batch = int(options.get("batch", 1))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        deeplab_v3.init_params(jax.random.PRNGKey(seed)), options
+    )
+
+    def fn(image):
+        return deeplab_v3.apply(params, image, compute_dtype=dtype)
+
+    spec = _image_spec(batch, deeplab_v3.INPUT_SIZE, options.get("input_dtype", "uint8"))
+    return ZooModel("deeplab_v3", fn, spec, params)
+
+
+@model_factory("face_detect")
+def _face_detect(**options) -> ZooModel:
+    """Face detector. Default output: [max_faces,7] OV detection rows
+    (decoder mode=ov-face-detection). ``output=regions`` emits int32
+    [max_faces,4] pixel (x,y,w,h) for tensor_crop, scaled to
+    ``frame_size=W:H`` (defaults to the model input size)."""
+    from nnstreamer_tpu.models import face_pipeline as fp
+
+    seed = int(options.get("seed", 0))
+    max_faces = int(options.get("max_faces", fp.MAX_FACES))
+    dtype = _compute_dtype(options)
+    out_mode = options.get("output", "ov")
+    threshold = float(options.get("threshold", 0.5))
+    frame_size = options.get("frame_size", f"{fp.DETECT_SIZE}:{fp.DETECT_SIZE}")
+    fw, fh = (int(v) for v in frame_size.split(":"))
+    params = _load_params_overlay(
+        fp.init_detect_params(jax.random.PRNGKey(seed)), options
+    )
+
+    def fn(image):
+        det = fp.apply_detect(params, image, max_faces=max_faces, compute_dtype=dtype)
+        if out_mode == "regions":
+            return fp.detections_to_regions(det, fw, fh, threshold)
+        return det
+
+    spec = _image_spec(1, fp.DETECT_SIZE, options.get("input_dtype", "uint8"))
+    return ZooModel("face_detect", fn, spec, params)
+
+
+@model_factory("face_landmark")
+def _face_landmark(**options) -> ZooModel:
+    """68-point landmark net on face crops (global-pooled trunk, so any
+    crop size ≥16 works; spec advertises the canonical 112)."""
+    from nnstreamer_tpu.models import face_pipeline as fp
+
+    seed = int(options.get("seed", 0))
+    batch = int(options.get("batch", 1))
+    size = int(options.get("size", fp.LANDMARK_SIZE))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        fp.init_landmark_params(jax.random.PRNGKey(seed)), options
+    )
+
+    def fn(image):
+        return fp.apply_landmark(params, image, compute_dtype=dtype)
+
+    spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
+    return ZooModel("face_landmark", fn, spec, params)
