@@ -1,0 +1,161 @@
+"""Decoder-only transformer LM — the long-context flagship family.
+
+The reference has no sequence models (SURVEY.md §5.7); this family exists to
+exercise the framework's genuinely-new long-context path: the attention is
+pluggable, so the same params run dense (single chip), ring attention
+(sequence-parallel over ICI, parallel/ring_attention.py), or Ulysses
+(parallel/ulysses.py) — and the block stack is a *stacked* pytree (every
+leaf carries a leading [n_layers] dim, consumed by ``lax.scan``), which is
+what lets pipeline parallelism shard layers over a mesh axis by slicing one
+array (parallel/pipeline_parallel.py).
+
+Architecture: RMSNorm pre-norm, RoPE, multi-head attention, SwiGLU MLP,
+tied-free output head. bfloat16 compute / float32 params by default on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.parallel.ring_attention import dense_attention
+
+
+def rmsnorm(x, w, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def rope(x, positions, base: float = 10000.0):
+    """Rotary embedding over the last dim. x [B,T,H,D], positions [T]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _init_dense(key, cin, cout, scale=None):
+    std = scale if scale is not None else math.sqrt(1.0 / cin)
+    return jax.random.normal(key, (cin, cout), jnp.float32) * std
+
+
+def init_params(
+    key,
+    vocab: int = 1024,
+    d_model: int = 256,
+    n_heads: int = 8,
+    n_layers: int = 4,
+    d_ff: Optional[int] = None,
+) -> Dict:
+    d_ff = d_ff or 4 * d_model
+    k = iter(jax.random.split(key, 8))
+    L = n_layers
+
+    def stack(init_one):
+        keys = jax.random.split(next(k), L)
+        return jax.vmap(init_one)(keys)
+
+    blocks = {
+        "ln1": jnp.ones((L, d_model), jnp.float32),
+        "ln2": jnp.ones((L, d_model), jnp.float32),
+        "wqkv": stack(lambda kk: _init_dense(kk, d_model, 3 * d_model)),
+        "wo": stack(lambda kk: _init_dense(kk, d_model, d_model)),
+        "w_gate": stack(lambda kk: _init_dense(kk, d_model, d_ff)),
+        "w_up": stack(lambda kk: _init_dense(kk, d_model, d_ff)),
+        "w_down": stack(lambda kk: _init_dense(kk, d_ff, d_model)),
+    }
+    return {
+        "embed": jax.random.normal(next(k), (vocab, d_model), jnp.float32) * 0.02,
+        "blocks": blocks,
+        "ln_f": jnp.ones((d_model,), jnp.float32),
+        "head": _init_dense(next(k), d_model, vocab),
+        # static metadata kept out of the grad path by being python ints
+    }
+
+
+def block_apply(
+    x,
+    blk: Dict,
+    n_heads: int,
+    positions,
+    attn_fn: Optional[Callable] = None,
+    ffn_fn: Optional[Callable] = None,
+):
+    """One transformer block. blk leaves are per-layer (no leading L dim).
+    attn_fn(q, k, v, causal=True) → [B,T,H,D] float32;
+    ffn_fn(x_normed, blk) → [B,T,D] overrides the SwiGLU MLP (MoE hook)."""
+    attn = attn_fn or dense_attention
+    b, t, d = x.shape
+    h = n_heads
+    hd = d // h
+
+    y = rmsnorm(x, blk["ln1"])
+    qkv = y @ blk["wqkv"].astype(y.dtype)
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    q = rope(q.reshape(b, t, h, hd), positions)
+    kk = rope(kk.reshape(b, t, h, hd), positions)
+    v = v.reshape(b, t, h, hd)
+    o = attn(q, kk, v, causal=True).astype(x.dtype)
+    x = x + o.reshape(b, t, d) @ blk["wo"].astype(x.dtype)
+
+    y = rmsnorm(x, blk["ln2"])
+    if ffn_fn is not None:
+        return x + ffn_fn(y, blk).astype(x.dtype)
+    gate = jax.nn.silu(y @ blk["w_gate"].astype(y.dtype))
+    up = y @ blk["w_up"].astype(y.dtype)
+    return x + (gate * up) @ blk["w_down"].astype(y.dtype)
+
+
+def apply_layers(
+    blocks: Dict,
+    x,
+    n_heads: int,
+    positions,
+    attn_fn: Optional[Callable] = None,
+    ffn_fn: Optional[Callable] = None,
+):
+    """Run a stacked block pytree (leaves [L, ...]) via lax.scan — one
+    compiled block body regardless of depth; pipeline stages call this on
+    their layer slice."""
+
+    def body(carry, blk):
+        return (
+            block_apply(carry, blk, n_heads, positions, attn_fn, ffn_fn),
+            None,
+        )
+
+    out, _ = jax.lax.scan(body, x, blocks)
+    return out
+
+
+def apply(
+    params: Dict,
+    tokens,
+    n_heads: int,
+    attn_fn: Optional[Callable] = None,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+    positions=None,
+):
+    """tokens [B, T] int32 → logits [B, T, vocab] float32.
+
+    ``positions`` [T] overrides the default arange — REQUIRED when tokens
+    are a sequence shard (sequence parallelism): RoPE needs the *global*
+    position of each token, so shard i of width Tl passes
+    ``i*Tl + arange(Tl)``."""
+    x = params["embed"][tokens].astype(compute_dtype)
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+    x = apply_layers(params["blocks"], x, n_heads, positions, attn_fn, ffn_fn)
+    x = rmsnorm(x, params["ln_f"])
+    return (x @ params["head"].astype(x.dtype)).astype(jnp.float32)

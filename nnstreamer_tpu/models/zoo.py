@@ -221,6 +221,34 @@ def _face_detect(**options) -> ZooModel:
     return ZooModel("face_detect", fn, spec, params)
 
 
+@model_factory("transformer_lm")
+def _transformer_lm(**options) -> ZooModel:
+    """Decoder-only transformer LM (models/transformer.py) — the
+    long-context flagship. fn: int32 tokens [B,T] → logits [B,T,V]."""
+    from nnstreamer_tpu.models import transformer as tfm
+
+    seed = int(options.get("seed", 0))
+    vocab = int(options.get("vocab", 1024))
+    d_model = int(options.get("d_model", 256))
+    n_heads = int(options.get("n_heads", 8))
+    n_layers = int(options.get("n_layers", 4))
+    batch = int(options.get("batch", 1))
+    seqlen = int(options.get("seqlen", 128))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        tfm.init_params(jax.random.PRNGKey(seed), vocab, d_model, n_heads, n_layers),
+        options,
+    )
+
+    def fn(tokens):
+        return tfm.apply(params, tokens, n_heads, compute_dtype=dtype)
+
+    spec = TensorsSpec.of(
+        TensorSpec((batch, seqlen), DType.from_any("int32"), name="tokens")
+    )
+    return ZooModel("transformer_lm", fn, spec, params)
+
+
 @model_factory("face_landmark")
 def _face_landmark(**options) -> ZooModel:
     """68-point landmark net on face crops (global-pooled trunk, so any
