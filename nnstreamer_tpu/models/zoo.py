@@ -239,9 +239,18 @@ def _transformer_lm(**options) -> ZooModel:
         tfm.init_params(jax.random.PRNGKey(seed), vocab, d_model, n_heads, n_layers),
         options,
     )
+    attn_kind = options.get("attn", "dense")
+    if attn_kind == "flash":
+        from nnstreamer_tpu.ops.pallas.flash_attention import make_flash_attention
+
+        attn_fn = make_flash_attention()
+    elif attn_kind == "dense":
+        attn_fn = None
+    else:
+        raise KeyError(f"transformer_lm: unknown attn {attn_kind!r}")
 
     def fn(tokens):
-        return tfm.apply(params, tokens, n_heads, compute_dtype=dtype)
+        return tfm.apply(params, tokens, n_heads, attn_fn=attn_fn, compute_dtype=dtype)
 
     spec = TensorsSpec.of(
         TensorSpec((batch, seqlen), DType.from_any("int32"), name="tokens")

@@ -1,0 +1,9 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+XLA fuses most of the pipeline (SURVEY.md §7 design mapping); these kernels
+cover the cases where explicit VMEM blocking beats the fusion XLA picks —
+flash attention first. Every kernel has an ``interpret=True`` path so the
+CPU test mesh exercises the same code the TPU runs.
+"""
+
+from nnstreamer_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401

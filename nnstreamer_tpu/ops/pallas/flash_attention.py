@@ -1,0 +1,178 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The single-chip hot path for the long-context family (models/transformer.py)
+— the [T, T] score matrix never leaves VMEM: the grid walks (batch*heads,
+q-blocks, k-blocks) with the k dimension innermost ("arbitrary" semantics —
+sequential on TPU), carrying the online-softmax running max/denominator/
+accumulator in VMEM scratch across k iterations. Q/K/V blocks stream
+HBM→VMEM via BlockSpecs (double-buffered by the pallas pipeline); the
+s = q·kᵀ and p·v contractions hit the MXU with float32 accumulation
+(preferred_element_type), so bfloat16 inputs keep full softmax precision.
+
+Causal masking compares global row/col indices built from program_id;
+fully-masked k-blocks are predicated off with @pl.when, so the causal case
+does ~half the work. Matches parallel/ring_attention.dense_attention to
+float tolerance (tests/test_pallas.py); composes with ring attention by
+serving as the per-shard block math (the same online recurrence
+ring_attention_local runs per rotation).
+
+Layout: [B, T, H, D] like the rest of the framework; internally [B*H, T, D].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_k: int,
+    valid_len: Optional[int],
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # predicate off blocks with no live entries: strictly-above-diagonal
+    # (causal) and fully-padded (valid_len) ones
+    live = True
+    if causal:
+        live = q_start + block_q - 1 >= k_start
+    if valid_len is not None:
+        live = jnp.logical_and(live, k_start < valid_len)
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal or valid_len is not None:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = jnp.ones(s.shape, bool)
+            if causal:
+                mask = rows >= cols
+            if valid_len is not None:
+                mask = jnp.logical_and(mask, cols < valid_len)
+            s = jnp.where(mask, s, NEG_INF)
+        # mosaic note: bool vectors cannot gain a minor dim — expand the
+        # f32 operands first, compare in 2D
+        m_prev = m_ref[:]  # [bq]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        m_new2 = m_new[:, None]
+        p = jnp.where(m_new2 <= NEG_INF, 0.0, jnp.exp(s - m_new2))
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _final():
+        l2 = l_ref[:][:, None]
+        o_ref[0] = jnp.where(
+            l2 > 0, acc_ref[:] / jnp.maximum(l2, 1e-30), 0.0
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """q, k, v: [B, T, H, D] → [B, T, H, D] float32.
+
+    T pads up to a block multiple internally; padded key columns are
+    masked to NEG_INF and padded query rows are sliced off on return."""
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bq = min(block_q, max(t, 16))
+    bk = min(block_k, max(t, 16))
+    blk = max(bq, bk)
+    t_pad = -(-t // blk) * blk
+
+    def to_bh(x):
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+        return x
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    n_q, n_k = t_pad // bq, t_pad // bk
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        causal=causal,
+        block_q=bq,
+        block_k=bk,
+        n_k=n_k,
+        valid_len=t if t_pad != t else None,
+    )
+
+    from jax.experimental.pallas import tpu as pltpu  # lazy: CPU tests interpret
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), jnp.float32),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out[:, :t, :].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def make_flash_attention(interpret: Optional[bool] = None, **kwargs):
+    """attn_fn factory matching the transformer's pluggable signature.
+    interpret=None auto-selects: real kernel on TPU, interpreter elsewhere."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def attn(q, k, v, causal: bool = True):
+        return flash_attention(q, k, v, causal=causal, interpret=interpret, **kwargs)
+
+    return attn
