@@ -51,6 +51,14 @@ def main(argv=None) -> int:
     ap.add_argument("--dot", action="store_true", help="print graphviz, don't run")
     ap.add_argument("--timeout", type=float, default=None, help="run timeout (s)")
     ap.add_argument("--stats", action="store_true", help="print per-node stats JSON")
+    ap.add_argument(
+        "--trace", metavar="FILE", default=None,
+        help="write chrome://tracing JSON of per-element frame spans",
+    )
+    ap.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="capture an on-device (XLA/TPU) profile into a TensorBoard logdir",
+    )
     ap.add_argument("--quiet", "-q", action="store_true")
     args = ap.parse_args(argv)
 
@@ -68,15 +76,29 @@ def main(argv=None) -> int:
         return 0
     if not args.quiet:
         print(f"Setting pipeline PLAYING ({len(pipeline.elements)} elements)", file=sys.stderr)
+    import contextlib
+
+    from nnstreamer_tpu import trace as trace_mod
+
+    tracer = trace_mod.enable() if args.trace else None
+    profile_cm = (
+        trace_mod.device_profile(args.profile) if args.profile
+        else contextlib.nullcontext()
+    )
     t0 = time.perf_counter()
     timed_out = False
-    try:
-        ex = pipeline.run(timeout=args.timeout)
-    except TimeoutError:
-        # operator-requested bound on an endless pipeline: a stop, not a bug
-        ex = pipeline._executor
-        timed_out = True
+    with profile_cm:
+        try:
+            ex = pipeline.run(timeout=args.timeout)
+        except TimeoutError:
+            # operator-requested bound on an endless pipeline: a stop, not a bug
+            ex = pipeline._executor
+            timed_out = True
     dt = time.perf_counter() - t0
+    if tracer is not None:
+        tracer.save(args.trace)
+        if not args.quiet:
+            print(f"Trace written to {args.trace}", file=sys.stderr)
     if not args.quiet:
         msg = "Timeout reached" if timed_out else "EOS"
         print(f"{msg} after {dt:.3f}s", file=sys.stderr)

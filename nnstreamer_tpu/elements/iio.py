@@ -1,0 +1,179 @@
+"""tensor_src_iio: Linux Industrial-I/O sensor source.
+
+Reference: gst/nnstreamer/elements/gsttensor_srciio.c (2604 LoC) — scans
+/sys/bus/iio/devices for iio:deviceN entries, resolves a device by name or
+number, enumerates in_*_raw scan channels, configures sampling frequency,
+and merges enabled channels into one tensor per capture (registration is
+Linux-only, registerer/nnstreamer.c:113-119).
+
+TPU-native redesign: the sysfs scanning/config logic is host-side and
+stays faithful (same device/channel resolution, scale/offset application:
+value = (raw + offset) * scale); the capture loop is the polled one-shot
+path (reading in_<ch>_raw at ``frequency`` Hz with a bounded wait, so the
+executor's stop event is honored — the reference's poll() timeout,
+gsttensor_srciio.c:379-381). The buffered /dev/iio:deviceN character-device
+path needs kernel trigger support and is intentionally not emulated; a
+``base-dir`` property points the scanner at any sysfs root, which is how
+tests provide a fake device tree (the reference tests do the same with
+mock sysfs dirs).
+
+Output: one float32 tensor [1, n_channels] per capture (merge-channels
+layout), framerate = frequency.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from fractions import Fraction
+from typing import Dict, List, Optional
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import ElementError, NegotiationError, Source, Spec
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+import numpy as np
+
+DEFAULT_BASE_DIR = "/sys/bus/iio/devices"
+_CHANNEL_RE = re.compile(r"^in_(.+)_raw$")
+
+
+def _read(path: str, default: Optional[str] = None) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return default
+
+
+def scan_devices(base_dir: str = DEFAULT_BASE_DIR) -> Dict[str, str]:
+    """name → device dir for every iio:deviceN under base_dir."""
+    out: Dict[str, str] = {}
+    try:
+        entries = sorted(os.listdir(base_dir))
+    except OSError:
+        return out
+    for entry in entries:
+        if not entry.startswith("iio:device"):
+            continue
+        d = os.path.join(base_dir, entry)
+        name = _read(os.path.join(d, "name"), entry)
+        out[name] = d
+    return out
+
+
+@registry.element("tensor_src_iio")
+class TensorSrcIIO(Source):
+    """Props: device (name), device-number, frequency (Hz, default 10),
+    channels (comma list of channel names, default all), num-frames
+    (-1 = endless), base-dir (sysfs root, for tests/containers)."""
+
+    FACTORY_NAME = "tensor_src_iio"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.base_dir = str(self.get_property("base-dir", DEFAULT_BASE_DIR))
+        self.device = self.get_property("device", None)
+        self.device_number = self.get_property("device-number", None)
+        self.frequency = float(self.get_property("frequency", 10.0))
+        self.num_frames = int(self.get_property("num-frames", -1))
+        chans = str(self.get_property("channels", ""))
+        self._want_channels = [c for c in chans.split(",") if c] or None
+        self._dir: Optional[str] = None
+        self._channels: List[str] = []
+        self._scales: Optional[np.ndarray] = None
+        self._offsets: Optional[np.ndarray] = None
+        self._i = 0
+        self._next_t: Optional[float] = None
+
+    # -- device resolution (reference: scan + match by name/number) --------
+    def _resolve(self) -> None:
+        if self._dir is not None:
+            return
+        if self.device_number is not None:
+            d = os.path.join(self.base_dir, f"iio:device{int(self.device_number)}")
+            if not os.path.isdir(d):
+                raise ElementError(f"{self.name}: no such IIO device dir {d}")
+            self._dir = d
+        else:
+            devices = scan_devices(self.base_dir)
+            if not devices:
+                raise ElementError(
+                    f"{self.name}: no IIO devices under {self.base_dir}"
+                )
+            if self.device is None:
+                self._dir = next(iter(devices.values()))
+            elif str(self.device) in devices:
+                self._dir = devices[str(self.device)]
+            else:
+                raise ElementError(
+                    f"{self.name}: IIO device {self.device!r} not found; "
+                    f"available: {sorted(devices)}"
+                )
+        found = sorted(
+            m.group(1)
+            for m in (_CHANNEL_RE.match(f) for f in os.listdir(self._dir))
+            if m
+        )
+        if self._want_channels:
+            missing = [c for c in self._want_channels if c not in found]
+            if missing:
+                raise ElementError(f"{self.name}: channels not found: {missing}")
+            self._channels = list(self._want_channels)
+        else:
+            self._channels = found
+        if not self._channels:
+            raise ElementError(f"{self.name}: device has no in_*_raw channels")
+        # per-channel scale/offset with device-wide fallback (IIO ABI)
+        def per_channel(suffix: str, default: float) -> np.ndarray:
+            dev_wide = _read(os.path.join(self._dir, f"in_{suffix}"))
+            vals = []
+            for c in self._channels:
+                v = _read(os.path.join(self._dir, f"in_{c}_{suffix}"), dev_wide)
+                vals.append(float(v) if v is not None else default)
+            return np.asarray(vals, np.float32)
+
+        self._scales = per_channel("scale", 1.0)
+        self._offsets = per_channel("offset", 0.0)
+        # push requested sampling frequency if the device exposes the knob
+        freq_path = os.path.join(self._dir, "sampling_frequency")
+        if os.path.isfile(freq_path) and os.access(freq_path, os.W_OK):
+            try:
+                with open(freq_path, "w") as f:
+                    f.write(str(self.frequency))
+            except OSError:
+                pass
+
+    def output_spec(self) -> Spec:
+        self._resolve()
+        rate = Fraction(self.frequency).limit_denominator(1000)
+        return TensorsSpec.of(
+            TensorSpec((1, len(self._channels)), DType.FLOAT32, name="iio"),
+            rate=rate,
+        )
+
+    def generate(self):
+        if self.num_frames >= 0 and self._i >= self.num_frames:
+            return EOS_FRAME
+        now = time.monotonic()
+        if self._next_t is None:
+            self._next_t = now
+        if now < self._next_t:
+            # bounded wait so the executor can stop us (reference poll timeout)
+            time.sleep(min(self._next_t - now, 0.1))
+            if time.monotonic() < self._next_t:
+                return None
+        self._next_t += 1.0 / self.frequency
+        raw = np.empty((len(self._channels),), np.float32)
+        for j, c in enumerate(self._channels):
+            v = _read(os.path.join(self._dir, f"in_{c}_raw"), "0")
+            try:
+                raw[j] = float(v)
+            except ValueError:
+                raise ElementError(f"{self.name}: bad raw value {v!r} for {c}")
+        data = ((raw + self._offsets) * self._scales).reshape(1, -1)
+        pts = Fraction(self._i) / Fraction(self.frequency).limit_denominator(1000)
+        self._i += 1
+        return Frame((data,), pts=pts)

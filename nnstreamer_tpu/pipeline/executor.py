@@ -34,6 +34,7 @@ from nnstreamer_tpu.elements.base import (
     Source,
     TensorOp,
 )
+from nnstreamer_tpu import trace
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.pipeline.graph import ExecPlan, FusedSegment, Link
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
@@ -109,10 +110,17 @@ class Node:
         raise NotImplementedError
 
     def stat(self, t0: float) -> None:
-        dt = (time.perf_counter() - t0) * 1000.0
+        now = time.perf_counter()
+        dt = (now - t0) * 1000.0
         self.frames_processed += 1
         a = 0.2
         self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+        tracer = trace.get()
+        if tracer is not None:
+            tracer.complete(
+                self.name, type(self).__name__, t0, now - t0,
+                {"frame": self.frames_processed},
+            )
 
 
 class SourceNode(Node):

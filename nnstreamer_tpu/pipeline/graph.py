@@ -231,6 +231,17 @@ class Pipeline:
         ex = self.start()
         completed = ex.wait(timeout)
         ex.stop()
+        # NNS_TRACE=<path> env opt-in (GST_DEBUG_DUMP_DOT_DIR-style):
+        # flush the chrome trace when the pipeline winds down
+        import os
+
+        from nnstreamer_tpu import trace as trace_mod
+
+        trace_path = os.environ.get("NNS_TRACE")
+        if trace_path:
+            tracer = trace_mod.get()
+            if tracer is not None:
+                tracer.save(trace_path)
         if ex.errors:
             raise ex.errors[0]
         if not completed:

@@ -1,0 +1,4 @@
+"""Developer tools (reference tools/development/, SURVEY.md §2.5):
+codegen (custom-plugin scaffolds), confchk (config sanity checker),
+pbtxt (pipeline description → mediapipe-style pbtxt). Each runs as
+``python -m nnstreamer_tpu.tools.<name>``."""

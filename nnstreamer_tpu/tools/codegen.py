@@ -1,0 +1,122 @@
+"""Custom-plugin scaffold generator.
+
+Reference: tools/development/nnstreamerCodeGenCustomFilter.py — emits a
+buildable skeleton for a custom tensor_filter. Here the plugin ABI is
+Python (backends/custom.py, decoders/, converters/ registries), so the
+scaffold is a ready-to-run .py the search-path loader picks up
+(config [filter]/[decoder]/[converter] plugin_paths).
+
+Usage: python -m nnstreamer_tpu.tools.codegen filter my_op [-o DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_FILTER_TEMPLATE = '''"""Custom tensor_filter: {name}.
+
+Load with: tensor_filter framework=custom model={name}.py
+(python3-subplugin protocol, backends/custom.py CustomScriptBackend).
+"""
+
+import jax.numpy as jnp
+
+
+class CustomFilter:
+    TRACEABLE = True  # jnp-only invoke: the pipeline compiler may fuse it
+
+    def setInputDim(self, in_spec):
+        """Shape-polymorphic: accept the upstream spec, return the output
+        spec (here passthrough). Shape-fixed filters implement
+        getInputDim()/getOutputDim() instead."""
+        self.in_spec = in_spec
+        return in_spec
+
+    def invoke(self, tensors):
+        return tuple(jnp.asarray(t) for t in tensors)
+'''
+
+_DECODER_TEMPLATE = '''"""Custom tensor_decoder subplugin: {name}.
+
+Use with: tensor_decoder mode=custom-code option1={name}
+after register(), or put on a [decoder] plugin_paths directory.
+"""
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import MediaSpec
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("{name}")
+class {cls}Decoder:
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        return MediaSpec("application", format="octet-stream")
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        data = np.asarray(frame.tensors[0])
+        return frame.with_tensors((data.tobytes(),))
+'''
+
+_CONVERTER_TEMPLATE = '''"""Custom tensor_converter subplugin: {name}.
+
+Importing registers it; place on a [converter] plugin_paths directory to
+load by name (registry search paths), then: tensor_converter mode={name}.
+"""
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+@registry.converter_plugin("{name}")
+class {cls}Converter:
+    def negotiate(self, in_spec, props: dict) -> TensorsSpec:
+        return TensorsSpec.of(TensorSpec((1,), DType.UINT8))
+
+    def convert(self, frame: Frame, props: dict) -> Frame:
+        data = np.asarray(frame.tensors[0], dtype=np.uint8)
+        return frame.with_tensors((data.reshape(1, -1),))
+'''
+
+_TEMPLATES = {
+    "filter": ("{name}.py", _FILTER_TEMPLATE),
+    "decoder": ("{name}_decoder.py", _DECODER_TEMPLATE),
+    "converter": ("{name}_converter.py", _CONVERTER_TEMPLATE),
+}
+
+
+def generate(kind: str, name: str, out_dir: str = ".") -> str:
+    if kind not in _TEMPLATES:
+        raise ValueError(f"unknown kind {kind!r}; one of {sorted(_TEMPLATES)}")
+    if not name.isidentifier():
+        raise ValueError(f"name must be a python identifier, got {name!r}")
+    fname, template = _TEMPLATES[kind]
+    cls = "".join(part.capitalize() for part in name.split("_"))
+    path = os.path.join(out_dir, fname.format(name=name))
+    if os.path.exists(path):
+        raise FileExistsError(path)
+    with open(path, "w") as f:
+        f.write(template.format(name=name, cls=cls))
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nns-codegen", description=__doc__)
+    ap.add_argument("kind", choices=sorted(_TEMPLATES))
+    ap.add_argument("name", help="plugin name (python identifier)")
+    ap.add_argument("-o", "--out-dir", default=".")
+    args = ap.parse_args(argv)
+    path = generate(args.kind, args.name, args.out_dir)
+    print(f"generated {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

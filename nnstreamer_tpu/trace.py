@@ -1,0 +1,146 @@
+"""Tracing / profiling: chrome-trace spans + device profiler integration.
+
+The reference has no in-tree tracer — it leans on GstShark/NNShark/
+HawkTracer (tools/tracing/README.md, tools/profiling/README.md; SURVEY.md
+§5.1), whose common output is chrome://tracing JSON. This module brings
+that capability in-tree:
+
+- ``Tracer``: lock-protected event buffer; ``span()`` context manager and
+  ``complete()`` record "X" (complete) events per element/frame,
+  ``instant()`` marks points, ``counter()`` tracks gauges (queue depths).
+  ``save()`` writes the Chrome Trace Event Format JSON that chrome://tracing
+  / Perfetto load directly (the HawkTracer workflow, no external daemon).
+- The executor records one span per frame per node when tracing is enabled
+  (pipeline/executor.py Node.stat), giving the per-element timeline
+  NNShark's per-element CPU/proctime view provides.
+- ``device_profile()``: wraps ``jax.profiler.trace`` — the XPlane/TensorBoard
+  capture for on-device (TPU) timing, the XLA-world analogue of GstShark's
+  proctime tracer.
+
+Enable via ``trace.enable()`` / ``nns-launch --trace out.json``; env knob
+``NNS_TRACE`` (path) mirrors the reference's GST_DEBUG_DUMP_DOT_DIR-style
+opt-in (nnstreamer_conf env > ini > default priority, SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_tracer: Optional["Tracer"] = None
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: List[Dict] = []
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+
+    # -- recording ---------------------------------------------------------
+    def _ts_us(self, t: Optional[float] = None) -> float:
+        return ((t if t is not None else time.perf_counter()) - self._t0) * 1e6
+
+    def complete(
+        self, name: str, cat: str, t_start: float, dur_s: float, args: Optional[Dict] = None
+    ) -> None:
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": self._ts_us(t_start),
+            "dur": dur_s * 1e6,
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "element", **args):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.complete(name, cat, t0, time.perf_counter() - t0, args or None)
+
+    def instant(self, name: str, cat: str = "event", **args) -> None:
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name, "cat": cat, "ph": "i", "s": "t",
+                    "ts": self._ts_us(), "pid": self._pid,
+                    "tid": threading.get_ident() & 0xFFFF,
+                    "args": args or {},
+                }
+            )
+
+    def counter(self, name: str, **values: float) -> None:
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name, "cat": "counter", "ph": "C",
+                    "ts": self._ts_us(), "pid": self._pid, "tid": 0,
+                    "args": values,
+                }
+            )
+
+    # -- output ------------------------------------------------------------
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_chrome_trace(self) -> Dict:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+def enable() -> Tracer:
+    """Install (or return) the global tracer; executor nodes start
+    recording as soon as this exists."""
+    global _tracer
+    with _lock:
+        if _tracer is None:
+            _tracer = Tracer()
+        return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    with _lock:
+        _tracer = None
+
+
+def get() -> Optional[Tracer]:
+    """Active tracer or None (the hot-path check: one global read)."""
+    t = _tracer
+    if t is None and os.environ.get("NNS_TRACE"):
+        t = enable()
+    return t
+
+
+@contextlib.contextmanager
+def device_profile(logdir: str):
+    """On-device (TPU/XLA) profile capture → TensorBoard/XProf logdir.
+    The XPlane-level complement to the host-side chrome trace."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,87 @@
+"""tensor_src_iio tests with a fake sysfs tree (the reference's mock-sysfs
+strategy, tests/nnstreamer_source/*)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import ElementError
+from nnstreamer_tpu.elements.converter import TensorConverter
+from nnstreamer_tpu.elements.iio import TensorSrcIIO, scan_devices
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.tensors.frame import EOS_FRAME
+
+
+def _fake_device(tmp_path, n=0, name="accel_3d", channels=("accel_x", "accel_y")):
+    d = tmp_path / f"iio:device{n}"
+    d.mkdir(parents=True)
+    (d / "name").write_text(name + "\n")
+    for i, c in enumerate(channels):
+        (d / f"in_{c}_raw").write_text(f"{100 + i}\n")
+        (d / f"in_{c}_scale").write_text("0.5\n")
+        (d / f"in_{c}_offset").write_text("2\n")
+    (d / "sampling_frequency").write_text("100\n")
+    return d
+
+
+def test_scan_devices(tmp_path):
+    _fake_device(tmp_path, 0, "accel_3d")
+    _fake_device(tmp_path, 1, "gyro_3d", channels=("anglvel_x",))
+    devs = scan_devices(str(tmp_path))
+    assert set(devs) == {"accel_3d", "gyro_3d"}
+
+
+def test_capture_applies_scale_offset(tmp_path):
+    _fake_device(tmp_path, 0)
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path), "device": "accel_3d",
+           "frequency": 1000, "num-frames": 2}
+    )
+    spec = src.output_spec()
+    assert spec[0].shape == (1, 2)
+    f = None
+    while f is None:
+        f = src.generate()
+    data = np.asarray(f.tensors[0])
+    # (raw + offset) * scale = (100+2)*0.5, (101+2)*0.5
+    np.testing.assert_allclose(data, [[51.0, 51.5]])
+
+
+def test_channel_selection_and_order(tmp_path):
+    _fake_device(tmp_path, 0, channels=("accel_x", "accel_y", "accel_z"))
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path), "channels": "accel_z,accel_x",
+           "frequency": 1000, "num-frames": 1}
+    )
+    assert src.output_spec()[0].shape == (1, 2)
+    assert src._channels == ["accel_z", "accel_x"]
+
+
+def test_missing_device_errors(tmp_path):
+    _fake_device(tmp_path, 0)
+    src = TensorSrcIIO(**{"base-dir": str(tmp_path), "device": "nope"})
+    with pytest.raises(ElementError, match="not found"):
+        src.output_spec()
+
+
+def test_eos_after_num_frames(tmp_path):
+    _fake_device(tmp_path, 0)
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path), "frequency": 10000, "num-frames": 1}
+    )
+    src.output_spec()
+    f = None
+    while f is None:
+        f = src.generate()
+    assert src.generate() is EOS_FRAME
+
+
+def test_pipeline_end_to_end(tmp_path):
+    _fake_device(tmp_path, 0)
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path), "frequency": 500, "num-frames": 3}
+    )
+    sink = TensorSink()
+    Pipeline().chain(src, sink).run(timeout=30)
+    assert sink.rendered == 3
+    assert sink.frames[0].tensors[0].shape == (1, 2)

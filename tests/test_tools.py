@@ -1,0 +1,102 @@
+"""Developer-tool tests (reference tools/development/: codegen, confchk,
+pipeline→pbtxt parser; SURVEY.md §2.5)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tools import codegen, confchk, pbtxt
+
+
+class TestCodegen:
+    def test_filter_scaffold_is_loadable(self, tmp_path):
+        path = codegen.generate("filter", "my_op", str(tmp_path))
+        from nnstreamer_tpu.single import SingleShot
+
+        data = np.arange(6, dtype=np.float32).reshape(2, 3)
+        with SingleShot(framework="custom", model=path) as s:
+            out = s.invoke(data)
+            np.testing.assert_array_equal(np.asarray(out[0]), data)
+
+    def test_decoder_scaffold_registers(self, tmp_path):
+        path = codegen.generate("decoder", "my_dec", str(tmp_path))
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("my_dec_plugin", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from nnstreamer_tpu import registry
+
+        assert registry.get(registry.KIND_DECODER, "my_dec")
+        registry.unregister(registry.KIND_DECODER, "my_dec")
+
+    def test_converter_scaffold_registers(self, tmp_path):
+        path = codegen.generate("converter", "my_conv", str(tmp_path))
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("my_conv_plugin", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from nnstreamer_tpu import registry
+
+        assert registry.get(registry.KIND_CONVERTER, "my_conv")
+        registry.unregister(registry.KIND_CONVERTER, "my_conv")
+
+    def test_rejects_bad_name(self, tmp_path):
+        with pytest.raises(ValueError):
+            codegen.generate("filter", "bad-name", str(tmp_path))
+        with pytest.raises(ValueError):
+            codegen.generate("nope", "ok_name", str(tmp_path))
+
+    def test_refuses_overwrite(self, tmp_path):
+        codegen.generate("filter", "dup", str(tmp_path))
+        with pytest.raises(FileExistsError):
+            codegen.generate("filter", "dup", str(tmp_path))
+
+
+class TestConfchk:
+    def test_clean_default_config(self):
+        info, warnings, errors = confchk.check()
+        assert not errors
+        assert any("[edge] default_port" in m for m in info)
+
+    def test_flags_unknown_keys(self, tmp_path):
+        ini = tmp_path / "bad.ini"
+        ini.write_text("[filter]\nbogus_key = 1\n\n[nosuchsection]\nx = y\n")
+        _, warnings, _ = confchk.check(str(ini))
+        assert any("bogus_key" in m for m in warnings)
+        assert any("nosuchsection" in m for m in warnings)
+
+    def test_flags_missing_plugin_dir(self, tmp_path, monkeypatch):
+        ini = tmp_path / "paths.ini"
+        ini.write_text("[filter]\nplugin_paths = /definitely/not/here\n")
+        _, _, errors = confchk.check(str(ini))
+        assert any("/definitely/not/here" in m for m in errors)
+
+
+class TestPbtxt:
+    def test_linear_pipeline(self):
+        out = pbtxt.to_pbtxt(
+            "videotestsrc num-frames=2 ! tensor_converter ! tensor_sink"
+        )
+        assert out.count("node {") == 3
+        assert 'calculator: "videotestsrc"' in out
+        assert 'calculator: "tensor_converter"' in out
+        # converter consumes the source's stream and produces its own
+        assert 'input_stream:' in out and 'output_stream:' in out
+
+    def test_props_serialized(self):
+        out = pbtxt.to_pbtxt("videotestsrc width=32 height=24 ! tensor_converter ! tensor_sink")
+        assert 'option: "width=32"' in out
+
+    def test_cli_entry(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "nnstreamer_tpu.tools.pbtxt",
+             "videotestsrc ! tensor_converter ! tensor_sink"],
+            capture_output=True, text=True, timeout=120,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0
+        assert 'calculator: "tensor_converter"' in proc.stdout

@@ -1,0 +1,53 @@
+"""Tracing subsystem tests (reference §5.1 analogue: GstShark/NNShark/
+HawkTracer chrome-trace workflows, brought in-tree)."""
+
+import json
+
+import numpy as np
+
+from nnstreamer_tpu import trace
+from nnstreamer_tpu.elements.converter import TensorConverter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.sources import VideoTestSrc
+from nnstreamer_tpu.pipeline.graph import Pipeline
+
+
+def teardown_function(_fn):
+    trace.disable()
+
+
+def test_span_and_counter_events():
+    t = trace.Tracer()
+    with t.span("work", "element", frame=1):
+        pass
+    t.instant("mark")
+    t.counter("queue_depth", q0=3)
+    evs = t.events()
+    assert [e["ph"] for e in evs] == ["X", "i", "C"]
+    assert evs[0]["name"] == "work" and evs[0]["dur"] >= 0
+    assert evs[2]["args"] == {"q0": 3}
+
+
+def test_pipeline_records_per_element_spans(tmp_path):
+    tracer = trace.enable()
+    tracer.clear()
+    src = VideoTestSrc(width=8, height=8, **{"num-frames": 3})
+    sink = TensorSink()
+    p = Pipeline().chain(src, TensorConverter(), sink)
+    p.run(timeout=30)
+    names = {e["name"] for e in tracer.events()}
+    assert any("videotestsrc" in n or "src" in n for n in names)
+    assert any("sink" in n for n in names)
+    out = tmp_path / "trace.json"
+    tracer.save(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"] and all("ts" in e for e in doc["traceEvents"])
+
+
+def test_disabled_by_default():
+    trace.disable()
+    assert trace.get() is None
+    src = VideoTestSrc(width=8, height=8, **{"num-frames": 1})
+    sink = TensorSink()
+    Pipeline().chain(src, TensorConverter(), sink).run(timeout=30)
+    assert trace.get() is None
