@@ -43,18 +43,46 @@ class EdgeSink(Sink):
         super().__init__(name, **props)
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", DEFAULT_PORT))
+        self.connect_type = str(self.get_property("connect-type", "TCP")).upper()
+        # MQTT mode (reference connect-type=MQTT): host/port address the
+        # broker, frames publish to ``topic``
+        self.topic = str(self.get_property("topic", "nns-edge"))
         self.wait_connection = _parse_bool(
             self.get_property("wait-connection", False)
         )
         self.conn_timeout = float(self.get_property("connection-timeout", 10.0))
         self.bound_port: Optional[int] = None
         self._transport = None
+        self._mqtt = None
+        if self.connect_type not in ("TCP", "MQTT"):
+            raise ValueError(
+                f"{self.name}: connect-type={self.connect_type} not built in "
+                "(reference HYBRID/AITT are broker-vendor specific)"
+            )
 
     def start(self) -> None:
+        if self.connect_type == "MQTT":
+            from nnstreamer_tpu.edge.mqtt import MqttClient, MqttError
+
+            try:
+                self._mqtt = MqttClient(self.host, self.port).connect()
+            except (MqttError, OSError) as exc:
+                raise ElementError(
+                    f"{self.name}: cannot reach MQTT broker "
+                    f"{self.host}:{self.port}: {exc}"
+                ) from exc
+            return
         self._transport = make_transport()
         self.bound_port = self._transport.listen(self.host, self.port)
 
     def stop(self) -> None:
+        if self._mqtt is not None:
+            try:
+                self._mqtt.publish(self.topic, encode_message(EOS_FRAME))
+            except OSError:
+                pass
+            self._mqtt.close()
+            self._mqtt = None
         if self._transport is not None:
             # subscribers see the stream end explicitly
             try:
@@ -65,6 +93,9 @@ class EdgeSink(Sink):
             self._transport = None
 
     def render(self, frame: Frame) -> None:
+        if self._mqtt is not None:
+            self._mqtt.publish(self.topic, encode_message(frame))
+            return
         if self.wait_connection and self._transport.peer_count() == 0:
             import time
 
@@ -84,6 +115,11 @@ class EdgeSink(Sink):
             pass  # best-effort: one dead subscriber must not kill the stream
 
     def on_eos(self) -> None:
+        if self._mqtt is not None:
+            try:
+                self._mqtt.publish(self.topic, encode_message(EOS_FRAME))
+            except OSError:
+                pass
         if self._transport is not None:
             try:
                 self._transport.send(0, encode_message(EOS_FRAME))
@@ -105,15 +141,31 @@ class EdgeSrc(Source):
         super().__init__(name, **props)
         self.host = str(self.get_property("dest-host", "127.0.0.1"))
         self.port = int(self.get_property("dest-port", DEFAULT_PORT))
+        self.connect_type = str(self.get_property("connect-type", "TCP")).upper()
+        self.topic = str(self.get_property("topic", "nns-edge"))
         self._transport = None
+        self._mqtt = None
 
     def output_spec(self) -> Spec:
-        ct = str(self.get_property("connect-type", "TCP")).upper()
-        if ct != "TCP":
-            raise NegotiationError(f"{self.name}: connect-type={ct} not built in")
+        if self.connect_type not in ("TCP", "MQTT"):
+            raise NegotiationError(
+                f"{self.name}: connect-type={self.connect_type} not built in"
+            )
         return TensorsSpec(format=TensorFormat.FLEXIBLE)
 
     def start(self) -> None:
+        if self.connect_type == "MQTT":
+            from nnstreamer_tpu.edge.mqtt import MqttClient, MqttError
+
+            try:
+                self._mqtt = MqttClient(self.host, self.port).connect()
+                self._mqtt.subscribe(self.topic)
+            except (MqttError, OSError) as exc:
+                raise ElementError(
+                    f"{self.name}: cannot reach MQTT broker "
+                    f"{self.host}:{self.port}: {exc}"
+                ) from exc
+            return
         self._transport = make_transport()
         try:
             self._transport.connect(self.host, self.port)
@@ -124,16 +176,25 @@ class EdgeSrc(Source):
             ) from exc
 
     def stop(self) -> None:
+        if self._mqtt is not None:
+            self._mqtt.close()
+            self._mqtt = None
         if self._transport is not None:
             self._transport.close()
             self._transport = None
 
     def generate(self):
-        got = self._transport.recv(timeout=0.1)
-        if got is None:
-            return None
-        _, payload = got
-        if not payload:
-            return EOS_FRAME  # publisher went away
+        if self._mqtt is not None:
+            got = self._mqtt.recv(timeout=0.1)
+            if got is None:
+                return None
+            payload = got[1]
+        else:
+            got = self._transport.recv(timeout=0.1)
+            if got is None:
+                return None
+            _, payload = got
+            if not payload:
+                return EOS_FRAME  # publisher went away
         msg = decode_message(payload)
         return EOS_FRAME if isinstance(msg, EOS) else msg

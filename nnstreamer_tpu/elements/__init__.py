@@ -26,5 +26,6 @@ from nnstreamer_tpu.elements import iio  # noqa: F401
 # distributed elements (conditional registration in the reference's
 # registerer, nnstreamer.c:113-119 — here always available, TCP transport)
 from nnstreamer_tpu.edge import pubsub  # noqa: F401
+from nnstreamer_tpu.edge import mqtt_elems  # noqa: F401
 from nnstreamer_tpu.edge import query  # noqa: F401
 from nnstreamer_tpu.edge import grpc_bridge  # noqa: F401
