@@ -38,6 +38,31 @@ from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
 
 _log = get_logger("backends.jax")
 
+_cache_initialized = False
+
+
+def _init_persistent_cache() -> None:
+    """[jax] persistent_cache = DIR enables XLA's on-disk compilation cache
+    — the checkpoint/resume analogue for an inference framework (SURVEY.md
+    §5.4: compiled-executable persistence), cutting model-open time on
+    every process restart."""
+    global _cache_initialized
+    if _cache_initialized:
+        return
+    _cache_initialized = True
+    from nnstreamer_tpu.config import conf
+
+    cache_dir = conf().get("jax", "persistent_cache")
+    if not cache_dir:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _log.info("persistent compilation cache at %s", cache_dir)
+    except Exception as exc:  # cache is an optimization, never fatal
+        _log.warning("persistent cache setup failed: %s", exc)
+
 
 def _spec_from_avals(avals) -> TensorsSpec:
     return TensorsSpec(
@@ -72,6 +97,7 @@ class JaxBackend(Backend):
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, props: FilterProps) -> None:
+        _init_persistent_cache()
         self.props = props
         path = props.model_path
         options = props.custom_dict()

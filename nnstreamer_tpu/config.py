@@ -22,6 +22,9 @@ from typing import Dict, List, Optional
 _DEFAULTS: Dict[str, Dict[str, str]] = {
     "common": {
         "enable_envvar": "true",
+        # comma list of allowed elements; empty = all (reference
+        # element-restriction product whitelist, meson_options.txt:40-41)
+        "restricted_elements": "",
     },
     "filter": {
         # search paths for out-of-tree backend plugins (python files defining

@@ -9,6 +9,7 @@ from nnstreamer_tpu.decoders import bounding_box  # noqa: F401
 from nnstreamer_tpu.decoders import direct_video  # noqa: F401
 from nnstreamer_tpu.decoders import flatbuf  # noqa: F401
 from nnstreamer_tpu.decoders import flexbuf  # noqa: F401
+from nnstreamer_tpu.decoders import font  # noqa: F401
 from nnstreamer_tpu.decoders import image_labeling  # noqa: F401
 from nnstreamer_tpu.decoders import image_segment  # noqa: F401
 from nnstreamer_tpu.decoders import octet_stream  # noqa: F401

@@ -1,0 +1,46 @@
+"""font decoder: render text tensors as video frames.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-font.c (153 LoC) — takes
+a uint8 text tensor and rasterizes it onto an RGBA canvas with the built-in
+ASCII font. option1 = WIDTH:HEIGHT of the output video (default 640:480).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.decoders import render
+from nnstreamer_tpu.elements.base import MediaSpec, NegotiationError
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+@registry.decoder_plugin("font")
+class FontDecoder:
+    def __init__(self) -> None:
+        self._out_wh = (640, 480)
+
+    def negotiate(self, in_spec: TensorsSpec, options: dict) -> MediaSpec:
+        if options.get("option1"):
+            self._out_wh = render.parse_wh(options["option1"], "font option1")
+        if in_spec.num_tensors != 1:
+            raise NegotiationError(
+                f"font: expected 1 text tensor, got {in_spec.num_tensors}"
+            )
+        w, h = self._out_wh
+        return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    def decode(self, frame: Frame, options: dict) -> Frame:
+        raw = np.asarray(frame.tensors[0]).reshape(-1).astype(np.uint8)
+        text = raw.tobytes().split(b"\0", 1)[0].decode("utf-8", "replace")
+        w, h = self._out_wh
+        canvas = render.new_canvas(w, h)
+        # line-wrapped top-left layout (reference draws at a fixed origin)
+        line_h = 14
+        for i, line in enumerate(text.splitlines() or [""]):
+            y = 2 + i * line_h
+            if y + line_h > h:
+                break
+            render.draw_text(canvas, line, 2, y)
+        return frame.with_tensors((canvas,)).with_meta(text=text)

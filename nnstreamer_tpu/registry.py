@@ -113,6 +113,18 @@ def _load_from_search_paths(kind: str, name: str) -> bool:
 def get(kind: str, name: str) -> Any:
     """get_subplugin analogue with lazy loading; raises KeyError on miss."""
     name = name.lower()
+    if kind == KIND_ELEMENT:
+        # product element restriction (reference meson_options.txt:40-41
+        # element-restriction whitelist): [common] restricted_elements =
+        # comma list; empty = everything allowed
+        from nnstreamer_tpu.config import conf
+
+        allowed = conf().get_list("common", "restricted_elements")
+        if allowed and name not in [a.lower() for a in allowed]:
+            raise KeyError(
+                f"element {name!r} is restricted by configuration "
+                "([common] restricted_elements)"
+            )
     with _lock:
         if name not in _registry[kind]:
             _load_builtins(kind)

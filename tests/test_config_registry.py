@@ -84,3 +84,25 @@ class TestRegistry:
         names = registry.available("filter")
         assert "passthrough" in names
         assert "jax" in names
+
+
+class TestElementRestriction:
+    def test_restricted_elements_enforced(self, monkeypatch):
+        import nnstreamer_tpu.config as config_mod
+        from nnstreamer_tpu import registry
+
+        monkeypatch.setenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS",
+                           "videotestsrc,tensor_sink")
+        config_mod.reload_conf()
+        try:
+            assert registry.get(registry.KIND_ELEMENT, "videotestsrc")
+            with pytest.raises(KeyError, match="restricted"):
+                registry.get(registry.KIND_ELEMENT, "tensor_converter")
+        finally:
+            monkeypatch.delenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS")
+            config_mod.reload_conf()
+
+    def test_empty_restriction_allows_all(self):
+        from nnstreamer_tpu import registry
+
+        assert registry.get(registry.KIND_ELEMENT, "tensor_converter")

@@ -323,3 +323,15 @@ def test_protobuf_carries_stream_rate():
     out = d.decode(Frame((np.zeros(4, np.float32),)), {})
     msg = pb.Tensors.FromString(out.tensors[0].tobytes())
     assert (msg.fr.rate_n, msg.fr.rate_d) == (25, 1)
+
+
+def test_font_decoder_renders_text():
+    d = _dec("font")
+    spec = TensorsSpec.from_strings("16:1")
+    media = d.negotiate(spec, {"option1": "64:32"})
+    assert (media.width, media.height) == (64, 32)
+    text = np.frombuffer(b"hi nns\0\0\0\0\0\0\0\0\0\0", np.uint8).reshape(16, 1)
+    out = d.decode(Frame((text,)), {})
+    assert out.tensors[0].shape == (32, 64, 4)
+    assert out.meta["text"] == "hi nns"
+    assert out.tensors[0].any()
