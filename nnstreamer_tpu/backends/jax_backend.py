@@ -101,6 +101,18 @@ class JaxBackend(Backend):
         self.props = props
         path = props.model_path
         options = props.custom_dict()
+        # per-stage device placement (SURVEY.md §7 build order 5): a
+        # pipeline shards across chips by pinning each filter to a device;
+        # inter-stage hops are device_put transfers riding ICI, replacing
+        # the reference's host TCP between pipeline segments
+        if "device" in options:
+            devs = jax.devices()
+            idx = int(options["device"])
+            if not (0 <= idx < len(devs)):
+                raise BackendError(
+                    f"jax: device:{idx} out of range (have {len(devs)})"
+                )
+            self._device = devs[idx]
         if path.startswith("zoo:"):
             self._open_zoo(path[len("zoo:"):], options)
         elif path.endswith(".py"):
@@ -152,6 +164,9 @@ class JaxBackend(Backend):
             jit_kwargs = dict(
                 in_shardings=self._shardings[0], out_shardings=self._shardings[1]
             )
+        elif self._device is not None:
+            single = jax.sharding.SingleDeviceSharding(self._device)
+            jit_kwargs = dict(out_shardings=single)
         self._jitted = jax.jit(wrapped, **jit_kwargs)
         # shape inference without running (reference getModelInfo): one
         # abstract evaluation of the jitted function
@@ -201,11 +216,18 @@ class JaxBackend(Backend):
                 raise BackendError(
                     f"jax: input shape {tuple(t.shape)} != negotiated {s.shape}"
                 )
+        if self._device is not None:
+            # cross-stage hop: async device→device transfer (ICI on TPU)
+            tensors = tuple(jax.device_put(t, self._device) for t in tensors)
         return self._jitted(*tensors)
 
     def traceable_fn(self):
         fn = self._fn
         if fn is None:
+            return None
+        if self._device is not None:
+            # a device-pinned stage is a fusion barrier: fusing it into a
+            # neighbor's XLA program would silently drop the placement
             return None
         return lambda tensors: _as_tuple(fn(*tensors))
 

@@ -1,0 +1,93 @@
+"""SSAT-style golden pipeline tests (reference test strategy, SURVEY.md §4:
+44 runTest.sh suites run gst-launch pipelines with deterministic sources,
+dump via filesink, and byte-compare against golden files).
+
+Golden files live in tests/golden/ and were produced by the same pipelines
+at introduction time; the tests re-run the pipeline through the CLI (the
+real user entry point, like SSAT drives gst-launch) and compare bytes.
+Regenerate with: python tests/test_golden.py --regen
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+# name → pipeline template ({out} replaced by the dump path)
+PIPELINES = {
+    # raw media→tensor ingress (counter pattern = frame index everywhere)
+    "converter_video": (
+        "videotestsrc pattern=counter num-frames=3 width=4 height=4 ! "
+        "tensor_converter ! filesink location={out}"
+    ),
+    # elementwise chain: typecast then arithmetic (transform suite analogue)
+    "transform_arith": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        'tensor_transform mode=arithmetic option="add:1,mul:2" ! '
+        "filesink location={out}"
+    ),
+    # transpose (HWC→CWH style dim reorder)
+    "transform_transpose": (
+        "videotestsrc pattern=gradient num-frames=2 width=4 height=6 ! "
+        "tensor_converter ! tensor_transform mode=transpose option=1:0:2:3 ! "
+        "filesink location={out}"
+    ),
+    # fake-backend inference (custom scaler = the reference's custom .so fake)
+    "filter_scaler": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        'tensor_filter framework=scaler custom="factor:0.5" ! '
+        "filesink location={out}"
+    ),
+    # static→sparse→static roundtrip must be byte-identical to the input
+    "sparse_roundtrip": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_sparse_enc ! tensor_sparse_dec ! "
+        "filesink location={out}"
+    ),
+    # aggregator: 2-frame temporal batch along the time axis
+    "aggregator_window": (
+        "videotestsrc pattern=counter num-frames=4 width=4 height=4 ! "
+        "tensor_converter ! tensor_aggregator frames-in=1 frames-out=2 "
+        "frames-flush=2 ! filesink location={out}"
+    ),
+}
+
+
+def _run(pipeline: str, out_path: str) -> None:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu.cli",
+         pipeline.format(out=out_path), "-q"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, f"pipeline failed:\n{proc.stderr}"
+
+
+@pytest.mark.parametrize("name", sorted(PIPELINES))
+def test_golden(name, tmp_path):
+    golden = os.path.join(GOLDEN_DIR, f"{name}.raw")
+    assert os.path.isfile(golden), f"missing golden {golden} (run --regen)"
+    out = tmp_path / "dump.raw"
+    _run(PIPELINES[name], str(out))
+    actual = out.read_bytes()
+    expected = open(golden, "rb").read()
+    assert len(actual) == len(expected), (
+        f"{name}: size {len(actual)} != golden {len(expected)}"
+    )
+    assert actual == expected, f"{name}: byte mismatch vs golden"
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        for name, pipe in sorted(PIPELINES.items()):
+            path = os.path.join(GOLDEN_DIR, f"{name}.raw")
+            _run(pipe, path)
+            print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+    else:
+        print(__doc__)

@@ -288,3 +288,47 @@ class TestSinkSyncWindow:
         run_chain(src, conv, sink)
         assert sink.rendered == 3
         assert sink.eos_seen
+
+
+class TestDevicePlacement:
+    def test_two_filters_on_different_devices(self):
+        """SURVEY §7 build order 5: per-stage chip placement; inter-stage
+        hop is a device_put over the interconnect (ICI on TPU; the CPU
+        mesh validates placement semantics)."""
+        import jax
+
+        from nnstreamer_tpu.single import SingleShot
+
+        devs = jax.devices()
+        assert len(devs) >= 2
+        with SingleShot(
+            framework="jax", model="zoo:add", custom="const:1,dims:4,device:0"
+        ) as s0, SingleShot(
+            framework="jax", model="zoo:add", custom="const:2,dims:4,device:1"
+        ) as s1:
+            x = np.ones((4,), np.float32)
+            mid = s0.invoke(x)[0]
+            assert list(mid.devices()) == [devs[0]]
+            out = s1.invoke(mid)[0]
+            assert list(out.devices()) == [devs[1]]
+            np.testing.assert_allclose(np.asarray(out), x + 3)
+
+    def test_pipeline_stage_placement(self):
+        import jax
+
+        src = TensorSrc(dims="8", dtype="float32", **{"num-frames": 2})
+        f0 = TensorFilter(framework="jax", model="zoo:add",
+                          custom="const:1,device:0")
+        f1 = TensorFilter(framework="jax", model="zoo:add",
+                          custom="const:1,device:1")
+        sink = TensorSink()
+        run_chain(src, f0, Queue(), f1, sink)
+        assert sink.rendered == 2
+
+    def test_device_out_of_range(self):
+        from nnstreamer_tpu.backends.base import BackendError
+        from nnstreamer_tpu.single import SingleShot
+
+        with pytest.raises(Exception, match="out of range"):
+            SingleShot(framework="jax", model="zoo:add",
+                       custom="dims:4,device:99").open()
