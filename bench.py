@@ -51,15 +51,16 @@ def main() -> None:
         out = fn(frames[i % len(frames)])
     jax.block_until_ready(out)
 
-    # throughput: stream with bounded dispatch-ahead window
+    # throughput: stream with bounded dispatch-ahead window. The device
+    # runs dispatches in order, so syncing the window's LAST result fences
+    # the whole window without touching every handle.
     t0 = time.perf_counter()
-    inflight = []
+    out = None
     for i in range(iters):
-        inflight.append(fn(frames[i % len(frames)]))
-        if len(inflight) >= sync_every:
-            jax.block_until_ready(inflight)
-            inflight = []
-    jax.block_until_ready(inflight)
+        out = fn(frames[i % len(frames)])
+        if (i + 1) % sync_every == 0:
+            out.block_until_ready()
+    out.block_until_ready()
     dt = time.perf_counter() - t0
     fps = iters * batch / dt
 
@@ -71,6 +72,28 @@ def main() -> None:
         lat.append((time.perf_counter() - t) * 1000)
     p50 = statistics.median(lat)
 
+    # micro-batched variant: the reference's converter frames-per-tensor
+    # batching (gsttensor_converter.c frames_per_tensor) maps to the
+    # aggregator batching 8 frames per invoke — same pipeline semantics,
+    # amortizing the per-dispatch cost the bs1 number is bound by.
+    mb = 8
+    m8 = zoo.get("mobilenet_v2", batch=str(mb), compute_dtype="bfloat16")
+    fn8 = jax.jit(m8.fn)
+    frames8 = [
+        jnp.asarray(rng.integers(0, 255, (mb, 224, 224, 3), np.uint8))
+        for _ in range(4)
+    ]
+    out = fn8(frames8[0])
+    jax.block_until_ready(out)
+    iters8 = 256
+    t0 = time.perf_counter()
+    for i in range(iters8):
+        out = fn8(frames8[i % 4])
+        if (i + 1) % 64 == 0:
+            out.block_until_ready()
+    out.block_until_ready()
+    mb_fps = iters8 * mb / (time.perf_counter() - t0)
+
     dev = jax.devices()[0]
     print(
         json.dumps(
@@ -81,6 +104,7 @@ def main() -> None:
                 "vs_baseline": round(fps / 1000.0, 3),
                 "p50_sync_latency_ms": round(p50, 3),
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
+                "microbatch8_fps": round(mb_fps, 1),
                 "platform": dev.platform,
                 "device": str(dev.device_kind),
             }
