@@ -1,0 +1,48 @@
+"""Pipeline split across two processes: a TPU-side server pipeline serves a
+client pipeline over the native TCP transport (reference edge-ai offload)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+import multiprocessing as mp
+import time
+
+
+def server(port_q):
+    from nnstreamer_tpu.edge.query import TensorQueryServerSrc, TensorQueryServerSink
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    src = TensorQueryServerSrc(port=0)
+    # serversrc emits format=flexible; declare the static input spec
+    filt = TensorFilter(framework="jax", model="zoo:add", custom="dims:4,const:10",
+                        input="4", inputtype="float32")
+    sink = TensorQueryServerSink()
+    p = Pipeline().chain(src, filt, sink)
+    ex = p.start()
+    port_q.put(src.bound_port)
+    time.sleep(10)  # serve for a while, then exit
+    p.stop()
+
+
+if __name__ == "__main__":
+    import numpy as np
+
+    from nnstreamer_tpu.edge.query import TensorQueryClient
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.sources import TensorSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    q = mp.Queue()
+    proc = mp.Process(target=server, args=(q,), daemon=True)
+    proc.start()
+    port = q.get(timeout=30)
+
+    src = TensorSrc(dimensions="4", types="float32", **{"num-frames": 3})
+    client = TensorQueryClient(**{"dest-port": port})
+    sink = TensorSink()
+    Pipeline().chain(src, client, sink).run(timeout=60)
+    for i, f in enumerate(sink.frames):
+        print(f"reply {i}: {np.asarray(f.tensors[0])}")
+    proc.terminate()

@@ -127,12 +127,29 @@ class TensorFilter(TensorOp):
             )
         b = self._ensure_open()
         model_in = self._select_model_inputs_spec(spec)
-        try:
-            cur_in, cur_out = b.get_model_info()
-            if not cur_in.is_compatible(model_in):
+        if not model_in.is_static:
+            # flexible input stream (e.g. from a query serversrc or edge
+            # src): the model's own spec governs; per-frame tensors are
+            # validated at invoke, like the reference parses the flexible
+            # header per buffer (tensor_filter.c:617-625)
+            self._flexible_input = True
+            try:
+                _, cur_out = b.get_model_info()
+            except Exception as exc:
+                raise NegotiationError(
+                    f"{self.name}: flexible input needs a model with known "
+                    f"input spec (or input=/inputtype= properties): {exc}"
+                ) from exc
+        else:
+            self._flexible_input = False
+            try:
+                cur_in, cur_out = b.get_model_info()
+                if not cur_in.is_compatible(model_in):
+                    cur_out = b.set_input_info(model_in)
+            except NegotiationError:
+                raise
+            except Exception:
                 cur_out = b.set_input_info(model_in)
-        except Exception:
-            cur_out = b.set_input_info(model_in)
         self._model_out_spec = cur_out
         out = self._compose_output_spec(spec, cur_out)
         return [out.with_rate(spec.rate)]
@@ -168,6 +185,9 @@ class TensorFilter(TensorOp):
 
     # -- execution ---------------------------------------------------------
     def is_traceable(self) -> bool:
+        if getattr(self, "_flexible_input", False):
+            # per-frame shapes: can't be part of a statically-jitted segment
+            return False
         b = self._ensure_open()
         return b.traceable_fn() is not None
 

@@ -1,0 +1,81 @@
+"""Multi-host (DCN) runtime: the jax.distributed bring-up for pod slices.
+
+The reference scales across machines with nnstreamer-edge over TCP/MQTT
+(SURVEY.md §5.8); the TPU-native equivalent inside a pod is the JAX
+distributed runtime — every host runs the same program, a coordinator
+rendezvous wires the hosts, `jax.devices()` becomes the GLOBAL device list,
+and the same Mesh/sharding code from this package spans hosts: XLA routes
+collectives over ICI within a slice and DCN across slices. Host-external
+clients still enter through the edge layer (tensor_query / gRPC / MQTT).
+
+Typical pod bring-up (same command on every host):
+
+    from nnstreamer_tpu.parallel import multihost, mesh
+    multihost.initialize()           # TPU pods: env auto-detection
+    m = mesh.make_mesh(axes=("dp", "tp"))   # spans ALL hosts' chips
+
+For CPU/GPU clusters or manual rendezvous, pass coordinator_address,
+num_processes and process_id explicitly (the torchrun-style contract).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("parallel.multihost")
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> None:
+    """Join the multi-host runtime. On TPU pods all arguments auto-detect
+    from the TPU environment; elsewhere pass them explicitly. Idempotent."""
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    _log.info(
+        "multihost up: process %d/%d, %d global / %d local devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.devices()), len(jax.local_devices()),
+    )
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def is_primary() -> bool:
+    """True on the process that should do singleton work (logging, golden
+    dumps, checkpoint writes)."""
+    return jax.process_index() == 0
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }

@@ -316,7 +316,7 @@ class TestDevicePlacement:
     def test_pipeline_stage_placement(self):
         import jax
 
-        src = TensorSrc(dims="8", dtype="float32", **{"num-frames": 2})
+        src = TensorSrc(dimensions="8", types="float32", **{"num-frames": 2})
         f0 = TensorFilter(framework="jax", model="zoo:add",
                           custom="const:1,device:0")
         f1 = TensorFilter(framework="jax", model="zoo:add",
