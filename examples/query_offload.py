@@ -6,7 +6,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 import multiprocessing as mp
-import time
+import threading
 
 
 def server(port_q):
@@ -20,10 +20,9 @@ def server(port_q):
                         input="4", inputtype="float32")
     sink = TensorQueryServerSink()
     p = Pipeline().chain(src, filt, sink)
-    ex = p.start()
+    p.start()
     port_q.put(src.bound_port)
-    time.sleep(10)  # serve for a while, then exit
-    p.stop()
+    threading.Event().wait()  # serve until the parent terminates us
 
 
 if __name__ == "__main__":

@@ -143,12 +143,12 @@ class TensorFilter(TensorOp):
         else:
             self._flexible_input = False
             try:
-                cur_in, cur_out = b.get_model_info()
-                if not cur_in.is_compatible(model_in):
-                    cur_out = b.set_input_info(model_in)
-            except NegotiationError:
-                raise
+                cur_in, _ = b.get_model_info()
             except Exception:
+                cur_in = None  # shape-polymorphic: model info needs input
+            if cur_in is not None and cur_in.is_compatible(model_in):
+                _, cur_out = b.get_model_info()
+            else:
                 cur_out = b.set_input_info(model_in)
         self._model_out_spec = cur_out
         out = self._compose_output_spec(spec, cur_out)
