@@ -1,0 +1,155 @@
+"""KV-cache autoregressive decoding for the transformer LM family.
+
+Streaming token generation is this framework's native ground (the
+reference's recurrent analogue is tensor_repo feedback loops holding RNN
+state across frames, tests/nnstreamer_repo_{rnn,lstm}): the KV cache is the
+in-pipeline state, and both prefill and the per-token step are single XLA
+programs with static shapes — the decode loop is a ``lax.scan`` over a
+fixed budget, so generation jit-compiles once.
+
+Layout: cache k/v are [L, B, max_len, H, Dh]; a scalar ``pos`` tracks the
+fill level. Attention at each step runs over the full max_len with a
+``<= pos`` mask (fixed shape; masked positions cost FLOPs but keep XLA
+static — the standard TPU serving trade).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import transformer as tfm
+
+NEG_INF = -1e30
+
+
+def init_cache(
+    params: Dict, batch: int, max_len: int, n_heads: int, dtype=jnp.float32
+) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed (k, v) cache [L, B, max_len, H, Dh]."""
+    L, d = params["blocks"]["ln1"].shape
+    hd = d // n_heads
+    shape = (L, batch, max_len, n_heads, hd)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def prefill(
+    params: Dict,
+    tokens,
+    n_heads: int,
+    max_len: int,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+):
+    """Run the prompt through the model once, filling the cache.
+
+    tokens [B, T] (T ≤ max_len) → (logits [B, T, V], (cache_k, cache_v),
+    pos=T)."""
+    b, t = tokens.shape
+    if t > max_len:
+        raise ValueError(f"prompt length {t} > max_len {max_len}")
+    x = params["embed"][tokens].astype(compute_dtype)
+    positions = jnp.arange(t)
+    x, (ks, vs) = tfm.apply_layers(
+        params["blocks"], x, n_heads, positions, ffn_fn=ffn_fn, return_kv=True
+    )
+    x = tfm.rmsnorm(x, params["ln_f"])
+    logits = (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+    pad = max_len - t
+    cache_k = jnp.pad(
+        ks.astype(compute_dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+    )
+    cache_v = jnp.pad(
+        vs.astype(compute_dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+    )
+    return logits, (cache_k, cache_v), jnp.asarray(t, jnp.int32)
+
+
+def decode_step(
+    params: Dict,
+    token,
+    pos,
+    cache: Tuple[jax.Array, jax.Array],
+    n_heads: int,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+):
+    """One token in, one distribution out.
+
+    token [B] int32, pos scalar (number of tokens already cached) →
+    (logits [B, V], cache', pos+1)."""
+    cache_k, cache_v = cache
+    max_len = cache_k.shape[2]
+    b = token.shape[0]
+    x = params["embed"][token][:, None, :].astype(compute_dtype)  # [B,1,D]
+    positions = pos[None].astype(jnp.int32)
+
+    def body(carry, layer):
+        x = carry
+        blk, ck, cv = layer
+        q, k, v = tfm.block_qkv(x, blk, n_heads, positions)  # [B,1,H,Dh]
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / (q.shape[-1] ** 0.5)
+        mask = jnp.arange(max_len) <= pos  # [max_len]
+        s = jnp.where(mask[None, None, None, :], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        o = o.astype(x.dtype).reshape(b, 1, -1)
+        x = x + o @ blk["wo"].astype(x.dtype)
+        x = tfm.block_ffn(x, blk, ffn_fn)
+        return x, (ck, cv)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    x = tfm.rmsnorm(x, params["ln_f"])
+    logits = (x @ params["head"].astype(x.dtype)).astype(jnp.float32)[:, 0]
+    return logits, (cache_k, cache_v), pos + 1
+
+
+def generate(
+    params: Dict,
+    prompt,
+    n_heads: int,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+):
+    """Greedy (temperature=0) or sampled generation.
+
+    prompt [B, T] int32 → tokens [B, max_new_tokens] int32. One prefill
+    program + one scanned decode program; both compile once per shape."""
+    b, t = prompt.shape
+    max_len = max_len or (t + max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    logits, cache, pos = prefill(
+        params, prompt, n_heads, max_len, ffn_fn, compute_dtype
+    )
+    last = logits[:, -1]
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    def step(carry, key):
+        last_logits, cache, pos = carry
+        tok = pick(last_logits, key)
+        logits, cache, pos = decode_step(
+            params, tok, pos, cache, n_heads, ffn_fn, compute_dtype
+        )
+        return (logits, cache, pos), tok
+
+    keys = jax.random.split(rng, max_new_tokens)
+    _, toks = jax.lax.scan(step, (last, cache, pos), keys)
+    return toks.T  # [B, max_new_tokens]

@@ -83,6 +83,29 @@ def init_params(
     }
 
 
+def block_ffn(x, blk: Dict, ffn_fn: Optional[Callable] = None):
+    """Post-attention half of a block: pre-norm + SwiGLU MLP (or MoE)."""
+    y = rmsnorm(x, blk["ln2"])
+    if ffn_fn is not None:
+        return x + ffn_fn(y, blk).astype(x.dtype)
+    gate = jax.nn.silu(y @ blk["w_gate"].astype(y.dtype))
+    up = y @ blk["w_up"].astype(y.dtype)
+    return x + (gate * up) @ blk["w_down"].astype(y.dtype)
+
+
+def block_qkv(x, blk: Dict, n_heads: int, positions):
+    """Pre-norm + qkv projection + RoPE → (q, k, v) [B,T,H,Dh]."""
+    b, t, d = x.shape
+    h = n_heads
+    hd = d // h
+    y = rmsnorm(x, blk["ln1"])
+    qkv = y @ blk["wqkv"].astype(y.dtype)
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    q = rope(q.reshape(b, t, h, hd), positions)
+    kk = rope(kk.reshape(b, t, h, hd), positions)
+    return q, kk, v.reshape(b, t, h, hd)
+
+
 def block_apply(
     x,
     blk: Dict,
@@ -90,30 +113,22 @@ def block_apply(
     positions,
     attn_fn: Optional[Callable] = None,
     ffn_fn: Optional[Callable] = None,
+    return_kv: bool = False,
 ):
     """One transformer block. blk leaves are per-layer (no leading L dim).
     attn_fn(q, k, v, causal=True) → [B,T,H,D] float32;
-    ffn_fn(x_normed, blk) → [B,T,D] overrides the SwiGLU MLP (MoE hook)."""
+    ffn_fn(x_normed, blk) → [B,T,D] overrides the SwiGLU MLP (MoE hook);
+    return_kv=True additionally returns this layer's (k, v) — the prefill
+    path of the KV-cache decoder (models/decode.py)."""
     attn = attn_fn or dense_attention
     b, t, d = x.shape
-    h = n_heads
-    hd = d // h
-
-    y = rmsnorm(x, blk["ln1"])
-    qkv = y @ blk["wqkv"].astype(y.dtype)
-    q, kk, v = jnp.split(qkv, 3, axis=-1)
-    q = rope(q.reshape(b, t, h, hd), positions)
-    kk = rope(kk.reshape(b, t, h, hd), positions)
-    v = v.reshape(b, t, h, hd)
+    q, kk, v = block_qkv(x, blk, n_heads, positions)
     o = attn(q, kk, v, causal=True).astype(x.dtype)
     x = x + o.reshape(b, t, d) @ blk["wo"].astype(x.dtype)
-
-    y = rmsnorm(x, blk["ln2"])
-    if ffn_fn is not None:
-        return x + ffn_fn(y, blk).astype(x.dtype)
-    gate = jax.nn.silu(y @ blk["w_gate"].astype(y.dtype))
-    up = y @ blk["w_up"].astype(y.dtype)
-    return x + (gate * up) @ blk["w_down"].astype(y.dtype)
+    x = block_ffn(x, blk, ffn_fn)
+    if return_kv:
+        return x, (kk, v)
+    return x
 
 
 def apply_layers(
@@ -123,18 +138,24 @@ def apply_layers(
     positions,
     attn_fn: Optional[Callable] = None,
     ffn_fn: Optional[Callable] = None,
+    return_kv: bool = False,
 ):
     """Run a stacked block pytree (leaves [L, ...]) via lax.scan — one
     compiled block body regardless of depth; pipeline stages call this on
-    their layer slice."""
+    their layer slice. return_kv=True also returns stacked per-layer
+    (k, v) [L,B,T,H,Dh] for KV-cache prefill."""
 
     def body(carry, blk):
-        return (
-            block_apply(carry, blk, n_heads, positions, attn_fn, ffn_fn),
-            None,
+        out = block_apply(
+            carry, blk, n_heads, positions, attn_fn, ffn_fn, return_kv
         )
+        if return_kv:
+            return out[0], out[1]
+        return out, None
 
-    out, _ = jax.lax.scan(body, x, blocks)
+    out, kv = jax.lax.scan(body, x, blocks)
+    if return_kv:
+        return out, kv
     return out
 
 
