@@ -24,7 +24,13 @@ _failed = False
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SOURCE = os.path.join(_REPO_ROOT, "native", "nns_edge.cpp")
 BUILD_DIR = os.path.join(_REPO_ROOT, "build")
-SO_PATH = os.path.join(BUILD_DIR, "libnns_edge.so")
+
+# NNS_EDGE_SANITIZE=thread|address builds an instrumented variant (the
+# race-detection story the reference lacks, SURVEY.md §5.2) — used by the
+# concurrency stress test; separate .so name so normal runs stay fast.
+SANITIZE = os.environ.get("NNS_EDGE_SANITIZE", "")
+_suffix = f"_{SANITIZE}" if SANITIZE else ""
+SO_PATH = os.path.join(BUILD_DIR, f"libnns_edge{_suffix}.so")
 
 
 def native_lib_path() -> Optional[str]:
@@ -48,6 +54,8 @@ def native_lib_path() -> Optional[str]:
                     "g++", "-O2", "-std=c++17", "-fPIC", "-shared",
                     "-pthread", SOURCE, "-o", SO_PATH,
                 ]
+                if SANITIZE:
+                    cmd[1:1] = [f"-fsanitize={SANITIZE}", "-g"]
                 subprocess.run(
                     cmd, check=True, capture_output=True, timeout=120
                 )

@@ -1,0 +1,81 @@
+"""Checkpoint / resume for training state (orbax-backed).
+
+The reference is a stateless inference framework — its closest analogues
+are model hot-reload and tensor_repo recurrent state (SURVEY.md §5.4).
+Since this framework adds training (parallel/train.py, parallel/lm.py), it
+also adds the matching persistence: save/restore of arbitrary pytrees
+(params, optimizer state, step counters) that is **sharding-aware** — on
+restore each leaf materializes directly with the sharding you pass, so a
+dp×tp×sp×ep run resumes onto the same (or a re-factored) mesh without a
+host-memory detour through one process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("parallel.checkpoint")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, state: Any, force: bool = True) -> None:
+    """Write a pytree checkpoint (atomic rename on completion)."""
+    path = os.path.abspath(path)
+    _checkpointer().save(path, state, force=force)
+    _log.info("checkpoint saved: %s", path)
+
+
+def restore(path: str, like: Optional[Any] = None, shardings: Optional[Any] = None):
+    """Read a checkpoint.
+
+    like: a pytree of arrays or ShapeDtypeStructs giving the expected
+    structure/dtypes. shardings: matching pytree of NamedShardings — leaves
+    restore directly onto devices with that placement (multi-host safe).
+    With neither, restores as host numpy arrays.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if like is None:
+        return _checkpointer().restore(path)
+    if shardings is not None:
+        targets = jax.tree_util.tree_map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            like,
+            shardings,
+        )
+    else:
+        targets = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), like
+        )
+    return _checkpointer().restore(
+        path, restore_args=ocp.checkpoint_utils.construct_restore_args(targets)
+    )
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Scan a directory of step-named checkpoints (root/step_N) → max N."""
+    if not os.path.isdir(root):
+        return None
+    steps = []
+    for entry in os.listdir(root):
+        if entry.startswith("step_"):
+            try:
+                steps.append(int(entry[len("step_"):]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def step_path(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step}")

@@ -356,3 +356,57 @@ def test_grpc_client_unreachable_raises():
     )
     with pytest.raises(ElementError, match="cannot reach"):
         src.start()
+
+
+def test_transport_churn_stress():
+    """Concurrency stress on the native transport: clients connect, send,
+    and vanish while the server broadcasts — exercises the dead-fd
+    bookkeeping (fd-reuse race) under churn. Build with
+    NNS_EDGE_SANITIZE=thread g++ instrumentation to run it under TSAN."""
+    from nnstreamer_tpu.edge.transport import make_transport
+
+    server = make_transport()
+    port = server.listen("127.0.0.1", 0)
+    stop = threading.Event()
+
+    def broadcaster():
+        while not stop.is_set():
+            try:
+                server.send(0, b"tick" * 64)
+            except Exception:
+                pass
+
+    bcast = threading.Thread(target=broadcaster, daemon=True)
+    bcast.start()
+
+    received = []
+
+    def client_life(i):
+        c = make_transport()
+        try:
+            c.connect("127.0.0.1", port)
+            c.send(0, f"hello {i}".encode())
+            got = c.recv(timeout=2)
+            if got is not None:
+                received.append(i)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=client_life, args=(i,)) for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    stop.set()
+    bcast.join(timeout=5)
+    # server saw the client messages (some may race with disconnect)
+    got_msgs = 0
+    while True:
+        m = server.recv(timeout=0.2)
+        if m is None:
+            break
+        if m[1]:
+            got_msgs += 1
+    server.close()
+    assert got_msgs >= 12, f"only {got_msgs} of 24 client messages arrived"
+    assert len(received) >= 12, f"only {len(received)} clients got a broadcast"
