@@ -114,16 +114,18 @@ def block_apply(
     attn_fn: Optional[Callable] = None,
     ffn_fn: Optional[Callable] = None,
     return_kv: bool = False,
+    causal: bool = True,
 ):
     """One transformer block. blk leaves are per-layer (no leading L dim).
-    attn_fn(q, k, v, causal=True) → [B,T,H,D] float32;
+    attn_fn(q, k, v, causal) → [B,T,H,D] float32;
     ffn_fn(x_normed, blk) → [B,T,D] overrides the SwiGLU MLP (MoE hook);
     return_kv=True additionally returns this layer's (k, v) — the prefill
-    path of the KV-cache decoder (models/decode.py)."""
+    path of the KV-cache decoder (models/decode.py). causal=False turns
+    the block into an encoder block (ViT)."""
     attn = attn_fn or dense_attention
     b, t, d = x.shape
     q, kk, v = block_qkv(x, blk, n_heads, positions)
-    o = attn(q, kk, v, causal=True).astype(x.dtype)
+    o = attn(q, kk, v, causal=causal).astype(x.dtype)
     x = x + o.reshape(b, t, d) @ blk["wo"].astype(x.dtype)
     x = block_ffn(x, blk, ffn_fn)
     if return_kv:
@@ -139,6 +141,7 @@ def apply_layers(
     attn_fn: Optional[Callable] = None,
     ffn_fn: Optional[Callable] = None,
     return_kv: bool = False,
+    causal: bool = True,
 ):
     """Run a stacked block pytree (leaves [L, ...]) via lax.scan — one
     compiled block body regardless of depth; pipeline stages call this on
@@ -147,7 +150,7 @@ def apply_layers(
 
     def body(carry, blk):
         out = block_apply(
-            carry, blk, n_heads, positions, attn_fn, ffn_fn, return_kv
+            carry, blk, n_heads, positions, attn_fn, ffn_fn, return_kv, causal
         )
         if return_kv:
             return out[0], out[1]

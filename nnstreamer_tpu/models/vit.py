@@ -1,0 +1,94 @@
+"""Vision Transformer (ViT) classifier — the second vision family.
+
+Built from the same stacked encoder blocks as the LM
+(models/transformer.py, causal=False): patch-embed conv → [N, P², D]
+token grid (+ 2-D sin/cos position encoding in place of RoPE — RoPE is
+disabled by passing zero positions), pre-norm encoder stack, mean-pooled
+head. TPU notes: the patch conv is one big MXU matmul (P×P×3 → D), tokens
+keep D on the lane dimension, and the whole uint8→logits path is a single
+XLA program like the CNN zoo models.
+
+fn: uint8 NHWC [N, S, S, 3] → logits [N, num_classes].
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models import mobilenet_v2, nn
+from nnstreamer_tpu.models import transformer as tfm
+
+INPUT_SIZE = 224
+PATCH = 16
+
+
+def sincos_2d(grid: int, d_model: int) -> jnp.ndarray:
+    """Fixed 2-D sin/cos position table [grid*grid, d_model]."""
+    assert d_model % 4 == 0, "d_model must be divisible by 4 for 2D sincos"
+    d4 = d_model // 4
+    omega = 1.0 / (10000 ** (np.arange(d4) / d4))
+    pos = np.arange(grid)
+    out = np.einsum("p,d->pd", pos, omega)
+    emb = [np.sin(out), np.cos(out)]  # [grid, d4] each
+    row = np.concatenate(emb, axis=1)  # [grid, d4*2]
+    full = np.concatenate(
+        [
+            np.repeat(row, grid, axis=0),  # y component
+            np.tile(row, (grid, 1)),  # x component
+        ],
+        axis=1,
+    )  # [grid*grid, d_model]
+    return jnp.asarray(full, jnp.float32)
+
+
+def init_params(
+    key,
+    num_classes: int = 1001,
+    d_model: int = 384,
+    n_heads: int = 6,
+    n_layers: int = 12,
+    patch: int = PATCH,
+    size: int = INPUT_SIZE,
+) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    lm = tfm.init_params(
+        k1, vocab=1, d_model=d_model, n_heads=n_heads, n_layers=n_layers
+    )
+    grid = size // patch
+    return {
+        "patch": {
+            "w": nn.init_conv(k2, patch, patch, 3, d_model),
+            "b": jnp.zeros((d_model,), jnp.float32),
+        },
+        "pos": sincos_2d(grid, d_model),
+        "blocks": lm["blocks"],
+        "ln_f": lm["ln_f"],
+        "head": nn.init_dense(k3, d_model, num_classes),
+    }
+
+
+def apply(params: Dict, x, n_heads: int, compute_dtype=jnp.float32):
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    patch = params["patch"]["w"].shape[0]
+    y = nn.conv2d(x, params["patch"]["w"], stride=patch, padding="VALID")
+    y = y + params["patch"]["b"]
+    n, gh, gw, d = y.shape
+    tokens = y.reshape(n, gh * gw, d) + params["pos"].astype(y.dtype)
+    # zero positions disable RoPE's rotation (angle 0 = identity), keeping
+    # position information purely in the additive 2-D table
+    positions = jnp.zeros((gh * gw,), jnp.int32)
+    tokens = tfm.apply_layers(
+        params["blocks"], tokens, n_heads, positions, causal=False
+    )
+    tokens = tfm.rmsnorm(tokens, params["ln_f"])
+    pooled = jnp.mean(tokens, axis=1)
+    return nn.dense(pooled, params["head"]).astype(jnp.float32)

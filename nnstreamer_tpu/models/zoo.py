@@ -249,13 +249,65 @@ def _transformer_lm(**options) -> ZooModel:
     else:
         raise KeyError(f"transformer_lm: unknown attn {attn_kind!r}")
 
-    def fn(tokens):
-        return tfm.apply(params, tokens, n_heads, attn_fn=attn_fn, compute_dtype=dtype)
+    gen_tokens = int(options.get("generate", 0))
+    if gen_tokens > 0:
+        # serving mode: prompt frames in, generated token frames out — the
+        # whole KV-cache loop (models/decode.py) is one jitted program, so
+        # a tensor_filter stage becomes an LLM generation server
+        from nnstreamer_tpu.models import decode as dec
+
+        temperature = float(options.get("temperature", 0.0))
+        gen_seed = int(options.get("gen_seed", 0))
+
+        def fn(tokens):
+            return dec.generate(
+                params, tokens, n_heads, gen_tokens,
+                temperature=temperature,
+                rng=jax.random.PRNGKey(gen_seed),
+                compute_dtype=dtype,
+            )
+    else:
+        def fn(tokens):
+            return tfm.apply(
+                params, tokens, n_heads, attn_fn=attn_fn, compute_dtype=dtype
+            )
 
     spec = TensorsSpec.of(
         TensorSpec((batch, seqlen), DType.from_any("int32"), name="tokens")
     )
     return ZooModel("transformer_lm", fn, spec, params)
+
+
+@model_factory("vit")
+def _vit(**options) -> ZooModel:
+    """Vision Transformer classifier (models/vit.py): patch-embed +
+    non-causal encoder stack, image-labeling compatible logits."""
+    from nnstreamer_tpu.models import vit
+
+    seed = int(options.get("seed", 0))
+    num_classes = int(options.get("num_classes", 1001))
+    d_model = int(options.get("d_model", 384))
+    n_heads = int(options.get("n_heads", 6))
+    n_layers = int(options.get("n_layers", 12))
+    patch = int(options.get("patch", vit.PATCH))
+    batch = int(options.get("batch", 1))
+    size = int(options.get("size", vit.INPUT_SIZE))
+    if size % patch:
+        raise ValueError(f"vit: size {size} not divisible by patch {patch}")
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        vit.init_params(
+            jax.random.PRNGKey(seed), num_classes, d_model, n_heads,
+            n_layers, patch, size,
+        ),
+        options,
+    )
+
+    def fn(image):
+        return vit.apply(params, image, n_heads, compute_dtype=dtype)
+
+    spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
+    return ZooModel("vit", fn, spec, params)
 
 
 @model_factory("face_landmark")

@@ -81,3 +81,30 @@ def test_generate_jits(params):
 def test_prompt_too_long_rejected(params):
     with pytest.raises(ValueError, match="max_len"):
         decode.prefill(params, jnp.zeros((1, 9), jnp.int32), H, max_len=8)
+
+
+def test_lm_generation_pipeline():
+    """LLM serving as a pipeline: prompt frames → tensor_filter in
+    generate mode → generated-token frames."""
+    import numpy as np
+
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    prompts = [np.asarray([[1, 2, 3, 4]], np.int32),
+               np.asarray([[9, 8, 7, 6]], np.int32)]
+    src = AppSrc(iterable=iter(prompts), dimensions="4:1", types="int32")
+    filt = TensorFilter(
+        framework="jax", model="zoo:transformer_lm",
+        custom="vocab:32,d_model:32,n_heads:4,n_layers:1,generate:5,seqlen:4",
+    )
+    sink = TensorSink()
+    Pipeline().chain(src, filt, sink).run(timeout=120)
+    assert sink.rendered == 2
+    for f in sink.frames:
+        out = np.asarray(f.tensors[0])
+        assert out.shape == (1, 5)
+        assert out.dtype == np.int32
+        assert np.all((out >= 0) & (out < 32))

@@ -219,3 +219,26 @@ def test_face_composite_detect_crop_landmark():
     assert crops
     lm = np.asarray(lmk_m.fn(jnp.asarray(crops[0])))
     assert lm.shape == (1, 136)
+
+
+# ---------------------------------------------------------------- ViT
+
+def test_vit_output_shape():
+    m = zoo.get("vit", size="64", patch="16", d_model="64", n_heads="4",
+                n_layers="2", num_classes="10")
+    assert _shapes(m) == [(1, 10)]
+
+
+def test_vit_forward_finite():
+    m = zoo.get("vit", size="64", patch="16", d_model="64", n_heads="4",
+                n_layers="2", num_classes="10")
+    img = jnp.asarray(
+        np.random.default_rng(10).integers(0, 255, (1, 64, 64, 3), np.uint8)
+    )
+    out = np.asarray(jax.jit(m.fn)(img))
+    assert np.all(np.isfinite(out))
+
+
+def test_vit_patch_divisibility_enforced():
+    with pytest.raises(ValueError, match="divisible"):
+        zoo.get("vit", size="65", patch="16")
