@@ -16,8 +16,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# Honor an explicit JAX_PLATFORMS pin even when a site hook force-set
+# jax.config after import (config outranks the env var): a user asking for
+# cpu must never block on an unavailable accelerator attachment.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def _inspect(name: str | None) -> int:

@@ -245,11 +245,24 @@ class SinkNode(Node):
     def run(self) -> None:
         window = getattr(self.elem, "sync_window", 1)
         pending: List = []  # frames trailing the device stream (sync-window)
+
+        def flush() -> None:
+            # one fence on the newest frame covers the whole window (the
+            # device executes dispatches in order); each block_until_ready
+            # is a device round-trip, so per-frame fencing would pay the
+            # full RTT per frame on remote-attached devices
+            if not pending:
+                return
+            pending[-1].block_until_ready()
+            for f in pending:
+                f.mark_synced()
+                self.elem.render(f)
+            pending.clear()
+
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
-                for f in pending:
-                    self.elem.render(f)
+                flush()
                 self.elem.on_eos()
                 break
             t0 = time.perf_counter()
@@ -257,7 +270,7 @@ class SinkNode(Node):
                 item.prefetch_host()
                 pending.append(item)
                 if len(pending) >= window:
-                    self.elem.render(pending.pop(0))
+                    flush()
             else:
                 self.elem.render(item)
             self.stat(t0)

@@ -84,9 +84,21 @@ class Frame:
         return self.with_tensors([jax.device_put(t, target) for t in self.tensors])
 
     def block_until_ready(self) -> "Frame":
+        # each block_until_ready costs a device round-trip even on finished
+        # arrays (pronounced on remote-attached devices) — once a frame is
+        # fenced, later calls are free
+        if self.meta.get("_synced"):
+            return self
         for t in self.tensors:
             if hasattr(t, "block_until_ready"):
                 t.block_until_ready()
+        self.meta["_synced"] = True
+        return self
+
+    def mark_synced(self) -> "Frame":
+        """Record that a later dispatch on the same device was fenced —
+        in-order execution means this frame's compute is done too."""
+        self.meta["_synced"] = True
         return self
 
     def prefetch_host(self) -> "Frame":
