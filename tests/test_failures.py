@@ -1,0 +1,127 @@
+"""Failure-injection sweep (reference §5.3: invalid models/dims/properties
+golden-failure cases — gstTest "expect fail" flags). Every bad input must
+produce a *typed, descriptive* error, never a hang or a silent wrong
+answer."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.backends.base import BackendError
+from nnstreamer_tpu.elements.base import ElementError, NegotiationError
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+class TestParseFailures:
+    def test_unknown_element(self):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        with pytest.raises(Exception, match="nosuchelement"):
+            parse_pipeline("nosuchelement ! tensor_sink")
+
+    def test_bad_dim_string(self):
+        with pytest.raises(Exception):
+            TensorsSpec.from_strings("4:x:1")
+
+    def test_empty_pipeline(self):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        with pytest.raises(Exception):
+            parse_pipeline("")
+
+
+class TestModelFailures:
+    def test_unknown_zoo_model(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        with pytest.raises(Exception, match="unknown zoo model"):
+            SingleShot(framework="jax", model="zoo:nope").open()
+
+    def test_missing_model_file(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        with pytest.raises(Exception, match="not found"):
+            SingleShot(framework="custom", model="/no/such/script.py").open()
+
+    def test_unknown_framework(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        with pytest.raises(Exception, match="no filter subplugin"):
+            SingleShot(framework="nosuchfw", model="x").open()
+
+    def test_invoke_shape_mismatch(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        with SingleShot(framework="jax", model="zoo:add", custom="dims:4") as s:
+            with pytest.raises(BackendError, match="shape"):
+                s.invoke(np.zeros((5,), np.float32))
+
+
+class TestDecoderFailures:
+    def test_unknown_mode(self):
+        from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+        d = TensorDecoder(mode="nosuchmode")
+        with pytest.raises(Exception, match="nosuchmode"):
+            d.negotiate([TensorsSpec.from_strings("4:1")])
+
+    def test_bbox_wrong_tensor_count(self):
+        cls = registry.get(registry.KIND_DECODER, "bounding_boxes")
+        with pytest.raises(NegotiationError, match="expected"):
+            cls().negotiate(
+                TensorsSpec.from_strings("4:1"),
+                {"option1": "mobilenet-ssd-postprocess"},
+            )
+
+    def test_pose_wrong_tensor_count(self):
+        cls = registry.get(registry.KIND_DECODER, "pose_estimation")
+        with pytest.raises(NegotiationError, match="expected"):
+            cls().negotiate(
+                TensorsSpec.from_strings("17:9:9:1,34:9:9:1,32:9:9:1"),
+                {"option4": "heatmap-only"},
+            )
+
+
+class TestElementFailures:
+    def test_mux_over_tensor_limit(self):
+        from nnstreamer_tpu.elements.routing import TensorMux
+        from nnstreamer_tpu.tensors.spec import NNS_TENSOR_SIZE_LIMIT
+
+        mux = TensorMux()
+        mux.set_pad_counts(3, 1)
+        specs = [
+            TensorsSpec.from_strings(",".join(["4"] * 6), ",".join(["float32"] * 6))
+            for _ in range(3)
+        ]
+        with pytest.raises(NegotiationError, match="exceeds limit"):
+            mux.negotiate(specs)
+
+    def test_filter_needs_tensor_input(self):
+        from nnstreamer_tpu.elements.base import MediaSpec
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        f = TensorFilter(framework="passthrough")
+        with pytest.raises(NegotiationError, match="tensor_converter"):
+            f.negotiate([MediaSpec("video", width=8, height=8, format="RGB")])
+
+    def test_pipeline_error_propagates(self):
+        """A failing element poisons the pipeline with its error (reference
+        GST_FLOW_ERROR → pipeline error message), not a hang."""
+        from nnstreamer_tpu.backends.custom import register_custom_easy, unregister_custom_easy
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.sources import TensorSrc
+        from nnstreamer_tpu.pipeline.graph import Pipeline
+
+        def boom(tensors):
+            raise RuntimeError("injected failure")
+
+        register_custom_easy("boom_fn", boom)
+        try:
+            src = TensorSrc(dimensions="4", types="float32", **{"num-frames": 2})
+            filt = TensorFilter(framework="custom-easy", model="boom_fn")
+            sink = TensorSink()
+            with pytest.raises(Exception, match="injected failure"):
+                Pipeline().chain(src, filt, sink).run(timeout=60)
+        finally:
+            unregister_custom_easy("boom_fn")
