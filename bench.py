@@ -8,27 +8,93 @@ dispatch-ahead. Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "fps", "vs_baseline": N, ...}
 vs_baseline is against the 1000 FPS/chip target (BASELINE.json).
 
+Robustness: the TPU backend attach over the tunnel is flaky (round-1 failure
+mode: ``Unable to initialize backend 'axon': UNAVAILABLE`` at the first device
+op, which jax then caches for the process lifetime). So this file is an
+orchestrator: each attempt runs the measurement in a FRESH subprocess
+(``bench.py --run``) with backoff between attempts; the final fallback attempt
+pins the CPU platform so a diagnostic number always exists. On total failure
+it still prints one parseable JSON line with the error tail instead of rc:1.
+
 Measurement notes: jax dispatch is async; a streaming pipeline only
 synchronizes when a sink consumes results on host. We sync on a bounded
 in-flight window — the executor's sink path with ``sync-window=N``
 (elements/base.py Sink, executor.py SinkNode) — which is the steady-state
 pattern, not a per-frame round-trip (the tunnelled device adds ~70ms per
 *sync*, not per dispatch, so per-frame blocking would measure the tunnel,
-not the TPU).
+not the TPU). Stats hooks mirror the reference's measurement surface
+(tensor_filter.c:334-433 latency/throughput properties).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
 
+# bf16 peak TFLOP/s per chip by PJRT device_kind substring (public specs).
+_PEAK_TFLOPS = {
+    "v6e": 918.0,
+    "v6": 918.0,
+    "v5p": 459.0,
+    "v5e": 197.0,
+    "v5litepod": 197.0,
+    "v5lite": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
 
-def main() -> None:
+
+def _peak_tflops(device_kind: str) -> float | None:
+    k = device_kind.lower().replace(" ", "")
+    for key, val in _PEAK_TFLOPS.items():
+        if key in k:
+            return val
+    return None
+
+
+def _flops_per_frame(fn, example) -> float | None:
+    """XLA's own cost analysis for one invoke, if available."""
+    try:
+        import jax
+
+        cost = jax.jit(fn).lower(example).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+def _run() -> None:
+    """One measurement attempt (run in a fresh subprocess)."""
+    plat = os.environ.get("BENCH_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    # attach probe with in-process retries (cheap transient errors)
+    last = None
+    for attempt in range(3):
+        try:
+            dev = jax.devices()[0]
+            jax.block_until_ready(jnp.zeros((8,), jnp.float32) + 1.0)
+            last = None
+            break
+        except Exception as exc:  # noqa: BLE001 — any attach error retries
+            last = exc
+            time.sleep(2.0 * (attempt + 1))
+    if last is not None:
+        raise last
 
     from nnstreamer_tpu.models import zoo
 
@@ -72,6 +138,24 @@ def main() -> None:
         lat.append((time.perf_counter() - t) * 1000)
     p50 = statistics.median(lat)
 
+    # streaming-ingest variant: fresh host frame every iteration, H2D via
+    # async device_put overlapping compute (the converter's real ingest path,
+    # vs the on-device-resident loop above).
+    host_frames = [
+        np.ascontiguousarray(rng.integers(0, 255, (batch, 224, 224, 3), np.uint8))
+        for _ in range(8)
+    ]
+    iters_h = 512
+    out = None
+    t0 = time.perf_counter()
+    for i in range(iters_h):
+        x = jax.device_put(host_frames[i % 8], dev)
+        out = fn(x)
+        if (i + 1) % 128 == 0:
+            out.block_until_ready()
+    out.block_until_ready()
+    h2d_fps = iters_h * batch / (time.perf_counter() - t0)
+
     # micro-batched variant: the reference's converter frames-per-tensor
     # batching (gsttensor_converter.c frames_per_tensor) maps to the
     # aggregator batching 8 frames per invoke — same pipeline semantics,
@@ -94,7 +178,16 @@ def main() -> None:
     out.block_until_ready()
     mb_fps = iters8 * mb / (time.perf_counter() - t0)
 
-    dev = jax.devices()[0]
+    # achieved MFU from XLA cost analysis + public per-chip peak
+    flops = _flops_per_frame(m.fn, frames[0])
+    peak = _peak_tflops(str(dev.device_kind))
+    mfu = mfu8 = None
+    if flops and peak:
+        mfu = fps * flops / (peak * 1e12)
+        flops8 = _flops_per_frame(m8.fn, frames8[0])
+        if flops8:
+            mfu8 = mb_fps * (flops8 / mb) / (peak * 1e12)
+
     print(
         json.dumps(
             {
@@ -104,9 +197,66 @@ def main() -> None:
                 "vs_baseline": round(fps / 1000.0, 3),
                 "p50_sync_latency_ms": round(p50, 3),
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
+                "h2d_streaming_fps": round(h2d_fps, 1),
                 "microbatch8_fps": round(mb_fps, 1),
+                "flops_per_frame": flops,
+                "mfu_bs1": round(mfu, 4) if mfu is not None else None,
+                "mfu_mb8": round(mfu8, 4) if mfu8 is not None else None,
                 "platform": dev.platform,
                 "device": str(dev.device_kind),
+            }
+        )
+    )
+
+
+def main() -> None:
+    if "--run" in sys.argv:
+        return _run()
+
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    # (delay_before_attempt, extra_env). Last attempt pins CPU so a
+    # diagnostic number exists even when the TPU never attaches.
+    attempts = [
+        (0, {}),
+        (5, {}),
+        (15, {}),
+        (30, {}),
+        (5, {"BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}),
+    ]
+    last_tail = ""
+    for delay, extra in attempts:
+        if delay:
+            time.sleep(delay)
+        env = dict(os.environ, **extra)
+        try:
+            p = subprocess.run(
+                [sys.executable, here, "--run"],
+                capture_output=True,
+                text=True,
+                timeout=1500,
+                env=env,
+            )
+        except subprocess.TimeoutExpired as exc:
+            last_tail = f"timeout after {exc.timeout}s"
+            continue
+        if p.returncode == 0:
+            for line in reversed(p.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{") and line.endswith("}"):
+                    print(line)
+                    return
+        last_tail = (p.stdout + "\n" + p.stderr)[-1200:]
+    print(
+        json.dumps(
+            {
+                "metric": "mobilenet_v2_224_bs1_fps_per_chip",
+                "value": None,
+                "unit": "fps",
+                "vs_baseline": None,
+                "error": "all bench attempts failed (incl. cpu fallback)",
+                "tail": last_tail,
             }
         )
     )

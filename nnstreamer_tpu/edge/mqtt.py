@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 CONNECT, CONNACK, PUBLISH, SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = (
     1, 2, 3, 8, 9, 10, 11,
 )
+PUBACK, PUBREC, PUBREL, PUBCOMP = 4, 5, 6, 7
 PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
 
 DEFAULT_PORT = 1883
@@ -274,20 +275,39 @@ class MqttBroker:
             ).start()
 
     def _client_loop(self, sock: socket.socket) -> None:
+        # All writes to this socket go through send_lock: _fanout delivers
+        # PUBLISHes from publisher threads concurrently with the acks sent
+        # here, and interleaved sendall calls would corrupt MQTT framing.
+        send_lock = threading.Lock()
+
+        def _send(pkt: bytes) -> None:
+            with send_lock:
+                sock.sendall(pkt)
+
         try:
             ptype, _, _payload = _read_packet(sock)
             if ptype != CONNECT:
                 sock.close()
                 return
-            sock.sendall(_packet(CONNACK, 0, bytes([0, 0])))
             with self._lock:
-                self._clients[sock] = (threading.Lock(), [])
+                self._clients[sock] = (send_lock, [])
+            _send(_packet(CONNACK, 0, bytes([0, 0])))
             while self._running.is_set():
-                ptype, _flags, payload = _read_packet(sock)
+                ptype, flags, payload = _read_packet(sock)
                 if ptype == PUBLISH:
+                    qos = (flags >> 1) & 0x3
                     tlen = struct.unpack(">H", payload[:2])[0]
                     topic = payload[2 : 2 + tlen].decode()
+                    if qos:
+                        # QoS 1/2 publishes carry a packet id after the
+                        # topic; strip it before fan-out and acknowledge
+                        # (delivery to subscribers stays at-most-once).
+                        pid = payload[2 + tlen : 4 + tlen]
+                        payload = payload[: 2 + tlen] + payload[4 + tlen :]
+                        _send(_packet(PUBACK if qos == 1 else PUBREC, 0, pid))
                     self._fanout(topic, payload, exclude=None)
+                elif ptype == PUBREL:
+                    _send(_packet(PUBCOMP, 0, payload[:2]))
                 elif ptype == SUBSCRIBE:
                     pid = payload[:2]
                     pos, filters = 2, []
@@ -298,11 +318,9 @@ class MqttBroker:
                     with self._lock:
                         if sock in self._clients:
                             self._clients[sock][1].extend(filters)
-                    sock.sendall(
-                        _packet(SUBACK, 0, pid + bytes([0] * len(filters)))
-                    )
+                    _send(_packet(SUBACK, 0, pid + bytes([0] * len(filters))))
                 elif ptype == PINGREQ:
-                    sock.sendall(_packet(PINGRESP, 0, b""))
+                    _send(_packet(PINGRESP, 0, b""))
                 elif ptype == DISCONNECT:
                     break
         except (MqttError, OSError):

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from nnstreamer_tpu import registry
+from nnstreamer_tpu.edge.mqtt import MqttError
 from nnstreamer_tpu.edge.serialize import decode_message, encode_message
 from nnstreamer_tpu.edge.transport import TransportError, make_transport
 from nnstreamer_tpu.elements.base import (
@@ -79,8 +80,8 @@ class EdgeSink(Sink):
         if self._mqtt is not None:
             try:
                 self._mqtt.publish(self.topic, encode_message(EOS_FRAME))
-            except OSError:
-                pass
+            except (MqttError, OSError):
+                pass  # broker already gone: teardown must not raise
             self._mqtt.close()
             self._mqtt = None
         if self._transport is not None:
@@ -94,7 +95,12 @@ class EdgeSink(Sink):
 
     def render(self, frame: Frame) -> None:
         if self._mqtt is not None:
-            self._mqtt.publish(self.topic, encode_message(frame))
+            try:
+                self._mqtt.publish(self.topic, encode_message(frame))
+            except (MqttError, OSError) as exc:
+                raise ElementError(
+                    f"{self.name}: MQTT publish failed: {exc}"
+                ) from exc
             return
         if self.wait_connection and self._transport.peer_count() == 0:
             import time
@@ -118,7 +124,7 @@ class EdgeSink(Sink):
         if self._mqtt is not None:
             try:
                 self._mqtt.publish(self.topic, encode_message(EOS_FRAME))
-            except OSError:
+            except (MqttError, OSError):
                 pass
         if self._transport is not None:
             try:

@@ -128,6 +128,13 @@ def generate(
     program + one scanned decode program; both compile once per shape."""
     b, t = prompt.shape
     max_len = max_len or (t + max_new_tokens)
+    if max_len < t + max_new_tokens:
+        # Too-small caches don't error downstream: dynamic_update_slice
+        # clamps the write index, silently overwriting the last slot.
+        raise ValueError(
+            f"max_len={max_len} < prompt_len({t}) + max_new_tokens"
+            f"({max_new_tokens}); KV cache would overflow"
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
     logits, cache, pos = prefill(

@@ -26,6 +26,10 @@ class ZooModel:
     fn: Callable  # (*tensors) -> tensor | tuple, pure & traceable
     input_spec: Optional[TensorsSpec]
     params: Optional[Dict] = None
+    # params-explicit form ``apply(params, *tensors)``: required for mesh-
+    # sharded filters (custom="mesh:dp2tp4") — closed-over params would be
+    # baked into the jaxpr as replicated constants, defeating TP sharding
+    apply: Optional[Callable] = None
 
 
 _FACTORIES: Dict[str, Callable[..., ZooModel]] = {}

@@ -246,14 +246,32 @@ class SinkNode(Node):
         window = getattr(self.elem, "sync_window", 1)
         pending: List = []  # frames trailing the device stream (sync-window)
 
+        def _dev_key(f) -> tuple:
+            keys = []
+            for t in f.tensors:
+                devs = getattr(t, "devices", None)
+                if callable(devs):
+                    try:
+                        keys.extend(sorted(str(d) for d in devs()))
+                    except Exception:  # noqa: BLE001 — deleted/host array
+                        pass
+            return tuple(keys)
+
         def flush() -> None:
-            # one fence on the newest frame covers the whole window (the
-            # device executes dispatches in order); each block_until_ready
-            # is a device round-trip, so per-frame fencing would pay the
-            # full RTT per frame on remote-attached devices
+            # one fence on the newest frame per device covers the window
+            # (each device executes its dispatches in order, but ordering
+            # holds only within a device — a window mixing frames pinned to
+            # different devices needs one fence per device); each
+            # block_until_ready is a device round-trip, so per-frame
+            # fencing would pay the full RTT per frame on remote-attached
+            # devices
             if not pending:
                 return
-            pending[-1].block_until_ready()
+            newest_per_device = {}
+            for f in pending:
+                newest_per_device[_dev_key(f)] = f
+            for f in newest_per_device.values():
+                f.block_until_ready()
             for f in pending:
                 f.mark_synced()
                 self.elem.render(f)

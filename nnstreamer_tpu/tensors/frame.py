@@ -46,6 +46,11 @@ class Frame:
     duration: Optional[int] = None
     meta: Dict[str, Any] = field(default_factory=dict)
     seq: int = field(default_factory=lambda: next(_frame_seq))
+    # Sync fence state is per-Frame-object, NOT in meta: replace()-derived
+    # frames share the meta dict, and a shared flag would mark sibling
+    # frames (holding different, possibly still-executing tensors) synced.
+    # init=False ⇒ every replace()-derived frame starts unsynced.
+    _synced: bool = field(default=False, init=False, repr=False, compare=False)
 
     def __post_init__(self):
         self.tensors = tuple(self.tensors)
@@ -87,18 +92,18 @@ class Frame:
         # each block_until_ready costs a device round-trip even on finished
         # arrays (pronounced on remote-attached devices) — once a frame is
         # fenced, later calls are free
-        if self.meta.get("_synced"):
+        if self._synced:
             return self
         for t in self.tensors:
             if hasattr(t, "block_until_ready"):
                 t.block_until_ready()
-        self.meta["_synced"] = True
+        self._synced = True
         return self
 
     def mark_synced(self) -> "Frame":
         """Record that a later dispatch on the same device was fenced —
         in-order execution means this frame's compute is done too."""
-        self.meta["_synced"] = True
+        self._synced = True
         return self
 
     def prefetch_host(self) -> "Frame":
