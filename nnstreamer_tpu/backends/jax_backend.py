@@ -94,6 +94,13 @@ class JaxBackend(Backend):
         self._out_spec: Optional[TensorsSpec] = None
         self._device = None
         self._shardings = None  # (in_shardings, out_shardings) when sharded
+        self._mesh_spec: Optional[str] = None  # e.g. "dp2tp4" (mesh: option)
+        self._mesh = None
+        self._apply: Optional[Callable] = None  # params-explicit fn
+        self._params = None
+        self._param_shardings = None
+        self._placed_params = None
+        self._params_explicit = False
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, props: FilterProps) -> None:
@@ -113,6 +120,17 @@ class JaxBackend(Backend):
                     f"jax: device:{idx} out of range (have {len(devs)})"
                 )
             self._device = devs[idx]
+        # mesh-sharded filter (the TP/DP inference story): custom
+        # "mesh:dp2tp4" pjits this filter over a named device mesh —
+        # replaces the reference's accelerator-string device selection
+        # (tensor_filter_common.c:451-) with XLA GSPMD partitioning
+        mesh_spec = options.get("mesh") or self._parse_accel_mesh(
+            props.accelerator
+        )
+        if mesh_spec:
+            if self._device is not None:
+                raise BackendError("jax: device: and mesh: are exclusive")
+            self._mesh_spec = mesh_spec
         if path.startswith("zoo:"):
             self._open_zoo(path[len("zoo:"):], options)
         elif path.endswith(".py"):
@@ -129,9 +147,12 @@ class JaxBackend(Backend):
     def _open_zoo(self, name: str, options) -> None:
         from nnstreamer_tpu.models import zoo
 
-        m = zoo.get(name, **options)
+        opts = {k: v for k, v in options.items() if k not in ("device", "mesh")}
+        m = zoo.get(name, **opts)
         self._fn = m.fn
         self._in_spec = m.input_spec
+        self._apply = m.apply
+        self._params = m.params
 
     def _open_script(self, path: str, options) -> None:
         if not os.path.isfile(path):
@@ -155,31 +176,124 @@ class JaxBackend(Backend):
         self._in_spec = _spec_from_avals(exported.in_avals)
 
     # -- compile -----------------------------------------------------------
+    @staticmethod
+    def _parse_accel_mesh(accelerator: str) -> Optional[str]:
+        """``accelerator=true:tpu:mesh=dp2tp4`` → ``dp2tp4`` (the reference's
+        accelerator-string grammar, extended with a mesh clause)."""
+        for part in (accelerator or "").split(":"):
+            part = part.strip()
+            if part.startswith("mesh="):
+                return part[len("mesh="):]
+        return None
+
+    def _build_mesh_shardings(self) -> None:
+        """Turn the mesh spec + negotiated input spec into jit shardings.
+
+        Any GSPMD sharding annotation compiles to a *correct* program (XLA
+        inserts the collectives); the choices here are the perf defaults:
+        batch dim over ``dp``, last weight dim over ``tp`` (column-parallel
+        matmuls/convs), everything else replicated.
+        """
+        import math
+        import re
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from nnstreamer_tpu.parallel.mesh import make_mesh
+
+        pairs = re.findall(r"([a-z]+)(\d+)", self._mesh_spec)
+        if not pairs or "".join(f"{a}{s}" for a, s in pairs) != self._mesh_spec:
+            raise BackendError(
+                f"jax: bad mesh spec {self._mesh_spec!r} (want e.g. dp2tp4)"
+            )
+        axes = tuple(a for a, _ in pairs)
+        sizes = tuple(int(s) for _, s in pairs)
+        n = math.prod(sizes)
+        if n > len(jax.devices()):
+            raise BackendError(
+                f"jax: mesh {self._mesh_spec} needs {n} devices, "
+                f"have {len(jax.devices())}"
+            )
+        mesh = make_mesh(n, axes=axes, shape=sizes)
+        ax = dict(zip(axes, sizes))
+        dp, tp = ax.get("dp", 1), ax.get("tp", 1)
+        rep = NamedSharding(mesh, P())
+        in_sh = []
+        for t in self._in_spec:
+            if dp > 1 and len(t.shape) >= 1 and t.shape[0] % dp == 0:
+                in_sh.append(
+                    NamedSharding(mesh, P("dp", *([None] * (len(t.shape) - 1))))
+                )
+            else:
+                in_sh.append(rep)
+        param_sh = None
+        if self._apply is not None and self._params is not None:
+            def rule(leaf):
+                shp = tuple(getattr(leaf, "shape", ()))
+                if tp > 1 and len(shp) >= 2 and shp[-1] % tp == 0 and shp[-1] >= tp:
+                    return NamedSharding(
+                        mesh, P(*([None] * (len(shp) - 1)), "tp")
+                    )
+                return rep
+
+            param_sh = jax.tree_util.tree_map(rule, self._params)
+        elif tp > 1:
+            _log.warning(
+                "jax: mesh %s has tp>1 but model exposes no params-explicit "
+                "apply; falling back to input sharding only", self._mesh_spec,
+            )
+        self._mesh = mesh
+        self._shardings = (tuple(in_sh), None)
+        self._param_shardings = param_sh
+
     def _compile(self) -> None:
         assert self._fn is not None and self._in_spec is not None
         fn = self._fn
         wrapped = lambda *tensors: _as_tuple(fn(*tensors))  # noqa: E731
-        jit_kwargs = {}
-        if self._shardings is not None:
-            jit_kwargs = dict(
-                in_shardings=self._shardings[0], out_shardings=self._shardings[1]
-            )
-        elif self._device is not None:
-            single = jax.sharding.SingleDeviceSharding(self._device)
-            jit_kwargs = dict(out_shardings=single)
-        self._jitted = jax.jit(wrapped, **jit_kwargs)
-        # shape inference without running (reference getModelInfo): one
-        # abstract evaluation of the jitted function
+        if self._mesh_spec:
+            self._build_mesh_shardings()
         dummies = [
             jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype) for t in self._in_spec
         ]
-        outs = jax.eval_shape(wrapped, *dummies)
+        if self._shardings is not None and self._param_shardings is not None:
+            apply = self._apply
+            wrapped_p = lambda p, *xs: _as_tuple(apply(p, *xs))  # noqa: E731
+            jit_kwargs = dict(
+                in_shardings=(self._param_shardings, *self._shardings[0])
+            )
+            if self._shardings[1] is not None:
+                jit_kwargs["out_shardings"] = self._shardings[1]
+            self._jitted = jax.jit(wrapped_p, **jit_kwargs)
+            self._placed_params = jax.device_put(
+                self._params, self._param_shardings
+            )
+            self._params_explicit = True
+            outs = jax.eval_shape(wrapped_p, self._params, *dummies)
+        else:
+            jit_kwargs = {}
+            if self._shardings is not None:
+                jit_kwargs = dict(in_shardings=self._shardings[0])
+                if self._shardings[1] is not None:
+                    jit_kwargs["out_shardings"] = self._shardings[1]
+            elif self._device is not None:
+                single = jax.sharding.SingleDeviceSharding(self._device)
+                jit_kwargs = dict(out_shardings=single)
+            self._jitted = jax.jit(wrapped, **jit_kwargs)
+            self._params_explicit = False
+            # shape inference without running (reference getModelInfo): one
+            # abstract evaluation of the jitted function
+            outs = jax.eval_shape(wrapped, *dummies)
         self._out_spec = _spec_from_avals(_as_tuple(outs))
 
-    def set_shardings(self, in_shardings, out_shardings) -> None:
-        """Install jit shardings (used by the parallel layer before open
-        completes or on renegotiation)."""
-        self._shardings = (in_shardings, out_shardings)
+    def set_shardings(
+        self, in_shardings, out_shardings=None, param_shardings=None
+    ) -> None:
+        """Install jit shardings programmatically (the parallel layer's
+        entry; the ``mesh:`` custom option builds the same state from a
+        spec string)."""
+        self._shardings = (tuple(in_shardings), out_shardings)
+        self._param_shardings = param_shardings
+        self._mesh_spec = None  # explicit shardings override the spec string
         if self._in_spec is not None and self._in_spec.is_static:
             self._compile()
 
@@ -211,23 +325,38 @@ class JaxBackend(Backend):
             raise BackendError(
                 f"jax: expected {self._in_spec.num_tensors} tensors, got {len(tensors)}"
             )
-        for t, s in zip(tensors, self._in_spec):
-            if tuple(t.shape) != s.shape:
-                raise BackendError(
-                    f"jax: input shape {tuple(t.shape)} != negotiated {s.shape}"
-                )
+        if not (self.props is not None and self.props.invoke_dynamic):
+            for t, s in zip(tensors, self._in_spec):
+                if tuple(t.shape) != s.shape:
+                    raise BackendError(
+                        f"jax: input shape {tuple(t.shape)} != negotiated {s.shape}"
+                    )
+        # invoke-dynamic: per-frame shapes may drift (e.g. tensor_crop
+        # output feeding a size-agnostic model); jax.jit retraces per new
+        # shape and caches each executable
         if self._device is not None:
             # cross-stage hop: async device→device transfer (ICI on TPU)
             tensors = tuple(jax.device_put(t, self._device) for t in tensors)
+        elif self._shardings is not None:
+            # reshard inputs arriving from any placement (committed
+            # single-device arrays from an upstream stage included) onto
+            # this filter's mesh; device_put is async and rides ICI
+            tensors = tuple(
+                jax.device_put(t, s)
+                for t, s in zip(tensors, self._shardings[0])
+            )
+        if self._params_explicit:
+            return self._jitted(self._placed_params, *tensors)
         return self._jitted(*tensors)
 
     def traceable_fn(self):
         fn = self._fn
         if fn is None:
             return None
-        if self._device is not None:
-            # a device-pinned stage is a fusion barrier: fusing it into a
-            # neighbor's XLA program would silently drop the placement
+        if self._device is not None or self._shardings is not None or self._mesh_spec:
+            # a device-pinned or mesh-sharded stage is a fusion barrier:
+            # fusing it into a neighbor's XLA program would silently drop
+            # the placement/partitioning
             return None
         return lambda tensors: _as_tuple(fn(*tensors))
 
@@ -236,6 +365,6 @@ class JaxBackend(Backend):
         before streaming starts, like the reference loads the model at
         PAUSED, not on the first frame)."""
         in_spec, _ = self.get_model_info()
-        zeros = [jnp.zeros(t.shape, t.dtype.np_dtype) for t in in_spec]
-        out = self._jitted(*zeros)
+        zeros = tuple(jnp.zeros(t.shape, t.dtype.np_dtype) for t in in_spec)
+        out = self.invoke(zeros)
         jax.block_until_ready(out)

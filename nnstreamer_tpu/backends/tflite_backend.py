@@ -35,9 +35,9 @@ def _load_interpreter():
         return Interpreter
     except ImportError:
         pass
-    from tensorflow.lite import Interpreter  # type: ignore
+    import tensorflow as tf  # type: ignore
 
-    return Interpreter
+    return tf.lite.Interpreter  # lazy-loader attr; not a real submodule
 
 
 def _spec_from_details(details) -> TensorsSpec:

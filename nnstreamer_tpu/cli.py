@@ -76,10 +76,17 @@ def main(argv=None) -> int:
     if not args.description:
         ap.error("pipeline description required")
 
-    from nnstreamer_tpu.pipeline.parse import parse_pipeline
+    from nnstreamer_tpu.elements.base import ElementError, NegotiationError
+    from nnstreamer_tpu.pipeline.parse import ParseError, parse_pipeline
 
-    pipeline = parse_pipeline(args.description)
-    pipeline.negotiate()
+    # gst-launch-style diagnostics: construction/negotiation failures are
+    # user errors — one clean line and rc 1, never a traceback dump
+    try:
+        pipeline = parse_pipeline(args.description)
+        pipeline.negotiate()
+    except (ParseError, NegotiationError, ElementError, KeyError, ValueError) as exc:
+        print(f"nns-launch: {exc}", file=sys.stderr)
+        return 1
     if args.dot:
         print(pipeline.dump_dot())
         return 0
@@ -103,6 +110,9 @@ def main(argv=None) -> int:
             # operator-requested bound on an endless pipeline: a stop, not a bug
             ex = pipeline._executor
             timed_out = True
+        except (ElementError, NegotiationError, RuntimeError) as exc:
+            print(f"nns-launch: pipeline error: {exc}", file=sys.stderr)
+            return 1
     dt = time.perf_counter() - t0
     if tracer is not None:
         tracer.save(args.trace)

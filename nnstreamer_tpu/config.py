@@ -38,6 +38,10 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "framework_priority_msgpack": "jax",
         "framework_priority_py": "custom",
         "framework_priority_tflite": "tflite,jax",
+        # .pt/.pth = TorchScript (torch.jit.load); .pt2 (torch.export
+        # archives) is NOT mapped — the torch backend can't load it
+        "framework_priority_pt": "torch",
+        "framework_priority_pth": "torch",
     },
     "decoder": {"plugin_paths": ""},
     "converter": {"plugin_paths": ""},

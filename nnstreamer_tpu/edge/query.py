@@ -62,13 +62,44 @@ def _unregister_server(srv_id: str, transport=None) -> None:
             _server_table.pop(srv_id, None)
 
 
-def _check_connect_type(elem) -> None:
+CONNECT_TYPES = ("TCP", "MQTT", "HYBRID")
+
+
+def _check_connect_type(elem) -> str:
+    """Validate and return connect-type (reference
+    tensor_query_common.c:35-42; AITT is vendor-gated like its meson
+    option)."""
     ct = str(elem.get_property("connect-type", "TCP")).upper()
-    if ct != "TCP":
+    if ct not in CONNECT_TYPES:
         raise NegotiationError(
-            f"{elem.name}: connect-type={ct} not built in (TCP only; "
-            "MQTT/HYBRID/AITT are gated like the reference's meson options)"
+            f"{elem.name}: connect-type={ct} not built in "
+            f"(have {'/'.join(CONNECT_TYPES)}; AITT is vendor-gated)"
         )
+    return ct
+
+
+def _make_client_transport(ct: str, topic: str):
+    if ct == "MQTT":
+        from nnstreamer_tpu.edge.query_transports import MqttQueryTransport
+
+        return MqttQueryTransport(topic)
+    if ct == "HYBRID":
+        from nnstreamer_tpu.edge.query_transports import HybridClientTransport
+
+        return HybridClientTransport(topic)
+    return make_transport()
+
+
+def _make_server_transport(ct: str, topic: str, data_host: str, data_port: int):
+    if ct == "MQTT":
+        from nnstreamer_tpu.edge.query_transports import MqttQueryTransport
+
+        return MqttQueryTransport(topic)
+    if ct == "HYBRID":
+        from nnstreamer_tpu.edge.query_transports import HybridServerTransport
+
+        return HybridServerTransport(topic, data_host, data_port)
+    return make_transport()
 
 
 @registry.element("tensor_query_client")
@@ -87,10 +118,12 @@ class TensorQueryClient(HostElement):
         self.host = str(self.get_property("dest-host", "127.0.0.1"))
         self.port = int(self.get_property("dest-port", 0))
         self.timeout = float(self.get_property("timeout", DEFAULT_TIMEOUT))
+        self.connect_type = "TCP"
+        self.topic = str(self.get_property("topic", "nns-query"))
         self._transport = None
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
-        _check_connect_type(self)
+        self.connect_type = _check_connect_type(self)
         if self.port <= 0:
             raise NegotiationError(f"{self.name}: dest-port required")
         # the reply's spec is the remote pipeline's business — flexible
@@ -99,7 +132,8 @@ class TensorQueryClient(HostElement):
         return [TensorsSpec(format=TensorFormat.FLEXIBLE)]
 
     def start(self) -> None:
-        self._transport = make_transport()
+        self.connect_type = _check_connect_type(self)
+        self._transport = _make_client_transport(self.connect_type, self.topic)
         try:
             self._transport.connect(self.host, self.port)
         except (TransportError, OSError) as exc:
@@ -154,15 +188,24 @@ class TensorQueryServerSrc(Source):
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", 0))
         self.srv_id = str(self.get_property("id", "0"))
+        self.topic = str(self.get_property("topic", "nns-query"))
+        # HYBRID: host/port address the broker; the TCP data plane binds
+        # data-host:data-port (default ephemeral on loopback)
+        self.data_host = str(self.get_property("data-host", "127.0.0.1"))
+        self.data_port = int(self.get_property("data-port", 0))
+        self.connect_type = "TCP"
         self.bound_port: Optional[int] = None
         self._transport = None
 
     def output_spec(self) -> Spec:
-        _check_connect_type(self)
+        self.connect_type = _check_connect_type(self)
         return TensorsSpec(format=TensorFormat.FLEXIBLE)
 
     def start(self) -> None:
-        self._transport = make_transport()
+        self.connect_type = _check_connect_type(self)
+        self._transport = _make_server_transport(
+            self.connect_type, self.topic, self.data_host, self.data_port
+        )
         self.bound_port = self._transport.listen(self.host, self.port)
         _register_server(self.srv_id, self._transport)
 

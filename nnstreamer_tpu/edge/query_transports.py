@@ -1,0 +1,216 @@
+"""Query connect-type transports beyond raw TCP: MQTT and HYBRID.
+
+Reference: tensor_query_common.c:35-42 — the query elements accept
+connect-type TCP / MQTT / HYBRID (/ AITT, vendor-gated). Semantics:
+
+- ``MQTT``: request/reply payloads ride the broker. Client publishes to
+  ``<topic>/req/<client_id>`` and subscribes ``<topic>/rep/<client_id>``;
+  the server subscribes ``<topic>/req/+`` and replies on the rep topic of
+  the requesting client. dest-host/dest-port address the *broker*.
+- ``HYBRID``: MQTT for discovery/control only, raw TCP for bulk tensors
+  (the reference's broker-assisted mode). The server listens on an
+  ephemeral TCP port and answers ``<topic>/whois`` discovery requests with
+  ``host:port``; clients then speak plain TCP.
+
+Both adapters expose the same surface as the native TCP transport
+(connect/listen/send/recv/close/peer_count) so the query elements stay
+transport-agnostic, like the reference elements over nns_edge handles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue as queue_mod
+import threading
+import time
+from typing import Optional, Tuple
+
+from nnstreamer_tpu.edge.mqtt import MqttClient, MqttError
+from nnstreamer_tpu.edge.transport import TransportError, make_transport
+
+_client_seq = itertools.count(1)
+
+
+class MqttQueryTransport:
+    """Request/reply over an MQTT broker, one topic pair per client."""
+
+    def __init__(self, topic: str = "nns-query") -> None:
+        self.topic = topic.rstrip("/")
+        self._mqtt: Optional[MqttClient] = None
+        self._queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=1024)
+        self._server = False
+        self._cid = f"c{os.getpid()}-{next(_client_seq)}"
+
+    # -- server side -------------------------------------------------------
+    def listen(self, host: str, port: int) -> int:
+        port = port or 1883
+        try:
+            self._mqtt = MqttClient(
+                host, port, on_message=self._on_message
+            ).connect()
+        except (MqttError, OSError) as exc:
+            raise TransportError(f"cannot reach MQTT broker {host}:{port}: {exc}")
+        self._server = True
+        self._mqtt.subscribe(f"{self.topic}/req/+")
+        return port
+
+    # -- client side -------------------------------------------------------
+    def connect(self, host: str, port: int) -> None:
+        port = port or 1883
+        try:
+            self._mqtt = MqttClient(
+                host, port, on_message=self._on_message
+            ).connect()
+        except (MqttError, OSError) as exc:
+            raise TransportError(f"cannot reach MQTT broker {host}:{port}: {exc}")
+        self._mqtt.subscribe(f"{self.topic}/rep/{self._cid}")
+
+    # -- shared ------------------------------------------------------------
+    def _on_message(self, topic: str, payload: bytes) -> None:
+        cid = topic.rsplit("/", 1)[-1]
+        if self._queue.full():  # drop-oldest backpressure, like the client
+            try:
+                self._queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+        self._queue.put((cid, payload))
+
+    def send(self, cid, payload: bytes) -> None:
+        if self._mqtt is None:
+            raise TransportError("mqtt transport not connected")
+        if self._server:
+            dest = f"{self.topic}/rep/{cid}"
+        else:
+            dest = f"{self.topic}/req/{self._cid}"
+        try:
+            self._mqtt.publish(dest, payload)
+        except (MqttError, OSError) as exc:
+            raise TransportError(f"mqtt publish failed: {exc}")
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Tuple[str, bytes]]:
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+
+    def peer_count(self) -> int:
+        return 1 if self._mqtt is not None else 0
+
+    def close(self) -> None:
+        if self._mqtt is not None:
+            self._mqtt.close()
+            self._mqtt = None
+
+
+class HybridServerTransport:
+    """TCP data plane + MQTT discovery: answers whois with host:port."""
+
+    def __init__(self, topic: str = "nns-query", data_host: str = "127.0.0.1",
+                 data_port: int = 0) -> None:
+        self.topic = topic.rstrip("/")
+        self.data_host = data_host
+        self.data_port = data_port
+        self._tcp = None
+        self._disc: Optional[MqttClient] = None
+        self._addr = ""
+
+    def listen(self, host: str, port: int) -> int:
+        self._tcp = make_transport()
+        tcp_port = self._tcp.listen(self.data_host, self.data_port)
+        self._addr = f"{self.data_host}:{tcp_port}"
+        try:
+            self._disc = MqttClient(
+                host, port or 1883, on_message=self._on_whois
+            ).connect()
+        except (MqttError, OSError) as exc:
+            self._tcp.close()
+            self._tcp = None
+            raise TransportError(
+                f"cannot reach MQTT broker {host}:{port or 1883}: {exc}"
+            )
+        self._disc.subscribe(f"{self.topic}/whois")
+        # announce once proactively for clients that subscribed early
+        self._announce()
+        return tcp_port
+
+    def _announce(self) -> None:
+        try:
+            self._disc.publish(f"{self.topic}/host", self._addr.encode())
+        except (MqttError, OSError):
+            pass  # discovery is best-effort; TCP plane keeps serving
+
+    def _on_whois(self, topic: str, payload: bytes) -> None:
+        self._announce()
+
+    def send(self, cid, payload: bytes) -> None:
+        self._tcp.send(cid, payload)
+
+    def recv(self, timeout: Optional[float] = None):
+        return self._tcp.recv(timeout=timeout)
+
+    def peer_count(self) -> int:
+        return self._tcp.peer_count() if self._tcp is not None else 0
+
+    def close(self) -> None:
+        if self._disc is not None:
+            self._disc.close()
+            self._disc = None
+        if self._tcp is not None:
+            self._tcp.close()
+            self._tcp = None
+
+
+class HybridClientTransport:
+    """Discover the server's TCP address over MQTT, then speak TCP."""
+
+    DISCOVERY_TIMEOUT = 5.0
+
+    def __init__(self, topic: str = "nns-query") -> None:
+        self.topic = topic.rstrip("/")
+        self._tcp = None
+
+    def connect(self, host: str, port: int) -> None:
+        try:
+            disc = MqttClient(host, port or 1883).connect()
+        except (MqttError, OSError) as exc:
+            raise TransportError(
+                f"cannot reach MQTT broker {host}:{port or 1883}: {exc}"
+            )
+        try:
+            disc.subscribe(f"{self.topic}/host")
+            deadline = time.monotonic() + self.DISCOVERY_TIMEOUT
+            addr = None
+            while time.monotonic() < deadline:
+                try:
+                    disc.publish(f"{self.topic}/whois", b"?")
+                except (MqttError, OSError) as exc:
+                    raise TransportError(f"discovery publish failed: {exc}")
+                got = disc.recv(timeout=0.5)
+                if got is not None:
+                    addr = got[1].decode()
+                    break
+            if addr is None:
+                raise TransportError(
+                    f"no query server answered whois on {self.topic!r} "
+                    f"within {self.DISCOVERY_TIMEOUT}s"
+                )
+        finally:
+            disc.close()
+        h, _, p = addr.rpartition(":")
+        self._tcp = make_transport()
+        self._tcp.connect(h, int(p))
+
+    def send(self, cid, payload: bytes) -> None:
+        self._tcp.send(cid, payload)
+
+    def recv(self, timeout: Optional[float] = None):
+        return self._tcp.recv(timeout=timeout)
+
+    def peer_count(self) -> int:
+        return self._tcp.peer_count() if self._tcp is not None else 0
+
+    def close(self) -> None:
+        if self._tcp is not None:
+            self._tcp.close()
+            self._tcp = None

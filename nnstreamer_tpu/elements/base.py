@@ -92,6 +92,10 @@ class Element:
         # queue-element analogue; see executor)
         self.queue_size = int(props.pop("queue-size", props.pop("queue_size", 4)))
         self.silent = _parse_bool(props.pop("silent", True))
+        # downstream QoS publishers (tensor_rate upstream-throttle analogue,
+        # gsttensor_rate.c:27-36,452): producers consult these and skip
+        # frames the downstream limiter would drop anyway
+        self.qos_sources: List[Any] = []
         for k, v in props.items():
             self.set_property(k, v)
 
@@ -112,6 +116,21 @@ class Element:
         self.in_specs = list(in_specs)
         self.out_specs = self.negotiate(list(in_specs))
         return self.out_specs
+
+    # -- QoS ----------------------------------------------------------------
+    def add_qos_source(self, qos: Any) -> None:
+        if qos not in self.qos_sources:
+            self.qos_sources.append(qos)
+
+    def qos_would_drop(self, frame: Any) -> bool:
+        """True if a downstream rate limiter will certainly drop this frame
+        — the producer can skip the work entirely (the reference's upstream
+        QoS event path; here the hint is pulled, not pushed)."""
+        if not self.qos_sources:
+            return False
+        pts = getattr(frame, "pts", None)
+        dur = getattr(frame, "duration", None)
+        return any(q.would_drop(pts, dur) for q in self.qos_sources)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

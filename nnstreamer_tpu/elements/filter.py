@@ -157,6 +157,11 @@ class TensorFilter(TensorOp):
     def _select_model_inputs_spec(self, spec: TensorsSpec) -> TensorsSpec:
         if self.in_combination is None:
             return spec
+        if not spec.is_static:
+            # flexible stream: per-frame tensor count is unknown until the
+            # frame arrives; the combination indexes are applied (and
+            # bounds-checked) at invoke time instead
+            return spec
         picks = []
         for kind, idx in self.in_combination:
             if kind == "o":

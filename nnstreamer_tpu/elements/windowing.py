@@ -120,15 +120,39 @@ class TensorAggregator(HostElement):
         self._window.clear()
 
 
+class RateQoS:
+    """Shared drop-ahead hint published by tensor_rate, consulted by
+    upstream producers (the reference's upstream QoS event,
+    gsttensor_rate.c:452, pulled instead of pushed).
+
+    ``next_ts`` only ever increases, so a stale read is conservative: a
+    frame judged droppable against an old (smaller) next_ts is also
+    dropped by the current one — no lock needed."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self.next_ts: Optional[int] = None
+        self.skipped_upstream = 0  # producers increment when they skip
+
+    def would_drop(self, pts: Optional[int], duration: Optional[int]) -> bool:
+        nt = self.next_ts
+        if not self.enabled or nt is None or pts is None:
+            return False
+        if duration is None:
+            return pts < nt
+        return pts + duration <= nt
+
+
 @registry.element("tensor_rate")
 class TensorRate(HostElement):
     """Framerate conversion by PTS-based dup/drop, plus optional wall-clock
     throttling (the compute-saving use of reference tensor_rate).
 
     Props: framerate="15/1" (target), throttle=true|false (sleep to cap
-    real-time emission rate; reference sends upstream QoS instead — bounded
-    queues already give us backpressure, so throttling here directly slows
-    the pipeline the same way).
+    real-time emission rate), qos=true|false (default true: publish the
+    next-needed timestamp upstream so producers skip frames this element
+    would drop — the reference's upstream QoS events,
+    gsttensor_rate.c:27-36,452).
     """
 
     FACTORY_NAME = "tensor_rate"
@@ -139,6 +163,10 @@ class TensorRate(HostElement):
         self.target: Optional[Fraction] = Fraction(str(fr)) if fr else None
         self.throttle = str(self.get_property("throttle", "false")).lower() in (
             "1", "true", "yes",
+        )
+        self.qos = RateQoS(
+            enabled=str(self.get_property("qos", "true")).lower()
+            in ("1", "true", "yes")
         )
         self._next_ts: Optional[int] = None
         self._last_emit_wall = 0.0
@@ -178,6 +206,7 @@ class TensorRate(HostElement):
             self._next_ts += out_dur
             if frame.duration is None:
                 break
+        self.qos.next_ts = self._next_ts  # publish drop-ahead hint upstream
         if not out:
             self.drop += 1
             return None

@@ -92,11 +92,14 @@ def _mobilenet_v2(**options) -> ZooModel:
     )
     params = _load_params_overlay(params, options)
 
+    def apply_fn(p, image):
+        return mobilenet_v2.apply(p, image, compute_dtype=compute_dtype)
+
     def fn(image):
-        return mobilenet_v2.apply(params, image, compute_dtype=compute_dtype)
+        return apply_fn(params, image)
 
     spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
-    return ZooModel("mobilenet_v2", fn, spec, params)
+    return ZooModel("mobilenet_v2", fn, spec, params, apply_fn)
 
 
 def _image_spec(batch: int, size: int, in_dtype: str) -> TensorsSpec:
@@ -124,13 +127,16 @@ def _ssd_mobilenet_v2(**options) -> ZooModel:
         ssd_mobilenet.init_params(jax.random.PRNGKey(seed), num_classes), options
     )
 
-    def fn(image):
+    def apply_fn(p, image):
         return ssd_mobilenet.apply(
-            params, image, compute_dtype=dtype, num_classes=num_classes
+            p, image, compute_dtype=dtype, num_classes=num_classes
         )
 
+    def fn(image):
+        return apply_fn(params, image)
+
     spec = _image_spec(batch, 300, options.get("input_dtype", "uint8"))
-    return ZooModel("ssd_mobilenet_v2", fn, spec, params)
+    return ZooModel("ssd_mobilenet_v2", fn, spec, params, apply_fn)
 
 
 @model_factory("ssd_mobilenet_v2_pp")
@@ -148,14 +154,17 @@ def _ssd_mobilenet_v2_pp(**options) -> ZooModel:
     )
     priors = jnp.asarray(ssd_mobilenet.generate_anchors())
 
-    def fn(image):
+    def apply_fn(p, image):
         return ssd_mobilenet.apply_postprocessed(
-            params, image, priors, max_out=max_out, threshold=threshold,
+            p, image, priors, max_out=max_out, threshold=threshold,
             compute_dtype=dtype,
         )
 
+    def fn(image):
+        return apply_fn(params, image)
+
     spec = _image_spec(1, 300, options.get("input_dtype", "uint8"))
-    return ZooModel("ssd_mobilenet_v2_pp", fn, spec, params)
+    return ZooModel("ssd_mobilenet_v2_pp", fn, spec, params, apply_fn)
 
 
 @model_factory("posenet")
@@ -169,11 +178,14 @@ def _posenet(**options) -> ZooModel:
     dtype = _compute_dtype(options)
     params = _load_params_overlay(posenet.init_params(jax.random.PRNGKey(seed)), options)
 
+    def apply_fn(p, image):
+        return posenet.apply(p, image, compute_dtype=dtype)
+
     def fn(image):
-        return posenet.apply(params, image, compute_dtype=dtype)
+        return apply_fn(params, image)
 
     spec = _image_spec(batch, posenet.INPUT_SIZE, options.get("input_dtype", "uint8"))
-    return ZooModel("posenet", fn, spec, params)
+    return ZooModel("posenet", fn, spec, params, apply_fn)
 
 
 @model_factory("deeplab_v3")
@@ -189,11 +201,14 @@ def _deeplab_v3(**options) -> ZooModel:
         deeplab_v3.init_params(jax.random.PRNGKey(seed)), options
     )
 
+    def apply_fn(p, image):
+        return deeplab_v3.apply(p, image, compute_dtype=dtype)
+
     def fn(image):
-        return deeplab_v3.apply(params, image, compute_dtype=dtype)
+        return apply_fn(params, image)
 
     spec = _image_spec(batch, deeplab_v3.INPUT_SIZE, options.get("input_dtype", "uint8"))
-    return ZooModel("deeplab_v3", fn, spec, params)
+    return ZooModel("deeplab_v3", fn, spec, params, apply_fn)
 
 
 @model_factory("face_detect")
@@ -215,14 +230,17 @@ def _face_detect(**options) -> ZooModel:
         fp.init_detect_params(jax.random.PRNGKey(seed)), options
     )
 
-    def fn(image):
-        det = fp.apply_detect(params, image, max_faces=max_faces, compute_dtype=dtype)
+    def apply_fn(p, image):
+        det = fp.apply_detect(p, image, max_faces=max_faces, compute_dtype=dtype)
         if out_mode == "regions":
             return fp.detections_to_regions(det, fw, fh, threshold)
         return det
 
+    def fn(image):
+        return apply_fn(params, image)
+
     spec = _image_spec(1, fp.DETECT_SIZE, options.get("input_dtype", "uint8"))
-    return ZooModel("face_detect", fn, spec, params)
+    return ZooModel("face_detect", fn, spec, params, apply_fn)
 
 
 @model_factory("transformer_lm")
@@ -270,16 +288,20 @@ def _transformer_lm(**options) -> ZooModel:
                 rng=jax.random.PRNGKey(gen_seed),
                 compute_dtype=dtype,
             )
+        apply_fn = None
     else:
-        def fn(tokens):
+        def apply_fn(p, tokens):
             return tfm.apply(
-                params, tokens, n_heads, attn_fn=attn_fn, compute_dtype=dtype
+                p, tokens, n_heads, attn_fn=attn_fn, compute_dtype=dtype
             )
+
+        def fn(tokens):
+            return apply_fn(params, tokens)
 
     spec = TensorsSpec.of(
         TensorSpec((batch, seqlen), DType.from_any("int32"), name="tokens")
     )
-    return ZooModel("transformer_lm", fn, spec, params)
+    return ZooModel("transformer_lm", fn, spec, params, apply_fn)
 
 
 @model_factory("vit")
@@ -307,11 +329,14 @@ def _vit(**options) -> ZooModel:
         options,
     )
 
+    def apply_fn(p, image):
+        return vit.apply(p, image, n_heads, compute_dtype=dtype)
+
     def fn(image):
-        return vit.apply(params, image, n_heads, compute_dtype=dtype)
+        return apply_fn(params, image)
 
     spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
-    return ZooModel("vit", fn, spec, params)
+    return ZooModel("vit", fn, spec, params, apply_fn)
 
 
 @model_factory("face_landmark")
@@ -328,8 +353,11 @@ def _face_landmark(**options) -> ZooModel:
         fp.init_landmark_params(jax.random.PRNGKey(seed)), options
     )
 
+    def apply_fn(p, image):
+        return fp.apply_landmark(p, image, compute_dtype=dtype)
+
     def fn(image):
-        return fp.apply_landmark(params, image, compute_dtype=dtype)
+        return apply_fn(params, image)
 
     spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
-    return ZooModel("face_landmark", fn, spec, params)
+    return ZooModel("face_landmark", fn, spec, params, apply_fn)

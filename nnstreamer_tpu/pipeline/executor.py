@@ -148,10 +148,17 @@ class FusedNode(Node):
 
     def run(self) -> None:
         self.seg.build()  # compile before first frame (PAUSED-state parity)
+        first = self.seg.first
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
                 break
+            if first.qos_would_drop(item):
+                # downstream rate limiter will drop this frame: skip the
+                # whole fused program (reference upstream-QoS work skip)
+                for q in first.qos_sources:
+                    q.skipped_upstream += 1
+                continue
             t0 = time.perf_counter()
             out = self.seg.process(item)
             self.stat(t0)
@@ -172,6 +179,10 @@ class TensorOpHostNode(Node):
             item = self.pop(0)
             if item is EOS_FRAME:
                 break
+            if self.elem.qos_would_drop(item):
+                for q in self.elem.qos_sources:
+                    q.skipped_upstream += 1
+                continue
             t0 = time.perf_counter()
             out = self.elem.host_process(item)
             self.stat(t0)
@@ -191,6 +202,10 @@ class HostNode(Node):
                 for f in self.elem.flush():
                     self.push_out(0, f)
                 break
+            if self.elem.qos_would_drop(item):
+                for q in self.elem.qos_sources:
+                    q.skipped_upstream += 1
+                continue
             t0 = time.perf_counter()
             out = self.elem.process(item)
             self.stat(t0)

@@ -178,8 +178,40 @@ class Pipeline:
                     f"{e.name}: negotiated {len(e.out_specs)} specs for "
                     f"{self.n_srcs(e)} src pads"
                 )
+        self._wire_qos()
         self._negotiated = True
         return self
+
+    def _wire_qos(self) -> None:
+        """Attach each tensor_rate's QoS hint to its upstream linear path
+        (the reference's upstream QoS event propagation,
+        gsttensor_rate.c:452): producers on the path skip frames the rate
+        limiter would drop. The walk stops at fan-in/fan-out boundaries —
+        a shared upstream (tee) may feed branches that still need the
+        frame — and at elements that restructure timestamps or windows
+        (aggregator, another rate, batching converter): skipping THEIR
+        inputs would change the content of outputs the limiter keeps."""
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.flow import Queue
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        passthrough_timing = (TensorFilter, TensorTransform, Queue)
+        for e in self.elements:
+            qos = getattr(e, "qos", None)
+            if qos is None or not getattr(qos, "enabled", False):
+                continue
+            cur = e
+            while True:
+                ins = self.in_links(cur)
+                if len(ins) != 1:
+                    break
+                up = ins[0].src
+                if len(self.out_links(up)) != 1:
+                    break  # tee/demux boundary: other branches need frames
+                if not isinstance(up, passthrough_timing):
+                    break  # timestamp-restructuring or unknown element
+                up.add_qos_source(qos)
+                cur = up
 
     # -- compile: fuse linear TensorOp chains ------------------------------
     def compile_plan(self) -> "ExecPlan":

@@ -1,0 +1,62 @@
+"""Composite face→crop→landmark pipeline, end-to-end across devices.
+
+BASELINE config #5: face detector on one chip feeds bounding regions to
+tensor_crop; crops stream to a landmark model on a second chip. Reference
+building blocks: gsttensor_crop.c + tensordec-boundingbox.c composition and
+the query-offload examples. Here the stages are pinned to different devices
+of the virtual 8-CPU mesh (custom="device:N") and the hop rides device
+transfer, not host TCP.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+COMPOSITE = (
+    "videotestsrc pattern=gradient num-frames={n} width=128 height=128 ! "
+    "tensor_converter ! tee name=t "
+    "t. ! queue ! tensor_filter framework=jax model=zoo:face_detect "
+    'custom="output:regions,threshold:0.0,frame_size:128:128{det_dev}" ! '
+    "crop.sink_1 "
+    "t. ! queue ! crop.sink_0 "
+    "tensor_crop name=crop ! "
+    "tensor_filter framework=jax model=zoo:face_landmark "
+    'custom="{lmk_dev}" invoke-dynamic=true input-combination=0 ! '
+    "tensor_sink name=out"
+)
+
+
+def _run_composite(n=2, det_dev=",device:0", lmk_dev="device:1"):
+    p = parse_pipeline(COMPOSITE.format(n=n, det_dev=det_dev, lmk_dev=lmk_dev))
+    p.run(timeout=240)
+    sink = next(e for e in p.elements if isinstance(e, TensorSink))
+    return [np.asarray(f.tensors[0]) for f in sink.frames]
+
+
+def test_composite_multichip_e2e():
+    outs = _run_composite()
+    assert len(outs) == 2
+    for lm in outs:
+        assert lm.shape == (1, 136)
+        assert np.all(np.isfinite(lm))
+        assert np.all(lm >= 0) and np.all(lm <= 1)
+
+
+def test_composite_deterministic_golden():
+    """Same pipeline, two fresh runs → bit-identical landmark streams (the
+    SSAT golden-compare property, held in-process)."""
+    a = _run_composite()
+    b = _run_composite()
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_composite_device_pinning_matches_unpinned():
+    """Placement is a scheduling choice, not a numeric one."""
+    pinned = _run_composite()
+    unpinned = _run_composite(det_dev="", lmk_dev="")
+    for x, y in zip(pinned, unpinned):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)

@@ -55,15 +55,132 @@ PIPELINES = {
         "tensor_converter ! tensor_aggregator frames-in=1 frames-out=2 "
         "frames-flush=2 ! filesink location={out}"
     ),
+    # BASELINE composite config #5: detect (device 0) → crop → landmark
+    # (device 1) over the virtual mesh, through the CLI
+    "composite_face": (
+        "videotestsrc pattern=gradient num-frames=2 width=128 height=128 ! "
+        "tensor_converter ! tee name=t "
+        "t. ! queue ! tensor_filter framework=jax model=zoo:face_detect "
+        'custom="output:regions,threshold:0.0,frame_size:128:128,device:0" '
+        "! crop.sink_1 "
+        "t. ! queue ! crop.sink_0 "
+        "tensor_crop name=crop ! "
+        'tensor_filter framework=jax model=zoo:face_landmark custom="device:1" '
+        "invoke-dynamic=true input-combination=0 ! filesink location={out}"
+    ),
+    # decoder goldens (reference tests/nnstreamer_decoder_*/runTest.sh)
+    "decoder_bbox_ov": (
+        "videotestsrc pattern=gradient num-frames=1 width=128 height=128 ! "
+        "tensor_converter ! tensor_filter framework=jax model=zoo:face_detect ! "
+        "tensor_decoder mode=bounding_boxes option1=ov-face-detection "
+        "option4=64:64 option5=128:128 ! filesink location={out}"
+    ),
+    "decoder_label": (
+        "videotestsrc pattern=gradient num-frames=1 width=64 height=64 ! "
+        "tensor_converter ! tensor_filter framework=jax model=zoo:mobilenet_v2 "
+        'custom="size:64,num_classes:16" ! '
+        "tensor_decoder mode=image_labeling ! filesink location={out}"
+    ),
+    "decoder_pose": (
+        "videotestsrc pattern=gradient num-frames=1 width=257 height=257 ! "
+        "tensor_converter ! tensor_filter framework=jax model=zoo:posenet "
+        "output-combination=o0,o1 ! "
+        "tensor_decoder mode=pose_estimation option1=32:32 option2=257:257 "
+        "option4=heatmap-offset ! filesink location={out}"
+    ),
+    "decoder_segment": (
+        "videotestsrc pattern=gradient num-frames=1 width=257 height=257 ! "
+        "tensor_converter ! tensor_filter framework=jax model=zoo:deeplab_v3 ! "
+        "tensor_decoder mode=image_segment option1=tflite-deeplab ! "
+        "filesink location={out}"
+    ),
+    "decoder_direct_video": (
+        "videotestsrc pattern=counter num-frames=2 width=8 height=8 ! "
+        "tensor_converter ! tensor_decoder mode=direct_video ! "
+        "filesink location={out}"
+    ),
+    # mux sync policies (synchronization-policies-at-mux-merge.md)
+    "mux_slowest": (
+        "videotestsrc pattern=counter num-frames=4 width=4 height=4 "
+        "framerate=20/1 ! tensor_converter ! mux.sink_0 "
+        "videotestsrc pattern=gradient num-frames=2 width=4 height=4 "
+        "framerate=10/1 ! tensor_converter ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=slowest ! filesink location={out}"
+    ),
+    "mux_basepad": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 "
+        "framerate=10/1 ! tensor_converter ! mux.sink_0 "
+        "videotestsrc pattern=gradient num-frames=4 width=4 height=4 "
+        "framerate=20/1 ! tensor_converter ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=basepad sync-option=0:0 ! "
+        "filesink location={out}"
+    ),
+    # demux tensorpick selection/reorder
+    "demux_tensorpick": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! mux.sink_0 "
+        "videotestsrc pattern=gradient num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=nosync ! "
+        "tensor_demux tensorpick=1 ! filesink location={out}"
+    ),
+    # split a tensor along a dim, then merge back (gsttensor_split/merge.c)
+    "split_merge": (
+        "videotestsrc pattern=counter num-frames=2 width=8 height=4 ! "
+        "tensor_converter ! tensor_split tensorseg=3:8:2:1,3:8:2:1 "
+        "name=sp sp.src_0 ! m.sink_0 sp.src_1 ! m.sink_1 "
+        "tensor_merge name=m mode=linear option=2 sync-mode=nosync ! "
+        "filesink location={out}"
+    ),
+    # data-dependent branch: average-value predicate, else fills zeros
+    "if_branch": (
+        "videotestsrc pattern=counter num-frames=4 width=4 height=4 ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_if compared-value=TENSOR_AVERAGE_VALUE "
+        "compared-value-option=0 operator=GT supplied-value=1.5 "
+        "then=PASSTHROUGH else=FILL_ZERO ! filesink location={out}"
+    ),
+    # rate conversion: 20 fps in → 10 fps out (dup/drop path)
+    "rate_drop": (
+        "videotestsrc pattern=counter num-frames=6 width=4 height=4 "
+        "framerate=20/1 ! tensor_converter ! tensor_rate framerate=10/1 ! "
+        "filesink location={out}"
+    ),
+}
+
+# "expect fail" golden cases (reference gstTest "expect fail" flags): the
+# CLI must exit non-zero with a diagnostic, not hang or dump raw output
+FAIL_PIPELINES = {
+    "unknown_element": "videotestsrc num-frames=1 ! no_such_element ! fakesink",
+    "filter_without_converter": (
+        "videotestsrc num-frames=1 ! "
+        "tensor_filter framework=jax model=zoo:add ! fakesink"
+    ),
+    "bad_mesh": (
+        "videotestsrc num-frames=1 width=64 height=64 ! tensor_converter ! "
+        "tensor_filter framework=jax model=zoo:mobilenet_v2 "
+        'custom="size:64,mesh:dp999" ! fakesink'
+    ),
+    "dangling_bang": "videotestsrc num-frames=1 ! tensor_converter !",
+    "demux_pick_out_of_range": (
+        "videotestsrc num-frames=1 width=4 height=4 ! tensor_converter ! "
+        "tensor_demux tensorpick=3 ! fakesink"
+    ),
 }
 
 
+def _env():
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
+
+
 def _run(pipeline: str, out_path: str) -> None:
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     proc = subprocess.run(
         [sys.executable, "-m", "nnstreamer_tpu.cli",
          pipeline.format(out=out_path), "-q"],
-        capture_output=True, text=True, timeout=300, env=env,
+        capture_output=True, text=True, timeout=300, env=_env(),
     )
     assert proc.returncode == 0, f"pipeline failed:\n{proc.stderr}"
 
@@ -82,11 +199,29 @@ def test_golden(name, tmp_path):
     assert actual == expected, f"{name}: byte mismatch vs golden"
 
 
+@pytest.mark.parametrize("name", sorted(FAIL_PIPELINES))
+def test_expect_fail(name):
+    proc = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu.cli", FAIL_PIPELINES[name], "-q"],
+        capture_output=True, text=True, timeout=300, env=_env(),
+    )
+    assert proc.returncode != 0, f"{name}: expected failure, got rc=0"
+    # diagnostic, not a bare traceback (CLI catches and reports)
+    assert "Traceback" not in (proc.stderr or ""), (
+        f"{name}: CLI dumped a traceback:\n{proc.stderr[-600:]}"
+    )
+    assert "nns-launch:" in (proc.stderr or "")
+
+
 if __name__ == "__main__":
     if "--regen" in sys.argv:
+        force = "--force" in sys.argv
         os.makedirs(GOLDEN_DIR, exist_ok=True)
         for name, pipe in sorted(PIPELINES.items()):
             path = os.path.join(GOLDEN_DIR, f"{name}.raw")
+            if os.path.exists(path) and not force:
+                print(f"keep  {path}")
+                continue
             _run(pipe, path)
             print(f"wrote {path} ({os.path.getsize(path)} bytes)")
     else:

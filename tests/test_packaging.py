@@ -1,0 +1,46 @@
+"""Packaging smoke tests (reference L7: debian/, packaging/, meson install).
+
+Builds a wheel from the checkout and checks the artifact contains the
+package and the nns-launch console script."""
+
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def wheel_path(tmp_path_factory):
+    out = tmp_path_factory.mktemp("wheel")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pip", "wheel", REPO,
+            "--no-deps", "--no-build-isolation", "-w", str(out), "-q",
+        ],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"pip wheel unavailable: {proc.stderr[-400:]}")
+    wheels = [f for f in os.listdir(out) if f.endswith(".whl")]
+    assert len(wheels) == 1, f"expected one wheel, got {wheels}"
+    return os.path.join(out, wheels[0])
+
+
+def test_wheel_contains_package_and_console_script(wheel_path):
+    with zipfile.ZipFile(wheel_path) as z:
+        names = z.namelist()
+        assert any(n == "nnstreamer_tpu/__init__.py" for n in names)
+        assert any(n.endswith("proto/nns_tensors.proto") for n in names)
+        entry = next(n for n in names if n.endswith("entry_points.txt"))
+        text = z.read(entry).decode()
+    assert "nns-launch = nnstreamer_tpu.cli:main" in text
+
+
+def test_wheel_has_no_test_or_bench_files(wheel_path):
+    with zipfile.ZipFile(wheel_path) as z:
+        names = z.namelist()
+    assert not any(n.startswith(("tests/", "bench")) for n in names)

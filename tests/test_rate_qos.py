@@ -1,0 +1,88 @@
+"""tensor_rate upstream QoS: producers skip work for frames the rate
+limiter would drop (reference gsttensor_rate.c:27-36 — QoS events sent
+upstream so elements save compute; here the hint is pulled from a shared
+RateQoS published by the rate element)."""
+
+import numpy as np
+
+from nnstreamer_tpu.backends.custom import (
+    register_custom_easy,
+    unregister_custom_easy,
+)
+from nnstreamer_tpu.elements.converter import TensorConverter
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.sources import VideoTestSrc
+from nnstreamer_tpu.elements.windowing import RateQoS, TensorRate
+from nnstreamer_tpu.pipeline.graph import Pipeline
+
+
+def _run_rate_pipeline(qos: str):
+    """videotestsrc 20fps → filter(counting) → tensor_rate 5fps → sink."""
+    calls = {"n": 0}
+
+    def counting(tensors):
+        calls["n"] += 1
+        return tuple(np.asarray(t) * 2 for t in tensors)
+
+    name = f"qos_counting_{qos}"
+    register_custom_easy(name, counting)
+    try:
+        src = VideoTestSrc(width=4, height=4, **{"num-frames": 20},
+                           framerate="20/1")
+        conv = TensorConverter()
+        filt = TensorFilter(framework="custom-easy", model=name)
+        rate = TensorRate(framerate="5/1", qos=qos)
+        sink = TensorSink()
+        p = Pipeline().chain(src, conv, filt, rate, sink)
+        p.run(timeout=60)
+        return calls["n"], sink.rendered, rate
+    finally:
+        unregister_custom_easy(name)
+
+
+def test_upstream_skips_dropped_frames():
+    calls, rendered, rate = _run_rate_pipeline("true")
+    # 20 frames at 20fps → 5fps keeps every 4th: 5 outputs
+    assert rendered == 5
+    # the filter must NOT have computed all 20 frames
+    assert calls < 20, f"filter ran {calls}/20 — no upstream skip happened"
+    assert rate.qos.skipped_upstream == 20 - calls
+    # every kept output slot still needs one compute
+    assert calls >= rendered
+
+
+def test_qos_disabled_computes_everything():
+    calls, rendered, rate = _run_rate_pipeline("false")
+    assert rendered == 5
+    assert calls == 20
+    assert rate.qos.skipped_upstream == 0
+
+
+def test_output_parity_with_and_without_qos():
+    """Skipping producer work must not change what the sink sees."""
+
+    def run(qos):
+        src = VideoTestSrc(width=4, height=4, **{"num-frames": 12},
+                           framerate="12/1", pattern="counter")
+        conv = TensorConverter()
+        rate = TensorRate(framerate="4/1", qos=qos)
+        sink = TensorSink()
+        p = Pipeline().chain(src, conv, rate, sink)
+        p.run(timeout=60)
+        return [(f.pts, np.asarray(f.tensors[0]).tobytes()) for f in sink.frames]
+
+    np.testing.assert_equal(run("true"), run("false"))
+
+
+def test_rateqos_would_drop_semantics():
+    q = RateQoS()
+    assert not q.would_drop(0, 100)  # no hint yet
+    q.next_ts = 1000
+    assert q.would_drop(0, 100)       # entirely before next slot
+    assert q.would_drop(900, 100)     # ends exactly at the slot boundary
+    assert not q.would_drop(950, 100)  # covers the slot
+    assert not q.would_drop(1000, 100)
+    assert not q.would_drop(None, 100)  # untimed frames always pass
+    q.enabled = False
+    assert not q.would_drop(0, 100)
