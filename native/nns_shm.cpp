@@ -1,0 +1,297 @@
+// nns_shm: single-producer/single-consumer shared-memory ring transport.
+//
+// Role: the same-host fast path of the among-device layer. The reference
+// moves frames between co-located pipelines through loopback TCP via the
+// nnstreamer-edge library (gst/edge/, tensor_query elements); for pipeline
+// shards living on one host that pays two socket copies plus syscall
+// round-trips per frame. This ring hands length-prefixed messages through
+// one POSIX shm segment with a process-shared mutex/condvar pair — one
+// memcpy in, one memcpy out, no sockets, honest blocking with timeouts.
+//
+// Not derived from the reference's C sources: different wire model
+// (framed ring, not stream), different sync (pthread process-shared
+// condvars, not poll loops).
+//
+// ABI (extern "C", used via ctypes from edge/shm.py):
+//   nns_shm_create(name, capacity) -> handle   producer side, creates
+//   nns_shm_open(name)             -> handle   consumer side, attaches
+//   nns_shm_write(h, buf, len, timeout_ms) -> 1 ok, 0 timeout, -1 error
+//   nns_shm_read(h, buf, cap, timeout_ms) -> n bytes, 0 timeout,
+//                                            -1 closed+drained, -2 cap too small
+//   nns_shm_reader_count(h) -> attached consumers
+//   nns_shm_mark_closed(h)   producer EOS: readers drain then see -1
+//   nns_shm_close(h, unlink)
+//
+// Layout: [Header][ring bytes]. Messages are u32-length-prefixed and may
+// wrap. A length prefix of 0xFFFFFFFF is a wrap marker (skip to ring
+// start) so a prefix never splits across the boundary.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x4e4e53534d454d31ull;  // "NNSSMEM1"
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;       // ring data bytes
+  uint64_t write_pos;      // absolute byte offsets (mod capacity for index)
+  uint64_t read_pos;
+  uint32_t closed;         // producer finished
+  uint32_t readers;        // attached consumer count
+  pthread_mutex_t mu;
+  pthread_cond_t can_read;
+  pthread_cond_t can_write;
+};
+
+struct Handle {
+  Header* h;
+  uint8_t* ring;
+  size_t map_len;
+  char name[256];
+  int creator;
+};
+
+uint64_t used(const Header* h) { return h->write_pos - h->read_pos; }
+
+void abs_deadline(struct timespec* ts, int timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// copy into the ring at logical offset (no wrap handling — callers ensure
+// the region is contiguous)
+void ring_put(Header* h, uint8_t* ring, uint64_t pos, const void* src,
+              size_t n) {
+  memcpy(ring + (pos % h->capacity), src, n);
+}
+
+void ring_get(Header* h, const uint8_t* ring, uint64_t pos, void* dst,
+              size_t n) {
+  memcpy(dst, ring + (pos % h->capacity), n);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nns_shm_create(const char* name, uint64_t capacity) {
+  if (capacity < 4096) capacity = 4096;
+  size_t total = sizeof(Header) + capacity;
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_mutexattr_destroy(&ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->can_read, &ca);
+  pthread_cond_init(&h->can_write, &ca);
+  pthread_condattr_destroy(&ca);
+  h->magic = kMagic;  // last: attachers spin on it
+
+  Handle* hd = new Handle();
+  hd->h = h;
+  hd->ring = (uint8_t*)mem + sizeof(Header);
+  hd->map_len = total;
+  hd->creator = 1;
+  strncpy(hd->name, name, sizeof(hd->name) - 1);
+  return hd;
+}
+
+void* nns_shm_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+           fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* h = (Header*)mem;
+  if (h->magic != kMagic ||
+      sizeof(Header) + h->capacity > (uint64_t)st.st_size) {
+    munmap(mem, (size_t)st.st_size);
+    return nullptr;
+  }
+  Handle* hd = new Handle();
+  hd->h = h;
+  hd->ring = (uint8_t*)mem + sizeof(Header);
+  hd->map_len = (size_t)st.st_size;
+  hd->creator = 0;
+  strncpy(hd->name, name, sizeof(hd->name) - 1);
+  pthread_mutex_lock(&h->mu);
+  h->readers += 1;
+  pthread_mutex_unlock(&h->mu);
+  return hd;
+}
+
+int nns_shm_write(void* handle, const void* data, uint64_t len,
+                  int timeout_ms) {
+  Handle* hd = (Handle*)handle;
+  if (!hd || !hd->h) return -1;
+  Header* h = hd->h;
+  uint64_t cap = h->capacity;
+  // cap/2 bound: a wrap can consume up to room_to_end (< 4+len) padding
+  // bytes on top of 4+len, so the worst-case need is < 2*(4+len); bounding
+  // len at cap/2-8 guarantees any message eventually fits from any ring
+  // position (no livelock on an empty-but-misaligned ring)
+  if (len + 8 > cap / 2) return -1;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&h->mu);
+  for (;;) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+    // exact need from the CURRENT write position: wrapping consumes the
+    // padding/marker bytes to the ring end plus the prefixed message
+    uint64_t idx_now = h->write_pos % cap;
+    uint64_t room_now = cap - idx_now;
+    uint64_t need =
+        (room_now < 4 + len) ? room_now + 4 + len : 4 + len;
+    if (cap - used(h) >= need) break;
+    if (timeout_ms <= 0 ||
+        pthread_cond_timedwait(&h->can_write, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return 0;
+    }
+  }
+  uint64_t idx = h->write_pos % cap;
+  uint64_t room_to_end = cap - idx;
+  if (room_to_end < 4) {
+    // not even a prefix fits contiguously: pad to ring start
+    h->write_pos += room_to_end;
+  } else if (room_to_end < 4 + len) {
+    // prefix fits but payload would split: wrap marker, then restart
+    uint32_t marker = kWrapMarker;
+    ring_put(h, hd->ring, h->write_pos, &marker, 4);
+    h->write_pos += room_to_end;
+  }
+  uint32_t len32 = (uint32_t)len;
+  ring_put(h, hd->ring, h->write_pos, &len32, 4);
+  ring_put(h, hd->ring, h->write_pos + 4, data, len);
+  h->write_pos += 4 + len;
+  pthread_cond_broadcast(&h->can_read);
+  pthread_mutex_unlock(&h->mu);
+  return 1;
+}
+
+int64_t nns_shm_read(void* handle, void* buf, uint64_t buf_cap,
+                     int timeout_ms) {
+  Handle* hd = (Handle*)handle;
+  if (!hd || !hd->h) return -1;
+  Header* h = hd->h;
+  uint64_t cap = h->capacity;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&h->mu);
+  for (;;) {
+    while (used(h) >= 4) {
+      uint64_t idx = h->read_pos % cap;
+      uint64_t room_to_end = cap - idx;
+      if (room_to_end < 4) {  // producer padded to ring start
+        h->read_pos += room_to_end;
+        continue;
+      }
+      uint32_t len32;
+      ring_get(h, hd->ring, h->read_pos, &len32, 4);
+      if (len32 == kWrapMarker) {
+        h->read_pos += room_to_end;
+        continue;
+      }
+      if (len32 > buf_cap) {
+        pthread_mutex_unlock(&h->mu);
+        return -2;  // caller's buffer too small; message stays queued
+      }
+      ring_get(h, hd->ring, h->read_pos + 4, buf, len32);
+      h->read_pos += 4 + len32;
+      pthread_cond_broadcast(&h->can_write);
+      pthread_mutex_unlock(&h->mu);
+      return (int64_t)len32;
+    }
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;  // drained and producer is done
+    }
+    if (timeout_ms <= 0 ||
+        pthread_cond_timedwait(&h->can_read, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return 0;
+    }
+  }
+}
+
+uint32_t nns_shm_reader_count(void* handle) {
+  Handle* hd = (Handle*)handle;
+  if (!hd || !hd->h) return 0;
+  pthread_mutex_lock(&hd->h->mu);
+  uint32_t n = hd->h->readers;
+  pthread_mutex_unlock(&hd->h->mu);
+  return n;
+}
+
+void nns_shm_mark_closed(void* handle) {
+  Handle* hd = (Handle*)handle;
+  if (!hd || !hd->h) return;
+  pthread_mutex_lock(&hd->h->mu);
+  hd->h->closed = 1;
+  pthread_cond_broadcast(&hd->h->can_read);
+  pthread_cond_broadcast(&hd->h->can_write);
+  pthread_mutex_unlock(&hd->h->mu);
+}
+
+void nns_shm_close(void* handle, int unlink_seg) {
+  Handle* hd = (Handle*)handle;
+  if (!hd) return;
+  if (hd->h) {
+    pthread_mutex_lock(&hd->h->mu);
+    if (!hd->creator && hd->h->readers > 0) hd->h->readers -= 1;
+    pthread_mutex_unlock(&hd->h->mu);
+    munmap((void*)hd->h, hd->map_len);
+  }
+  if (unlink_seg) shm_unlink(hd->name);
+  delete hd;
+}
+
+}  // extern "C"

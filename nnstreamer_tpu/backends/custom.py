@@ -27,7 +27,9 @@ from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps
 from nnstreamer_tpu.tensors.spec import TensorsSpec
 
 _custom_easy_lock = threading.Lock()
-_custom_easy_table: Dict[str, Tuple[Callable, Optional[TensorsSpec], Optional[TensorsSpec], bool]] = {}
+_custom_easy_table: Dict[
+    str, Tuple[Callable, Optional[TensorsSpec], Optional[TensorsSpec], bool]
+] = {}
 
 
 def register_custom_easy(

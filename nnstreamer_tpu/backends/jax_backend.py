@@ -55,6 +55,7 @@ def _init_persistent_cache() -> None:
     cache_dir = conf().get("jax", "persistent_cache")
     if not cache_dir:
         return
+    cache_dir = os.path.expanduser(cache_dir)
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -66,3 +66,44 @@ def native_lib_path() -> Optional[str]:
             return None
         _cached = SO_PATH
         return _cached
+
+
+# -- generic builder for other in-tree native components -------------------
+
+_generic_lock = threading.Lock()
+_generic_cache: dict = {}  # source basename -> path | None
+
+
+def build_native(source_name: str, extra_flags=()) -> Optional[str]:
+    """Compile native/<source_name> into build/lib<stem>.so (mtime-cached),
+    honoring NNS_EDGE_SANITIZE like the edge transport. Returns None when
+    the toolchain or source is unavailable (callers degrade gracefully)."""
+    src = os.path.join(_REPO_ROOT, "native", source_name)
+    stem = os.path.splitext(source_name)[0]
+    so = os.path.join(BUILD_DIR, f"lib{stem}{_suffix}.so")
+    with _generic_lock:
+        if source_name in _generic_cache:
+            return _generic_cache[source_name]
+        result: Optional[str] = None
+        if os.path.isfile(src):
+            try:
+                if not (
+                    os.path.isfile(so)
+                    and os.path.getmtime(so) >= os.path.getmtime(src)
+                ):
+                    os.makedirs(BUILD_DIR, exist_ok=True)
+                    cmd = [
+                        "g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                        "-pthread", *extra_flags, src, "-o", so, "-lrt",
+                    ]
+                    if SANITIZE:
+                        cmd[1:1] = [f"-fsanitize={SANITIZE}", "-g"]
+                    subprocess.run(
+                        cmd, check=True, capture_output=True, timeout=120
+                    )
+                    _log.info("built native lib: %s", so)
+                result = so
+            except (subprocess.SubprocessError, OSError) as exc:
+                _log.warning("native build of %s failed: %s", source_name, exc)
+        _generic_cache[source_name] = result
+        return result

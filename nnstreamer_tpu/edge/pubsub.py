@@ -55,7 +55,7 @@ class EdgeSink(Sink):
         self.bound_port: Optional[int] = None
         self._transport = None
         self._mqtt = None
-        if self.connect_type not in ("TCP", "MQTT"):
+        if self.connect_type not in ("TCP", "MQTT", "SHM"):
             raise ValueError(
                 f"{self.name}: connect-type={self.connect_type} not built in "
                 "(reference HYBRID/AITT are broker-vendor specific)"
@@ -73,7 +73,12 @@ class EdgeSink(Sink):
                     f"{self.host}:{self.port}: {exc}"
                 ) from exc
             return
-        self._transport = make_transport()
+        if self.connect_type == "SHM":
+            from nnstreamer_tpu.edge.shm import ShmTransport
+
+            self._transport = ShmTransport()
+        else:
+            self._transport = make_transport()
         self.bound_port = self._transport.listen(self.host, self.port)
 
     def stop(self) -> None:
@@ -138,7 +143,9 @@ class EdgeSrc(Source):
     """Subscribe to an edgesink and emit its frames.
 
     Props: dest-host (default 127.0.0.1), dest-port (default 3000),
-    connect-type=TCP.
+    connect-type=TCP (sockets), MQTT (broker pub/sub via ``topic``), or
+    SHM (same-host native shared-memory ring, native/nns_shm.cpp —
+    zero-socket fast path; single consumer).
     """
 
     FACTORY_NAME = "edgesrc"
@@ -153,7 +160,7 @@ class EdgeSrc(Source):
         self._mqtt = None
 
     def output_spec(self) -> Spec:
-        if self.connect_type not in ("TCP", "MQTT"):
+        if self.connect_type not in ("TCP", "MQTT", "SHM"):
             raise NegotiationError(
                 f"{self.name}: connect-type={self.connect_type} not built in"
             )
@@ -172,7 +179,12 @@ class EdgeSrc(Source):
                     f"{self.host}:{self.port}: {exc}"
                 ) from exc
             return
-        self._transport = make_transport()
+        if self.connect_type == "SHM":
+            from nnstreamer_tpu.edge.shm import ShmTransport
+
+            self._transport = ShmTransport()
+        else:
+            self._transport = make_transport()
         try:
             self._transport.connect(self.host, self.port)
         except (TransportError, OSError) as exc:

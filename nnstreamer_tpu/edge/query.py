@@ -107,9 +107,12 @@ class TensorQueryClient(HostElement):
     """Offload frames to a remote pipeline and emit the replies.
 
     Props: dest-host (default 127.0.0.1), dest-port, timeout (seconds),
-    connect-type=TCP. Requests are strictly synchronous request/reply per
-    frame (the reference's max-request pipelining knob does not apply).
-    """
+    connect-type=TCP|MQTT|HYBRID (MQTT: dest addresses the broker,
+    payloads ride <topic>/req|rep topics; HYBRID: MQTT whois discovery +
+    raw TCP bulk — reference tensor_query_common.c:35-42), topic
+    (default nns-query). Requests are strictly synchronous request/reply
+    per frame (the reference's max-request pipelining knob does not
+    apply)."""
 
     FACTORY_NAME = "tensor_query_client"
 
@@ -178,7 +181,9 @@ class TensorQueryServerSrc(Source):
     """Emit incoming query requests, tagged with client_id meta.
 
     Props: host (default 127.0.0.1), port (0 = ephemeral; read back via
-    ``bound_port``), id (pairing key, default "0"), connect-type=TCP.
+    ``bound_port``), id (pairing key, default "0"),
+    connect-type=TCP|MQTT|HYBRID, topic (MQTT/HYBRID), data-host/
+    data-port (HYBRID TCP data plane, default ephemeral loopback).
     """
 
     FACTORY_NAME = "tensor_query_serversrc"

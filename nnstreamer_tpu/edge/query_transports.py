@@ -198,6 +198,11 @@ class HybridClientTransport:
         finally:
             disc.close()
         h, _, p = addr.rpartition(":")
+        if not h or not p.isdigit():
+            raise TransportError(
+                f"malformed discovery announcement {addr!r} on "
+                f"{self.topic}/host (expected host:port)"
+            )
         self._tcp = make_transport()
         self._tcp.connect(h, int(p))
 

@@ -16,18 +16,71 @@ model, input/inputtype (spec override), output/outputtype, custom,
 accelerator, input-combination (select a subset/reorder of input tensors
 for the model), output-combination (compose output frame from model outputs
 ``o#`` and passthrough inputs ``i#``), invoke-dynamic, is-updatable (model
-reload via reload_model()). Read-only: latency, throughput.
+reload via reload_model()), shared-tensor-filter-key (filters with the
+same key share one opened backend — one weight copy, reload swaps for
+all). Read-only: latency, throughput.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.backends.base import Backend, FilterProps
 from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+# shared-model table (reference shared_tensor_filter_key,
+# tensor_filter_common.c shared-model support): filters with the same key
+# share ONE opened backend instance — one copy of the weights on device,
+# and a reload through any sharer swaps the model for all of them.
+_shared_lock = threading.Lock()
+_shared_backends: Dict[str, List] = {}  # key -> [backend, refcount, signature]
+
+
+def _props_signature(p: FilterProps) -> tuple:
+    """Everything that shapes an opened backend: sharers must agree on the
+    full configuration, not just the model path."""
+    return (
+        p.framework, p.model, p.custom, p.accelerator, p.invoke_dynamic,
+        str(p.input_spec), str(p.output_spec),
+    )
+
+
+def _shared_acquire(key: str, props: FilterProps, opener):
+    sig = _props_signature(props)
+    with _shared_lock:
+        entry = _shared_backends.get(key)
+        if entry is not None:
+            if entry[2] != sig:
+                raise NegotiationError(
+                    f"shared-tensor-filter-key={key!r} already bound to "
+                    f"{entry[2]}, cannot rebind to {sig}"
+                )
+            entry[1] += 1
+            return entry[0]
+        backend = opener()
+        # stateful host backends (tflite set_tensor/invoke/get_tensor,
+        # custom scripts) are not reentrant; sharers run on separate
+        # executor threads, so serialize their invokes
+        backend.shared_invoke_lock = threading.Lock()
+        _shared_backends[key] = [backend, 1, sig]
+        return backend
+
+
+def _shared_release(key: str, backend) -> bool:
+    """Drop one ref; True if the caller should actually close the backend."""
+    with _shared_lock:
+        entry = _shared_backends.get(key)
+        if entry is None or entry[0] is not backend:
+            return True  # not (or no longer) shared: caller owns it
+        entry[1] -= 1
+        if entry[1] <= 0:
+            del _shared_backends[key]
+            return True
+        return False
 
 
 def _parse_combination(s: str, prefix_ok=("i", "o")) -> Optional[List[Tuple[str, int]]]:
@@ -89,6 +142,9 @@ class TensorFilter(TensorOp):
             accelerator=str(self.get_property("accelerator", "")),
             invoke_dynamic=bool(self.get_property("invoke-dynamic", False)),
         )
+        self.shared_key = str(
+            self.get_property("shared-tensor-filter-key", "")
+        )
         self.in_combination = _parse_combination(
             str(self.get_property("input-combination", ""))
         )
@@ -99,17 +155,28 @@ class TensorFilter(TensorOp):
         self._traceable: Optional[Callable] = None
 
     # -- lifecycle ---------------------------------------------------------
+    def _open_backend(self) -> Backend:
+        cls = registry.get(registry.KIND_FILTER, self.fprops.framework)
+        b: Backend = cls()
+        b.open(self.fprops)
+        return b
+
     def _ensure_open(self) -> Backend:
         if self.backend is None:
-            cls = registry.get(registry.KIND_FILTER, self.fprops.framework)
-            b: Backend = cls()
-            b.open(self.fprops)
-            self.backend = b
+            if self.shared_key:
+                self.backend = _shared_acquire(
+                    self.shared_key, self.fprops, self._open_backend
+                )
+            else:
+                self.backend = self._open_backend()
         return self.backend
 
     def stop(self) -> None:
         if self.backend is not None:
-            self.backend.close()
+            if not self.shared_key or _shared_release(
+                self.shared_key, self.backend
+            ):
+                self.backend.close()
             self.backend = None
             self._traceable = None
 
@@ -225,6 +292,10 @@ class TensorFilter(TensorOp):
     def host_process(self, frame: Frame) -> Frame:
         b = self._ensure_open()
         fn = self._apply_combinations(b.invoke_timed)
+        lock = getattr(b, "shared_invoke_lock", None)
+        if lock is not None:
+            with lock:
+                return frame.with_tensors(fn(frame.tensors))
         return frame.with_tensors(fn(frame.tensors))
 
     # -- stats (reference read-only latency/throughput props) -------------

@@ -144,7 +144,8 @@ class AppSrc(Source):
 
     FACTORY_NAME = "appsrc"
 
-    def __init__(self, name=None, iterable: Optional[Iterable] = None, spec: Optional[Spec] = None, **props):
+    def __init__(self, name=None, iterable: Optional[Iterable] = None,
+                 spec: Optional[Spec] = None, **props):
         super().__init__(name, **props)
         self._iter: Optional[Iterator] = iter(iterable) if iterable is not None else None
         self._spec = spec

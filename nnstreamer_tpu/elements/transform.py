@@ -92,7 +92,9 @@ class TensorTransform(TensorOp):
             return t
         if m == "stand":
             _, _, out_type = self._parse_stand()
-            return t.with_dtype(out_type) if out_type else t.with_dtype(DType.FLOAT32) if not t.dtype.is_float else t
+            if out_type:
+                return t.with_dtype(out_type)
+            return t if t.dtype.is_float else t.with_dtype(DType.FLOAT32)
         raise AssertionError(m)
 
     # -- option parsing ----------------------------------------------------

@@ -30,9 +30,12 @@ _ASPP_CH = 256
 def init_params(key, num_classes: int = NUM_CLASSES) -> Dict:
     keys = iter(jax.random.split(key, 8))
     p: Dict = {"backbone": mobilenet_v2.init_params(next(keys))}
-    p["aspp_conv"] = {"w": nn.init_conv(next(keys), 1, 1, 320, _ASPP_CH), "bn": nn.init_bn(_ASPP_CH)}
-    p["aspp_pool"] = {"w": nn.init_conv(next(keys), 1, 1, 320, _ASPP_CH), "bn": nn.init_bn(_ASPP_CH)}
-    p["project"] = {"w": nn.init_conv(next(keys), 1, 1, 2 * _ASPP_CH, _ASPP_CH), "bn": nn.init_bn(_ASPP_CH)}
+    p["aspp_conv"] = {"w": nn.init_conv(next(keys), 1, 1, 320, _ASPP_CH),
+                      "bn": nn.init_bn(_ASPP_CH)}
+    p["aspp_pool"] = {"w": nn.init_conv(next(keys), 1, 1, 320, _ASPP_CH),
+                      "bn": nn.init_bn(_ASPP_CH)}
+    p["project"] = {"w": nn.init_conv(next(keys), 1, 1, 2 * _ASPP_CH, _ASPP_CH),
+                    "bn": nn.init_bn(_ASPP_CH)}
     p["classifier"] = {
         "w": nn.init_conv(next(keys), 1, 1, _ASPP_CH, num_classes),
         "b": jnp.zeros((num_classes,), jnp.float32),
@@ -84,14 +87,18 @@ def apply(params: Dict, x, train: bool = False, compute_dtype=jnp.float32):
     if compute_dtype != jnp.float32:
         params = nn.cast_params(params, compute_dtype)
     feat = _backbone_os16(params["backbone"], x, train)  # [N, s/16, s/16, 320]
-    a = nn.relu6(nn.batch_norm(nn.conv2d(feat, params["aspp_conv"]["w"]), params["aspp_conv"]["bn"], train))
+    a = nn.relu6(nn.batch_norm(
+        nn.conv2d(feat, params["aspp_conv"]["w"]), params["aspp_conv"]["bn"], train
+    ))
     pooled = jnp.mean(feat, axis=(1, 2), keepdims=True)
     pooled = nn.relu6(
         nn.batch_norm(nn.conv2d(pooled, params["aspp_pool"]["w"]), params["aspp_pool"]["bn"], train)
     )
     pooled = jnp.broadcast_to(pooled, a.shape)
     y = jnp.concatenate([a, pooled], axis=-1)
-    y = nn.relu6(nn.batch_norm(nn.conv2d(y, params["project"]["w"]), params["project"]["bn"], train))
+    y = nn.relu6(nn.batch_norm(
+        nn.conv2d(y, params["project"]["w"]), params["project"]["bn"], train
+    ))
     logits = nn.conv2d(y, params["classifier"]["w"]) + params["classifier"]["b"]
     logits = jax.image.resize(
         logits.astype(jnp.float32), (n, size, size, logits.shape[-1]), "bilinear"

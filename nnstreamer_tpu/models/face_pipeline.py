@@ -112,6 +112,44 @@ def detections_to_regions(det, frame_w: int, frame_h: int, threshold: float = 0.
     return jnp.where(keep[:, None], out, 0.0).astype(jnp.int32)
 
 
+def apply_composite(
+    det_params: Dict,
+    lmk_params: Dict,
+    image,
+    max_faces: int = MAX_FACES,
+    threshold: float = 0.5,
+    compute_dtype=jnp.float32,
+):
+    """The whole detect→crop→landmark cascade as ONE XLA program.
+
+    The element-level composite (tensor_crop + second filter) is faithful
+    to the reference's cascade shape but pays a host hop per frame: crop
+    output sizes are data-dependent, so the regions must materialize on
+    host (gsttensor_crop.c emits variable-size flexible buffers). Here the
+    crop is ops/image.crop_and_resize to the canonical LANDMARK_SIZE —
+    fixed shapes end to end, the landmark net runs all max_faces crops as
+    one batch on the MXU, and nothing leaves HBM.
+
+    uint8 [1, H, W, 3] → (landmarks [max_faces, 136], det [max_faces, 7]).
+    Below-threshold rows keep top-k order; mask with ``det[:, 2]``.
+    """
+    from nnstreamer_tpu.ops.image import crop_and_resize
+
+    det = apply_detect(det_params, image, max_faces, compute_dtype)
+    h, w = image.shape[1], image.shape[2]
+    scale = jnp.asarray([w, h, w, h], jnp.float32)
+    boxes = det[:, 3:7] * scale  # normalized x1,y1,x2,y2 → pixels
+    img = image[0]
+    if img.dtype == jnp.uint8:
+        img = mobilenet_v2.normalize_uint8(img, compute_dtype)
+    else:
+        img = img.astype(compute_dtype)
+    crops = crop_and_resize(img, boxes, LANDMARK_SIZE, LANDMARK_SIZE)
+    lmk = apply_landmark(lmk_params, crops, compute_dtype)
+    keep = det[:, 2] >= threshold
+    return jnp.where(keep[:, None], lmk, 0.0), det
+
+
 def init_landmark_params(key, num_landmarks: int = NUM_LANDMARKS) -> Dict:
     keys = iter(jax.random.split(key, 12))
     p: Dict = {"stem": {"w": nn.init_conv(next(keys), 3, 3, 3, 16), "bn": nn.init_bn(16)}}

@@ -109,7 +109,9 @@ def _block(x, blk: Dict, stride: int, train: bool):
 def features(params: Dict, x, train: bool = False):
     """Backbone: normalized f32/bf16 NHWC → final 7x7x1280 feature map.
     Exposed separately for SSD/DeepLab heads."""
-    y = nn.relu6(nn.batch_norm(nn.conv2d(x, params["stem"]["w"], stride=2), params["stem"]["bn"], train))
+    y = nn.relu6(nn.batch_norm(
+        nn.conv2d(x, params["stem"]["w"], stride=2), params["stem"]["bn"], train
+    ))
     for blk, stride in zip(params["blocks"], _block_strides()):
         y = _block(y, blk, stride, train)
     y = nn.relu6(nn.batch_norm(nn.conv2d(y, params["head"]["w"]), params["head"]["bn"], train))

@@ -243,6 +243,39 @@ def _face_detect(**options) -> ZooModel:
     return ZooModel("face_detect", fn, spec, params, apply_fn)
 
 
+@model_factory("face_composite")
+def _face_composite(**options) -> ZooModel:
+    """Fused detect→crop+resize→landmark cascade as ONE XLA program
+    (fp.apply_composite): fixed shapes, all max_faces crops batched on
+    the MXU, zero host hops — the TPU-first form of the element-level
+    tensor_crop composite. fn: uint8 [1,S,S,3] → (landmarks [max,136],
+    detections [max,7])."""
+    from nnstreamer_tpu.models import face_pipeline as fp
+
+    seed = int(options.get("seed", 0))
+    max_faces = int(options.get("max_faces", fp.MAX_FACES))
+    threshold = float(options.get("threshold", 0.5))
+    size = int(options.get("size", fp.DETECT_SIZE))
+    dtype = _compute_dtype(options)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    params = {
+        "detect": _load_params_overlay(fp.init_detect_params(k1), options),
+        "landmark": fp.init_landmark_params(k2),
+    }
+
+    def apply_fn(p, image):
+        return fp.apply_composite(
+            p["detect"], p["landmark"], image,
+            max_faces=max_faces, threshold=threshold, compute_dtype=dtype,
+        )
+
+    def fn(image):
+        return apply_fn(params, image)
+
+    spec = _image_spec(1, size, options.get("input_dtype", "uint8"))
+    return ZooModel("face_composite", fn, spec, params, apply_fn)
+
+
 @model_factory("transformer_lm")
 def _transformer_lm(**options) -> ZooModel:
     """Decoder-only transformer LM (models/transformer.py) — the

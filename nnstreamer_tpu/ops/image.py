@@ -1,0 +1,47 @@
+"""Jittable image ops: fixed-shape crop+resize for on-device cascades.
+
+The reference composes detector→crop→second-model cascades through
+tensor_crop (gsttensor_crop.c), whose outputs are *variable-size* host
+buffers — every frame crosses the host and each crop size retriggers
+downstream negotiation. The TPU-first alternative: crop and resample to a
+canonical size inside the same XLA program (fixed shapes, MXU-friendly),
+so a whole detect→crop→landmark cascade is ONE program with zero host
+hops (see models/face_pipeline.apply_composite).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def crop_and_resize(image, boxes, out_h: int, out_w: int):
+    """Bilinear crop+resize (TF crop_and_resize semantics, pixel boxes).
+
+    image: [H, W, C] float; boxes: [N, 4] (x1, y1, x2, y2) in pixel
+    coordinates (any float dtype; degenerate boxes clamp to edge pixels)
+    → [N, out_h, out_w, C], image dtype.
+    """
+    h, w, _ = image.shape
+    boxes = boxes.astype(jnp.float32)
+
+    def one(box):
+        x1, y1, x2, y2 = box
+        # sample at output-pixel centers mapped into the box
+        ys = y1 + (y2 - y1) * (jnp.arange(out_h, dtype=jnp.float32) + 0.5) / out_h - 0.5
+        xs = x1 + (x2 - x1) * (jnp.arange(out_w, dtype=jnp.float32) + 0.5) / out_w - 0.5
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        y0i = jnp.clip(y0, 0, h - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x0i = jnp.clip(x0, 0, w - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        top = image[y0i][:, x0i] * (1 - wx)[None, :, None] + \
+            image[y0i][:, x1i] * wx[None, :, None]
+        bot = image[y1i][:, x0i] * (1 - wx)[None, :, None] + \
+            image[y1i][:, x1i] * wx[None, :, None]
+        return top * (1 - wy)[:, None, None] + bot * wy[:, None, None]
+
+    return jax.vmap(one)(boxes).astype(image.dtype)

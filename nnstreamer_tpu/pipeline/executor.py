@@ -70,9 +70,15 @@ class Node:
                 raise _Stop()
             try:
                 q.put(item, timeout=0.1)
+                dst.notify()
                 return
             except queue_mod.Full:
                 continue
+
+    def notify(self) -> None:
+        """Data arrived on one of this node's input queues. Nodes that
+        block on a single queue don't need it (queue.get wakes them);
+        multi-pad nodes override to wake their scheduler."""
 
     def broadcast_eos(self) -> None:
         for pad in self.outs:
@@ -220,35 +226,46 @@ class RoutingNode(Node):
     def __init__(self, ex, elem: Routing) -> None:
         super().__init__(ex, elem.name)
         self.elem = elem
+        # producers notify() on push so the pad scan sleeps until there is
+        # actually data, instead of busy-polling every pad on a 20 ms beat
+        # (O(pads) idle wakeups/sec on wide mux fan-ins)
+        self._wake = threading.Event()
+
+    def notify(self) -> None:
+        self._wake.set()
 
     def run(self) -> None:
         n = len(self.in_queues)
         eos_seen = [False] * n
-        # round-robin service of pads; Routing elements that need timestamp
+        # drain-all service of pads; Routing elements that need timestamp
         # sync buffer internally and emit when policy satisfied
         while not all(eos_seen):
+            self._wake.clear()
             progressed = False
             for pad in range(n):
                 if eos_seen[pad]:
                     continue
-                try:
-                    item = self.in_queues[pad].get(timeout=0.02)
-                except queue_mod.Empty:
-                    if self.ex.stop_event.is_set():
-                        raise _Stop()
-                    continue
-                progressed = True
-                if item is EOS_FRAME:
-                    eos_seen[pad] = True
-                    for out_pad, f in self.elem.eos(pad):
+                while True:  # drain the pad without per-item timeouts
+                    try:
+                        item = self.in_queues[pad].get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    progressed = True
+                    if item is EOS_FRAME:
+                        eos_seen[pad] = True
+                        for out_pad, f in self.elem.eos(pad):
+                            self.push_out(out_pad, f)
+                        break
+                    t0 = time.perf_counter()
+                    for out_pad, f in self.elem.receive(pad, item):
                         self.push_out(out_pad, f)
-                    continue
-                t0 = time.perf_counter()
-                for out_pad, f in self.elem.receive(pad, item):
-                    self.push_out(out_pad, f)
-                self.stat(t0)
-            if not progressed and self.ex.stop_event.is_set():
+                    self.stat(t0)
+            if self.ex.stop_event.is_set():
                 raise _Stop()
+            if not progressed and not all(eos_seen):
+                # sleep until a producer pushes (bounded so stop_event is
+                # still honored even if a notify is lost)
+                self._wake.wait(timeout=0.1)
         self.broadcast_eos()
 
 

@@ -1,0 +1,115 @@
+"""Two-process CLI loopback: server and client pipelines as separate
+processes on localhost, golden-compared — the reference's
+tests/nnstreamer_edge/query/runTest.sh strategy (gstTestBackground +
+sleep-sync + compare)."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags,
+            "PYTHONPATH": REPO}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"server port {port} never opened")
+
+
+def test_query_offload_two_processes(tmp_path):
+    """client: testsrc → query_client → filesink; server: serversrc →
+    scaler ×2 → serversink. Output must equal the local scaler result."""
+    port = _free_port()
+    server = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu.cli",
+         f"tensor_query_serversrc port={port} id=cli1 ! "
+         'tensor_filter framework=scaler custom="factor:2.0" '
+         "input=3:4:4:1 inputtype=uint8 ! "
+         "tensor_query_serversink id=cli1",
+         "--timeout", "60", "-q"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        _wait_port(port)
+        out = tmp_path / "reply.raw"
+        client = subprocess.run(
+            [sys.executable, "-m", "nnstreamer_tpu.cli",
+             "videotestsrc pattern=counter num-frames=3 width=4 height=4 ! "
+             f"tensor_converter ! tensor_query_client dest-port={port} "
+             f"timeout=30 ! filesink location={out}",
+             "-q"],
+            env=_env(), capture_output=True, text=True, timeout=120,
+        )
+        assert client.returncode == 0, client.stderr[-600:]
+        got = np.frombuffer(out.read_bytes(), np.uint8).reshape(3, -1)
+        # counter pattern: every pixel of frame i is i; scaler doubles
+        # (uint8 math) → frame i is 2*i everywhere
+        for i in range(3):
+            assert (got[i] == np.uint8(2 * i)).all()
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+def test_edge_pubsub_two_processes(tmp_path):
+    """edgesink publisher process → edgesrc subscriber process (TCP).
+    Publisher starts first with wait-connection so no frame is lost."""
+    port = _free_port()
+    out = tmp_path / "sub.raw"
+    pub = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu.cli",
+         "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+         f"tensor_converter ! edgesink port={port} "
+         "wait-connection=true connection-timeout=60",
+         "-q"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        _wait_port(port)
+        sub = subprocess.run(
+            [sys.executable, "-m", "nnstreamer_tpu.cli",
+             f"edgesrc dest-port={port} ! filesink location={out}",
+             "--timeout", "60", "-q"],
+            env=_env(), capture_output=True, text=True, timeout=120,
+        )
+        assert sub.returncode == 0, sub.stderr[-600:]
+        assert pub.wait(timeout=30) == 0
+        data = np.frombuffer(out.read_bytes(), np.uint8)
+        assert data.size == 2 * 4 * 4 * 3
+        assert (data[:48] == 0).all() and (data[48:] == 1).all()
+    finally:
+        if pub.poll() is None:
+            pub.terminate()
+            try:
+                pub.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pub.kill()

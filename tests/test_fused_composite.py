@@ -1,0 +1,91 @@
+"""Fused on-device composite (detect→crop+resize→landmark in one XLA
+program) — ops/image.crop_and_resize + models/face_pipeline.apply_composite
++ zoo:face_composite. The TPU-first redesign of the tensor_crop cascade."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models import zoo
+from nnstreamer_tpu.ops.image import crop_and_resize
+from nnstreamer_tpu.single import SingleShot
+
+
+def test_crop_and_resize_identity_box():
+    """Cropping the full image at native size is the identity."""
+    img = jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 6, 3)), jnp.float32
+    )
+    out = crop_and_resize(img, jnp.asarray([[0.0, 0.0, 6.0, 8.0]]), 8, 6)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(img), atol=1e-5)
+
+
+def test_crop_and_resize_matches_manual_bilinear():
+    """2x upsample of a 2x2 gradient against hand-computed samples."""
+    img = jnp.asarray([[[0.0], [1.0]], [[2.0], [3.0]]], jnp.float32)
+    out = np.asarray(crop_and_resize(img, jnp.asarray([[0.0, 0.0, 2.0, 2.0]]), 4, 4))[:, :, :, 0]
+    # sample centers at 0.25-spaced grid minus 0.5 → bilinear of corners
+    assert out.shape == (1, 4, 4)
+    # corners clamp to the corner pixels
+    assert out[0, 0, 0] == 0.0 and out[0, 3, 3] == 3.0
+    # exact center of the image = mean of all four
+    center = crop_and_resize(img, jnp.asarray([[0.5, 0.5, 1.5, 1.5]]), 1, 1)
+    np.testing.assert_allclose(float(center[0, 0, 0, 0]), 1.5, atol=1e-5)
+
+
+def test_crop_and_resize_subpixel_region():
+    img = jnp.asarray(
+        np.random.default_rng(1).standard_normal((16, 16, 2)), jnp.float32
+    )
+    out = crop_and_resize(img, jnp.asarray([[2.5, 3.5, 9.5, 12.5]]), 7, 5)
+    assert out.shape == (1, 7, 5, 2)
+    assert np.all(np.isfinite(np.asarray(out)))
+    # values stay within the sampled region's range (bilinear is convex)
+    region = np.asarray(img[3:14, 2:11])
+    assert np.asarray(out).min() >= region.min() - 1e-5
+    assert np.asarray(out).max() <= region.max() + 1e-5
+
+
+def test_fused_composite_one_program():
+    m = zoo.get("face_composite", threshold="0.0")
+    img = jnp.asarray(
+        np.random.default_rng(2).integers(0, 255, (1, 128, 128, 3), np.uint8)
+    )
+    lmk, det = jax.jit(m.fn)(img)
+    lmk, det = np.asarray(lmk), np.asarray(det)
+    assert lmk.shape == (16, 136) and det.shape == (16, 7)
+    assert np.all(np.isfinite(lmk)) and np.all(np.isfinite(det))
+    assert np.all(lmk >= 0) and np.all(lmk <= 1)
+    assert np.all(det[:-1, 2] >= det[1:, 2])  # top-k order preserved
+
+
+def test_fused_composite_threshold_masks_landmarks():
+    m = zoo.get("face_composite", threshold="1.1")  # nothing passes
+    img = jnp.asarray(
+        np.random.default_rng(3).integers(0, 255, (1, 128, 128, 3), np.uint8)
+    )
+    lmk, det = m.fn(img)
+    assert np.all(np.asarray(lmk) == 0.0)
+
+
+def test_fused_composite_through_filter_surface():
+    """zoo:face_composite behind tensor_filter is traceable (fusable)."""
+    with SingleShot(
+        framework="jax", model="zoo:face_composite", custom="threshold:0.0"
+    ) as s:
+        outs = s.invoke(
+            np.random.default_rng(4).integers(0, 255, (1, 128, 128, 3), np.uint8)
+        )
+        assert len(outs) == 2
+        assert np.asarray(outs[0]).shape == (16, 136)
+        assert s.backend.traceable_fn() is not None
+
+
+def test_fused_composite_deterministic():
+    m = zoo.get("face_composite", threshold="0.0")
+    img = jnp.asarray(
+        np.random.default_rng(5).integers(0, 255, (1, 128, 128, 3), np.uint8)
+    )
+    a = jax.jit(m.fn)(img)
+    b = jax.jit(m.fn)(img)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))

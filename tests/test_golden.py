@@ -146,6 +146,58 @@ PIPELINES = {
         "framerate=20/1 ! tensor_converter ! tensor_rate framerate=10/1 ! "
         "filesink location={out}"
     ),
+    # wire codecs (tensor_decoder flexbuf/protobuf/flatbuf serializations)
+    "decoder_flexbuf": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_decoder mode=flexbuf ! "
+        "filesink location={out}"
+    ),
+    "decoder_protobuf": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_decoder mode=protobuf ! "
+        "filesink location={out}"
+    ),
+    "decoder_flatbuf": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_decoder mode=flatbuf ! "
+        "filesink location={out}"
+    ),
+    "decoder_octet": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_decoder mode=octet_stream ! "
+        "filesink location={out}"
+    ),
+    # overlapping sliding window (frames-flush < frames-out)
+    "aggregator_overlap": (
+        "videotestsrc pattern=counter num-frames=5 width=4 height=4 ! "
+        "tensor_converter ! tensor_aggregator frames-in=1 frames-out=3 "
+        "frames-flush=1 ! filesink location={out}"
+    ),
+    # flexbuf wire roundtrip back to static tensors must be identity
+    "converter_flexbuf_roundtrip": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_decoder mode=flexbuf ! "
+        "tensor_converter mode=flexbuf ! filesink location={out}"
+    ),
+    # audio ingress (audio/x-raw → tensors, S16LE)
+    "converter_audio": (
+        "audiotestsrc samples-per-buffer=32 num-buffers=2 channels=2 ! "
+        "tensor_converter ! filesink location={out}"
+    ),
+    # application/octet-stream ingress with fixed framing
+    "converter_octet": (
+        "filesrc location={fix}/octet20.bin blocksize=5 ! "
+        "tensor_converter input-dim=5 input-type=uint8 ! "
+        "filesink location={out}"
+    ),
+    # fused on-device cascade (zoo:face_composite): detect→crop+resize→
+    # landmark as one XLA program, landmarks + detections to file
+    "composite_fused": (
+        "videotestsrc pattern=gradient num-frames=2 width=128 height=128 ! "
+        "tensor_converter ! tensor_filter framework=jax "
+        'model=zoo:face_composite custom="threshold:0.0" ! '
+        "filesink location={out}"
+    ),
 }
 
 # "expect fail" golden cases (reference gstTest "expect fail" flags): the
@@ -176,10 +228,13 @@ def _env():
     return {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
 
 
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
 def _run(pipeline: str, out_path: str) -> None:
     proc = subprocess.run(
         [sys.executable, "-m", "nnstreamer_tpu.cli",
-         pipeline.format(out=out_path), "-q"],
+         pipeline.format(out=out_path, fix=FIXTURE_DIR), "-q"],
         capture_output=True, text=True, timeout=300, env=_env(),
     )
     assert proc.returncode == 0, f"pipeline failed:\n{proc.stderr}"

@@ -85,3 +85,130 @@ def test_pipeline_end_to_end(tmp_path):
     Pipeline().chain(src, sink).run(timeout=30)
     assert sink.rendered == 3
     assert sink.frames[0].tensors[0].shape == (1, 2)
+
+
+# ------------------------------------------------- buffered chardev mode
+
+def _fake_buffered_device(tmp_path, records, n=0, name="accel_3d"):
+    """Fake sysfs tree with scan_elements + a regular-file 'chardev'.
+
+    Channels: accel_x le:s12/16>>0 (index 0), accel_y be:u10/16>>2
+    (index 1), temp le:s8/8>>0 (index 2) — mixed widths exercise the
+    alignment/padding layout. ``records`` is a list of (x_raw, y_raw,
+    t_raw) integer triples packed as the kernel would.
+    """
+    d = tmp_path / "sys" / f"iio:device{n}"
+    scan = d / "scan_elements"
+    scan.mkdir(parents=True)
+    (d / "name").write_text(name + "\n")
+    (d / "buffer").mkdir()
+    (d / "buffer" / "length").write_text("16\n")
+    (d / "buffer" / "enable").write_text("0\n")
+    for c, idx, t in (
+        ("accel_x", 0, "le:s12/16>>0"),
+        ("accel_y", 1, "be:u10/16>>2"),
+        ("temp", 2, "le:s8/8>>0"),
+    ):
+        (scan / f"in_{c}_en").write_text("0\n")
+        (scan / f"in_{c}_index").write_text(f"{idx}\n")
+        (scan / f"in_{c}_type").write_text(t + "\n")
+        (d / f"in_{c}_scale").write_text("1.0\n")
+        (d / f"in_{c}_offset").write_text("0\n")
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    blob = b""
+    for x, y, t in records:
+        # layout: u16@0 (x), u16@2 (y), u8@4 (temp), record padded to 6
+        blob += int(x).to_bytes(2, "little")
+        blob += int(y).to_bytes(2, "big")
+        blob += int(t).to_bytes(1, "little")
+        blob += b"\x00"  # pad to 2-byte alignment
+    (dev / f"iio:device{n}").write_bytes(blob)
+    return d, dev
+
+
+def _capture_buffered(tmp_path, records, **extra):
+    _fake_buffered_device(tmp_path, records)
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path / "sys"), "dev-dir": str(tmp_path / "dev"),
+           "mode": "buffer", "frequency": 100,
+           "num-frames": len(records), **extra}
+    )
+    spec = src.output_spec()
+    frames = []
+    while True:
+        f = src.generate()
+        if f is EOS_FRAME:
+            break
+        if f is not None:
+            frames.append(f)
+    src.stop()
+    return spec, frames
+
+
+def test_buffered_capture_decodes_packed_records(tmp_path):
+    # x: s12 → 0x801 = -2047; y: u10 stored <<2 → raw word 40<<2; t: s8 -5
+    records = [(0x801 & 0xFFFF, 40 << 2, (-5) & 0xFF), (100, 3 << 2, 7)]
+    spec, frames = _capture_buffered(tmp_path, records)
+    assert spec[0].shape == (1, 3)
+    assert len(frames) == 2
+    np.testing.assert_allclose(
+        np.asarray(frames[0].tensors[0]), [[-2047.0, 40.0, -5.0]]
+    )
+    np.testing.assert_allclose(
+        np.asarray(frames[1].tensors[0]), [[100.0, 3.0, 7.0]]
+    )
+    # pts is integer nanoseconds at the configured frequency
+    assert frames[0].pts == 0 and frames[1].pts == 10_000_000
+
+
+def test_buffered_channel_enable_written(tmp_path):
+    d, _ = _fake_buffered_device(tmp_path, [(1, 4, 1)])
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path / "sys"), "dev-dir": str(tmp_path / "dev"),
+           "mode": "buffer", "channels": "accel_x,temp", "num-frames": 0}
+    )
+    src.output_spec()
+    scan = d / "scan_elements"
+    assert (scan / "in_accel_x_en").read_text() == "1"
+    assert (scan / "in_accel_y_en").read_text() == "0"
+    assert (scan / "in_temp_en").read_text() == "1"
+    assert (d / "buffer" / "enable").read_text() == "1"
+
+
+def test_buffered_subset_repacks_layout(tmp_path):
+    """Disabling accel_y changes the record layout: x u16@0, temp u8@2,
+    record size 2-aligned = 4... the element must compute the packed
+    layout of ONLY the enabled channels."""
+    d = tmp_path / "sys" / "iio:device0"
+    scan = d / "scan_elements"
+    scan.mkdir(parents=True)
+    (d / "name").write_text("dev\n")
+    (d / "buffer").mkdir()
+    for c, idx, t in (("a", 0, "le:u16/16>>0"), ("b", 1, "le:u8/8>>0")):
+        (scan / f"in_{c}_en").write_text("0\n")
+        (scan / f"in_{c}_index").write_text(f"{idx}\n")
+        (scan / f"in_{c}_type").write_text(t + "\n")
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    blob = (500).to_bytes(2, "little") + (9).to_bytes(1, "little") + b"\x00"
+    (dev / "iio:device0").write_bytes(blob)
+    src = TensorSrcIIO(
+        **{"base-dir": str(tmp_path / "sys"), "dev-dir": str(tmp_path / "dev"),
+           "mode": "buffer", "frequency": 100, "num-frames": 1}
+    )
+    src.output_spec()
+    f = None
+    while f is None or f is EOS_FRAME:
+        f = src.generate()
+    np.testing.assert_allclose(np.asarray(f.tensors[0]), [[500.0, 9.0]])
+    src.stop()
+    # teardown disabled the buffer
+    assert (d / "buffer" / "enable").read_text() == "0"
+
+
+def test_bad_type_string_rejected(tmp_path):
+    from nnstreamer_tpu.elements.iio import ChannelFormat
+
+    with pytest.raises(ElementError, match="bad IIO channel type"):
+        ChannelFormat("xx:s12/16>>0")

@@ -244,7 +244,8 @@ class TestIf:
         register_if_condition("even_seq", lambda f: float(np.asarray(f.tensors[0])[0]) % 2 == 0)
         try:
             frames = [np.full((1,), v, np.float32) for v in (0, 1, 2, 3)]
-            src = AppSrc(iterable=[(f,) for f in frames], spec=TensorsSpec.from_strings("1", "float32"))
+            src = AppSrc(iterable=[(f,) for f in frames],
+                         spec=TensorsSpec.from_strings("1", "float32"))
             tif = TensorIf(
                 **{"compared-value": "CUSTOM", "compared-value-option": "even_seq"}
             )

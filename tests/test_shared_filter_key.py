@@ -1,0 +1,94 @@
+"""shared-tensor-filter-key: filters sharing a key share ONE opened
+backend (reference shared-model table, tensor_filter_common.c
+shared_tensor_filter_key): one weight copy, reload swaps for all."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import NegotiationError
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+def _spec():
+    return TensorsSpec.from_strings("4", "float32")
+
+
+def test_same_key_shares_backend_instance():
+    a = TensorFilter(framework="scaler", custom="factor:3",
+                     **{"shared-tensor-filter-key": "k1"})
+    b = TensorFilter(framework="scaler", custom="factor:3",
+                     **{"shared-tensor-filter-key": "k1"})
+    try:
+        a.negotiate([_spec()])
+        b.negotiate([_spec()])
+        assert a.backend is b.backend
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_refcounted_close():
+    a = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "k2"})
+    b = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "k2"})
+    a.negotiate([_spec()])
+    b.negotiate([_spec()])
+    shared = a.backend
+    a.stop()
+    # still open for b: a third filter re-acquires the SAME instance
+    c = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "k2"})
+    c.negotiate([_spec()])
+    assert c.backend is shared
+    b.stop()
+    c.stop()
+    # all refs dropped: a new filter gets a FRESH instance
+    d = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "k2"})
+    d.negotiate([_spec()])
+    assert d.backend is not shared
+    d.stop()
+
+
+def test_key_conflict_rejected():
+    a = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "k3"})
+    a.negotiate([_spec()])
+    try:
+        b = TensorFilter(framework="passthrough",
+                         **{"shared-tensor-filter-key": "k3"})
+        with pytest.raises(NegotiationError, match="already bound"):
+            b.negotiate([_spec()])
+    finally:
+        a.stop()
+
+
+def test_reload_visible_to_all_sharers(tmp_path):
+    """is-updatable reload through one sharer swaps the model for all
+    (the reference's shared-model reload semantics)."""
+    m1 = tmp_path / "m1.py"
+    m2 = tmp_path / "m2.py"
+    for path, k in ((m1, 10.0), (m2, 100.0)):
+        path.write_text(
+            "def get_model(options):\n"
+            f"    return (lambda x: x * {k}), None\n"
+        )
+    a = TensorFilter(framework="jax", model=str(m1),
+                     input="4", inputtype="float32",
+                     **{"shared-tensor-filter-key": "k4"})
+    b = TensorFilter(framework="jax", model=str(m1),
+                     input="4", inputtype="float32",
+                     **{"shared-tensor-filter-key": "k4"})
+    try:
+        from nnstreamer_tpu.tensors.frame import Frame
+
+        a.negotiate([_spec()])
+        b.negotiate([_spec()])
+        x = Frame((np.ones(4, np.float32),))
+        np.testing.assert_allclose(
+            np.asarray(b.host_process(x).tensors[0]), np.full(4, 10.0)
+        )
+        a.reload_model(str(m2))
+        np.testing.assert_allclose(
+            np.asarray(b.host_process(x).tensors[0]), np.full(4, 100.0)
+        )
+    finally:
+        a.stop()
+        b.stop()

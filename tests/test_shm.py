@@ -1,0 +1,178 @@
+"""Native shared-memory ring transport tests (native/nns_shm.cpp via
+edge/shm.py) — the same-host zero-socket fast path of the among-device
+layer. Includes a true cross-process producer (subprocess), wraparound
+coverage, and the edgesink/edgesrc connect-type=SHM loopback."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.edge.shm import ShmTransport, segment_name
+from nnstreamer_tpu.edge.transport import TransportError
+
+pytestmark = pytest.mark.skipif(
+    __import__("nnstreamer_tpu.edge._build", fromlist=["build_native"])
+    .build_native("nns_shm.cpp") is None,
+    reason="native toolchain unavailable",
+)
+
+
+def _pair(port, capacity=64 * 1024):
+    prod = ShmTransport(capacity=capacity)
+    bound = prod.listen("", port)
+    cons = ShmTransport()
+    cons.connect("", bound)
+    return prod, cons
+
+
+def test_roundtrip_and_order(tmp_path):
+    prod, cons = _pair(41001)
+    try:
+        for i in range(32):
+            prod.send(0, bytes([i]) * (i + 1))
+        for i in range(32):
+            cid, payload = cons.recv(timeout=2)
+            assert payload == bytes([i]) * (i + 1)
+    finally:
+        cons.close()
+        prod.close()
+
+
+def test_wraparound_many_messages():
+    """Messages much larger than capacity/N force repeated wrap markers."""
+    prod, cons = _pair(41002, capacity=8 * 1024)
+    msgs = [os.urandom(700) for _ in range(200)]
+    errs = []
+
+    def pump():
+        try:
+            for m in msgs:
+                prod.send(0, m, timeout=5)
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        got = [cons.recv(timeout=5)[1] for _ in range(len(msgs))]
+        assert got == msgs
+        t.join(timeout=5)
+        assert not errs
+    finally:
+        cons.close()
+        prod.close()
+
+
+def test_reader_count_and_timeout():
+    prod = ShmTransport()
+    port = prod.listen("", 41003)
+    try:
+        assert prod.peer_count() == 0
+        cons = ShmTransport()
+        cons.connect("", port)
+        assert prod.peer_count() == 1
+        assert cons.recv(timeout=0.05) is None  # empty ring times out
+        cons.close()
+        assert prod.peer_count() == 0
+    finally:
+        prod.close()
+
+
+def test_close_drains_then_eos():
+    prod, cons = _pair(41004)
+    prod.send(0, b"last")
+    prod.close()  # marks closed + unlinks
+    assert cons.recv(timeout=2) == (0, b"last")
+    assert cons.recv(timeout=2) == (0, b"")  # closed + drained
+    cons.close()
+
+
+def test_large_message_grows_reader_buffer():
+    prod, cons = _pair(41005, capacity=32 * 1024 * 1024)
+    big = os.urandom(9 * 1024 * 1024)  # > initial 4 MB reader buffer
+    prod.send(0, big, timeout=10)
+    got = cons.recv(timeout=10)
+    assert got[1] == big
+    cons.close()
+    prod.close()
+
+
+def test_oversized_message_rejected():
+    prod, cons = _pair(41006, capacity=8 * 1024)
+    with pytest.raises(TransportError):
+        prod.send(0, b"x" * (64 * 1024), timeout=1)
+    cons.close()
+    prod.close()
+
+
+def test_connect_without_producer_fails():
+    t = ShmTransport()
+    with pytest.raises(TransportError, match="producer"):
+        t.connect("", 49999)
+
+
+def test_cross_process_consumer():
+    """A different PROCESS reads the ring this one writes (the real
+    deployment shape: two pipelines on one host). Messages are queued
+    before the child spawns, so the test is race-free."""
+    port = 41007
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prod = ShmTransport()
+    prod.listen("", port)
+    prod.send(0, b"hello")
+    prod.send(0, b"world")
+    child = subprocess.run(
+        [sys.executable, "-c", (
+            f"import sys; sys.path.insert(0, {repo!r})\n"
+            "from nnstreamer_tpu.edge.shm import ShmTransport\n"
+            "t = ShmTransport()\n"
+            f"t.connect('', {port})\n"
+            "print(t.recv(timeout=10)[1].decode())\n"
+            "print(t.recv(timeout=10)[1].decode())\n"
+            "t.close()\n"
+        )],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert child.returncode == 0, child.stderr[-400:]
+    assert child.stdout.split() == ["hello", "world"]
+    prod.close()
+
+
+def test_edgesink_edgesrc_shm_pipeline():
+    """connect-type=SHM end to end through the pipeline elements."""
+    from nnstreamer_tpu.edge.pubsub import EdgeSink, EdgeSrc
+    from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+
+    sink = EdgeSink(**{"connect-type": "SHM", "port": 41008})
+    sink.start()
+    src = EdgeSrc(**{"connect-type": "SHM", "dest-port": sink.bound_port})
+    src.start()
+    try:
+        frames = [
+            Frame((np.full((2, 2), i, np.float32),), pts=i * 1000)
+            for i in range(5)
+        ]
+        for f in frames:
+            sink.render(f)
+        got = []
+        while len(got) < 5:
+            f = src.generate()
+            if f is not None and f is not EOS_FRAME:
+                got.append(f)
+        for sent, rcv in zip(frames, got):
+            np.testing.assert_array_equal(
+                np.asarray(sent.tensors[0]), np.asarray(rcv.tensors[0])
+            )
+            assert rcv.pts == sent.pts
+        sink.on_eos()
+        f = None
+        while f is None:
+            f = src.generate()
+        assert f is EOS_FRAME
+    finally:
+        src.stop()
+        sink.stop()

@@ -1,0 +1,16 @@
+"""Style gate (the reference's gst-indent/pre-commit role, SURVEY.md §2.5):
+the in-tree checker must pass over the whole tree."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tree_is_style_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_style.py"), REPO],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"style problems:\n{proc.stdout}"

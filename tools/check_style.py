@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""In-tree style checker — the role of the reference's gst-indent /
+pre-commit hooks (tools/development/, SURVEY.md §2.5), self-contained so it
+runs with no network or extra deps.
+
+Rules for tracked .py files (and the C++ under native/):
+- no tabs, no trailing whitespace, LF line endings, final newline
+- max line length 100 (the repo style; docstring URLs exempt)
+- no merge-conflict markers
+
+Usage: python tools/check_style.py [paths...]   (default: repo tree)
+Exit 0 clean, 1 with findings listed one per line.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+MAX_LEN = 100
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "golden", "build",
+              "dist", ".eggs"}
+_EXTS = (".py", ".cpp", ".cc", ".h", ".hpp", ".proto", ".toml")
+_CONFLICT = re.compile(r"^(<{7}|={7}|>{7})( |$)")
+_GENERATED = ("_pb2.py", "_pb2_grpc.py")
+_URL = re.compile(r"https?://\S+")
+
+
+def check_file(path: str) -> list:
+    problems = []
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as exc:
+        return [f"{path}: unreadable: {exc}"]
+    if b"\r\n" in blob:
+        problems.append(f"{path}: CRLF line endings")
+    if blob and not blob.endswith(b"\n"):
+        problems.append(f"{path}: missing final newline")
+    text = blob.decode("utf-8", errors="replace")
+    for i, line in enumerate(text.split("\n"), 1):
+        if "\t" in line:
+            problems.append(f"{path}:{i}: tab character")
+        if line != line.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if len(line) > MAX_LEN and not _URL.search(line):
+            problems.append(f"{path}:{i}: line longer than {MAX_LEN} "
+                            f"({len(line)})")
+        if _CONFLICT.match(line):
+            problems.append(f"{path}:{i}: merge conflict marker")
+    return problems
+
+
+def iter_files(roots):
+    for root in roots:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in filenames:
+                if fn.endswith(_EXTS) and not fn.endswith(_GENERATED):
+                    yield os.path.join(dirpath, fn)
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:]) or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    problems = []
+    for path in iter_files(args):
+        problems.extend(check_file(path))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} style problem(s)", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
