@@ -70,8 +70,23 @@ def _flops_per_frame(fn, example) -> float | None:
         return None
 
 
+def _mark(label: str, _t=[None]) -> None:
+    """Section progress to stderr (the JSON protocol owns stdout)."""
+    now = time.perf_counter()
+    if _t[0] is not None:
+        print(f"[bench] {label} (+{now - _t[0]:.1f}s)", file=sys.stderr)
+    else:
+        print(f"[bench] {label}", file=sys.stderr)
+    _t[0] = now
+
+
+def _round(v, nd=1):
+    return round(v, nd) if v is not None else None
+
+
 def _run() -> None:
     """One measurement attempt (run in a fresh subprocess)."""
+    run_start = time.perf_counter()
     plat = os.environ.get("BENCH_PLATFORM", "")
     if plat:
         import jax
@@ -98,6 +113,7 @@ def _run() -> None:
 
     from nnstreamer_tpu.models import zoo
 
+    _mark("attach ok")
     batch = 1
     iters = 1024
     warmup = 20
@@ -117,6 +133,7 @@ def _run() -> None:
         out = fn(frames[i % len(frames)])
     jax.block_until_ready(out)
 
+    _mark("bs1 compiled+warm")
     # throughput: stream with bounded dispatch-ahead window. The device
     # runs dispatches in order, so syncing the window's LAST result fences
     # the whole window without touching every handle.
@@ -130,6 +147,7 @@ def _run() -> None:
     dt = time.perf_counter() - t0
     fps = iters * batch / dt
 
+    _mark("bs1 measured")
     # p50 sync round-trip latency (includes device-tunnel RTT when remote)
     lat = []
     for i in range(50):
@@ -138,6 +156,7 @@ def _run() -> None:
         lat.append((time.perf_counter() - t) * 1000)
     p50 = statistics.median(lat)
 
+    _mark("p50 measured")
     # streaming-ingest variant: fresh host frame every iteration, H2D via
     # async device_put overlapping compute (the converter's real ingest path,
     # vs the on-device-resident loop above).
@@ -156,6 +175,7 @@ def _run() -> None:
     out.block_until_ready()
     h2d_fps = iters_h * batch / (time.perf_counter() - t0)
 
+    _mark("h2d measured")
     # micro-batched variant: the reference's converter frames-per-tensor
     # batching (gsttensor_converter.c frames_per_tensor) maps to the
     # aggregator batching 8 frames per invoke — same pipeline semantics,
@@ -177,6 +197,15 @@ def _run() -> None:
             out.block_until_ready()
     out.block_until_ready()
     mb_fps = iters8 * mb / (time.perf_counter() - t0)
+
+    _mark("mb8 measured")
+    # Optional sections below run inside a soft budget: the primary
+    # metric is already measured, and a slow tunnel day must not turn a
+    # recorded number into an rc:1 (the round-1 failure mode).
+    soft_budget = float(os.environ.get("BENCH_SOFT_BUDGET_S", "700"))
+
+    def _over_budget() -> bool:
+        return time.perf_counter() - run_start > soft_budget
 
     # composite face→crop→landmark pipeline (BASELINE config #5) through
     # the real pipeline executor; on a single chip both stages share the
@@ -208,49 +237,58 @@ def _run() -> None:
     # regions) — on a remote-attached device every frame pays the tunnel
     # RTT, so keep the frame count small; the number reports the
     # host-in-the-loop pipeline rate, not pure device throughput.
-    _composite(2)  # warm: compile detect + landmark executables
-    composite_fps = _composite(16)
+    composite_fps = None
+    if not _over_budget():
+        _composite(2)  # warm: compile detect + landmark executables
+        composite_fps = _composite(16)
 
+    _mark("composite measured")
     # fused form of the same cascade: detect→crop+resize→landmark as ONE
     # XLA program (zoo:face_composite), no host hop at the crop — the
     # TPU-first redesign the element composite above is measured against
-    mfc = zoo.get("face_composite", compute_dtype="bfloat16")
-    fnc = jax.jit(mfc.fn)
-    fframes = [
-        jnp.asarray(rng.integers(0, 255, (1, 128, 128, 3), np.uint8))
-        for _ in range(4)
-    ]
-    jax.block_until_ready(fnc(fframes[0]))
-    iters_f = 512
-    t0 = time.perf_counter()
-    out = None
-    for i in range(iters_f):
-        out = fnc(fframes[i % 4])
-        if (i + 1) % 128 == 0:
-            jax.block_until_ready(out)
-    jax.block_until_ready(out)
-    fused_fps = iters_f / (time.perf_counter() - t0)
+    fused_fps = None
+    if not _over_budget():
+        mfc = zoo.get("face_composite", compute_dtype="bfloat16")
+        fnc = jax.jit(mfc.fn)
+        fframes = [
+            jnp.asarray(rng.integers(0, 255, (1, 128, 128, 3), np.uint8))
+            for _ in range(4)
+        ]
+        jax.block_until_ready(fnc(fframes[0]))
+        iters_f = 512
+        t0 = time.perf_counter()
+        out = None
+        for i in range(iters_f):
+            out = fnc(fframes[i % 4])
+            if (i + 1) % 128 == 0:
+                jax.block_until_ready(out)
+        jax.block_until_ready(out)
+        fused_fps = iters_f / (time.perf_counter() - t0)
 
+    _mark("fused measured")
     # long-context serving: KV-cache greedy decode throughput (the
     # transformer_lm zoo model in generate mode — models/decode.py, one
     # prefill program + one scanned decode program)
-    mlm = zoo.get(
-        "transformer_lm", generate="64", vocab="32000", d_model="512",
-        n_heads="8", n_layers="4", seqlen="128", compute_dtype="bfloat16",
-    )
-    lm_fn = jax.jit(mlm.fn)
-    toks = jnp.asarray(
-        rng.integers(0, 32000, (1, 128), np.int64), jnp.int32
-    )
-    jax.block_until_ready(lm_fn(toks))  # compile prefill + decode scan
-    iters_lm = 8
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters_lm):
-        out = lm_fn(toks)
-    jax.block_until_ready(out)
-    lm_tok_s = iters_lm * 64 / (time.perf_counter() - t0)
+    lm_tok_s = None
+    if not _over_budget():
+        mlm = zoo.get(
+            "transformer_lm", generate="64", vocab="32000", d_model="512",
+            n_heads="8", n_layers="4", seqlen="128", compute_dtype="bfloat16",
+        )
+        lm_fn = jax.jit(mlm.fn)
+        toks = jnp.asarray(
+            rng.integers(0, 32000, (1, 128), np.int64), jnp.int32
+        )
+        jax.block_until_ready(lm_fn(toks))  # compile prefill + decode scan
+        iters_lm = 8
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters_lm):
+            out = lm_fn(toks)
+        jax.block_until_ready(out)
+        lm_tok_s = iters_lm * 64 / (time.perf_counter() - t0)
 
+    _mark("lm measured")
     # achieved MFU from XLA cost analysis + public per-chip peak
     flops = _flops_per_frame(m.fn, frames[0])
     peak = _peak_tflops(str(dev.device_kind))
@@ -272,9 +310,9 @@ def _run() -> None:
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
                 "h2d_streaming_fps": round(h2d_fps, 1),
                 "microbatch8_fps": round(mb_fps, 1),
-                "composite_face_fps": round(composite_fps, 1),
-                "composite_fused_fps": round(fused_fps, 1),
-                "lm_decode_tok_s": round(lm_tok_s, 1),
+                "composite_face_fps": _round(composite_fps),
+                "composite_fused_fps": _round(fused_fps),
+                "lm_decode_tok_s": _round(lm_tok_s),
                 "flops_per_frame": flops,
                 "mfu_bs1": round(mfu, 4) if mfu is not None else None,
                 "mfu_mb8": round(mfu8, 4) if mfu8 is not None else None,

@@ -34,6 +34,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -50,6 +51,7 @@ struct Header {
   uint64_t read_pos;
   uint32_t closed;         // producer finished
   uint32_t readers;        // attached consumer count
+  int32_t creator_pid;     // liveness probe target for stale reclamation
   pthread_mutex_t mu;
   pthread_cond_t can_read;
   pthread_cond_t can_write;
@@ -94,7 +96,31 @@ extern "C" {
 void* nns_shm_create(const char* name, uint64_t capacity) {
   if (capacity < 4096) capacity = 4096;
   size_t total = sizeof(Header) + capacity;
-  shm_unlink(name);  // stale segment from a crashed run
+  // A LIVE producer's segment must not be clobbered (mirror TCP listen's
+  // EADDRINUSE). Reclaim only when the previous producer marked it closed
+  // or its pid is gone (crashed run).
+  int probe = shm_open(name, O_RDWR, 0600);
+  if (probe >= 0) {
+    struct stat st;
+    bool reclaim = false;
+    if (fstat(probe, &st) == 0 && (size_t)st.st_size >= sizeof(Header)) {
+      void* mem = mmap(nullptr, sizeof(Header), PROT_READ, MAP_SHARED,
+                       probe, 0);
+      if (mem != MAP_FAILED) {
+        Header* ph = (Header*)mem;
+        bool creator_dead =
+            ph->creator_pid > 0 &&
+            kill(ph->creator_pid, 0) != 0 && errno == ESRCH;
+        reclaim = (ph->magic != kMagic) || ph->closed || creator_dead;
+        munmap(mem, sizeof(Header));
+      }
+    } else {
+      reclaim = true;  // truncated debris
+    }
+    close(probe);
+    if (!reclaim) return nullptr;  // live producer owns the name
+    shm_unlink(name);
+  }
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
   if (ftruncate(fd, (off_t)total) != 0) {
@@ -111,6 +137,7 @@ void* nns_shm_create(const char* name, uint64_t capacity) {
   Header* h = (Header*)mem;
   memset(h, 0, sizeof(Header));
   h->capacity = capacity;
+  h->creator_pid = (int32_t)getpid();
 
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);

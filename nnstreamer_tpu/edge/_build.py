@@ -1,9 +1,9 @@
-"""On-demand g++ build of the native edge transport.
+"""On-demand g++ build of the in-tree native components.
 
 The reference links a prebuilt external nnstreamer-edge .so discovered via
-pkg-config; here the native source ships in-tree (native/nns_edge.cpp) and
-compiles once into a cached .so keyed on source mtime. A missing toolchain
-degrades to the pure-python transport (transport.py), the way the
+pkg-config; here the native sources ship in-tree (native/*.cpp) and compile
+once into cached .so files keyed on source mtime. A missing toolchain
+degrades to the pure-python fallbacks (transport.py), the way the
 reference's meson options degrade features — never a hard failure.
 """
 
@@ -17,12 +17,8 @@ from typing import Optional
 from nnstreamer_tpu.log import get_logger
 
 _log = get_logger("edge.build")
-_lock = threading.Lock()
-_cached: Optional[str] = None
-_failed = False
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-SOURCE = os.path.join(_REPO_ROOT, "native", "nns_edge.cpp")
 BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 
 # NNS_EDGE_SANITIZE=thread|address builds an instrumented variant (the
@@ -30,60 +26,22 @@ BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 # concurrency stress test; separate .so name so normal runs stay fast.
 SANITIZE = os.environ.get("NNS_EDGE_SANITIZE", "")
 _suffix = f"_{SANITIZE}" if SANITIZE else ""
-SO_PATH = os.path.join(BUILD_DIR, f"libnns_edge{_suffix}.so")
 
-
-def native_lib_path() -> Optional[str]:
-    """Compile (if stale) and return the .so path, or None if unavailable."""
-    global _cached, _failed
-    with _lock:
-        if _cached:
-            return _cached
-        if _failed:
-            return None
-        if not os.path.isfile(SOURCE):
-            _failed = True
-            return None
-        try:
-            if not (
-                os.path.isfile(SO_PATH)
-                and os.path.getmtime(SO_PATH) >= os.path.getmtime(SOURCE)
-            ):
-                os.makedirs(BUILD_DIR, exist_ok=True)
-                cmd = [
-                    "g++", "-O2", "-std=c++17", "-fPIC", "-shared",
-                    "-pthread", SOURCE, "-o", SO_PATH,
-                ]
-                if SANITIZE:
-                    cmd[1:1] = [f"-fsanitize={SANITIZE}", "-g"]
-                subprocess.run(
-                    cmd, check=True, capture_output=True, timeout=120
-                )
-                _log.info("built native edge transport: %s", SO_PATH)
-        except (subprocess.SubprocessError, OSError) as exc:
-            _log.warning("native edge build failed (%s); using python transport", exc)
-            _failed = True
-            return None
-        _cached = SO_PATH
-        return _cached
-
-
-# -- generic builder for other in-tree native components -------------------
-
-_generic_lock = threading.Lock()
-_generic_cache: dict = {}  # source basename -> path | None
+_lock = threading.Lock()
+_cache: dict = {}  # source basename -> path | None (None = build failed)
 
 
 def build_native(source_name: str, extra_flags=()) -> Optional[str]:
     """Compile native/<source_name> into build/lib<stem>.so (mtime-cached),
-    honoring NNS_EDGE_SANITIZE like the edge transport. Returns None when
-    the toolchain or source is unavailable (callers degrade gracefully)."""
+    honoring NNS_EDGE_SANITIZE. Returns None when the toolchain or source
+    is unavailable (callers degrade gracefully); the failure is cached for
+    the process lifetime."""
     src = os.path.join(_REPO_ROOT, "native", source_name)
     stem = os.path.splitext(source_name)[0]
     so = os.path.join(BUILD_DIR, f"lib{stem}{_suffix}.so")
-    with _generic_lock:
-        if source_name in _generic_cache:
-            return _generic_cache[source_name]
+    with _lock:
+        if source_name in _cache:
+            return _cache[source_name]
         result: Optional[str] = None
         if os.path.isfile(src):
             try:
@@ -105,5 +63,10 @@ def build_native(source_name: str, extra_flags=()) -> Optional[str]:
                 result = so
             except (subprocess.SubprocessError, OSError) as exc:
                 _log.warning("native build of %s failed: %s", source_name, exc)
-        _generic_cache[source_name] = result
+        _cache[source_name] = result
         return result
+
+
+def native_lib_path() -> Optional[str]:
+    """The edge transport .so (compat wrapper over build_native)."""
+    return build_native("nns_edge.cpp")

@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.edge.mqtt import MqttError
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.edge.serialize import decode_message, encode_message
 from nnstreamer_tpu.edge.transport import TransportError, make_transport
 from nnstreamer_tpu.elements.base import (
@@ -27,6 +28,8 @@ from nnstreamer_tpu.tensors.frame import EOS, EOS_FRAME, Frame
 from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
 
 DEFAULT_PORT = 3000  # reference edge_common.h:36-37
+
+_log = get_logger("edge.pubsub")
 
 
 @registry.element("edgesink")
@@ -74,9 +77,10 @@ class EdgeSink(Sink):
                 ) from exc
             return
         if self.connect_type == "SHM":
-            from nnstreamer_tpu.edge.shm import ShmTransport
+            from nnstreamer_tpu.edge.shm import DEFAULT_CAPACITY, ShmTransport
 
-            self._transport = ShmTransport()
+            cap = int(self.get_property("shm-capacity", DEFAULT_CAPACITY))
+            self._transport = ShmTransport(capacity=cap)
         else:
             self._transport = make_transport()
         self.bound_port = self._transport.listen(self.host, self.port)
@@ -122,8 +126,10 @@ class EdgeSink(Sink):
                 )
         try:
             self._transport.send(0, encode_message(frame))  # 0 = broadcast
-        except (TransportError, OSError):
-            pass  # best-effort: one dead subscriber must not kill the stream
+        except (TransportError, OSError) as exc:
+            # best-effort: one dead subscriber must not kill the stream —
+            # but dropped frames must be visible, not silent
+            _log.warning("%s: frame dropped: %s", self.name, exc)
 
     def on_eos(self) -> None:
         if self._mqtt is not None:

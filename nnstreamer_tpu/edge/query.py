@@ -12,9 +12,11 @@ Reference: gst/nnstreamer/tensor_query/ —
 - serversrc/sink pair through a global id table
   (tensor_query_server.c, hdr :25-73) — here :data:`_server_table`.
 
-The transport is the in-tree native C++ edge library (python fallback);
-``connect-type`` accepts only TCP for now — the reference's MQTT/HYBRID/
-AITT transports are config-gated the same way its meson options gate them.
+Transports (``connect-type``, reference tensor_query_common.c:35-42):
+``TCP`` (in-tree native C++ edge library, python fallback), ``MQTT``
+(request/reply topics over the broker), ``HYBRID`` (MQTT whois discovery
++ raw TCP bulk) — see query_transports.py. AITT stays vendor-gated like
+its meson option.
 """
 
 from __future__ import annotations

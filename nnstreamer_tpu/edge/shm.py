@@ -83,7 +83,11 @@ class ShmTransport:
         port = port or os.getpid() % 50000 + 10000
         h = lib.nns_shm_create(segment_name(port).encode(), self.capacity)
         if not h:
-            raise TransportError(f"cannot create shm segment for port {port}")
+            raise TransportError(
+                f"cannot create shm segment {segment_name(port)!r}: a live "
+                "producer owns it (TCP-listen EADDRINUSE analogue), or shm "
+                f"is unavailable; stale file: /dev/shm{segment_name(port)}"
+            )
         self._h = h
         self._producer = True
         return port
@@ -101,6 +105,13 @@ class ShmTransport:
     def send(self, cid, payload: bytes, timeout: float = 10.0) -> None:
         if self._h is None:
             raise TransportError("shm transport not started")
+        if len(payload) + 8 > self.capacity // 2:
+            # the ring guarantees progress only for messages ≤ capacity/2
+            raise TransportError(
+                f"shm message ({len(payload)} B) exceeds ring capacity/2 "
+                f"({self.capacity // 2} B); raise the transport capacity "
+                "(edgesink shm-capacity property)"
+            )
         rc = _get_lib().nns_shm_write(
             self._h, payload, len(payload), int(timeout * 1000)
         )
@@ -125,7 +136,9 @@ class ShmTransport:
                     raise TransportError("shm message exceeds max size")
                 self._buf = ctypes.create_string_buffer(len(self._buf) * 2)
                 continue
-            return (0, self._buf.raw[:n])
+            # string_at copies exactly n bytes; .raw would materialize the
+            # whole (possibly hundreds-of-MB) reader buffer per message
+            return (0, ctypes.string_at(self._buf, n))
 
     def peer_count(self) -> int:
         if self._h is None:

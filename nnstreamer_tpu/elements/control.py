@@ -58,6 +58,7 @@ _OPERATORS = (
 )
 _ACTIONS = (
     "PASSTHROUGH", "SKIP", "FILL_ZERO", "FILL_VALUES",
+    "FILL_WITH_FILE", "FILL_WITH_FILE_RPT",
     "REPEAT_PREVIOUS_FRAME", "TENSORPICK",
 )
 
@@ -94,6 +95,24 @@ class TensorIf(HostElement):
             if a not in _ACTIONS:
                 raise ValueError(f"{self.name}: unknown action {a}")
         self._prev: Optional[Frame] = None
+        self._file_cache: dict = {}
+
+    def _file_blob(self, path: str) -> bytes:
+        if not path:
+            raise RuntimeError(
+                f"{self.name}: FILL_WITH_FILE needs then/else-option=<path>"
+            )
+        blob = self._file_cache.get(path)
+        if blob is None:
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError as exc:
+                raise RuntimeError(
+                    f"{self.name}: cannot read fill file {path}: {exc}"
+                ) from exc
+            self._file_cache[path] = blob
+        return blob
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs
@@ -192,6 +211,20 @@ class TensorIf(HostElement):
             out = frame.with_tensors(
                 [np.full_like(np.asarray(t), val) for t in frame.tensors]
             )
+        elif action in ("FILL_WITH_FILE", "FILL_WITH_FILE_RPT"):
+            # reference gsttensor_if.h: replace payload with file content;
+            # plain variant zero-pads a short file, _RPT repeats it
+            blob = self._file_blob(option)
+            outs = []
+            for t in frame.tensors:
+                a = np.asarray(t)
+                n = a.nbytes
+                if action.endswith("_RPT") and blob:
+                    raw = (blob * (-(-n // len(blob))))[:n]
+                else:
+                    raw = blob[:n].ljust(n, b"\0")
+                outs.append(np.frombuffer(raw, a.dtype).reshape(a.shape))
+            out = frame.with_tensors(outs)
         elif action == "REPEAT_PREVIOUS_FRAME":
             out = (
                 self._prev.with_pts(frame.pts, frame.duration)

@@ -37,7 +37,8 @@ from nnstreamer_tpu.tensors.spec import TensorsSpec
 # share ONE opened backend instance — one copy of the weights on device,
 # and a reload through any sharer swaps the model for all of them.
 _shared_lock = threading.Lock()
-_shared_backends: Dict[str, List] = {}  # key -> [backend, refcount, signature]
+# key -> {"backend", "refs", "sig", "open_lock"}
+_shared_backends: Dict[str, Dict] = {}
 
 
 def _props_signature(p: FilterProps) -> tuple:
@@ -53,31 +54,44 @@ def _shared_acquire(key: str, props: FilterProps, opener):
     sig = _props_signature(props)
     with _shared_lock:
         entry = _shared_backends.get(key)
-        if entry is not None:
-            if entry[2] != sig:
-                raise NegotiationError(
-                    f"shared-tensor-filter-key={key!r} already bound to "
-                    f"{entry[2]}, cannot rebind to {sig}"
-                )
-            entry[1] += 1
-            return entry[0]
-        backend = opener()
-        # stateful host backends (tflite set_tensor/invoke/get_tensor,
-        # custom scripts) are not reentrant; sharers run on separate
-        # executor threads, so serialize their invokes
-        backend.shared_invoke_lock = threading.Lock()
-        _shared_backends[key] = [backend, 1, sig]
-        return backend
+        if entry is None:
+            entry = {"backend": None, "refs": 0, "sig": sig,
+                     "open_lock": threading.Lock()}
+            _shared_backends[key] = entry
+        elif entry["sig"] != sig:
+            raise NegotiationError(
+                f"shared-tensor-filter-key={key!r} already bound to "
+                f"{entry['sig']}, cannot rebind to {sig}"
+            )
+        entry["refs"] += 1
+    try:
+        # per-key open lock: model opens (jit compiles) for DIFFERENT keys
+        # must not serialize behind one global lock
+        with entry["open_lock"]:
+            if entry["backend"] is None:
+                backend = opener()
+                # stateful host backends (tflite set_tensor/invoke/
+                # get_tensor, custom scripts) are not reentrant; sharers
+                # run on separate executor threads, so serialize invokes
+                backend.shared_invoke_lock = threading.Lock()
+                entry["backend"] = backend
+        return entry["backend"]
+    except Exception:
+        with _shared_lock:
+            entry["refs"] -= 1
+            if entry["refs"] <= 0 and entry["backend"] is None:
+                _shared_backends.pop(key, None)
+        raise
 
 
 def _shared_release(key: str, backend) -> bool:
     """Drop one ref; True if the caller should actually close the backend."""
     with _shared_lock:
         entry = _shared_backends.get(key)
-        if entry is None or entry[0] is not backend:
+        if entry is None or entry["backend"] is not backend:
             return True  # not (or no longer) shared: caller owns it
-        entry[1] -= 1
-        if entry[1] <= 0:
+        entry["refs"] -= 1
+        if entry["refs"] <= 0:
             del _shared_backends[key]
             return True
         return False

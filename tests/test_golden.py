@@ -30,6 +30,30 @@ PIPELINES = {
         'tensor_transform mode=arithmetic option="add:1,mul:2" ! '
         "filesink location={out}"
     ),
+    # remaining transform suites (reference tests/transform_{clamp,stand,
+    # dimchg}/runTest.sh)
+    "transform_clamp": (
+        "videotestsrc pattern=counter num-frames=3 width=4 height=4 ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_transform mode=clamp option=0.5:1.5 ! "
+        "filesink location={out}"
+    ),
+    "transform_stand": (
+        "videotestsrc pattern=gradient num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_transform mode=stand option=default ! "
+        "filesink location={out}"
+    ),
+    "transform_dimchg": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=6 ! "
+        "tensor_converter ! tensor_transform mode=dimchg option=0:2 ! "
+        "filesink location={out}"
+    ),
+    # converter frames-per-tensor batching (gsttensor_converter.c)
+    "converter_batch": (
+        "videotestsrc pattern=counter num-frames=4 width=4 height=4 ! "
+        "tensor_converter frames-per-tensor=2 ! filesink location={out}"
+    ),
     # transpose (HWC→CWH style dim reorder)
     "transform_transpose": (
         "videotestsrc pattern=gradient num-frames=2 width=4 height=6 ! "
@@ -189,6 +213,15 @@ PIPELINES = {
         "filesrc location={fix}/octet20.bin blocksize=5 ! "
         "tensor_converter input-dim=5 input-type=uint8 ! "
         "filesink location={out}"
+    ),
+    # tensor_if FILL_WITH_FILE_RPT: else-branch payload comes from a file
+    "if_fill_file": (
+        "videotestsrc pattern=counter num-frames=3 width=4 height=4 ! "
+        "tensor_converter ! "
+        "tensor_if compared-value=TENSOR_AVERAGE_VALUE "
+        "compared-value-option=0 operator=GE supplied-value=1 "
+        "then=PASSTHROUGH else=FILL_WITH_FILE_RPT "
+        "else-option={fix}/octet20.bin ! filesink location={out}"
     ),
     # fused on-device cascade (zoo:face_composite): detect→crop+resize→
     # landmark as one XLA program, landmarks + detections to file

@@ -1,0 +1,70 @@
+"""tensor_if FILL_WITH_FILE / FILL_WITH_FILE_RPT actions (reference
+gsttensor_if.h:79-90 action set)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.control import TensorIf
+from nnstreamer_tpu.tensors.frame import Frame
+
+
+def _if(action, option, operator="LT"):
+    # predicate false for positive averages → else branch runs
+    return TensorIf(
+        **{"compared-value": "TENSOR_AVERAGE_VALUE", "compared-value-option": "0",
+           "operator": operator, "supplied-value": "0",
+           "then": "PASSTHROUGH", "else": action, "else-option": option}
+    )
+
+
+def test_fill_with_file_exact(tmp_path):
+    path = tmp_path / "fill.bin"
+    data = np.arange(12, dtype=np.uint8)
+    path.write_bytes(data.tobytes())
+    elem = _if("FILL_WITH_FILE", str(path))
+    out = elem.process(Frame((np.ones((3, 4), np.uint8),)))
+    np.testing.assert_array_equal(
+        np.asarray(out.tensors[0]), data.reshape(3, 4)
+    )
+
+
+def test_fill_with_file_zero_pads_short_file(tmp_path):
+    path = tmp_path / "short.bin"
+    path.write_bytes(b"\x07\x08")
+    elem = _if("FILL_WITH_FILE", str(path))
+    out = elem.process(Frame((np.ones(5, np.uint8),)))
+    np.testing.assert_array_equal(
+        np.asarray(out.tensors[0]), [7, 8, 0, 0, 0]
+    )
+
+
+def test_fill_with_file_rpt_cycles(tmp_path):
+    path = tmp_path / "cycle.bin"
+    path.write_bytes(b"\x01\x02\x03")
+    elem = _if("FILL_WITH_FILE_RPT", str(path))
+    out = elem.process(Frame((np.zeros(7, np.uint8) + 9,)))
+    np.testing.assert_array_equal(
+        np.asarray(out.tensors[0]), [1, 2, 3, 1, 2, 3, 1]
+    )
+
+
+def test_fill_with_file_typed(tmp_path):
+    """File bytes reinterpret as the tensor dtype."""
+    path = tmp_path / "f32.bin"
+    vals = np.asarray([1.5, -2.0], np.float32)
+    path.write_bytes(vals.tobytes())
+    elem = _if("FILL_WITH_FILE", str(path))
+    out = elem.process(Frame((np.zeros(2, np.float32),)))
+    np.testing.assert_array_equal(np.asarray(out.tensors[0]), vals)
+
+
+def test_missing_file_raises_cleanly(tmp_path):
+    elem = _if("FILL_WITH_FILE", str(tmp_path / "nope.bin"))
+    with pytest.raises(RuntimeError, match="cannot read fill file"):
+        elem.process(Frame((np.ones(4, np.uint8),)))
+
+
+def test_missing_option_raises():
+    elem = _if("FILL_WITH_FILE", "")
+    with pytest.raises(RuntimeError, match="needs then/else-option"):
+        elem.process(Frame((np.ones(4, np.uint8),)))

@@ -176,3 +176,25 @@ def test_edgesink_edgesrc_shm_pipeline():
     finally:
         src.stop()
         sink.stop()
+
+
+def test_live_producer_name_not_clobbered():
+    """Second producer on the same port must fail (TCP EADDRINUSE
+    analogue); after the first closes cleanly the name is reclaimable."""
+    a = ShmTransport()
+    port = a.listen("", 41009)
+    b = ShmTransport()
+    with pytest.raises(TransportError, match="live producer"):
+        b.listen("", port)
+    a.close()  # marks closed + unlinks → name free again
+    c = ShmTransport()
+    assert c.listen("", port) == port
+    c.close()
+
+
+def test_oversized_message_error_names_capacity():
+    prod = ShmTransport(capacity=8 * 1024)
+    prod.listen("", 41010)
+    with pytest.raises(TransportError, match="capacity"):
+        prod.send(0, b"x" * (5 * 1024))
+    prod.close()
