@@ -114,10 +114,13 @@ def _run() -> None:
     from nnstreamer_tpu.models import zoo
 
     _mark("attach ok")
+    on_tpu = dev.platform == "tpu"
     batch = 1
-    iters = 1024
-    warmup = 20
-    sync_every = 256  # bounded in-flight window (256 frames ≈ 40 MB on-device)
+    # CPU fallback exists to record a diagnostic number, not to spend 15
+    # minutes interpreting convs — scale the loops down off-TPU
+    iters = 1024 if on_tpu else 48
+    warmup = 20 if on_tpu else 3
+    sync_every = 256 if on_tpu else 16
 
     m = zoo.get("mobilenet_v2", batch=str(batch), compute_dtype="bfloat16")
     fn = jax.jit(m.fn)
@@ -150,7 +153,7 @@ def _run() -> None:
     _mark("bs1 measured")
     # p50 sync round-trip latency (includes device-tunnel RTT when remote)
     lat = []
-    for i in range(50):
+    for i in range(50 if on_tpu else 8):
         t = time.perf_counter()
         fn(frames[i % len(frames)]).block_until_ready()
         lat.append((time.perf_counter() - t) * 1000)
@@ -164,7 +167,7 @@ def _run() -> None:
         np.ascontiguousarray(rng.integers(0, 255, (batch, 224, 224, 3), np.uint8))
         for _ in range(8)
     ]
-    iters_h = 512
+    iters_h = 512 if on_tpu else 24
     out = None
     t0 = time.perf_counter()
     for i in range(iters_h):
@@ -189,7 +192,7 @@ def _run() -> None:
     ]
     out = fn8(frames8[0])
     jax.block_until_ready(out)
-    iters8 = 256
+    iters8 = 256 if on_tpu else 8
     t0 = time.perf_counter()
     for i in range(iters8):
         out = fn8(frames8[i % 4])
@@ -205,7 +208,9 @@ def _run() -> None:
     soft_budget = float(os.environ.get("BENCH_SOFT_BUDGET_S", "700"))
 
     def _over_budget() -> bool:
-        return time.perf_counter() - run_start > soft_budget
+        # optional sections are TPU evidence; the CPU fallback records the
+        # primary diagnostics only
+        return (not on_tpu) or time.perf_counter() - run_start > soft_budget
 
     # composite face→crop→landmark pipeline (BASELINE config #5) through
     # the real pipeline executor; on a single chip both stages share the
@@ -330,26 +335,35 @@ def main() -> None:
     import subprocess
 
     here = os.path.abspath(__file__)
-    # (delay_before_attempt, extra_env). Last attempt pins CPU so a
-    # diagnostic number exists even when the TPU never attaches.
+    # (delay_before_attempt, extra_env, per_attempt_timeout). The first
+    # attempt gets the full window; retries get short windows so a WEDGED
+    # attach (jax.devices() blocking for minutes, observed after an
+    # ungraceful TPU-process death) still leaves time for the final
+    # CPU-pinned attempt — a diagnostic number always beats rc:1/124.
     attempts = [
-        (0, {}),
-        (5, {}),
-        (15, {}),
-        (30, {}),
-        (5, {"BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}),
+        (0, {}, 1500),
+        (5, {}, 420),
+        (15, {}, 420),
+        (30, {}, 420),
+        (5, {"BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}, 600),
     ]
     last_tail = ""
-    for delay, extra in attempts:
+    for delay, extra, attempt_timeout in attempts:
         if delay:
             time.sleep(delay)
         env = dict(os.environ, **extra)
+        # the child must give up on optional sections well before ITS
+        # hard timeout, or a slow attempt loses the already-measured
+        # primary metrics to a SIGKILL
+        env.setdefault(
+            "BENCH_SOFT_BUDGET_S", str(max(attempt_timeout - 150, 120))
+        )
         try:
             p = subprocess.run(
                 [sys.executable, here, "--run"],
                 capture_output=True,
                 text=True,
-                timeout=1500,
+                timeout=attempt_timeout,
                 env=env,
             )
         except subprocess.TimeoutExpired as exc:

@@ -41,7 +41,10 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x4e4e53534d454d31ull;  // "NNSSMEM1"
+// Bumped with every Header-layout change: stale segments from older
+// builds then fail the magic check and get reclaimed instead of being
+// misread ("2" added creator_pid).
+constexpr uint64_t kMagic = 0x4e4e53534d454d32ull;  // "NNSSMEM2"
 constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
 
 struct Header {

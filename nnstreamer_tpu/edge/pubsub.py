@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.edge.mqtt import MqttError
+from nnstreamer_tpu.edge.shm import MessageTooLarge
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.edge.serialize import decode_message, encode_message
 from nnstreamer_tpu.edge.transport import TransportError, make_transport
@@ -127,6 +128,10 @@ class EdgeSink(Sink):
         try:
             self._transport.send(0, encode_message(frame))  # 0 = broadcast
         except (TransportError, OSError) as exc:
+            if isinstance(exc, MessageTooLarge):
+                # permanent misconfiguration: EVERY frame would drop —
+                # fail the pipeline with the remedy, don't warn forever
+                raise ElementError(f"{self.name}: {exc}") from exc
             # best-effort: one dead subscriber must not kill the stream —
             # but dropped frames must be visible, not silent
             _log.warning("%s: frame dropped: %s", self.name, exc)

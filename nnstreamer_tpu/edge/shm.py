@@ -23,7 +23,13 @@ from nnstreamer_tpu.edge._build import build_native
 from nnstreamer_tpu.edge.transport import TransportError
 
 DEFAULT_CAPACITY = 32 * 1024 * 1024  # 32 MB ring
+MIN_CAPACITY = 4096  # native layer clamps to this (nns_shm.cpp)
 _MAX_MSG = 512 * 1024 * 1024
+
+
+class MessageTooLarge(TransportError):
+    """Permanent per-configuration failure: the message can NEVER fit the
+    ring — callers should fail loudly, not retry/drop."""
 
 
 def _load() -> ctypes.CDLL:
@@ -72,7 +78,7 @@ class ShmTransport:
     """Producer (listen) or consumer (connect) end of one shm ring."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
-        self.capacity = capacity
+        self.capacity = max(int(capacity), MIN_CAPACITY)  # mirror native clamp
         self._h: Optional[int] = None
         self._producer = False
         self._buf = ctypes.create_string_buffer(4 * 1024 * 1024)
@@ -107,7 +113,7 @@ class ShmTransport:
             raise TransportError("shm transport not started")
         if len(payload) + 8 > self.capacity // 2:
             # the ring guarantees progress only for messages ≤ capacity/2
-            raise TransportError(
+            raise MessageTooLarge(
                 f"shm message ({len(payload)} B) exceeds ring capacity/2 "
                 f"({self.capacity // 2} B); raise the transport capacity "
                 "(edgesink shm-capacity property)"

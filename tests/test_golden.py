@@ -148,6 +148,25 @@ PIPELINES = {
         "tensor_mux name=mux sync-mode=nosync ! "
         "tensor_demux tensorpick=1 ! filesink location={out}"
     ),
+    # grouped tensorpick: pads carry tensor GROUPS ('0:1' = first two)
+    "demux_grouped": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! mux.sink_0 "
+        "videotestsrc pattern=gradient num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! mux.sink_1 "
+        "videotestsrc pattern=solid num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! mux.sink_2 "
+        "tensor_mux name=mux sync-mode=nosync ! "
+        "tensor_demux tensorpick=0:1 ! filesink location={out}"
+    ),
+    # refresh policy: emit on every new frame, reusing the other pad's last
+    "mux_refresh": (
+        "videotestsrc pattern=counter num-frames=4 width=4 height=4 "
+        "framerate=20/1 ! tensor_converter ! mux.sink_0 "
+        "videotestsrc pattern=gradient num-frames=2 width=4 height=4 "
+        "framerate=10/1 ! tensor_converter ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=refresh ! filesink location={out}"
+    ),
     # split a tensor along a dim, then merge back (gsttensor_split/merge.c)
     "split_merge": (
         "videotestsrc pattern=counter num-frames=2 width=8 height=4 ! "

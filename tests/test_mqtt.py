@@ -202,3 +202,47 @@ class TestEdgeMqttConnectType:
 
         with pytest.raises(ValueError, match="connect-type"):
             EdgeSink(**{"connect-type": "AITT"})
+
+
+class TestBrokerQoS:
+    """QoS 1/2 PUBLISH from external 3.1.1 clients: the broker must strip
+    the packet id before fan-out and acknowledge (round-1 advisory fix)."""
+
+    def _raw_connect(self, broker):
+        from nnstreamer_tpu.edge import mqtt as m
+
+        sock = socket.create_connection(("127.0.0.1", broker.port), timeout=5)
+        var = (
+            m._string("MQTT") + bytes([4]) + bytes([0x02])
+            + __import__("struct").pack(">H", 60)
+        )
+        sock.sendall(m._packet(m.CONNECT, 0, var + m._string("raw-qos")))
+        ptype, _, payload = m._read_packet(sock)
+        assert ptype == m.CONNACK and payload[1] == 0
+        return sock
+
+    @pytest.mark.parametrize("qos", [1, 2])
+    def test_qos_publish_stripped_and_acked(self, broker, qos):
+        import struct
+
+        from nnstreamer_tpu.edge import mqtt as m
+
+        sub = MqttClient(port=broker.port).connect()
+        sub.subscribe("qos/t")
+        time.sleep(0.1)
+        sock = self._raw_connect(broker)
+        body = m._string("qos/t") + struct.pack(">H", 77) + b"payload!"
+        sock.sendall(m._packet(m.PUBLISH, qos << 1, body))
+        # broker acknowledges: PUBACK for qos1, PUBREC for qos2
+        ptype, _, ack = m._read_packet(sock)
+        assert ptype == (m.PUBACK if qos == 1 else m.PUBREC)
+        assert struct.unpack(">H", ack[:2])[0] == 77
+        if qos == 2:
+            sock.sendall(m._packet(m.PUBREL, 2, struct.pack(">H", 77)))
+            ptype, _, comp = m._read_packet(sock)
+            assert ptype == m.PUBCOMP
+        # subscriber receives the CLEAN payload (no packet-id bytes)
+        got = sub.recv(timeout=5)
+        assert got == ("qos/t", b"payload!")
+        sock.close()
+        sub.close()

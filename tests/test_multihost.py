@@ -1,0 +1,91 @@
+"""Multi-host (DCN analogue) loopback: TWO REAL PROCESSES join via
+jax.distributed, build one global mesh, and run a psum across process
+boundaries — the single-machine stand-in for a pod slice (SURVEY.md §5.8;
+the reference's equivalent is its multi-process query/edge loopback
+tests). CPU backend, 2 virtual devices per process → 4 global."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.parallel import multihost
+from nnstreamer_tpu.parallel.mesh import make_mesh
+
+pid = int(sys.argv[1])
+multihost.initialize(
+    coordinator_address={coord!r}, num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
+assert multihost.is_primary() == (pid == 0)
+
+mesh = make_mesh(4, axes=("dp",))
+sh = NamedSharding(mesh, P("dp"))
+
+# global array: each process contributes its local shard
+global_shape = (8, 4)
+local = np.arange(8 * 4, dtype=np.float32).reshape(global_shape)
+arrs = [
+    jax.device_put(local[idx], d)
+    for d, idx in sh.addressable_devices_indices_map(global_shape).items()
+]
+x = jax.make_array_from_single_device_arrays(global_shape, sh, arrs)
+
+@jax.jit
+def total(v):
+    return jnp.sum(v)
+
+# the reduction crosses the process boundary (devices live on 2 procs)
+t = total(x)
+expected = float(np.arange(32, dtype=np.float32).sum())
+assert float(t) == expected, (float(t), expected)
+print(f"proc{{pid}} ok", flush=True)
+multihost.shutdown()
+"""
+
+
+def test_two_process_mesh_psum(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=REPO, coord=coord))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"proc{i} failed:\n{err[-800:]}"
+        assert f"proc{i} ok" in out

@@ -198,3 +198,21 @@ def test_oversized_message_error_names_capacity():
     with pytest.raises(TransportError, match="capacity"):
         prod.send(0, b"x" * (5 * 1024))
     prod.close()
+
+
+def test_edgesink_oversized_frame_fails_loudly():
+    """A frame that can NEVER fit the ring is a pipeline error with the
+    remedy in the message, not an eternal silent drop."""
+    from nnstreamer_tpu.edge.pubsub import EdgeSink
+    from nnstreamer_tpu.elements.base import ElementError
+    from nnstreamer_tpu.tensors.frame import Frame
+
+    sink = EdgeSink(**{"connect-type": "SHM", "port": 41011,
+                       "shm-capacity": 64 * 1024})
+    sink.start()
+    try:
+        big = Frame((np.zeros(128 * 1024, np.uint8),))
+        with pytest.raises(ElementError, match="shm-capacity"):
+            sink.render(big)
+    finally:
+        sink.stop()
