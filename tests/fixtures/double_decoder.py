@@ -1,0 +1,10 @@
+"""Golden-test python3 decoder: doubles every tensor value (uint8 wrap)."""
+import numpy as np
+
+
+class CustomDecoder:
+    def negotiate(self, in_spec, options):
+        return in_spec  # tensors in, tensors out
+
+    def decode(self, tensors):
+        return tuple(np.asarray(t) * 2 for t in tensors)

@@ -1,0 +1,10 @@
+"""Golden-test python3 custom filter: bitwise-not of uint8 frames."""
+import numpy as np
+
+
+class CustomFilter:
+    def setInputDim(self, in_spec):
+        return in_spec
+
+    def invoke(self, tensors):
+        return tuple(255 - np.asarray(t) for t in tensors)

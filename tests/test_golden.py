@@ -242,6 +242,18 @@ PIPELINES = {
         "then=PASSTHROUGH else=FILL_WITH_FILE_RPT "
         "else-option={fix}/octet20.bin ! filesink location={out}"
     ),
+    # python3 script subplugins through the CLI (tensordec-python3.cc /
+    # tensor_filter_python3.cc parity)
+    "decoder_python3": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_decoder mode=python3 "
+        "option1={fix}/double_decoder.py ! filesink location={out}"
+    ),
+    "filter_python3": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_filter framework=custom "
+        "model={fix}/negate_filter.py ! filesink location={out}"
+    ),
     # fused on-device cascade (zoo:face_composite): detect→crop+resize→
     # landmark as one XLA program, landmarks + detections to file
     "composite_fused": (
