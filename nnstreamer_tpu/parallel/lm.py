@@ -60,16 +60,23 @@ def init_lm_params(
     return params
 
 
-def _make_attn_fn(mesh: Mesh, kind: str, dp_axis: str, sp_axis: str):
+def _make_attn_fn(mesh: Mesh, kind: str, dp_axis: str, sp_axis: str,
+                  kv_chunk=None):
     local = {
         "ring": ring_attention_local,
         "ulysses": ulysses_attention_local,
     }[kind]
+    if kv_chunk is not None and kind != "ring":
+        raise ValueError(
+            f"kv_chunk applies to attn='ring' only (got attn={kind!r}); "
+            "ulysses gathers full sequences per head and has no chunked path"
+        )
+    extra = {"kv_chunk": kv_chunk} if kind == "ring" else {}
     spec = P(dp_axis, sp_axis, None, None)
 
     def attn(q, k, v, causal=True):
         return jax.shard_map(
-            functools.partial(local, axis_name=sp_axis, causal=causal),
+            functools.partial(local, axis_name=sp_axis, causal=causal, **extra),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -132,10 +139,12 @@ def make_lm_train_step(
     top_k: int = 2,
     learning_rate: float = 0.1,
     compute_dtype=jnp.float32,
+    kv_chunk=None,
 ) -> Tuple:
     """Returns (jitted_step, sharded_params). step(params, tokens) →
-    (params, loss); tokens [B, T+1] sharded (dp, sp)."""
-    attn_fn = _make_attn_fn(mesh, attn, dp_axis, sp_axis)
+    (params, loss); tokens [B, T+1] sharded (dp, sp). ``kv_chunk`` bounds
+    the in-shard attention score tensor for long contexts (ring only)."""
+    attn_fn = _make_attn_fn(mesh, attn, dp_axis, sp_axis, kv_chunk=kv_chunk)
     is_moe = "moe_gate" in params["blocks"]
     ffn_fn = _make_moe_ffn(mesh, ep_axis, top_k) if is_moe else None
     p_shard = param_shardings(mesh, params, ep_axis)

@@ -54,14 +54,46 @@ def _online_block(q, k, v, mask, m_prev, l_prev, o_prev, scale):
     return m_new, l_new, o_new
 
 
+def _online_block_chunked(q, k, v, mask, m_prev, l_prev, o_prev, scale,
+                          chunk: int):
+    """Same recurrence, but scanning K/V in ``chunk``-sized pieces so the
+    live score tensor is [B,H,Tq,chunk] instead of [B,H,Tq,Tk] — the
+    HBM-bounding path for long local sequences (the in-shard analogue of
+    the ring's cross-shard blocking)."""
+    tk = k.shape[1]
+    if chunk <= 0 or tk % chunk:
+        raise ValueError(
+            f"ring attention: kv_chunk must be a positive divisor of the "
+            f"local sequence ({tk}), got {chunk}"
+        )
+    nc = tk // chunk
+    b, _, h, d = k.shape
+    kc = k.reshape(b, nc, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    vc = v.reshape(b, nc, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    maskc = mask.reshape(mask.shape[0], nc, chunk).transpose(1, 0, 2)
+
+    def step(carry, xs):
+        m, l, o = carry
+        kb, vb, mb = xs
+        m, l, o = _online_block(q, kb, vb, mb, m, l, o, scale)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(
+        step, (m_prev, l_prev, o_prev), (kc, vc, maskc)
+    )
+    return m, l, o
+
+
 def ring_attention_local(
-    q, k, v, axis_name: str, causal: bool = True, scale: Optional[float] = None
+    q, k, v, axis_name: str, causal: bool = True,
+    scale: Optional[float] = None, kv_chunk: Optional[int] = None,
 ):
     """The per-shard computation (call inside shard_map / shard-mapped jit).
 
     Sequence is sharded contiguously over ``axis_name``: shard i holds
     global positions [i*Tl, (i+1)*Tl). Returns the local output block
-    [B, Tl, H, D] in float32.
+    [B, Tl, H, D] in float32. ``kv_chunk`` bounds the live score tensor to
+    [B,H,Tl,kv_chunk] (long-context HBM control); None = whole block.
     """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -85,9 +117,13 @@ def ring_attention_local(
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = jnp.ones((tl, tl), bool)
-        m, l, o = _online_block(
-            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), mask, m, l, o, scale
-        )
+        kf, vf = kb.astype(jnp.float32), vb.astype(jnp.float32)
+        if kv_chunk is not None and kv_chunk < tl:
+            m, l, o = _online_block_chunked(
+                qf, kf, vf, mask, m, l, o, scale, kv_chunk
+            )
+        else:
+            m, l, o = _online_block(qf, kf, vf, mask, m, l, o, scale)
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return (kb, vb, m, l, o), None
@@ -100,14 +136,18 @@ def ring_attention_local(
 
 
 def make_ring_attention(
-    mesh: Mesh, axis: str = "sp", causal: bool = True
+    mesh: Mesh, axis: str = "sp", causal: bool = True,
+    kv_chunk: Optional[int] = None,
 ):
     """Jitted full-array entry: (q, k, v) [B, T, H, D] sequence-sharded over
     ``axis`` → attention output with the same sharding."""
     spec = P(None, axis, None, None)
 
     fn = jax.shard_map(
-        functools.partial(ring_attention_local, axis_name=axis, causal=causal),
+        functools.partial(
+            ring_attention_local, axis_name=axis, causal=causal,
+            kv_chunk=kv_chunk,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

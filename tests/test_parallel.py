@@ -49,6 +49,38 @@ class TestRingAttention:
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(g), np.asarray(gd), atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_kv_chunked_matches_dense(self, causal):
+        """kv_chunk bounds the in-shard score tensor; numerics must match
+        the unchunked ring and the dense reference."""
+        mesh = make_mesh(8, axes=("sp",))
+        q, k, v = _qkv(np.random.default_rng(3))
+        out_c = ra.make_ring_attention(mesh, "sp", causal=causal, kv_chunk=2)(
+            q, k, v
+        )
+        out_dense = ra.dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out_c, out_dense, atol=2e-5)
+
+    def test_kv_chunk_must_divide(self):
+        with pytest.raises(ValueError, match="chunk"):
+            b = jnp.zeros((1, 6, 1, 4), jnp.float32)
+            ra._online_block_chunked(
+                b, b, b, jnp.ones((6, 6), bool),
+                jnp.full((1, 1, 6), ra.NEG_INF), jnp.zeros((1, 1, 6)),
+                jnp.zeros((1, 6, 1, 4)), 0.5, chunk=4,
+            )
+
+    def test_kv_chunk_rejects_nonpositive(self):
+        mesh = make_mesh(8, axes=("sp",))
+        q, k, v = _qkv(np.random.default_rng(4), t=16, h=1, d=4)
+        with pytest.raises(ValueError, match="positive divisor"):
+            ra.make_ring_attention(mesh, "sp", kv_chunk=0)(q, k, v)
+
+    def test_kv_chunk_rejected_for_ulysses(self):
+        mesh = make_mesh(8, axes=("dp", "sp"), shape=(2, 4))
+        with pytest.raises(ValueError, match="ring"):
+            lm._make_attn_fn(mesh, "ulysses", "dp", "sp", kv_chunk=4)
+
     def test_fully_masked_rows_are_zero(self):
         # row 0 of a causal block attends only to itself; a remote-only
         # shard sees fully-masked blocks and must contribute exact zeros
@@ -162,6 +194,29 @@ class TestLMTrainStep:
         dense = tfm.apply(params, x, 4)
         ring = jax.jit(lambda t: tfm.apply(params, t, 4, attn_fn=attn))(x)
         np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=1e-4)
+
+    def test_kv_chunked_train_step_matches_unchunked(self):
+        """kv_chunk is a memory knob, not a numerics knob: the sequence-
+        parallel forward with chunked in-shard attention equals dense."""
+        mesh = make_mesh(8, axes=("dp", "sp", "ep"), shape=(2, 2, 2))
+        params = lm.init_lm_params(
+            jax.random.PRNGKey(3), vocab=64, d_model=32, n_heads=4, n_layers=2
+        )
+        attn = lm._make_attn_fn(mesh, "ring", "dp", "sp", kv_chunk=4)
+        x = jnp.asarray(
+            np.random.default_rng(11).integers(0, 64, (4, 16)), jnp.int32
+        )
+        dense = tfm.apply(params, x, 4)
+        ring = jax.jit(lambda t: tfm.apply(params, t, 4, attn_fn=attn))(x)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), atol=1e-4)
+        step, sparams = lm.make_lm_train_step(
+            mesh, params, n_heads=4, kv_chunk=4
+        )
+        toks = jnp.asarray(
+            np.random.default_rng(12).integers(0, 64, (4, 17)), jnp.int32
+        )
+        _, loss = step(sparams, toks)
+        assert np.isfinite(float(loss))
 
     def test_ulysses_attn_kind(self):
         mesh = make_mesh(8, axes=("dp", "sp"), shape=(2, 4))
