@@ -328,6 +328,28 @@ def _run() -> None:
     )
 
 
+def _tunnel_alive():
+    """Cheap liveness probe for the remote-accelerator relay. When the
+    relay is dead the axon client retries connect forever and
+    jax.devices() blocks indefinitely — burning every retry window.
+    Returns None when the topology is unknown (don't gate)."""
+    ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if not ips:
+        return None
+    import socket
+
+    hosts = [h.strip() for h in ips.split(",") if h.strip()]
+    for _ in range(3):
+        for host in hosts:  # any live pool member counts
+            try:
+                socket.create_connection((host, 8082), timeout=2).close()
+                return True
+            except OSError:
+                pass
+        time.sleep(2)
+    return False
+
+
 def main() -> None:
     if "--run" in sys.argv:
         return _run()
@@ -347,6 +369,13 @@ def main() -> None:
         (30, {}, 420),
         (5, {"BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}, 600),
     ]
+    if _tunnel_alive() is False:
+        print(
+            "[bench] accelerator relay unreachable; skipping straight to "
+            "the CPU diagnostic attempt",
+            file=sys.stderr,
+        )
+        attempts = [(0, *attempts[-1][1:])]  # no backoff delay needed
     last_tail = ""
     for delay, extra, attempt_timeout in attempts:
         if delay:
