@@ -339,14 +339,15 @@ def _tunnel_alive():
     import socket
 
     hosts = [h.strip() for h in ips.split(",") if h.strip()]
-    for _ in range(3):
+    for attempt in range(3):
         for host in hosts:  # any live pool member counts
             try:
                 socket.create_connection((host, 8082), timeout=2).close()
                 return True
             except OSError:
                 pass
-        time.sleep(2)
+        if attempt < 2:
+            time.sleep(2)
     return False
 
 

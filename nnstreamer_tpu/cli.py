@@ -20,13 +20,9 @@ import os
 import sys
 import time
 
-# Honor an explicit JAX_PLATFORMS pin even when a site hook force-set
-# jax.config after import (config outranks the env var): a user asking for
-# cpu must never block on an unavailable accelerator attachment.
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from nnstreamer_tpu.platform_pin import honor_jax_platforms_env
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_jax_platforms_env()
 
 
 def _inspect(name: str | None) -> int:

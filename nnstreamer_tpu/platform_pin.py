@@ -1,0 +1,20 @@
+"""Honor an explicit JAX_PLATFORMS env pin.
+
+A site hook may force-set the hardware platform via ``jax.config``
+(which outranks the env var); a user who asked for ``JAX_PLATFORMS=cpu``
+must never block on an unavailable accelerator attachment. One shared
+implementation for the CLI and every example — call before the first
+device operation (jax backend init is lazy, so import order is enough).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -30,6 +30,14 @@ PIPELINES = {
         'tensor_transform mode=arithmetic option="add:1,mul:2" ! '
         "filesink location={out}"
     ),
+    # per-channel arithmetic constants (transform_arithmetic per-channel
+    # cases: add:N@CH applies to one channel index)
+    "transform_per_channel": (
+        "videotestsrc pattern=counter num-frames=2 width=4 height=4 ! "
+        "tensor_converter ! tensor_transform mode=arithmetic "
+        'option="typecast:float32,per-channel:true@0,add:100@0,mul:2@2" ! '
+        "filesink location={out}"
+    ),
     # remaining transform suites (reference tests/transform_{clamp,stand,
     # dimchg}/runTest.sh)
     "transform_clamp": (
