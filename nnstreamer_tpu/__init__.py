@@ -23,7 +23,7 @@ Design (TPU-first, not a port):
   reference's host TCP/MQTT "among-device" layer for intra-slice traffic.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from nnstreamer_tpu.tensors.spec import (  # noqa: F401
     DType,

@@ -65,6 +65,11 @@ def main(argv=None) -> int:
         help="capture an on-device (XLA/TPU) profile into a TensorBoard logdir",
     )
     ap.add_argument("--quiet", "-q", action="store_true")
+    from nnstreamer_tpu import __version__
+
+    ap.add_argument(
+        "--version", action="version", version=f"nns-launch {__version__}"
+    )
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
