@@ -325,20 +325,29 @@ void nns_edge_close(Handle *h) {
     std::lock_guard<std::mutex> lk(h->q_mu);
     h->running.store(false);
   }
-  if (h->listen_fd >= 0) {
-    ::shutdown(h->listen_fd, SHUT_RDWR);
-    ::close(h->listen_fd);
-  }
+  // Teardown order matters on three counts:
+  // 1. join the ACCEPTOR before sweeping conns — it may be past accept()
+  //    with a fresh fd and insert it right after a sweep, leaving a
+  //    reader on a never-shutdown socket (close would hang on its join);
+  // 2. shutdown() conn fds but close() them only after their reader
+  //    threads have RETURNED from recv and been joined — close while a
+  //    thread is inside recv(fd) frees the fd number for kernel reuse
+  //    and the woken thread could touch an unrelated fd (TSAN flags it);
+  // 3. do NOT route these fds through dead_fds: its invariant is that
+  //    pushed fds are no longer used by their reader, and send/acceptor
+  //    drains may run before the joins below.
+  if (h->listen_fd >= 0) ::shutdown(h->listen_fd, SHUT_RDWR);
+  if (h->acceptor.joinable()) h->acceptor.join();
+  std::vector<int> conn_fds;
   {
     std::lock_guard<std::mutex> lk(h->conn_mu);
     for (auto &kv : h->conns) {
       ::shutdown(kv.second, SHUT_RDWR);
-      ::close(kv.second);
+      conn_fds.push_back(kv.second);
     }
     h->conns.clear();
   }
   h->q_cv.notify_all();
-  if (h->acceptor.joinable()) h->acceptor.join();
   // join outside conn_mu: a reader may be blocked on conn_mu erasing itself
   std::vector<std::thread> readers;
   {
@@ -347,6 +356,9 @@ void nns_edge_close(Handle *h) {
   }
   for (auto &t : readers)
     if (t.joinable()) t.join();
+  // readers are gone: now the fd numbers are safe to release
+  for (int fd : conn_fds) ::close(fd);
+  if (h->listen_fd >= 0) ::close(h->listen_fd);
   {
     std::lock_guard<std::mutex> lk(h->send_mu);
     h->drain_dead_fds_locked();

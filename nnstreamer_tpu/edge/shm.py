@@ -38,7 +38,15 @@ def _load() -> ctypes.CDLL:
         raise TransportError(
             "native shm transport unavailable (g++ build failed)"
         )
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as exc:
+        # e.g. a sanitizer build: libtsan needs LD_PRELOAD to dlopen into
+        # an uninstrumented interpreter (static TLS)
+        raise TransportError(
+            f"native shm transport failed to load: {exc} "
+            "(sanitizer builds need LD_PRELOAD of the sanitizer runtime)"
+        )
     lib.nns_shm_create.restype = ctypes.c_void_p
     lib.nns_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.nns_shm_open.restype = ctypes.c_void_p
