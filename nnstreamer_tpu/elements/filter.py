@@ -27,7 +27,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.backends.base import Backend, FilterProps
+from nnstreamer_tpu.backends.base import Backend, FilterProps, InvokeStats
 from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
@@ -167,6 +167,12 @@ class TensorFilter(TensorOp):
         )
         self.backend: Optional[Backend] = None
         self._traceable: Optional[Callable] = None
+        # Per-ELEMENT invoke stats, like the reference's (latency/
+        # throughput live in the element private data, tensor_filter.c:
+        # 334-433) — backends keep their own cumulative stats (the
+        # per-framework statistics analogue), but filters sharing one
+        # backend must not report each other's invokes as their own.
+        self._elem_stats = InvokeStats()
 
     # -- lifecycle ---------------------------------------------------------
     def _open_backend(self) -> Backend:
@@ -304,19 +310,31 @@ class TensorFilter(TensorOp):
         return self._apply_combinations(traced)
 
     def host_process(self, frame: Frame) -> Frame:
+        import time as _time
+
         b = self._ensure_open()
         fn = self._apply_combinations(b.invoke_timed)
         lock = getattr(b, "shared_invoke_lock", None)
+        t0 = _time.perf_counter_ns()
         if lock is not None:
             with lock:
-                return frame.with_tensors(fn(frame.tensors))
-        return frame.with_tensors(fn(frame.tensors))
+                out = fn(frame.tensors)
+        else:
+            out = fn(frame.tensors)
+        self._elem_stats.record(_time.perf_counter_ns() - t0)
+        return frame.with_tensors(out)
 
     # -- stats (reference read-only latency/throughput props) -------------
     @property
+    def invoke_stats(self) -> InvokeStats:
+        """This element's own invokes only (survives teardown; sharers of
+        one backend do not see each other's numbers)."""
+        return self._elem_stats
+
+    @property
     def latency_us(self) -> float:
-        return self.backend.stats.latency_us if self.backend else 0.0
+        return self._elem_stats.latency_us
 
     @property
     def throughput_fps(self) -> float:
-        return self.backend.stats.throughput_fps if self.backend else 0.0
+        return self._elem_stats.throughput_fps

@@ -425,10 +425,19 @@ class Executor:
 
     # -- introspection (per-element proctime, §5.1 parity) ----------------
     def stats(self) -> Dict[str, Dict[str, float]]:
-        return {
-            n.name: {
+        out = {}
+        for n in self.nodes:
+            s: Dict[str, float] = {
                 "frames": n.frames_processed,
                 "proc_ms_ema": round(n.proc_time_ema_ms, 3),
             }
-            for n in self.nodes
-        }
+            # filter invoke stats (reference latency/throughput read-only
+            # properties, tensor_filter.c:334-433) surface per element
+            elem = getattr(n, "elem", None)
+            istats = getattr(elem, "invoke_stats", None)
+            if istats is not None and istats.total_invoke_num:
+                s["invoke_count"] = istats.total_invoke_num
+                s["invoke_latency_us"] = round(istats.latency_us, 1)
+                s["invoke_throughput_fps"] = round(istats.throughput_fps, 1)
+            out[n.name] = s
+        return out

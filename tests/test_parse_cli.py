@@ -114,3 +114,36 @@ class TestCLI:
         rc = main(["--dot", "tensorsrc dimensions=2 ! tensor_sink"])
         assert rc == 0
         assert "digraph" in capsys.readouterr().out
+
+
+def test_stats_include_filter_invoke_metrics(tmp_path):
+    """--stats surfaces the filter's invoke count/latency/throughput
+    (reference tensor_filter latency/throughput read-only props,
+    tensor_filter.c:334-433), surviving pipeline teardown."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "ident.py"
+    script.write_text(
+        "import numpy as np\n"
+        "class CustomFilter:\n"
+        "    def setInputDim(self, s):\n"
+        "        return s\n"
+        "    def invoke(self, ts):\n"
+        "        return tuple(np.asarray(t) for t in ts)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu.cli",
+         "videotestsrc num-frames=3 width=4 height=4 ! tensor_converter ! "
+         f"tensor_filter framework=custom model={script} ! tensor_sink",
+         "--stats", "-q"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-400:]
+    stats = json.loads(proc.stdout)
+    filt = next(v for k, v in stats.items() if k.startswith("tensor_filter"))
+    assert filt["invoke_count"] == 3
+    assert filt["invoke_latency_us"] > 0

@@ -92,3 +92,32 @@ def test_reload_visible_to_all_sharers(tmp_path):
     finally:
         a.stop()
         b.stop()
+
+
+def test_shared_backend_stats_are_per_element():
+    """Sharers of one backend must not report each other's invokes
+    (reference: latency/throughput live per element, tensor_filter.c:334)."""
+    import numpy as np
+
+    from nnstreamer_tpu.tensors.frame import Frame
+
+    a = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "ks"})
+    b = TensorFilter(framework="scaler", **{"shared-tensor-filter-key": "ks"})
+    try:
+        a.negotiate([_spec()])
+        b.negotiate([_spec()])
+        f = Frame((np.ones(4, np.float32),))
+        for _ in range(3):
+            a.host_process(f)
+        b.host_process(f)
+        assert a.invoke_stats.total_invoke_num == 3
+        assert b.invoke_stats.total_invoke_num == 1
+        # the shared backend keeps the cumulative per-framework view
+        assert a.backend.stats.total_invoke_num == 4
+        a.stop()
+        before = a.invoke_stats.total_invoke_num
+        b.host_process(f)  # other sharer keeps running
+        assert a.invoke_stats.total_invoke_num == before  # frozen view
+    finally:
+        a.stop()
+        b.stop()
