@@ -24,6 +24,7 @@ all). Read-only: latency, throughput.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu import registry
@@ -310,18 +311,21 @@ class TensorFilter(TensorOp):
         return self._apply_combinations(traced)
 
     def host_process(self, frame: Frame) -> Frame:
-        import time as _time
-
         b = self._ensure_open()
         fn = self._apply_combinations(b.invoke_timed)
         lock = getattr(b, "shared_invoke_lock", None)
-        t0 = _time.perf_counter_ns()
+        # time inside the shared lock so per-element stats report this
+        # element's invoke, not other sharers' lock-wait
         if lock is not None:
             with lock:
+                t0 = time.perf_counter_ns()
                 out = fn(frame.tensors)
+                dt = time.perf_counter_ns() - t0
         else:
+            t0 = time.perf_counter_ns()
             out = fn(frame.tensors)
-        self._elem_stats.record(_time.perf_counter_ns() - t0)
+            dt = time.perf_counter_ns() - t0
+        self._elem_stats.record(dt)
         return frame.with_tensors(out)
 
     # -- stats (reference read-only latency/throughput props) -------------

@@ -25,9 +25,13 @@ from nnstreamer_tpu.parallel.ring_attention import dense_attention
 
 
 def rmsnorm(x, w, eps: float = 1e-6):
+    # Normalize in f32, apply the (f32) weight in f32, THEN cast back —
+    # casting before the weight multiply would promote bf16 x back to f32
+    # (breaking scan carry dtypes and silently running the block matmuls
+    # off the bf16 MXU path).
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (x32 * scale).astype(x.dtype) * w
+    return (x32 * scale * w).astype(x.dtype)
 
 
 def rope(x, positions, base: float = 10000.0):

@@ -242,3 +242,33 @@ def test_vit_forward_finite():
 def test_vit_patch_divisibility_enforced():
     with pytest.raises(ValueError, match="divisible"):
         zoo.get("vit", size="65", patch="16")
+
+
+# bench.py runs every model below in bfloat16 on the real chip; a dtype
+# promotion anywhere in a scan carry (the round-2 rmsnorm bug: bf16 * f32
+# weight → f32 carry) is a trace-time error, so eval_shape catches it
+# without compiling.
+@pytest.mark.parametrize(
+    "name,options",
+    [
+        ("mobilenet_v2", {}),
+        ("ssd_mobilenet_v2", {}),
+        ("ssd_mobilenet_v2_pp", {}),
+        ("posenet", {}),
+        ("deeplab_v3", {}),
+        ("face_detect", {}),
+        ("face_composite", {}),
+        ("vit", dict(size="64", patch="16", d_model="64", n_heads="4",
+                     n_layers="2")),
+        ("transformer_lm", dict(vocab="512", d_model="64", n_heads="4",
+                                n_layers="2")),
+        ("transformer_lm", dict(vocab="512", d_model="64", n_heads="4",
+                                n_layers="2", generate="4", seqlen="16")),
+    ],
+)
+def test_zoo_traces_in_bfloat16(name, options):
+    m = zoo.get(name, compute_dtype="bfloat16", **options)
+    dummies = [
+        jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype) for t in m.input_spec
+    ]
+    jax.eval_shape(m.fn, *dummies)
