@@ -212,6 +212,31 @@ def _run() -> None:
         # primary diagnostics only
         return (not on_tpu) or time.perf_counter() - run_start > soft_budget
 
+    # batched-ingest variant: fresh host frames, but 8 per transfer (the
+    # converter's frames-per-tensor batching) — one device_put per invoke
+    # amortizes the per-transfer cost that bounds the per-frame H2D number
+    # above (dominant when the device is tunnel-attached).
+    h2d_b8_fps = None
+    if not _over_budget():
+        host8 = [
+            np.ascontiguousarray(
+                rng.integers(0, 255, (mb, 224, 224, 3), np.uint8)
+            )
+            for _ in range(4)
+        ]
+        iters_b = 128
+        out = None
+        t0 = time.perf_counter()
+        for i in range(iters_b):
+            x = jax.device_put(host8[i % 4], dev)
+            out = fn8(x)
+            if (i + 1) % 32 == 0:
+                out.block_until_ready()
+        out.block_until_ready()
+        h2d_b8_fps = iters_b * mb / (time.perf_counter() - t0)
+
+    _mark("h2d-batched8 measured")
+
     # composite face→crop→landmark pipeline (BASELINE config #5) through
     # the real pipeline executor; on a single chip both stages share the
     # device, on a slice they pin via custom="device:N"
@@ -294,15 +319,64 @@ def _run() -> None:
         lm_tok_s = iters_lm * 64 / (time.perf_counter() - t0)
 
     _mark("lm measured")
+    # deep microbatch: 32 frames/invoke — past the dispatch-bound knee,
+    # so this is the number that reflects device compute, not per-call
+    # overhead (and the MFU that is fair to judge the chip against)
+    mb32_fps = None
+    mb32 = 32
+    if not _over_budget():
+        m32 = zoo.get(
+            "mobilenet_v2", batch=str(mb32), compute_dtype="bfloat16"
+        )
+        fn32 = jax.jit(m32.fn)
+        frames32 = [
+            jnp.asarray(rng.integers(0, 255, (mb32, 224, 224, 3), np.uint8))
+            for _ in range(2)
+        ]
+        jax.block_until_ready(fn32(frames32[0]))
+        iters32 = 64
+        t0 = time.perf_counter()
+        out = None
+        for i in range(iters32):
+            out = fn32(frames32[i % 2])
+            if (i + 1) % 16 == 0:
+                out.block_until_ready()
+        out.block_until_ready()
+        mb32_fps = iters32 * mb32 / (time.perf_counter() - t0)
+
+    _mark("mb32 measured")
+    # int8 serving path (models/quantize.py): the reference's
+    # *_quant.tflite slot on the MXU's s8×s8→s32 units — same microbatch
+    # as mb8 so the two numbers isolate the dtype effect
+    int8_fps = None
+    if not _over_budget():
+        mi8 = zoo.get("mobilenet_v2", quantize="int8", batch=str(mb))
+        fni8 = jax.jit(mi8.fn)
+        jax.block_until_ready(fni8(frames8[0]))
+        iters_i = 256
+        t0 = time.perf_counter()
+        out = None
+        for i in range(iters_i):
+            out = fni8(frames8[i % 4])
+            if (i + 1) % 64 == 0:
+                out.block_until_ready()
+        out.block_until_ready()
+        int8_fps = iters_i * mb / (time.perf_counter() - t0)
+
+    _mark("int8 measured")
     # achieved MFU from XLA cost analysis + public per-chip peak
     flops = _flops_per_frame(m.fn, frames[0])
     peak = _peak_tflops(str(dev.device_kind))
-    mfu = mfu8 = None
+    mfu = mfu8 = mfu32 = None
     if flops and peak:
         mfu = fps * flops / (peak * 1e12)
         flops8 = _flops_per_frame(m8.fn, frames8[0])
         if flops8:
             mfu8 = mb_fps * (flops8 / mb) / (peak * 1e12)
+        if mb32_fps:
+            flops32 = _flops_per_frame(m32.fn, frames32[0])
+            if flops32:
+                mfu32 = mb32_fps * (flops32 / mb32) / (peak * 1e12)
 
     print(
         json.dumps(
@@ -314,13 +388,17 @@ def _run() -> None:
                 "p50_sync_latency_ms": round(p50, 3),
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
                 "h2d_streaming_fps": round(h2d_fps, 1),
+                "h2d_batched8_fps": _round(h2d_b8_fps),
                 "microbatch8_fps": round(mb_fps, 1),
+                "microbatch32_fps": _round(mb32_fps),
+                "int8_mb8_fps": _round(int8_fps),
                 "composite_face_fps": _round(composite_fps),
                 "composite_fused_fps": _round(fused_fps),
                 "lm_decode_tok_s": _round(lm_tok_s),
                 "flops_per_frame": flops,
                 "mfu_bs1": round(mfu, 4) if mfu is not None else None,
                 "mfu_mb8": round(mfu8, 4) if mfu8 is not None else None,
+                "mfu_mb32": round(mfu32, 4) if mfu32 is not None else None,
                 "platform": dev.platform,
                 "device": str(dev.device_kind),
             }

@@ -3,9 +3,11 @@
 Reference: gst/nnstreamer/elements/gsttensor_converter.c (chain :1015,
 media-type dispatch :1046-1270). Direct converters for video/audio/text/
 octet media, flexible→static, plus converter subplugins (mode=) for
-arbitrary formats. This is the host→device boundary: output tensors are
-handed (as tight numpy arrays) to the first fused XLA segment, which
-uploads once — no per-element map/unmap.
+arbitrary formats, plus in-process custom callbacks
+(``mode=custom-code:<name>``, the nnstreamer_converter_custom_register
+analogue — :1220-1250 _NNS_MEDIA_ANY dispatch). This is the host→device
+boundary: output tensors are handed (as tight numpy arrays) to the first
+fused XLA segment, which uploads once — no per-element map/unmap.
 
 Video: HWC uint8 → (frames-per-tensor, H, W, C); the reference's innermost-
 first dim string C:W:H:N describes the same canonical NHWC layout.
@@ -15,7 +17,8 @@ partial batch at EOS is dropped like leftover adapter bytes.
 
 from __future__ import annotations
 
-from typing import List, Optional, Union
+import threading
+from typing import Callable, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -28,6 +31,24 @@ from nnstreamer_tpu.elements.base import (
 )
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import DType, TensorFormat, TensorSpec, TensorsSpec
+
+_custom_lock = threading.Lock()
+_custom_converters: Dict[str, Callable] = {}
+
+
+def register_custom_converter(
+    name: str, fn: Callable[[Frame, dict], Frame]
+) -> None:
+    """nnstreamer_converter_custom_register analogue: an in-process
+    callable ``fn(frame, props) -> Frame`` invoked per input buffer.
+    Output frames are self-describing (format=flexible) downstream."""
+    with _custom_lock:
+        _custom_converters[name] = fn
+
+
+def unregister_custom_converter(name: str) -> bool:
+    with _custom_lock:
+        return _custom_converters.pop(name, None) is not None
 
 
 @registry.element("tensor_converter")
@@ -43,10 +64,31 @@ class TensorConverter(HostElement):
         self._batch: List[np.ndarray] = []
         self._batch_pts = None
         self._subplugin = None
+        self._custom_fn = None
 
     # -- negotiation -------------------------------------------------------
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs
+        if self.mode and self.mode.startswith("custom-code"):
+            _, _, name = self.mode.partition(":")
+            with _custom_lock:
+                fn = _custom_converters.get(name)
+            if fn is None:
+                raise NegotiationError(
+                    f"{self.name}: custom converter {name!r} not registered"
+                )
+            self._custom_fn = fn
+            # custom callbacks declare no static shape; frames are
+            # self-describing (the reference emits flexible caps here too)
+            rate = getattr(spec, "rate", None)
+            return [TensorsSpec(format=TensorFormat.FLEXIBLE, rate=rate)]
+        if self.mode and self.mode.startswith("custom-script"):
+            # reference spelling for the python script converter:
+            # mode=custom-script:<path.py> (gsttensor_converter.c mode prop)
+            _, _, path = self.mode.partition(":")
+            if path:
+                self.props.setdefault("script", path)
+            self.mode = "python3"
         if self.mode:
             self._subplugin = registry.get(registry.KIND_CONVERTER, self.mode)
             sub = self._subplugin() if isinstance(self._subplugin, type) else self._subplugin
@@ -95,6 +137,8 @@ class TensorConverter(HostElement):
 
     # -- streaming ---------------------------------------------------------
     def process(self, frame: Frame) -> Union[Frame, List[Frame], None]:
+        if self._custom_fn is not None:
+            return self._custom_fn(frame, dict(self.props))
         if self._subplugin is not None:
             return self._subplugin.convert(frame, dict(self.props))
         in_spec = self.in_specs[0]

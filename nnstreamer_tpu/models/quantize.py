@@ -1,0 +1,195 @@
+"""Post-training int8 quantization for the flagship conv family.
+
+The reference's canonical model is mobilenet_v2_1.0_224_quant.tflite — a
+*quantized* network executed by TFLite's int8 kernels
+(tensor_filter_tensorflow_lite.cc). The TPU-native equivalent is not a
+tflite-flatbuffer interpreter but an int8 compute path on the MXU: v5e/v6e
+run s8×s8→s32 matmuls at 2× the bf16 rate, so the win lands exactly where
+the FLOPs are.
+
+Design (TPU-first, not a tflite emulation):
+- **BN folding**: conv+batchnorm collapse to conv+bias before quantizing
+  (standard inference transform; the tflite converter does the same).
+- **Weights**: per-output-channel symmetric int8 (scale = maxabs/127).
+- **Activations**: per-tensor symmetric int8, scales calibrated by running
+  sample batches through the folded fp32 model and recording maxabs at
+  every quantization point.
+- **What gets int8**: the 1×1 convs (expand/project/head — ~95% of
+  MobileNet FLOPs) lowered as ``lax.dot_general`` s8×s8→s32, the form XLA
+  maps straight onto the MXU. Depthwise 3×3 and the stem stay float:
+  depthwise convs run on the VPU where int8 buys nothing, and keeping them
+  float avoids requant noise for <5% of FLOPs. This split is the *point*
+  of a TPU redesign — quantize where the systolic array pays, not
+  everywhere the wire format demands.
+
+Everything stays one XLA program: quant/requant are elementwise ops fused
+into the surrounding convs by the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import nn
+from nnstreamer_tpu.models.mobilenet_v2 import (
+    _block_strides,
+    normalize_uint8,
+)
+
+
+def fold_bn(w, bn: Dict, eps: float = 1e-3) -> Tuple[jax.Array, jax.Array]:
+    """conv(w) → batch_norm(bn) ≡ conv(w·inv) + b  (inference moments)."""
+    inv = bn["scale"] * jax.lax.rsqrt(bn["var"] + eps)  # [cout]
+    return w * inv, bn["bias"] - bn["mean"] * inv
+
+
+def fold_mobilenet(params: Dict) -> Dict:
+    """Fold every conv+BN pair of a MobileNet-v2 params tree into (w, b)."""
+    out: Dict = {}
+    out["stem"] = dict(zip(("w", "b"), fold_bn(params["stem"]["w"], params["stem"]["bn"])))
+    blocks = []
+    for blk in params["blocks"]:
+        fb: Dict = {}
+        for part in ("expand", "dw", "project"):
+            if part in blk:
+                fb[part] = dict(zip(("w", "b"), fold_bn(blk[part]["w"], blk[part]["bn"])))
+        blocks.append(fb)
+    out["blocks"] = blocks
+    out["head"] = dict(zip(("w", "b"), fold_bn(params["head"]["w"], params["head"]["bn"])))
+    out["classifier"] = params["classifier"]
+    return out
+
+
+def _conv1x1(x, w):
+    """1×1 conv as a channel contraction (float path)."""
+    return jax.lax.dot_general(x, w[0, 0], (((x.ndim - 1,), (0,)), ((), ())))
+
+
+def _folded_forward(folded: Dict, x, collect: List):
+    """fp32 forward of the folded model, appending the maxabs of every
+    quantization-point input to ``collect`` (the calibration taps)."""
+    y = nn.relu6(nn.conv2d(x, folded["stem"]["w"], stride=2) + folded["stem"]["b"])
+    for blk, stride in zip(folded["blocks"], _block_strides()):
+        r = y
+        if "expand" in blk:
+            collect.append(jnp.max(jnp.abs(y)))
+            y = nn.relu6(_conv1x1(y, blk["expand"]["w"]) + blk["expand"]["b"])
+        y = nn.relu6(
+            nn.conv2d(y, blk["dw"]["w"], stride=stride, groups=y.shape[-1])
+            + blk["dw"]["b"]
+        )
+        collect.append(jnp.max(jnp.abs(y)))
+        y = _conv1x1(y, blk["project"]["w"]) + blk["project"]["b"]
+        if stride == 1 and y.shape[-1] == r.shape[-1]:
+            y = y + r
+    collect.append(jnp.max(jnp.abs(y)))
+    y = nn.relu6(_conv1x1(y, folded["head"]["w"]) + folded["head"]["b"])
+    return y
+
+
+def calibrate_mobilenet(folded: Dict, batches) -> jax.Array:
+    """Run uint8 sample batches through the folded fp32 model; return the
+    per-quant-point activation scales [n_points] (maxabs/127)."""
+
+    @jax.jit
+    def taps_of(img):
+        collect: List = []
+        _folded_forward(folded, normalize_uint8(img), collect)
+        return jnp.stack(collect)
+
+    maxes = None
+    for img in batches:
+        t = taps_of(img)
+        maxes = t if maxes is None else jnp.maximum(maxes, t)
+    if maxes is None:
+        raise ValueError("calibrate_mobilenet: need at least one calibration batch")
+    return jnp.maximum(maxes, 1e-6) / 127.0
+
+
+def _quantize_w(w) -> Tuple[jax.Array, jax.Array]:
+    """Per-output-channel symmetric int8 for a 1×1 conv kernel [1,1,I,O]."""
+    m = jnp.maximum(jnp.max(jnp.abs(w), axis=(0, 1, 2)), 1e-8)  # [O]
+    scale = m / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q[0, 0], scale  # [I,O], [O]
+
+
+def quantize_mobilenet(folded: Dict, act_scales) -> Dict:
+    """Folded fp32 tree → int8 serving tree (1×1 convs quantized)."""
+    q: Dict = {"stem": folded["stem"], "classifier": folded["classifier"]}
+    idx = 0
+    blocks = []
+    for blk in folded["blocks"]:
+        qb: Dict = {"dw": blk["dw"]}
+        if "expand" in blk:
+            w8, sw = _quantize_w(blk["expand"]["w"])
+            qb["expand"] = {
+                "w8": w8, "wscale": sw, "b": blk["expand"]["b"],
+                "ascale": act_scales[idx],
+            }
+            idx += 1
+        w8, sw = _quantize_w(blk["project"]["w"])
+        qb["project"] = {
+            "w8": w8, "wscale": sw, "b": blk["project"]["b"],
+            "ascale": act_scales[idx],
+        }
+        idx += 1
+        blocks.append(qb)
+    q["blocks"] = blocks
+    w8, sw = _quantize_w(folded["head"]["w"])
+    q["head"] = {
+        "w8": w8, "wscale": sw, "b": folded["head"]["b"],
+        "ascale": act_scales[idx],
+    }
+    return q
+
+
+def _q_conv1x1(x, qc: Dict):
+    """Quantize the activation, contract s8×s8→s32 on the MXU, dequantize.
+    The quant/dequant elementwise ops fuse into the dot's prologue/epilogue.
+    Quant/dequant math runs in f32 regardless of the carry dtype (scales
+    stay exact); the result is cast back to the carry dtype."""
+    ascale = qc["ascale"].astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / ascale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        q, qc["w8"], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * (ascale * qc["wscale"]) + qc["b"]
+    return out.astype(x.dtype)
+
+
+def apply_int8(qparams: Dict, x, compute_dtype=jnp.float32):
+    """uint8 NHWC batch → logits [N, classes], 1×1 convs in int8.
+
+    ``compute_dtype`` governs the float remainder (stem, depthwise convs,
+    pool/classifier); params and quantization scales stay f32 — weights
+    are cast at trace time, which XLA constant-folds."""
+    if x.dtype == jnp.uint8:
+        x = normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+
+    def w(a):
+        return a.astype(compute_dtype)
+
+    y = nn.relu6(
+        nn.conv2d(x, w(qparams["stem"]["w"]), stride=2) + w(qparams["stem"]["b"])
+    )
+    for blk, stride in zip(qparams["blocks"], _block_strides()):
+        r = y
+        if "expand" in blk:
+            y = nn.relu6(_q_conv1x1(y, blk["expand"]))
+        y = nn.relu6(
+            nn.conv2d(y, w(blk["dw"]["w"]), stride=stride, groups=y.shape[-1])
+            + w(blk["dw"]["b"])
+        )
+        y = _q_conv1x1(y, blk["project"])
+        if stride == 1 and y.shape[-1] == r.shape[-1]:
+            y = y + r
+    y = nn.relu6(_q_conv1x1(y, qparams["head"]))
+    y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
+    return nn.dense(y, qparams["classifier"]).astype(jnp.float32)

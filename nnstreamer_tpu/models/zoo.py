@@ -92,6 +92,30 @@ def _mobilenet_v2(**options) -> ZooModel:
     )
     params = _load_params_overlay(params, options)
 
+    if options.get("quantize") == "int8":
+        # the reference's *_quant.tflite slot, redesigned for the MXU's
+        # s8×s8→s32 path (models/quantize.py): fold BN, calibrate
+        # activation scales on seeded sample batches, serve int8
+        from nnstreamer_tpu.models import quantize as qz
+
+        folded = qz.fold_mobilenet(params)
+        rng = np.random.default_rng(seed)
+        calib = [
+            jnp.asarray(rng.integers(0, 255, (batch, size, size, 3), np.uint8))
+            for _ in range(int(options.get("calib_batches", 2)))
+        ]
+        qparams = qz.quantize_mobilenet(
+            folded, qz.calibrate_mobilenet(folded, calib)
+        )
+        def q_apply(p, image):
+            return qz.apply_int8(p, image, compute_dtype=compute_dtype)
+
+        def q_fn(image):
+            return q_apply(qparams, image)
+
+        spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
+        return ZooModel("mobilenet_v2", q_fn, spec, qparams, q_apply)
+
     def apply_fn(p, image):
         return mobilenet_v2.apply(p, image, compute_dtype=compute_dtype)
 

@@ -252,6 +252,7 @@ def test_vit_patch_divisibility_enforced():
     "name,options",
     [
         ("mobilenet_v2", {}),
+        ("mobilenet_v2", dict(quantize="int8", size="96", num_classes="16")),
         ("ssd_mobilenet_v2", {}),
         ("ssd_mobilenet_v2_pp", {}),
         ("posenet", {}),

@@ -249,6 +249,39 @@ class TestErrorPropagation:
             unregister_custom_decoder("boom")
 
 
+class TestCustomConverter:
+    def test_custom_code_converter(self):
+        """mode=custom-code:<name> runs a registered in-process callable
+        (reference nnstreamer_converter_custom_register)."""
+        from nnstreamer_tpu.elements.converter import (
+            register_custom_converter,
+            unregister_custom_converter,
+        )
+
+        def flatten(frame, props):
+            img = np.asarray(frame.tensors[0])
+            return frame.with_tensors((img.reshape(1, -1).astype(np.int32),))
+
+        register_custom_converter("flat", flatten)
+        try:
+            src = VideoTestSrc(width=8, height=8, **{"num-frames": 3})
+            conv = TensorConverter(mode="custom-code:flat")
+            sink = TensorSink()
+            run_chain(src, conv, sink)
+            assert sink.rendered == 3
+            assert sink.frames[0].tensors[0].shape == (1, 8 * 8 * 3)
+            assert sink.frames[0].tensors[0].dtype == np.int32
+        finally:
+            unregister_custom_converter("flat")
+
+    def test_unregistered_custom_converter_fails_negotiation(self):
+        src = VideoTestSrc(width=8, height=8, **{"num-frames": 1})
+        conv = TensorConverter(mode="custom-code:nope")
+        p = Pipeline().chain(src, conv, FakeSink())
+        with pytest.raises(NegotiationError, match="not registered"):
+            p.negotiate()
+
+
 class TestAppSink:
     def test_pop_api(self):
         src = TensorSrc(dimensions="3", **{"num-frames": 3})

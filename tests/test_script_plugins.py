@@ -46,3 +46,21 @@ def test_python_script_decoder(tmp_path):
         Frame((np.ones(3, np.float32), np.zeros(2, np.float32))), opts
     )
     assert out.tensors[0].shape == (5,)
+
+
+def test_custom_script_mode_alias(tmp_path):
+    """tensor_converter mode=custom-script:<path.py> — the reference's
+    spelling — routes to the python3 converter subplugin."""
+    from nnstreamer_tpu.elements.converter import TensorConverter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.sources import TensorSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    p = tmp_path / "conv.py"
+    p.write_text(CONVERTER_SCRIPT)
+    src = TensorSrc(dimensions="10", **{"input-type": "uint8", "num-frames": 2})
+    conv = TensorConverter(mode=f"custom-script:{p}")
+    sink = TensorSink()
+    Pipeline().chain(src, conv, sink).run(timeout=30)
+    assert sink.rendered == 2
+    assert sink.frames[0].num_tensors == 2
