@@ -50,13 +50,13 @@ def prefill(
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(f"prompt length {t} > max_len {max_len}")
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = tfm.embed_lookup(params["embed"], tokens, compute_dtype)
     positions = jnp.arange(t)
     x, (ks, vs) = tfm.apply_layers(
         params["blocks"], x, n_heads, positions, ffn_fn=ffn_fn, return_kv=True
     )
     x = tfm.rmsnorm(x, params["ln_f"])
-    logits = (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
     pad = max_len - t
     cache_k = jnp.pad(
         ks.astype(compute_dtype), ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
@@ -83,7 +83,7 @@ def decode_step(
     cache_k, cache_v = cache
     max_len = cache_k.shape[2]
     b = token.shape[0]
-    x = params["embed"][token][:, None, :].astype(compute_dtype)  # [B,1,D]
+    x = tfm.embed_lookup(params["embed"], token, compute_dtype)[:, None, :]  # [B,1,D]
     positions = pos[None].astype(jnp.int32)
 
     def body(carry, layer):
@@ -99,7 +99,7 @@ def decode_step(
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
         o = o.astype(x.dtype).reshape(b, 1, -1)
-        x = x + o @ blk["wo"].astype(x.dtype)
+        x = x + o @ tfm.wt(blk["wo"], x.dtype)
         x = tfm.block_ffn(x, blk, ffn_fn)
         return x, (ck, cv)
 
@@ -107,7 +107,7 @@ def decode_step(
         body, x, (params["blocks"], cache_k, cache_v)
     )
     x = tfm.rmsnorm(x, params["ln_f"])
-    logits = (x @ params["head"].astype(x.dtype)).astype(jnp.float32)[:, 0]
+    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)[:, 0]
     return logits, (cache_k, cache_v), pos + 1
 
 

@@ -24,6 +24,25 @@ import jax.numpy as jnp
 from nnstreamer_tpu.parallel.ring_attention import dense_attention
 
 
+def wt(w, dtype):
+    """Weight fetch honoring weight-only int8 quantization
+    (models/quantize.py quantize_lm_weights): a quantized weight is
+    ``{"w8": int8 […, cout], "scale": f32 […broadcastable…]}`` and
+    dequantizes at the matmul operand — autoregressive decode is
+    HBM-bandwidth-bound, so halving/quartering the bytes per weight read
+    is a direct tok/s lever on TPU."""
+    if isinstance(w, dict) and "w8" in w:
+        return w["w8"].astype(dtype) * w["scale"].astype(dtype)
+    return w.astype(dtype)
+
+
+def embed_lookup(embed, tokens, dtype):
+    """Embedding row gather, quantization-aware (per-feature scales)."""
+    if isinstance(embed, dict) and "w8" in embed:
+        return embed["w8"][tokens].astype(dtype) * embed["scale"].astype(dtype)
+    return embed[tokens].astype(dtype)
+
+
 def rmsnorm(x, w, eps: float = 1e-6):
     # Normalize in f32, apply the (f32) weight in f32, THEN cast back —
     # casting before the weight multiply would promote bf16 x back to f32
@@ -35,13 +54,18 @@ def rmsnorm(x, w, eps: float = 1e-6):
 
 
 def rope(x, positions, base: float = 10000.0):
-    """Rotary embedding over the last dim. x [B,T,H,D], positions [T]."""
+    """Rotary embedding over the last dim. x [B,T,H,D]; positions is [T]
+    (shared across the batch) or [B,T] (per-batch — the continuous-
+    batching decode step, where every slot sits at a different depth)."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    pos = positions.astype(jnp.float32)
+    if pos.ndim == 1:
+        pos = pos[None, :]  # [1, T] broadcasts over batch
+    angles = pos[:, :, None] * freqs  # [B|1, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
@@ -92,9 +116,9 @@ def block_ffn(x, blk: Dict, ffn_fn: Optional[Callable] = None):
     y = rmsnorm(x, blk["ln2"])
     if ffn_fn is not None:
         return x + ffn_fn(y, blk).astype(x.dtype)
-    gate = jax.nn.silu(y @ blk["w_gate"].astype(y.dtype))
-    up = y @ blk["w_up"].astype(y.dtype)
-    return x + (gate * up) @ blk["w_down"].astype(y.dtype)
+    gate = jax.nn.silu(y @ wt(blk["w_gate"], y.dtype))
+    up = y @ wt(blk["w_up"], y.dtype)
+    return x + (gate * up) @ wt(blk["w_down"], y.dtype)
 
 
 def block_qkv(x, blk: Dict, n_heads: int, positions):
@@ -103,7 +127,7 @@ def block_qkv(x, blk: Dict, n_heads: int, positions):
     h = n_heads
     hd = d // h
     y = rmsnorm(x, blk["ln1"])
-    qkv = y @ blk["wqkv"].astype(y.dtype)
+    qkv = y @ wt(blk["wqkv"], y.dtype)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
     q = rope(q.reshape(b, t, h, hd), positions)
     kk = rope(kk.reshape(b, t, h, hd), positions)
@@ -130,7 +154,7 @@ def block_apply(
     b, t, d = x.shape
     q, kk, v = block_qkv(x, blk, n_heads, positions)
     o = attn(q, kk, v, causal=causal).astype(x.dtype)
-    x = x + o.reshape(b, t, d) @ blk["wo"].astype(x.dtype)
+    x = x + o.reshape(b, t, d) @ wt(blk["wo"], x.dtype)
     x = block_ffn(x, blk, ffn_fn)
     if return_kv:
         return x, (kk, v)
@@ -181,9 +205,9 @@ def apply(
     are a sequence shard (sequence parallelism): RoPE needs the *global*
     position of each token, so shard i of width Tl passes
     ``i*Tl + arange(Tl)``."""
-    x = params["embed"][tokens].astype(compute_dtype)
+    x = embed_lookup(params["embed"], tokens, compute_dtype)
     if positions is None:
         positions = jnp.arange(tokens.shape[1])
     x = apply_layers(params["blocks"], x, n_heads, positions, attn_fn, ffn_fn)
     x = rmsnorm(x, params["ln_f"])
-    return (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+    return (x @ wt(params["head"], x.dtype)).astype(jnp.float32)

@@ -318,6 +318,12 @@ def _transformer_lm(**options) -> ZooModel:
         tfm.init_params(jax.random.PRNGKey(seed), vocab, d_model, n_heads, n_layers),
         options,
     )
+    if options.get("quantize") == "int8w":
+        # weight-only int8 (models/quantize.py): decode reads every
+        # weight once per token, so fewer bytes/weight → more tok/s
+        from nnstreamer_tpu.models import quantize as qz
+
+        params = qz.quantize_lm_weights(params)
     attn_kind = options.get("attn", "dense")
     if attn_kind == "flash":
         from nnstreamer_tpu.ops.pallas.flash_attention import make_flash_attention

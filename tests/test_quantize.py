@@ -82,3 +82,53 @@ def test_int8_through_single_shot():
         out = s.invoke(np.zeros((1, 96, 96, 3), np.uint8))
     assert out[0].shape == (1, 16)
     assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+# -- weight-only int8 for the transformer family ---------------------------
+
+_LM_KW = dict(vocab="512", d_model="128", n_heads="4", n_layers="2")
+
+
+def _toks(n=32, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 512, (1, n)), jnp.int32
+    )
+
+
+def test_lm_int8w_forward_close():
+    mf = zoo.get("transformer_lm", **_LM_KW)
+    mq = zoo.get("transformer_lm", quantize="int8w", **_LM_KW)
+    toks = _toks()
+    fl = np.asarray(jax.jit(mf.fn)(toks))
+    ql = np.asarray(jax.jit(mq.fn)(toks))
+    cos = (ql * fl).sum() / (np.linalg.norm(ql) * np.linalg.norm(fl))
+    assert cos > 0.995, f"cosine {cos}"
+
+
+def test_lm_int8w_weights_are_int8():
+    mq = zoo.get("transformer_lm", quantize="int8w", **_LM_KW)
+    blocks = mq.params["blocks"]
+    for k in ("wqkv", "wo", "w_gate", "w_up", "w_down"):
+        assert blocks[k]["w8"].dtype == jnp.int8
+        # stacked [L, 1, cout] scales: one scale per layer per out-channel
+        assert blocks[k]["scale"].shape[0] == blocks["ln1"].shape[0]
+    assert mq.params["embed"]["w8"].dtype == jnp.int8
+    # norms stay exact f32
+    assert blocks["ln1"].dtype == jnp.float32
+
+
+def test_lm_int8w_generate_deterministic():
+    toks = _toks(16)
+    a = zoo.get("transformer_lm", generate="8", quantize="int8w", **_LM_KW)
+    b = zoo.get("transformer_lm", generate="8", quantize="int8w", **_LM_KW)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(a.fn)(toks)), np.asarray(jax.jit(b.fn)(toks))
+    )
+
+
+def test_lm_int8w_bf16_traces():
+    m = zoo.get(
+        "transformer_lm", quantize="int8w", compute_dtype="bfloat16",
+        generate="4", **_LM_KW,
+    )
+    jax.eval_shape(m.fn, jax.ShapeDtypeStruct((1, 16), jnp.int32))
