@@ -30,6 +30,7 @@ Design notes:
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -51,13 +52,16 @@ def batched_decode_step(
     cache: Tuple[jax.Array, jax.Array],
     n_heads: int,
     compute_dtype=jnp.float32,
+    attn_fn=None,
 ):
     """One decode step for a whole slot batch.
 
     tok [B] int32, pos [B] int32 (per-slot fill level), active [B] bool →
     (logits [B, V] f32, cache', pos'). Inactive slots: cache and pos are
     unchanged and their logits are garbage (callers must gate on
-    ``active``)."""
+    ``active``). ``attn_fn(q, ck, cv, pos) -> [B,1,H,Dh]`` overrides the
+    inline masked attention (the Pallas single-pass kernel,
+    ops/pallas/decode_attention.py)."""
     cache_k, cache_v = cache
     max_len = cache_k.shape[2]
     b = tok.shape[0]
@@ -86,13 +90,17 @@ def batched_decode_step(
         v = v.reshape(bsz, 1, h, hd)
         ck = write(ck, k)
         cv = write(cv, v)
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
-        ) / (hd ** 0.5)
-        mask = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, max_len]
-        s = jnp.where(mask[:, None, None, :], s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        if attn_fn is not None:
+            o = attn_fn(q, ck, cv, pos)  # [B,1,H,Dh] f32
+        else:
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                ck.astype(jnp.float32),
+            ) / (hd ** 0.5)
+            mask = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, max_len]
+            s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
         o = o.astype(x.dtype).reshape(bsz, 1, -1)
         x = x + o @ tfm.wt(blk["wo"], x.dtype)
         x = tfm.block_ffn(x, blk)
@@ -147,9 +155,21 @@ class ContinuousBatcher:
         max_len: int = 256,
         prompt_len: int = 64,
         compute_dtype=jnp.float32,
+        attn_impl: str = "xla",
+        keep_results: int = 1024,
     ):
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
+        if attn_impl == "pallas":
+            from nnstreamer_tpu.ops.pallas.decode_attention import (
+                make_decode_attention,
+            )
+
+            attn_fn = make_decode_attention()
+        elif attn_impl == "xla":
+            attn_fn = None
+        else:
+            raise ValueError(f"unknown attn_impl {attn_impl!r}")
         self.params = params
         self.n_heads = n_heads
         self.n_slots = n_slots
@@ -159,7 +179,10 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self._next_rid = 0
         self._slots: List[Optional[_Request]] = [None] * n_slots
-        self._done_pool: Dict[int, _Request] = {}
+        # finished requests await pickup here; bounded FIFO so a caller
+        # that never collects cannot grow the host heap without limit
+        self._done_pool: "OrderedDict[int, _Request]" = OrderedDict()
+        self._keep_results = keep_results
 
         L, d = params["blocks"]["ln1"].shape
         hd = d // n_heads
@@ -180,7 +203,8 @@ class ContinuousBatcher:
         )
         self._step = jax.jit(
             lambda tok, pos, active, cache: batched_decode_step(
-                params, tok, pos, active, cache, n_heads, compute_dtype
+                params, tok, pos, active, cache, n_heads, compute_dtype,
+                attn_fn=attn_fn,
             )
         )
         self._insert = jax.jit(insert_slot)
@@ -204,6 +228,9 @@ class ContinuousBatcher:
                 f"{self.max_len}"
             )
         with self._lock:
+            # claim only — the slot is owned (so no other submit takes it)
+            # but inactive, so concurrent step() calls skip it while the
+            # prefill below runs outside the lock
             try:
                 slot = next(
                     i for i, r in enumerate(self._slots) if r is None
@@ -215,10 +242,12 @@ class ContinuousBatcher:
             req = _Request(rid, max_new_tokens)
             self._slots[slot] = req
 
-            padded = np.zeros((1, self.prompt_len), np.int32)
-            padded[0, :t] = prompt
-            logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
-            first = int(jnp.argmax(logits[0, t - 1]))
+        padded = np.zeros((1, self.prompt_len), np.int32)
+        padded[0, :t] = prompt
+        logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
+        first = int(jnp.argmax(logits[0, t - 1]))
+
+        with self._lock:
             self._cache = self._insert(self._cache, ks, vs, slot)
             self._tok = self._tok.at[slot].set(first)
             self._pos = self._pos.at[slot].set(t)
@@ -226,7 +255,7 @@ class ContinuousBatcher:
             req.tokens.append(first)
             if len(req.tokens) >= req.budget:
                 self._finish(slot)
-            return rid
+        return rid
 
     def step(self) -> Dict[int, int]:
         """Advance every active slot one token; returns {rid: token}."""
@@ -256,6 +285,8 @@ class ContinuousBatcher:
         req.done = True
         self._active[slot] = False
         self._done_pool[req.rid] = req
+        while len(self._done_pool) > self._keep_results:
+            self._done_pool.popitem(last=False)  # evict oldest uncollected
         self._slots[slot] = None
 
     def result(self, rid: int) -> Optional[List[int]]:

@@ -1,0 +1,140 @@
+"""Single-token decode attention as a Pallas TPU kernel.
+
+The serving hot loop (models/serving.py batched_decode_step) attends one
+query token per slot against that slot's KV cache. Decode attention is
+memory-bound: the FLOPs are trivial, the cost is streaming the cache out
+of HBM. An unfused formulation reads K for the scores and V for the
+weighted sum as two separate passes with a [B,H,1,S] score tensor in
+between; this kernel is the flash-style single pass — each cache block is
+read once, scores never leave VMEM, and the per-slot fill-level mask is
+an additive bias fused into the same pass.
+
+Grid: (B*H, k-blocks), k innermost with "arbitrary" semantics (sequential
+on TPU), online-softmax scratch (m, l, acc) carried across k iterations —
+the same recurrence as ops/pallas/flash_attention.py specialized to one
+query row. Layout contract: q [BH, D], k/v [BH, S, D], bias [BH, S]
+(0 for live positions, NEG_INF for masked); the wrapper builds these from
+the serving shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, scale: float, n_k: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[:].astype(jnp.float32)        # [1, d]
+    k = k_ref[0].astype(jnp.float32)        # [bk, d]
+    v = v_ref[0].astype(jnp.float32)        # [bk, d]
+    bias = b_ref[:].astype(jnp.float32)     # [1, bk]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale + bias                        # [1, bk]
+
+    m_prev = m_ref[:]                       # [1]
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+    p = jnp.where(m_new[:, None] <= NEG_INF, 0.0, jnp.exp(s - m_new[:, None]))
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
+    m_ref[:] = m_new
+    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == n_k - 1)
+    def _final():
+        l2 = l_ref[:][:, None]
+        o_ref[:] = jnp.where(
+            l2 > 0, acc_ref[:] / jnp.maximum(l2, 1e-30), 0.0
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def decode_attention(
+    q,
+    cache_k,
+    cache_v,
+    pos,
+    scale: Optional[float] = None,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """q [B,1,H,D], cache_k/v [B,S,H,D] (serving layout), pos [B] → o
+    [B,1,H,D] float32. Positions > pos[b] are masked per slot."""
+    b, _, h, d = q.shape
+    s_len = cache_k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bk = min(block_k, s_len)
+    s_pad = -(-s_len // bk) * bk
+
+    qf = q.reshape(b, h, d).reshape(b * h, d)
+
+    def fold(c):
+        c = c.transpose(0, 2, 1, 3).reshape(b * h, s_len, d)
+        if s_pad != s_len:
+            c = jnp.pad(c, ((0, 0), (0, s_pad - s_len), (0, 0)))
+        return c
+
+    kf, vf = fold(cache_k), fold(cache_v)
+    live = jnp.arange(s_pad)[None, :] <= pos[:, None]  # [B, s_pad]
+    bias = jnp.where(live, 0.0, NEG_INF).astype(jnp.float32)
+    bias = jnp.repeat(bias, h, axis=0)  # [BH, s_pad]
+
+    n_k = s_pad // bk
+    kernel = functools.partial(_kernel, scale=scale, n_k=n_k)
+
+    from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, d), jnp.float32),
+        grid=(b * h, n_k),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, kk: (i, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk), lambda i, kk: (i, kk)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, kk: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, bias)
+    return out.reshape(b, h, d)[:, None]  # [B,1,H,D]
+
+
+def make_decode_attention(interpret: Optional[bool] = None, **kwargs):
+    """attn factory: real kernel on TPU, interpreter elsewhere."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def attn(q, cache_k, cache_v, pos):
+        return decode_attention(q, cache_k, cache_v, pos,
+                                interpret=interpret, **kwargs)
+
+    return attn

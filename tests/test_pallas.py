@@ -58,3 +58,74 @@ class TestFlashAttention:
             attn_fn=make_flash_attention(interpret=True, block_q=16, block_k=16),
         )
         np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=1e-4)
+
+
+class TestDecodeAttention:
+    """Single-pass decode kernel (ops/pallas/decode_attention.py) vs the
+    serving step's inline masked-softmax reference."""
+
+    def _ref(self, q, ck, cv, pos):
+        hd = q.shape[-1]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
+        ) / (hd ** 0.5)
+        mask = jnp.arange(ck.shape[1])[None, :] <= pos[:, None]
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        return o
+
+    @pytest.mark.parametrize("s_len,block_k", [(64, 16), (48, 16), (40, 128)])
+    def test_matches_masked_softmax(self, s_len, block_k):
+        from nnstreamer_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(1)
+        b, h, d = 3, 4, 16
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((b, s_len, h, d)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((b, s_len, h, d)), jnp.float32)
+        pos = jnp.asarray([0, s_len // 2, s_len - 1], jnp.int32)
+        out = decode_attention(q, ck, cv, pos, block_k=block_k, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(q, ck, cv, pos)), atol=2e-5
+        )
+
+    def test_bfloat16_cache(self):
+        from nnstreamer_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(2)
+        b, s_len, h, d = 2, 32, 2, 16
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.bfloat16)
+        ck = jnp.asarray(rng.standard_normal((b, s_len, h, d)), jnp.bfloat16)
+        cv = jnp.asarray(rng.standard_normal((b, s_len, h, d)), jnp.bfloat16)
+        pos = jnp.asarray([5, 20], jnp.int32)
+        out = decode_attention(q, ck, cv, pos, block_k=16, interpret=True)
+        ref = self._ref(
+            q.astype(jnp.float32), ck.astype(jnp.float32),
+            cv.astype(jnp.float32), pos,
+        )
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+    def test_serving_step_with_pallas_attn(self):
+        """ContinuousBatcher(attn_impl="pallas") emits the same greedy
+        tokens as the XLA step."""
+        from nnstreamer_tpu.models import transformer as tfm
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = tfm.init_params(
+            jax.random.PRNGKey(3), vocab=128, d_model=32, n_heads=2,
+            n_layers=2,
+        )
+        prompt = np.random.default_rng(4).integers(1, 128, (6,))
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cb = ContinuousBatcher(
+                params, 2, n_slots=2, max_len=32, prompt_len=8,
+                attn_impl=impl,
+            )
+            rid = cb.submit(prompt, 4)
+            while cb.result(rid) is None:
+                cb.step()
+            outs[impl] = cb.result(rid)
+        assert outs["xla"] == outs["pallas"]

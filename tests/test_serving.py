@@ -103,3 +103,14 @@ def test_budget_one_finishes_at_submit(params):
     assert cb.result(rid) == _alone(params, prompt, 1)
     assert cb.n_free == 1
     assert cb.step() == {}
+
+
+def test_done_pool_bounded(params):
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+                           prompt_len=8, keep_results=3)
+    rids = []
+    for seed in range(5):
+        rids.append(cb.submit(_prompt(4, seed), 1))
+    assert len(cb._done_pool) == 3
+    assert cb.result(rids[0]) is None  # evicted (oldest)
+    assert cb.result(rids[-1]) is not None
