@@ -6,15 +6,17 @@ memory-bound: the FLOPs are trivial, the cost is streaming the cache out
 of HBM. An unfused formulation reads K for the scores and V for the
 weighted sum as two separate passes with a [B,H,1,S] score tensor in
 between; this kernel is the flash-style single pass — each cache block is
-read once, scores never leave VMEM, and the per-slot fill-level mask is
-an additive bias fused into the same pass.
+read once, scores never leave VMEM, and the per-slot fill level arrives
+as a scalar-prefetch operand, so masking costs no extra HBM tensor.
 
-Grid: (B*H, k-blocks), k innermost with "arbitrary" semantics (sequential
-on TPU), online-softmax scratch (m, l, acc) carried across k iterations —
-the same recurrence as ops/pallas/flash_attention.py specialized to one
-query row. Layout contract: q [BH, D], k/v [BH, S, D], bias [BH, S]
-(0 for live positions, NEG_INF for masked); the wrapper builds these from
-the serving shapes.
+The kernel indexes the serving cache layout [B, S, H, D] directly via
+BlockSpecs (grid (B, H, k-blocks), block (1, bk, 1, d)) — no transpose,
+no pad, no bias materialization on the host side; ``pos`` [B] rides in
+SMEM. k innermost with "arbitrary" semantics (sequential on TPU), the
+online-softmax scratch (m, l, acc) carried across k iterations — the same
+recurrence as ops/pallas/flash_attention.py specialized to one query row.
+Blocks entirely beyond a slot's fill level are predicated off with
+@pl.when.
 """
 
 from __future__ import annotations
@@ -29,9 +31,10 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, m_ref, l_ref, acc_ref,
-            *, scale: float, n_k: int):
-    ki = pl.program_id(1)
+def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, scale: float, block_k: int, n_k: int):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -39,31 +42,47 @@ def _kernel(q_ref, k_ref, v_ref, b_ref, o_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[:].astype(jnp.float32)        # [1, d]
-    k = k_ref[0].astype(jnp.float32)        # [bk, d]
-    v = v_ref[0].astype(jnp.float32)        # [bk, d]
-    bias = b_ref[:].astype(jnp.float32)     # [1, bk]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale + bias                        # [1, bk]
+    k_start = ki * block_k
+    live_len = pos_ref[b] + 1  # positions 0..pos inclusive are attendable
 
-    m_prev = m_ref[:]                       # [1]
-    l_prev = l_ref[:]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-    alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-    p = jnp.where(m_new[:, None] <= NEG_INF, 0.0, jnp.exp(s - m_new[:, None]))
-    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
-    m_ref[:] = m_new
-    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    @pl.when(k_start < live_len)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)       # [1, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                  # [1, bk]
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < live_len, s, NEG_INF)
+
+        m_prev = m_ref[:]                          # [1]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(
+            m_new[:, None] <= NEG_INF, 0.0, jnp.exp(s - m_new[:, None])
+        )
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
 
     @pl.when(ki == n_k - 1)
     def _final():
         l2 = l_ref[:][:, None]
-        o_ref[:] = jnp.where(
+        o_ref[0, 0] = jnp.where(
             l2 > 0, acc_ref[:] / jnp.maximum(l2, 1e-30), 0.0
         ).astype(o_ref.dtype)
+
+
+def _pick_block(s_len: int, block_k: int) -> int:
+    """Largest divisor of s_len ≤ block_k (no padding pass needed)."""
+    for cand in range(min(block_k, s_len), 0, -1):
+        if s_len % cand == 0:
+            return cand
+    return s_len
 
 
 @functools.partial(
@@ -78,54 +97,45 @@ def decode_attention(
     block_k: int = 128,
     interpret: bool = False,
 ):
-    """q [B,1,H,D], cache_k/v [B,S,H,D] (serving layout), pos [B] → o
-    [B,1,H,D] float32. Positions > pos[b] are masked per slot."""
+    """q [B,1,H,D], cache_k/v [B,S,H,D] (the serving layout, consumed
+    in place), pos [B] → o [B,1,H,D] float32. Positions > pos[b] are
+    masked per slot."""
     b, _, h, d = q.shape
     s_len = cache_k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    bk = min(block_k, s_len)
-    s_pad = -(-s_len // bk) * bk
-
-    qf = q.reshape(b, h, d).reshape(b * h, d)
-
-    def fold(c):
-        c = c.transpose(0, 2, 1, 3).reshape(b * h, s_len, d)
-        if s_pad != s_len:
-            c = jnp.pad(c, ((0, 0), (0, s_pad - s_len), (0, 0)))
-        return c
-
-    kf, vf = fold(cache_k), fold(cache_v)
-    live = jnp.arange(s_pad)[None, :] <= pos[:, None]  # [B, s_pad]
-    bias = jnp.where(live, 0.0, NEG_INF).astype(jnp.float32)
-    bias = jnp.repeat(bias, h, axis=0)  # [BH, s_pad]
-
-    n_k = s_pad // bk
-    kernel = functools.partial(_kernel, scale=scale, n_k=n_k)
+    bk = _pick_block(s_len, block_k)
+    n_k = s_len // bk
+    kernel = functools.partial(_kernel, scale=scale, block_k=bk, n_k=n_k)
 
     from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets
 
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, d), jnp.float32),
-        grid=(b * h, n_k),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, n_k),
         in_specs=[
-            pl.BlockSpec((1, d), lambda i, kk: (i, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bk), lambda i, kk: (i, kk)),
+            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, bk, 1, d), lambda bi, hi, kk, pos_ref: (bi, kk, hi, 0)),
+            pl.BlockSpec((1, bk, 1, d), lambda bi, hi, kk, pos_ref: (bi, kk, hi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, d), lambda i, kk: (i, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)
+        ),
         scratch_shapes=[
             pltpu.VMEM((1,), jnp.float32),
             pltpu.VMEM((1,), jnp.float32),
             pltpu.VMEM((1, d), jnp.float32),
         ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), jnp.float32),
+        grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qf, kf, vf, bias)
-    return out.reshape(b, h, d)[:, None]  # [B,1,H,D]
+    )(pos.astype(jnp.int32), q, cache_k, cache_v)
+    return out
 
 
 def make_decode_attention(interpret: Optional[bool] = None, **kwargs):
