@@ -262,6 +262,21 @@ PIPELINES = {
         "tensor_converter ! tensor_filter framework=custom "
         "model={fix}/negate_filter.py ! filesink location={out}"
     ),
+    # int8 PTQ serving path (models/quantize.py; the *_quant.tflite slot):
+    # calibration is seeded, so the quantized logits are deterministic
+    "filter_int8": (
+        "videotestsrc pattern=gradient num-frames=2 width=96 height=96 ! "
+        "tensor_converter ! tensor_filter framework=jax "
+        'model=zoo:mobilenet_v2 custom="quantize:int8,size:96,'
+        'num_classes:16" ! filesink location={out}'
+    ),
+    # weight-only int8 LLM generation through a filter stage
+    "filter_lm_int8w": (
+        "tensorsrc dimensions=16:1 types=int32 num-frames=1 ! "
+        "tensor_filter framework=jax model=zoo:transformer_lm "
+        'custom="vocab:512,d_model:64,n_heads:4,n_layers:2,generate:6,'
+        'quantize:int8w,seqlen:16" ! filesink location={out}'
+    ),
     # fused on-device cascade (zoo:face_composite): detect→crop+resize→
     # landmark as one XLA program, landmarks + detections to file
     "composite_fused": (
