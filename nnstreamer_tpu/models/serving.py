@@ -226,6 +226,8 @@ class ContinuousBatcher:
         attn_impl: str = "xla",
         keep_results: int = 1024,
         cache_dtype: str = "auto",
+        mesh=None,
+        slots_axis: str = "dp",
     ):
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
@@ -236,6 +238,12 @@ class ContinuousBatcher:
             raise ValueError(
                 "attn_impl='pallas' needs a float cache (the kernel takes "
                 "no scale operand yet); use cache_dtype='auto'"
+            )
+        if mesh is not None and attn_impl == "pallas":
+            raise ValueError(
+                "attn_impl='pallas' does not compose with mesh= (GSPMD "
+                "cannot partition the kernel's custom call over the slot-"
+                "sharded cache); use the default XLA attention"
             )
         if attn_impl == "pallas":
             from nnstreamer_tpu.ops.pallas.decode_attention import (
@@ -278,6 +286,32 @@ class ContinuousBatcher:
         self._tok = jnp.zeros((n_slots,), jnp.int32)
         self._pos = jnp.zeros((n_slots,), jnp.int32)
         self._active = np.zeros((n_slots,), bool)
+
+        if mesh is not None:
+            # shard the slot axis over the mesh: the batched step runs
+            # SPMD with each device decoding its share of the slots (the
+            # data-parallel serving layout; params stay replicated, so
+            # the only cross-device traffic is the host-driven admit)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from nnstreamer_tpu.parallel.mesh import batch_sharding
+
+            n_mesh = mesh.shape[slots_axis]
+            if n_slots % n_mesh:
+                raise ValueError(
+                    f"n_slots={n_slots} must divide over mesh axis "
+                    f"{slots_axis!r} (size {n_mesh})"
+                )
+            cache_sh = NamedSharding(mesh, P(None, slots_axis))
+            vec_sh = batch_sharding(mesh, slots_axis)
+            self._vec_sh = vec_sh
+            self._cache = jax.tree_util.tree_map(
+                lambda c: jax.device_put(c, cache_sh), self._cache
+            )
+            self._tok = jax.device_put(self._tok, vec_sh)
+            self._pos = jax.device_put(self._pos, vec_sh)
+        else:
+            self._vec_sh = None
 
         self._prefill = jax.jit(
             lambda toks: dec.prefill(
@@ -340,8 +374,8 @@ class ContinuousBatcher:
 
         with self._lock:
             self._cache = self._insert(self._cache, ks, vs, slot)
-            self._tok = self._tok.at[slot].set(first)
-            self._pos = self._pos.at[slot].set(t)
+            self._tok = self._pin(self._tok.at[slot].set(first))
+            self._pos = self._pin(self._pos.at[slot].set(t))
             self._active[slot] = True
             req.tokens.append(first)
             if len(req.tokens) >= req.budget:
@@ -358,7 +392,7 @@ class ContinuousBatcher:
                 self._tok, self._pos, active, self._cache
             )
             new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            self._tok = jnp.where(active, new_tok, self._tok)
+            self._tok = self._pin(jnp.where(active, new_tok, self._tok))
             emitted: Dict[int, int] = {}
             toks = np.asarray(self._tok)
             for slot, req in enumerate(self._slots):
@@ -370,6 +404,11 @@ class ContinuousBatcher:
                 if len(req.tokens) >= req.budget:
                     self._finish(slot)
             return emitted
+
+    def _pin(self, x):
+        """Keep per-slot vectors on their mesh sharding after eager
+        updates, so the compiled step sees stable input shardings."""
+        return jax.device_put(x, self._vec_sh) if self._vec_sh else x
 
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]

@@ -191,3 +191,38 @@ def test_submit_releases_slot_when_prefill_fails(params):
     with pytest.raises(RuntimeError, match="prefill exploded"):
         cb.submit(_prompt(4, 20), 2)
     assert cb.n_free == 1  # slot released, server still serviceable
+
+
+def test_mesh_sharded_slots_match_unsharded(params):
+    """Slots sharded over an 8-device mesh (SPMD decode) produce the same
+    greedy tokens as the single-device batcher."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, axes=("dp",))
+    prompts = [_prompt(4 + i, 30 + i) for i in range(3)]
+    outs = {}
+    for label, kw in (("plain", {}), ("mesh", dict(mesh=mesh))):
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=8, max_len=32,
+                               prompt_len=16, **kw)
+        rids = [cb.submit(p, 5) for p in prompts]
+        while any(cb.result(r) is None for r in rids):
+            cb.step()
+        outs[label] = [cb.result(r) for r in rids]
+    assert outs["plain"] == outs["mesh"]
+
+
+def test_mesh_requires_divisible_slots(params):
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="divide"):
+        ContinuousBatcher(params, N_HEADS, n_slots=3,
+                          mesh=make_mesh(8, axes=("dp",)))
+
+
+def test_mesh_plus_pallas_rejected(params):
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="mesh"):
+        ContinuousBatcher(params, N_HEADS, n_slots=8,
+                          mesh=make_mesh(8, axes=("dp",)),
+                          attn_impl="pallas")
