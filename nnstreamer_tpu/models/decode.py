@@ -79,26 +79,66 @@ def decode_step(
     """One token in, one distribution out.
 
     token [B] int32, pos scalar (number of tokens already cached) →
-    (logits [B, V], cache', pos+1)."""
+    (logits [B, V], cache', pos+1). This is exactly verify_chunk with a
+    1-token chunk — one shared body keeps the plain and speculative
+    decode paths identical by construction."""
+    logits, cache, _ = verify_chunk(
+        params, token[:, None], pos, cache, n_heads, ffn_fn, compute_dtype
+    )
+    return logits[:, 0], cache, pos + 1
+
+
+def verify_chunk(
+    params: Dict,
+    tokens,
+    pos,
+    cache: Tuple[jax.Array, jax.Array],
+    n_heads: int,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+):
+    """Score a k-token candidate chunk in ONE forward against the cache.
+
+    tokens [B, k] int32 (candidates, e.g. a draft model's proposals), pos
+    scalar (tokens already cached) → (logits [B, k, V] f32, cache', pos+k).
+    Query i sits at absolute position pos+i and attends cache positions
+    ≤ pos+i (causal within the chunk). The chunk's K/V are written at
+    pos..pos+k-1; the caller rolls back rejected tokens by simply using a
+    smaller ``pos`` afterwards — positions beyond the accepted point are
+    overwritten before any mask can reach them (the same invariant the
+    continuous batcher relies on). This is the speculative-decoding
+    verify step (models/speculative.py).
+
+    Precondition: pos + k ≤ max_len — dynamic_update_slice would clamp
+    the start index and silently overwrite certified earlier positions.
+    Checked here whenever ``pos`` is concrete (outside a trace)."""
     cache_k, cache_v = cache
     max_len = cache_k.shape[2]
-    b = token.shape[0]
-    x = tfm.embed_lookup(params["embed"], token, compute_dtype)[:, None, :]  # [B,1,D]
-    positions = pos[None].astype(jnp.int32)
+    b, kk_len = tokens.shape
+    if not isinstance(pos, jax.core.Tracer) and int(pos) + kk_len > max_len:
+        raise ValueError(
+            f"verify_chunk: pos({int(pos)}) + k({kk_len}) > max_len"
+            f"({max_len}); KV cache would clamp and corrupt"
+        )
+    x = tfm.embed_lookup(params["embed"], tokens, compute_dtype)  # [B,k,D]
+    positions = pos + jnp.arange(kk_len, dtype=jnp.int32)
 
     def body(carry, layer):
         x = carry
         blk, ck, cv = layer
-        q, k, v = tfm.block_qkv(x, blk, n_heads, positions)  # [B,1,H,Dh]
+        q, k, v = tfm.block_qkv(x, blk, n_heads, positions)  # [B,k,H,Dh]
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                       ck.astype(jnp.float32)) / (q.shape[-1] ** 0.5)
-        mask = jnp.arange(max_len) <= pos  # [max_len]
-        s = jnp.where(mask[None, None, None, :], s, NEG_INF)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
+        ) / (q.shape[-1] ** 0.5)
+        mask = (
+            jnp.arange(max_len)[None, :] <= positions[:, None]
+        )  # [k, max_len]
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
-        o = o.astype(x.dtype).reshape(b, 1, -1)
+        o = o.astype(x.dtype).reshape(b, kk_len, -1)
         x = x + o @ tfm.wt(blk["wo"], x.dtype)
         x = tfm.block_ffn(x, blk, ffn_fn)
         return x, (ck, cv)
@@ -107,8 +147,8 @@ def decode_step(
         body, x, (params["blocks"], cache_k, cache_v)
     )
     x = tfm.rmsnorm(x, params["ln_f"])
-    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)[:, 0]
-    return logits, (cache_k, cache_v), pos + 1
+    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
+    return logits, (cache_k, cache_v), pos + kk_len
 
 
 def generate(

@@ -1,0 +1,135 @@
+"""Greedy speculative decoding: a draft model proposes, the target verifies.
+
+Autoregressive decode is sequential and memory-bound — the big model reads
+all its weights once per token. Speculative decoding breaks the serial
+chain: a small draft model runs k cheap steps, then the target scores all
+k candidates in ONE chunked forward (decode.verify_chunk) and keeps the
+longest prefix that matches its own greedy choice, plus one corrected
+token. Per round the target does one weight pass for up to k+1 emitted
+tokens; with greedy acceptance the output is EXACTLY the sequence the
+target would produce alone (tested invariant — no approximation).
+
+Rollback is free by construction: rejected candidates' K/V stay in the
+cache beyond ``pos`` but the ≤ pos attention mask never reaches them, and
+they are overwritten before the mask grows past them (the same invariant
+models/serving.py relies on for slot reuse).
+
+Two compiled programs per model pair (draft k-step scan, target verify
+chunk) regardless of sequence length or acceptance pattern.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models import decode as dec
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_heads", "compute_dtype")
+)
+def _draft_k(params, cache, pos, tok, k, n_heads, compute_dtype):
+    """k greedy draft steps from ``tok``: returns proposals [B, k-1] (the
+    chunk tail) and the advanced draft cache.
+
+    Module-level jit: the compile caches on the params/cache shapes, not
+    per speculative_generate call. The scan runs k steps, one more than
+    the proposals used: the k-th step's emission is discarded but its
+    *input* (the last proposal) gets its K/V written — on full acceptance
+    the rolled-forward draft position covers that slot, and an unwritten
+    hole there would be attended as garbage next round."""
+
+    def step(carry, _):
+        cache, pos, tok = carry
+        logits, cache, pos = dec.decode_step(
+            params, tok, pos, cache, n_heads, compute_dtype=compute_dtype
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, pos, nxt), nxt
+
+    (cache, pos, _), props = jax.lax.scan(
+        step, (cache, pos, tok), None, length=k
+    )
+    return props.T[:, : k - 1], cache, pos  # [B, k-1]
+
+
+@functools.partial(jax.jit, static_argnames=("n_heads", "compute_dtype"))
+def _verify(params, cache, pos, chunk, n_heads, compute_dtype):
+    logits, cache, _ = dec.verify_chunk(
+        params, chunk, pos, cache, n_heads, compute_dtype=compute_dtype
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache  # [B, k]
+
+
+def speculative_generate(
+    target_params: Dict,
+    draft_params: Dict,
+    prompt,
+    n_heads: int,
+    max_new_tokens: int,
+    draft_n_heads: Optional[int] = None,
+    k: int = 4,
+    compute_dtype=jnp.float32,
+):
+    """prompt [B, T] int32 → tokens [B, max_new_tokens] int32 (greedy,
+    byte-identical to decode.generate on the target alone).
+
+    ``k`` = draft lookahead per round. Both models must share the vocab.
+    B=1 is the intended serving shape (acceptance lengths are per-stream;
+    batching streams belongs to the continuous batcher)."""
+    if draft_n_heads is None:
+        draft_n_heads = n_heads
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError("speculative_generate serves one stream (B=1)")
+    if k < 2:
+        raise ValueError("k must be ≥ 2 (one proposal + one correction)")
+    # chunk writes can overshoot the accepted point by up to k-1
+    max_len = t + max_new_tokens + k
+
+    t_logits, t_cache, t_pos = dec.prefill(
+        target_params, prompt, n_heads, max_len, compute_dtype=compute_dtype
+    )
+    _, d_cache, d_pos = dec.prefill(
+        draft_params, prompt, draft_n_heads, max_len,
+        compute_dtype=compute_dtype,
+    )
+    cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
+
+    out = []
+    accept_lens = []
+    while len(out) < max_new_tokens:
+        out.append(int(cur[0]))  # cur is already target-certified
+        if len(out) >= max_new_tokens:
+            break
+        props, d_cache, _ = _draft_k(
+            draft_params, d_cache, d_pos, cur, k, draft_n_heads,
+            compute_dtype,
+        )
+        chunk = jnp.concatenate([cur[:, None], props], axis=1)  # [B, k]
+        preds, t_cache = _verify(
+            target_params, t_cache, t_pos, chunk, n_heads, compute_dtype
+        )
+
+        # longest prefix of proposals matching the target's own argmax
+        pn = np.asarray(preds[0])
+        prn = np.asarray(props[0])
+        n_acc = 0
+        while n_acc < k - 1 and prn[n_acc] == pn[n_acc]:
+            n_acc += 1
+        accept_lens.append(n_acc)
+        out.extend(int(x) for x in prn[:n_acc])
+        cur = preds[:, n_acc]  # target's correction after the prefix
+        # roll back both caches to the certified length (rejected K/V
+        # beyond pos are masked until overwritten)
+        t_pos = t_pos + n_acc + 1
+        d_pos = d_pos + n_acc + 1
+
+    toks = jnp.asarray(np.asarray(out[:max_new_tokens], np.int32))[None, :]
+    return toks, accept_lens

@@ -1,0 +1,86 @@
+"""Speculative decoding tests (models/speculative.py).
+
+The invariant: greedy speculative output is byte-identical to
+decode.generate on the target model alone, for any draft model — the
+draft only changes how fast tokens are certified, never which tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import decode as dec
+from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.models.speculative import speculative_generate
+
+N_HEADS = 4
+
+
+@pytest.fixture(scope="module")
+def target():
+    return tfm.init_params(
+        jax.random.PRNGKey(0), vocab=211, d_model=64, n_heads=N_HEADS,
+        n_layers=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # smaller and differently seeded: realistic partial agreement
+    return tfm.init_params(
+        jax.random.PRNGKey(9), vocab=211, d_model=32, n_heads=2, n_layers=1,
+    )
+
+
+def _prompt(n, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(1, 211, (1, n)), jnp.int32
+    )
+
+
+def _alone(params, prompt, n_new):
+    return np.asarray(dec.generate(params, prompt, N_HEADS, n_new))
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_matches_target_alone(target, draft, k):
+    prompt = _prompt(12, 1)
+    toks, accept_lens = speculative_generate(
+        target, draft, prompt, N_HEADS, 16, draft_n_heads=2, k=k
+    )
+    np.testing.assert_array_equal(
+        np.asarray(toks), _alone(target, prompt, 16)
+    )
+    assert len(accept_lens) >= 1
+
+
+def test_self_draft_accepts_everything(target):
+    """Draft == target: every proposal matches, so each round certifies
+    the full k-1 lookahead (the acceptance-path sanity check)."""
+    prompt = _prompt(8, 2)
+    toks, accept_lens = speculative_generate(
+        target, target, prompt, N_HEADS, 12, k=4
+    )
+    np.testing.assert_array_equal(
+        np.asarray(toks), _alone(target, prompt, 12)
+    )
+    # all but possibly the final (truncated) round accept fully
+    assert all(a == 3 for a in accept_lens[:-1])
+
+
+def test_single_token(target, draft):
+    prompt = _prompt(5, 3)
+    toks, _ = speculative_generate(
+        target, draft, prompt, N_HEADS, 1, draft_n_heads=2, k=2
+    )
+    np.testing.assert_array_equal(np.asarray(toks), _alone(target, prompt, 1))
+
+
+def test_validation(target, draft):
+    with pytest.raises(ValueError, match="B=1"):
+        speculative_generate(
+            target, draft, jnp.zeros((2, 4), jnp.int32), N_HEADS, 4
+        )
+    with pytest.raises(ValueError, match="k must be"):
+        speculative_generate(target, draft, _prompt(4), N_HEADS, 4, k=1)
