@@ -1,0 +1,233 @@
+"""tensor_llm_{serversink,serversrc}: continuous-batching LLM serving as
+pipeline elements.
+
+The reference serves one model to many clients at *frame* granularity:
+tensor_query_serversrc emits client-tagged requests, the pipeline
+processes them one at a time, serversink routes replies by client_id
+(gst/nnstreamer/tensor_query/tensor_query_serversrc.c:379-427). An LLM
+server multiplexes at *token* granularity instead — requests decode
+concurrently in one slot batch (models/serving.ContinuousBatcher) and
+finish out of order.
+
+That asynchrony maps onto the same pairing pattern the reference uses for
+repo and query elements: two elements share a server object through a
+global ``id`` table —
+
+    tensor_query_serversrc id=7 ! tensor_llm_serversink id=0 model=...
+    tensor_llm_serversrc id=0 ! tensor_query_serversink id=7
+
+- ``tensor_llm_serversink`` (a Sink) submits each incoming prompt frame
+  (int32 token tensor; per-frame ``max_new_tokens`` meta overrides the
+  element default). When the batch is full it pumps the batcher until a
+  slot frees — admission backpressure.
+- ``tensor_llm_serversrc`` (a Source, its own executor thread → decode
+  makes progress even when no new prompts arrive) steps the batcher and
+  emits one frame per *completed* request: tokens [1, n], with the
+  request frame's meta (client_id!) preserved, so a downstream
+  query-serversink routes each generation back to its requester.
+
+EOS: the sink's flush marks end-of-submissions; the src drains every
+pending request, then ends its stream.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import (
+    ElementError,
+    NegotiationError,
+    Sink,
+    Source,
+    Spec,
+)
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+_table: Dict[str, "_LlmServer"] = {}
+_table_lock = threading.Lock()
+
+
+def _get_server(srv_id: str, create_kw: Optional[dict] = None):
+    with _table_lock:
+        srv = _table.get(srv_id)
+        if srv is not None and create_kw is not None and srv.eos:
+            # stale server from a previous (stopped/drained) pipeline
+            # run reusing this id: replace rather than resurrect — its
+            # props may differ and its eos flag would end the new stream
+            srv = None
+        if srv is None:
+            if create_kw is None:
+                raise ElementError(
+                    f"tensor_llm_server id={srv_id}: no serversink created "
+                    "the server yet (the sink owns the model props)"
+                )
+            srv = _table[srv_id] = _LlmServer(**create_kw)
+        return srv
+
+
+def _drop_server(srv_id: str) -> None:
+    with _table_lock:
+        _table.pop(srv_id, None)
+
+
+class _LlmServer:
+    """Shared state between the sink (submit) and src (pump/emit)."""
+
+    def __init__(self, model: str, options: Dict[str, str], n_slots: int,
+                 max_len: int, prompt_len: int, default_new: int):
+        from nnstreamer_tpu.models import zoo
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        if not model.startswith("zoo:"):
+            raise ElementError(
+                f"tensor_llm_serversink: model must be zoo:<name>, got "
+                f"{model!r}"
+            )
+        m = zoo.get(model[len("zoo:"):], **options)
+        n_heads = int(options.get("n_heads", 8))
+        self.cb = ContinuousBatcher(
+            m.params, n_heads, n_slots=n_slots, max_len=max_len,
+            prompt_len=prompt_len,
+        )
+        self.default_new = default_new
+        self._lock = threading.Lock()
+        self._pending: Dict[int, dict] = {}  # rid -> request meta
+        self._out: deque = deque()
+        self.eos = False
+        self.stopped = False
+
+    def submit(self, frame: Frame) -> None:
+        import time as _time
+
+        prompt = np.asarray(frame.tensors[0]).reshape(-1).astype(np.int32)
+        budget = int(frame.meta.get("max_new_tokens", self.default_new))
+        while True:
+            if self.stopped:
+                raise ElementError("tensor_llm_serversink: stopped")
+            rid = self.cb.submit(prompt, budget)
+            if rid is not None:
+                break
+            # batch full: pumping here IS the backpressure — admission
+            # waits until decoding frees a slot. A no-progress pump is
+            # NOT an error: the src thread may have just stepped/ drained
+            # concurrently (freeing slots), so loop and retry submit.
+            if not self.pump():
+                _time.sleep(0.005)
+        with self._lock:
+            self._pending[rid] = dict(frame.meta)
+
+    def pump(self) -> bool:
+        """One decode step; harvest finished requests. True if anything
+        advanced (steps happened or results were collected)."""
+        emitted = self.cb.step()
+        harvested = False
+        with self._lock:
+            for rid in list(self._pending):
+                toks = self.cb.result(rid)
+                if toks is not None:
+                    meta = self._pending.pop(rid)
+                    self._out.append((toks, meta))
+                    harvested = True
+        return bool(emitted) or harvested
+
+    def pop(self):
+        with self._lock:
+            return self._out.popleft() if self._out else None
+
+    @property
+    def drained(self) -> bool:
+        with self._lock:
+            return self.eos and not self._pending and not self._out
+
+
+@registry.element("tensor_llm_serversink")
+class LlmServerSink(Sink):
+    """Submit prompt frames into the shared continuous batcher.
+
+    Props: id (pairing key), model (zoo:transformer_lm), custom
+    (model options, filter-style "k:v,k2:v2"), n-slots, max-len,
+    prompt-len, max-new-tokens (per-request default; per-frame
+    ``max_new_tokens`` meta overrides)."""
+
+    FACTORY_NAME = "tensor_llm_serversink"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.srv_id = str(self.get_property("id", "0"))
+        # filter-style "k:v,k2:v2" option grammar (one parser for all
+        # custom= props)
+        from nnstreamer_tpu.backends.base import FilterProps
+
+        options = FilterProps(
+            custom=str(self.get_property("custom", ""))
+        ).custom_dict()
+        self._create_kw = dict(
+            model=str(self.get_property("model", "zoo:transformer_lm")),
+            options=options,
+            n_slots=int(self.get_property("n-slots", 4)),
+            max_len=int(self.get_property("max-len", 256)),
+            prompt_len=int(self.get_property("prompt-len", 64)),
+            default_new=int(self.get_property("max-new-tokens", 16)),
+        )
+        self._server: Optional[_LlmServer] = None
+
+    def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
+        (spec,) = in_specs
+        if not isinstance(spec, TensorsSpec):
+            raise NegotiationError(f"{self.name}: needs tensor input")
+        self._server = _get_server(self.srv_id, self._create_kw)
+        return []
+
+    def render(self, frame: Frame) -> None:
+        self._server.submit(frame)
+
+    def on_eos(self) -> None:
+        if self._server is not None:
+            self._server.eos = True
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.eos = True
+            self._server.stopped = True
+
+
+@registry.element("tensor_llm_serversrc")
+class LlmServerSrc(Source):
+    """Emit one frame per completed generation: tokens [1, n] int32 with
+    the submitting frame's meta preserved (client_id routing)."""
+
+    FACTORY_NAME = "tensor_llm_serversrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.srv_id = str(self.get_property("id", "0"))
+
+    def stop(self) -> None:
+        # pipeline teardown (drained or not) releases the server — model
+        # params and KV caches must not outlive the pipeline in _table
+        _drop_server(self.srv_id)
+
+    def output_spec(self) -> Spec:
+        # generations vary in length per request → flexible
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def generate(self):
+        srv = _get_server(self.srv_id)
+        item = srv.pop()
+        if item is None:
+            if srv.drained:
+                _drop_server(self.srv_id)
+                return EOS_FRAME
+            srv.pump()  # decode even while no prompts arrive
+            item = srv.pop()
+            if item is None:
+                return None  # executor re-polls (bounded wait)
+        toks, meta = item
+        arr = np.asarray(toks, np.int32)[None, :]
+        return Frame((arr,), meta=meta)

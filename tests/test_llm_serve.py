@@ -1,0 +1,118 @@
+"""tensor_llm_serversink/src element tests: continuous-batching LLM
+serving through the pipeline surface (elements/llm_serve.py).
+
+The invariant chain: prompts in, per-request generations out with meta
+preserved, tokens byte-identical to decode.generate run alone."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import decode as dec
+from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+MODEL_OPTS = "vocab:211,d_model:32,n_heads:2,n_layers:2,seed:5"
+N_HEADS = 2
+
+
+def _params():
+    return tfm.init_params(
+        jax.random.PRNGKey(5), vocab=211, d_model=32, n_heads=2, n_layers=2
+    )
+
+
+def _alone(prompt, n_new):
+    toks = dec.generate(
+        _params(), np.asarray(prompt, np.int32)[None, :], N_HEADS, n_new
+    )
+    return [int(t) for t in np.asarray(toks)[0]]
+
+
+def test_llm_serve_pipeline_roundtrip():
+    """appsrc prompts → llm server pair → appsink generations. Meta rides
+    through; tokens match solo generation for every request."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    rng = np.random.default_rng(0)
+    prompts = {
+        f"req{i}": rng.integers(1, 211, (4 + 3 * i,)).astype(np.int32)
+        for i in range(3)
+    }
+
+    src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+    sink = LlmServerSink(
+        **{"id": "t0", "model": "zoo:transformer_lm", "custom": MODEL_OPTS,
+           "n-slots": 2, "max-len": 64, "prompt-len": 16,
+           "max-new-tokens": 6}
+    )
+    out_src = LlmServerSrc(**{"id": "t0"})
+    out_sink = AppSink()
+    p = Pipeline().chain(src, sink)
+    p.chain(out_src, out_sink)
+    p.start()
+    try:
+        for name, prompt in prompts.items():
+            src.push(Frame((prompt,), meta={"req": name}))
+        src.end_of_stream()
+        results = {}
+        while len(results) < len(prompts):
+            f = out_sink.pop(timeout=120)
+            assert f is not None, "serving pipeline drained early"
+            results[f.meta["req"]] = [int(t) for t in np.asarray(f.tensors[0])[0]]
+    finally:
+        p.stop()
+    for name, prompt in prompts.items():
+        assert results[name] == _alone(prompt, 6), f"{name} diverged"
+
+
+def test_llm_serve_cli_parses():
+    """Both elements resolve from a pipeline description (the reference's
+    pairing-by-id pattern, like tensor_repo)."""
+    p = parse_pipeline(
+        "tensorsrc dimensions=4:1 types=int32 num-frames=2 pattern=ones ! "
+        f'tensor_llm_serversink id=c1 custom="{MODEL_OPTS}" '
+        "max-new-tokens=3 n-slots=2 max-len=32 prompt-len=8 "
+        "tensor_llm_serversrc id=c1 ! tensor_sink name=out"
+    )
+    from nnstreamer_tpu import registry
+
+    sink = p["out"]
+    p.run(timeout=300)
+    assert sink.rendered == 2
+    for f in sink.frames:
+        assert f.tensors[0].shape == (1, 3)
+
+
+def test_src_without_sink_errors():
+    from nnstreamer_tpu.elements.base import ElementError
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSrc
+
+    src = LlmServerSrc(**{"id": "nosuch"})
+    with pytest.raises(ElementError, match="no serversink"):
+        src.generate()
+
+
+def test_stop_releases_server_and_id_is_reusable():
+    """Stopping a pipeline (drained or not) removes the server from the
+    global table; a later pipeline reusing the id gets a fresh server
+    with its own props."""
+    from nnstreamer_tpu.elements import llm_serve
+
+    for run in range(2):  # second run reuses id=r0
+        p = parse_pipeline(
+            "tensorsrc dimensions=4:1 types=int32 num-frames=1 pattern=ones"
+            f' ! tensor_llm_serversink id=r0 custom="{MODEL_OPTS}" '
+            "max-new-tokens=2 n-slots=1 max-len=16 prompt-len=8 "
+            "tensor_llm_serversrc id=r0 ! tensor_sink name=out"
+        )
+        p.run(timeout=120)
+        assert p["out"].rendered == 1
+        assert "r0" not in llm_serve._table, f"run {run}: server leaked"
