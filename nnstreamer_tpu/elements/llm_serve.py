@@ -218,16 +218,21 @@ class LlmServerSrc(Source):
         return TensorsSpec(format=TensorFormat.FLEXIBLE)
 
     def generate(self):
+        import time as _time
+
         srv = _get_server(self.srv_id)
         item = srv.pop()
         if item is None:
             if srv.drained:
                 _drop_server(self.srv_id)
                 return EOS_FRAME
-            srv.pump()  # decode even while no prompts arrive
+            if not srv.pump():  # decode even while no prompts arrive
+                # idle (no active slots): the executor re-polls
+                # immediately, so bound the spin here
+                _time.sleep(0.002)
             item = srv.pop()
             if item is None:
-                return None  # executor re-polls (bounded wait)
+                return None
         toks, meta = item
         arr = np.asarray(toks, np.int32)[None, :]
         return Frame((arr,), meta=meta)

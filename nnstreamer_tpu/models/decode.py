@@ -28,10 +28,11 @@ NEG_INF = -1e30
 def init_cache(
     params: Dict, batch: int, max_len: int, n_heads: int, dtype=jnp.float32
 ) -> Tuple[jax.Array, jax.Array]:
-    """Zeroed (k, v) cache [L, B, max_len, H, Dh]."""
+    """Zeroed (k, v) cache [L, B, max_len, KV, Dh] (KV < H under GQA)."""
     L, d = params["blocks"]["ln1"].shape
     hd = d // n_heads
-    shape = (L, batch, max_len, n_heads, hd)
+    kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, n_heads)
+    shape = (L, batch, max_len, kv, hd)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -126,18 +127,13 @@ def verify_chunk(
     def body(carry, layer):
         x = carry
         blk, ck, cv = layer
-        q, k, v = tfm.block_qkv(x, blk, n_heads, positions)  # [B,k,H,Dh]
+        q, k, v = tfm.block_qkv(x, blk, n_heads, positions)  # k/v [B,k,KV,Dh]
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
-        ) / (q.shape[-1] ** 0.5)
         mask = (
             jnp.arange(max_len)[None, :] <= positions[:, None]
         )  # [k, max_len]
-        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        o = tfm.cache_attention(q, ck, cv, mask[None])
         o = o.astype(x.dtype).reshape(b, kk_len, -1)
         x = x + o @ tfm.wt(blk["wo"], x.dtype)
         x = tfm.block_ffn(x, blk, ffn_fn)

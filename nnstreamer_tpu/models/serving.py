@@ -115,15 +115,9 @@ def batched_decode_step(
         else:
             blk, ck, cv = layer
         bsz, _, d = x.shape
-        h = n_heads
-        hd = d // h
-        y = tfm.rmsnorm(x, blk["ln1"])
-        qkv = y @ tfm.wt(blk["wqkv"], y.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        # per-slot positions: rope() takes [B,T] (here T=1)
-        q = tfm.rope(q.reshape(bsz, 1, h, hd), pos[:, None])
-        k = tfm.rope(k.reshape(bsz, 1, h, hd), pos[:, None])
-        v = v.reshape(bsz, 1, h, hd)
+        # per-slot positions: block_qkv → rope() take [B,T] (here T=1);
+        # k/v come back with KV ≤ H heads (GQA) matching the cache
+        q, k, v = tfm.block_qkv(x, blk, n_heads, pos[:, None])
         if quantized:
             k8, ks = quantize_kv(k)
             v8, vs = quantize_kv(v)
@@ -141,14 +135,8 @@ def batched_decode_step(
         if attn_fn is not None:
             o = attn_fn(q, ck, cv, pos)  # [B,1,H,Dh] f32
         else:
-            s = jnp.einsum(
-                "bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                ck.astype(jnp.float32),
-            ) / (hd ** 0.5)
             mask = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, max_len]
-            s = jnp.where(mask[:, None, None, :], s, NEG_INF)
-            p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+            o = tfm.cache_attention(q, ck, cv, mask[:, None, :])
         o = o.astype(x.dtype).reshape(bsz, 1, -1)
         x = x + o @ tfm.wt(blk["wo"], x.dtype)
         x = tfm.block_ffn(x, blk)
@@ -271,7 +259,8 @@ class ContinuousBatcher:
 
         L, d = params["blocks"]["ln1"].shape
         hd = d // n_heads
-        shape = (L, n_slots, max_len, n_heads, hd)
+        kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, n_heads)
+        shape = (L, n_slots, max_len, kv, hd)
         if quantized_cache:
             sshape = shape[:-1]
             self._cache = (

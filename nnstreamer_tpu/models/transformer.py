@@ -84,8 +84,19 @@ def init_params(
     n_heads: int = 8,
     n_layers: int = 4,
     d_ff: Optional[int] = None,
+    n_kv_heads: Optional[int] = None,
 ) -> Dict:
+    """``n_kv_heads < n_heads`` = grouped-query attention: K/V project to
+    fewer heads, each shared by n_heads/n_kv_heads query heads — the KV
+    cache (decode's HBM footprint) shrinks by that factor. The kv head
+    count is never passed around: block_qkv derives it from wqkv's width,
+    so every consumer (prefill, decode, serving, speculative) supports
+    GQA params transparently."""
     d_ff = d_ff or 4 * d_model
+    kv = n_kv_heads or n_heads
+    if n_heads % kv:
+        raise ValueError(f"n_heads {n_heads} not divisible by n_kv_heads {kv}")
+    hd = d_model // n_heads
     k = iter(jax.random.split(key, 8))
     L = n_layers
 
@@ -96,7 +107,9 @@ def init_params(
     blocks = {
         "ln1": jnp.ones((L, d_model), jnp.float32),
         "ln2": jnp.ones((L, d_model), jnp.float32),
-        "wqkv": stack(lambda kk: _init_dense(kk, d_model, 3 * d_model)),
+        "wqkv": stack(
+            lambda kk: _init_dense(kk, d_model, d_model + 2 * kv * hd)
+        ),
         "wo": stack(lambda kk: _init_dense(kk, d_model, d_model)),
         "w_gate": stack(lambda kk: _init_dense(kk, d_model, d_ff)),
         "w_up": stack(lambda kk: _init_dense(kk, d_model, d_ff)),
@@ -121,17 +134,62 @@ def block_ffn(x, blk: Dict, ffn_fn: Optional[Callable] = None):
     return x + (gate * up) @ wt(blk["w_down"], y.dtype)
 
 
+def n_kv_heads_of(blk_wqkv, d_model: int, n_heads: int) -> int:
+    """Derive the kv head count from the fused projection's width
+    (d_model q columns + 2·kv·hd k/v columns)."""
+    hd = d_model // n_heads
+    total = blk_wqkv["w8"].shape[-1] if isinstance(blk_wqkv, dict) else blk_wqkv.shape[-1]
+    return (total - d_model) // (2 * hd)
+
+
+def repeat_kv(t, n_heads: int):
+    """[B,T,KV,Dh] → [B,T,H,Dh]: expand grouped K/V heads for attention
+    (each kv head serves n_heads/kv query heads)."""
+    kv = t.shape[2]
+    if kv == n_heads:
+        return t
+    return jnp.repeat(t, n_heads // kv, axis=2)
+
+
+NEG_INF = -1e30
+
+
+def cache_attention(q, ck, cv, mask):
+    """Masked attention against a KV cache, GQA-aware without expansion.
+
+    q [B,T,H,Dh], ck/cv [B,S,KV,Dh] (KV ≤ H), mask [B,T,S] bool (or
+    broadcastable) → o [B,T,H,Dh] float32. Query heads fold into
+    [KV, H/KV] groups and contract the compact cache directly — the
+    decode hot loop streams KV-head-sized tensors, which is the entire
+    point of a grouped cache (an explicit repeat_kv here would
+    re-materialize the H-head copy every step and layer)."""
+    b, t, h, hd = q.shape
+    kv = ck.shape[2]
+    g = h // kv
+    q5 = q.astype(jnp.float32).reshape(b, t, kv, g, hd)
+    s = jnp.einsum(
+        "btkgd,bskd->bkgts", q5, ck.astype(jnp.float32)
+    ) / (hd ** 0.5)
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgts,bskd->btkgd", p, cv.astype(jnp.float32))
+    return o.reshape(b, t, h, hd)
+
+
 def block_qkv(x, blk: Dict, n_heads: int, positions):
-    """Pre-norm + qkv projection + RoPE → (q, k, v) [B,T,H,Dh]."""
+    """Pre-norm + qkv projection + RoPE → q [B,T,H,Dh], k/v [B,T,KV,Dh]
+    (KV ≤ H under grouped-query attention; KV == H otherwise)."""
     b, t, d = x.shape
     h = n_heads
     hd = d // h
+    kv = n_kv_heads_of(blk["wqkv"], d, h)
     y = rmsnorm(x, blk["ln1"])
     qkv = y @ wt(blk["wqkv"], y.dtype)
-    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    q = qkv[..., :d]
+    kk, v = jnp.split(qkv[..., d:], 2, axis=-1)
     q = rope(q.reshape(b, t, h, hd), positions)
-    kk = rope(kk.reshape(b, t, h, hd), positions)
-    return q, kk, v.reshape(b, t, h, hd)
+    kk = rope(kk.reshape(b, t, kv, hd), positions)
+    return q, kk, v.reshape(b, t, kv, hd)
 
 
 def block_apply(
@@ -153,7 +211,9 @@ def block_apply(
     attn = attn_fn or dense_attention
     b, t, d = x.shape
     q, kk, v = block_qkv(x, blk, n_heads, positions)
-    o = attn(q, kk, v, causal=causal).astype(x.dtype)
+    o = attn(
+        q, repeat_kv(kk, n_heads), repeat_kv(v, n_heads), causal=causal
+    ).astype(x.dtype)
     x = x + o.reshape(b, t, d) @ wt(blk["wo"], x.dtype)
     x = block_ffn(x, blk, ffn_fn)
     if return_kv:

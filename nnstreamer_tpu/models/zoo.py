@@ -314,8 +314,12 @@ def _transformer_lm(**options) -> ZooModel:
     batch = int(options.get("batch", 1))
     seqlen = int(options.get("seqlen", 128))
     dtype = _compute_dtype(options)
+    n_kv_heads = int(options.get("n_kv_heads", n_heads))
     params = _load_params_overlay(
-        tfm.init_params(jax.random.PRNGKey(seed), vocab, d_model, n_heads, n_layers),
+        tfm.init_params(
+            jax.random.PRNGKey(seed), vocab, d_model, n_heads, n_layers,
+            n_kv_heads=n_kv_heads,
+        ),
         options,
     )
     if options.get("quantize") == "int8w":

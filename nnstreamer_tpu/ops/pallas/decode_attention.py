@@ -97,11 +97,17 @@ def decode_attention(
     block_k: int = 128,
     interpret: bool = False,
 ):
-    """q [B,1,H,D], cache_k/v [B,S,H,D] (the serving layout, consumed
-    in place), pos [B] → o [B,1,H,D] float32. Positions > pos[b] are
-    masked per slot."""
+    """q [B,1,H,D], cache_k/v [B,S,KV,D] (the serving layout, consumed
+    in place; KV ≤ H under grouped-query attention — query head hi reads
+    kv head hi//(H/KV) straight from the BlockSpec index map, no
+    expansion pass), pos [B] → o [B,1,H,D] float32. Positions > pos[b]
+    are masked per slot."""
     b, _, h, d = q.shape
     s_len = cache_k.shape[1]
+    n_kv = cache_k.shape[2]
+    if h % n_kv:
+        raise ValueError(f"query heads {h} not divisible by kv heads {n_kv}")
+    group = h // n_kv
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bk = _pick_block(s_len, block_k)
     n_k = s_len // bk
@@ -114,8 +120,14 @@ def decode_attention(
         grid=(b, h, n_k),
         in_specs=[
             pl.BlockSpec((1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)),
-            pl.BlockSpec((1, bk, 1, d), lambda bi, hi, kk, pos_ref: (bi, kk, hi, 0)),
-            pl.BlockSpec((1, bk, 1, d), lambda bi, hi, kk, pos_ref: (bi, kk, hi, 0)),
+            pl.BlockSpec(
+                (1, bk, 1, d),
+                lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0),
+            ),
+            pl.BlockSpec(
+                (1, bk, 1, d),
+                lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0),
+            ),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)

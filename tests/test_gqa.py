@@ -1,0 +1,116 @@
+"""Grouped-query attention tests: KV heads < query heads across the whole
+family — forward, KV-cache decode, serving, speculative, Pallas kernel.
+
+GQA's contract here: the kv head count is carried by wqkv's width alone
+(transformer.n_kv_heads_of), so every consumer picks it up with no API
+change, and the KV cache shrinks by n_heads/n_kv_heads.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import decode as dec
+from nnstreamer_tpu.models import transformer as tfm
+
+H, KV = 8, 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(
+        jax.random.PRNGKey(2), vocab=127, d_model=64, n_heads=H,
+        n_layers=2, n_kv_heads=KV,
+    )
+
+
+def _toks(n, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(1, 127, (1, n)), jnp.int32
+    )
+
+
+def test_forward_shapes_and_finite(params):
+    logits = tfm.apply(params, _toks(10), H)
+    assert logits.shape == (1, 10, 127)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_kv_cache_is_grouped(params):
+    ck, cv = dec.init_cache(params, 1, 32, H)
+    assert ck.shape == (2, 1, 32, KV, 64 // H)  # KV heads, not H
+
+
+def test_generate_matches_dense_argmax_chain(params):
+    """KV-cache greedy decode == full-forward argmax chain (the same
+    invariant test_decode checks for MHA, under GQA)."""
+    prompt = _toks(6, 1)
+    got = np.asarray(dec.generate(params, prompt, H, 5))[0]
+    seq = np.asarray(prompt)[0].tolist()
+    for _ in range(5):
+        logits = tfm.apply(params, jnp.asarray(seq)[None, :], H)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        seq.append(nxt)
+    np.testing.assert_array_equal(got, seq[-5:])
+
+
+def test_serving_with_gqa(params):
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    cb = ContinuousBatcher(params, H, n_slots=2, max_len=32, prompt_len=8)
+    prompt = np.asarray(_toks(5, 2))[0]
+    rid = cb.submit(prompt, 4)
+    while cb.result(rid) is None:
+        cb.step()
+    alone = [int(t) for t in np.asarray(
+        dec.generate(params, prompt[None, :], H, 4))[0]]
+    assert cb.result(rid) == alone
+
+
+def test_speculative_with_gqa_draft(params):
+    from nnstreamer_tpu.models.speculative import speculative_generate
+
+    draft = tfm.init_params(
+        jax.random.PRNGKey(7), vocab=127, d_model=32, n_heads=4,
+        n_layers=1, n_kv_heads=1,  # MQA draft
+    )
+    prompt = _toks(7, 3)
+    toks, _ = speculative_generate(
+        params, draft, prompt, H, 8, draft_n_heads=4, k=3
+    )
+    np.testing.assert_array_equal(
+        np.asarray(toks), np.asarray(dec.generate(params, prompt, H, 8))
+    )
+
+
+def test_pallas_kernel_reads_grouped_cache(params):
+    from nnstreamer_tpu.ops.pallas.decode_attention import decode_attention
+
+    rng = np.random.default_rng(4)
+    b, s_len, hd = 2, 32, 16
+    q = jnp.asarray(rng.standard_normal((b, 1, H, hd)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((b, s_len, KV, hd)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((b, s_len, KV, hd)), jnp.float32)
+    pos = jnp.asarray([3, 30], jnp.int32)
+    out = decode_attention(q, ck, cv, pos, block_k=16, interpret=True)
+
+    ckr = tfm.repeat_kv(ck, H)
+    cvr = tfm.repeat_kv(cv, H)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, ckr) / (hd ** 0.5)
+    mask = jnp.arange(s_len)[None, :] <= pos[:, None]
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), cvr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gqa_with_int8w_weights(params):
+    from nnstreamer_tpu.models.quantize import quantize_lm_weights
+
+    qp = quantize_lm_weights(params)
+    prompt = _toks(6, 5)
+    toks = dec.generate(qp, prompt, H, 4)
+    assert np.asarray(toks).shape == (1, 4)
+    # cache stays grouped under quantized weights too
+    ck, _ = dec.init_cache(qp, 1, 16, H)
+    assert ck.shape[3] == KV
