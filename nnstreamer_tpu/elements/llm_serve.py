@@ -107,10 +107,17 @@ class _LlmServer:
 
         prompt = np.asarray(frame.tensors[0]).reshape(-1).astype(np.int32)
         budget = int(frame.meta.get("max_new_tokens", self.default_new))
+        # per-request sampling params ride in frame meta (greedy default)
+        kw = dict(
+            temperature=float(frame.meta.get("temperature", 0.0)),
+            top_k=int(frame.meta.get("top_k", 0)),
+        )
+        if "seed" in frame.meta:
+            kw["seed"] = int(frame.meta["seed"])
         while True:
             if self.stopped:
                 raise ElementError("tensor_llm_serversink: stopped")
-            rid = self.cb.submit(prompt, budget)
+            rid = self.cb.submit(prompt, budget, **kw)
             if rid is not None:
                 break
             # batch full: pumping here IS the backpressure — admission

@@ -22,8 +22,6 @@ import jax.numpy as jnp
 
 from nnstreamer_tpu.models import transformer as tfm
 
-NEG_INF = -1e30
-
 
 def init_cache(
     params: Dict, batch: int, max_len: int, n_heads: int, dtype=jnp.float32

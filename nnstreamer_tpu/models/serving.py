@@ -44,8 +44,6 @@ import numpy as np
 from nnstreamer_tpu.models import decode as dec
 from nnstreamer_tpu.models import transformer as tfm
 
-NEG_INF = -1e30
-
 
 def quantize_kv(t):
     """[..., H, Dh] float → (int8 same shape, f32 scale [..., H]).
@@ -191,12 +189,30 @@ def insert_slot(cache, ks, vs, slot):
 class _Request:
     rid: int
     budget: int
+    temperature: float = 0.0
+    top_k: int = 0
+    rng: Optional[np.random.Generator] = None
     tokens: List[int] = field(default_factory=list)
     done: bool = False
 
+    def pick(self, logits_row: np.ndarray) -> int:
+        """Select this request's next token from its logits row (host-
+        side: sampling params are per-request, batches mix freely)."""
+        if self.temperature <= 0.0:
+            return int(logits_row.argmax())
+        scaled = logits_row.astype(np.float64) / self.temperature
+        if self.top_k > 0 and self.top_k < scaled.shape[0]:
+            kth = np.partition(scaled, -self.top_k)[-self.top_k]
+            scaled = np.where(scaled >= kth, scaled, -np.inf)
+        scaled -= scaled.max()
+        p = np.exp(scaled)
+        p /= p.sum()
+        return int(self.rng.choice(p.shape[0], p=p))
+
 
 class ContinuousBatcher:
-    """Greedy continuous-batching server over a fixed slot batch.
+    """Continuous-batching server over a fixed slot batch (greedy by
+    default; per-request temperature/top-k sampling via submit()).
 
     submit() may be called at any time (thread-safe); step() advances every
     active slot by one token. Finished requests free their slot for the
@@ -317,10 +333,21 @@ class ContinuousBatcher:
         self._insert = jax.jit(insert_slot)
 
     # -- client API --------------------------------------------------------
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Optional[int]:
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: Optional[int] = None,
+    ) -> Optional[int]:
         """Claim a free slot for ``prompt`` [T] (T ≤ prompt_len); returns a
         request id, or None when the batch is full (caller queues/retries —
-        the admission queue is the caller's policy, not the batcher's)."""
+        the admission queue is the caller's policy, not the batcher's).
+
+        Sampling is per-request: temperature ≤ 0 is greedy; otherwise
+        softmax sampling (optionally top-k truncated) with a deterministic
+        per-request stream seeded by ``seed`` (default: the request id)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
         if max_new_tokens < 1:
@@ -346,14 +373,17 @@ class ContinuousBatcher:
                 return None
             rid = self._next_rid
             self._next_rid += 1
-            req = _Request(rid, max_new_tokens)
+            req = _Request(
+                rid, max_new_tokens, temperature=temperature, top_k=top_k,
+                rng=np.random.default_rng(rid if seed is None else seed),
+            )
             self._slots[slot] = req
 
         try:
             padded = np.zeros((1, self.prompt_len), np.int32)
             padded[0, :t] = prompt
             logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
-            first = int(jnp.argmax(logits[0, t - 1]))
+            first = req.pick(np.asarray(logits[0, t - 1]))
         except Exception:
             # release the claimed slot or n_slots failed prefills would
             # brick the server with every slot claimed-but-never-active
@@ -380,10 +410,25 @@ class ContinuousBatcher:
             logits, self._cache, self._pos = self._step(
                 self._tok, self._pos, active, self._cache
             )
-            new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            self._tok = self._pin(jnp.where(active, new_tok, self._tok))
+            sampling = any(
+                req is not None and self._active[s] and req.temperature > 0
+                for s, req in enumerate(self._slots)
+            )
             emitted: Dict[int, int] = {}
-            toks = np.asarray(self._tok)
+            if sampling:
+                # mixed batch: per-request pick on host logits
+                lg = np.asarray(logits)
+                toks = np.asarray(self._tok).copy()
+                for slot, req in enumerate(self._slots):
+                    if req is None or not self._active[slot]:
+                        continue
+                    toks[slot] = req.pick(lg[slot])
+                self._tok = self._pin(jnp.asarray(toks))
+            else:
+                # all-greedy fast path: argmax on device, one transfer
+                new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                self._tok = self._pin(jnp.where(active, new_tok, self._tok))
+                toks = np.asarray(self._tok)
             for slot, req in enumerate(self._slots):
                 if req is None or not self._active[slot]:
                     continue

@@ -116,3 +116,35 @@ def test_stop_releases_server_and_id_is_reusable():
         p.run(timeout=120)
         assert p["out"].rendered == 1
         assert "r0" not in llm_serve._table, f"run {run}: server leaked"
+
+
+def test_sampling_meta_rides_through():
+    """temperature/seed in frame meta select sampled generation; same
+    seed → same tokens across two server runs."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    prompt = np.random.default_rng(50).integers(1, 211, (6,)).astype(np.int32)
+    outs = []
+    for run in range(2):
+        src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+        sink = LlmServerSink(
+            **{"id": f"s{run}", "custom": MODEL_OPTS, "n-slots": 1,
+               "max-len": 48, "prompt-len": 16, "max-new-tokens": 8}
+        )
+        out_sink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.chain(LlmServerSrc(**{"id": f"s{run}"}), out_sink)
+        p.start()
+        try:
+            src.push(Frame((prompt,), meta={"temperature": 0.9, "seed": 11}))
+            src.end_of_stream()
+            f = out_sink.pop(timeout=120)
+            outs.append([int(t) for t in np.asarray(f.tensors[0])[0]])
+        finally:
+            p.stop()
+    assert outs[0] == outs[1]
