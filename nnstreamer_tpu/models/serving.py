@@ -191,9 +191,17 @@ class _Request:
     budget: int
     temperature: float = 0.0
     top_k: int = 0
+    stop_token: Optional[int] = None
     rng: Optional[np.random.Generator] = None
     tokens: List[int] = field(default_factory=list)
     done: bool = False
+
+    def finished(self) -> bool:
+        """Budget exhausted, or the stop token was emitted (which stays
+        in the output, like an EOS id in any serving API)."""
+        if len(self.tokens) >= self.budget:
+            return True
+        return bool(self.tokens) and self.tokens[-1] == self.stop_token
 
     def pick(self, logits_row: np.ndarray) -> int:
         """Select this request's next token from its logits row (host-
@@ -340,6 +348,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: Optional[int] = None,
+        stop_token: Optional[int] = None,
     ) -> Optional[int]:
         """Claim a free slot for ``prompt`` [T] (T ≤ prompt_len); returns a
         request id, or None when the batch is full (caller queues/retries —
@@ -375,6 +384,7 @@ class ContinuousBatcher:
             self._next_rid += 1
             req = _Request(
                 rid, max_new_tokens, temperature=temperature, top_k=top_k,
+                stop_token=stop_token,
                 rng=np.random.default_rng(rid if seed is None else seed),
             )
             self._slots[slot] = req
@@ -397,7 +407,7 @@ class ContinuousBatcher:
             self._pos = self._pin(self._pos.at[slot].set(t))
             self._active[slot] = True
             req.tokens.append(first)
-            if len(req.tokens) >= req.budget:
+            if req.finished():
                 self._finish(slot)
         return rid
 
@@ -435,7 +445,7 @@ class ContinuousBatcher:
                 tok = int(toks[slot])
                 req.tokens.append(tok)
                 emitted[req.rid] = tok
-                if len(req.tokens) >= req.budget:
+                if req.finished():
                     self._finish(slot)
             return emitted
 

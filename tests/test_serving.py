@@ -271,3 +271,20 @@ class TestSampling:
         while cb.result(rid) is None:
             cb.step()
         assert cb.result(rid) == _alone(params, p, 6)
+
+
+def test_stop_token_ends_request_early(params):
+    """The request finishes as soon as its stop token is emitted; the
+    stop token stays in the output (EOS-id semantics)."""
+    prompt = _prompt(8, 60)
+    full = _alone(params, prompt, 12)
+    stop = full[4]  # force an early stop at a token we know appears
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=64,
+                           prompt_len=16)
+    rid = cb.submit(prompt, 12, stop_token=stop)
+    while cb.result(rid) is None:
+        cb.step()
+    got = cb.result(rid)
+    assert got == full[:5]
+    assert got[-1] == stop
+    assert cb.n_free == 1
