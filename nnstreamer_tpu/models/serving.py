@@ -69,6 +69,7 @@ def batched_decode_step(
     n_heads: int,
     compute_dtype=jnp.float32,
     attn_fn=None,
+    windowed: bool = False,
 ):
     """One decode step for a whole slot batch.
 
@@ -80,7 +81,19 @@ def batched_decode_step(
     ops/pallas/decode_attention.py; float caches only).
 
     ``cache`` is either ``(ck, cv)`` (float) or
-    ``((ck8, kscale), (cv8, vscale))`` (int8, see quantize_kv)."""
+    ``((ck8, kscale), (cv8, vscale))`` (int8, see quantize_kv).
+
+    ``windowed=True`` treats the cache's length dim as a RING over the
+    last max_len tokens (sliding-window attention): writes land at
+    ``pos % max_len``, and that is the ONLY change — the ≤pos liveness
+    mask saturates to all-live once pos ≥ max_len, which is exactly the
+    ring's semantics (every entry then holds one of the last max_len
+    tokens). K rows are stored already RoPE-rotated at their absolute
+    position, so the softmax needs only the *set* of the last-W keys,
+    never their ring order; ``pos`` keeps counting absolute tokens,
+    which is what keeps RoPE exact across arbitrarily long streams.
+    The same saturation argument makes windowed compose with attn_fn
+    (the Pallas kernel's ``cols ≤ pos`` mask degenerates identically)."""
     quantized = isinstance(cache[0], tuple)
     if quantized and attn_fn is not None:
         raise ValueError(
@@ -91,19 +104,20 @@ def batched_decode_step(
     b = tok.shape[0]
     x = tfm.embed_lookup(params["embed"], tok, compute_dtype)[:, None, :]
     gate = active[:, None, None, None]
+    wpos = pos % max_len if windowed else pos
 
     def write(c, new):
         """c [B,max_len,H,Dh] ← new [B,1,H,Dh] at per-slot pos, if active."""
         written = jax.vmap(
             lambda cb, nb, p: jax.lax.dynamic_update_slice(cb, nb, (p, 0, 0))
-        )(c, new.astype(c.dtype), pos)
+        )(c, new.astype(c.dtype), wpos)
         return jnp.where(gate, written, c)
 
     def write_scale(sc, new):
         """sc [B,max_len,H] ← new [B,1,H] at per-slot pos, if active."""
         written = jax.vmap(
             lambda sb, nb, p: jax.lax.dynamic_update_slice(sb, nb, (p, 0))
-        )(sc, new, pos)
+        )(sc, new, wpos)
         return jnp.where(gate[..., 0], written, sc)
 
     def body(carry, layer):
@@ -133,7 +147,10 @@ def batched_decode_step(
         if attn_fn is not None:
             o = attn_fn(q, ck, cv, pos)  # [B,1,H,Dh] f32
         else:
-            mask = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, max_len]
+            # liveness mask [B, max_len]: the ≤pos prefix — which
+            # saturates to all-live past a ring wrap (windowed), exactly
+            # the last-W-tokens semantics
+            mask = jnp.arange(max_len)[None, :] <= pos[:, None]
             o = tfm.cache_attention(q, ck, cv, mask[:, None, :])
         o = o.astype(x.dtype).reshape(bsz, 1, -1)
         x = x + o @ tfm.wt(blk["wo"], x.dtype)
@@ -240,7 +257,13 @@ class ContinuousBatcher:
         cache_dtype: str = "auto",
         mesh=None,
         slots_axis: str = "dp",
+        windowed: bool = False,
     ):
+        """``windowed=True`` makes max_len a sliding attention window
+        over a ring-buffer cache: generations of ANY length run in the
+        fixed [max_len] cache, each token attending the previous max_len
+        (Mistral-style sliding-window attention — the time-axis sibling
+        of tensor_aggregator's bounded windows)."""
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
         if cache_dtype not in ("auto", "int8"):
@@ -271,6 +294,7 @@ class ContinuousBatcher:
         self.n_heads = n_heads
         self.n_slots = n_slots
         self.max_len = max_len
+        self.windowed = windowed
         self.prompt_len = prompt_len
         self.compute_dtype = compute_dtype
         self._lock = threading.Lock()
@@ -335,7 +359,7 @@ class ContinuousBatcher:
         self._step = jax.jit(
             lambda tok, pos, active, cache: batched_decode_step(
                 params, tok, pos, active, cache, n_heads, compute_dtype,
-                attn_fn=attn_fn,
+                attn_fn=attn_fn, windowed=windowed,
             )
         )
         self._insert = jax.jit(insert_slot)
@@ -365,10 +389,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt length {t} not in [1, {self.prompt_len}]"
             )
-        if t + max_new_tokens > self.max_len:
+        if not self.windowed and t + max_new_tokens > self.max_len:
             raise ValueError(
                 f"{t}+{max_new_tokens} tokens would overflow max_len="
-                f"{self.max_len}"
+                f"{self.max_len} (windowed=True lifts this: the cache "
+                "becomes a sliding ring)"
             )
         with self._lock:
             # claim only — the slot is owned (so no other submit takes it)

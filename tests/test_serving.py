@@ -288,3 +288,90 @@ def test_stop_token_ends_request_early(params):
     assert got == full[:5]
     assert got[-1] == stop
     assert cb.n_free == 1
+
+
+class TestSlidingWindow:
+    def test_window_large_enough_matches_plain(self, params):
+        """When no wrap happens, windowed == plain (same programs,
+        identical ring/prefix masks)."""
+        prompt = _prompt(10, 70)
+        outs = {}
+        for label, kw in (("plain", {}), ("ring", dict(windowed=True))):
+            cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=64,
+                                   prompt_len=16, **kw)
+            rid = cb.submit(prompt, 8)
+            while cb.result(rid) is None:
+                cb.step()
+            outs[label] = cb.result(rid)
+        assert outs["plain"] == outs["ring"]
+
+    def test_generation_beyond_cache_length(self, params):
+        """A generation much longer than the cache runs in fixed memory
+        and every token is finite/valid (the whole point of the ring)."""
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=24,
+                               prompt_len=16, windowed=True)
+        prompt = _prompt(8, 71)
+        rid = cb.submit(prompt, 60)  # 8 + 60 >> 24
+        while cb.result(rid) is None:
+            assert cb.step()
+        toks = cb.result(rid)
+        assert len(toks) == 60
+        assert all(0 <= t < 257 for t in toks)
+
+    def test_ring_matches_sliding_mask_on_unbounded_cache(self, params):
+        """The real post-wrap check: the ring stream must equal a
+        reference stream computed on an UNBOUNDED cache whose attention
+        is masked to exactly the last W positions (a sliding-mask
+        attn_fn) — byte-identical through many wrapped steps."""
+        from nnstreamer_tpu.models import transformer as tfm
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        W = 16
+        n_new = 40  # wraps the W-ring several times
+
+        def sliding_attn(q, ck, cv, pos):
+            s_len = ck.shape[1]
+            idx = jnp.arange(s_len)[None, :]
+            mask = (idx <= pos[:, None]) & (idx > pos[:, None] - W)
+            return tfm.cache_attention(q, ck, cv, mask[:, None, :])
+
+        prompt = _prompt(10, 72)
+        outs = {}
+        for label, kw in (
+            ("ring", dict(max_len=W, windowed=True)),
+            ("reference", dict(max_len=96, attn_impl="xla")),
+        ):
+            cb = ContinuousBatcher(params, N_HEADS, n_slots=1,
+                                   prompt_len=16, **kw)
+            if label == "reference":
+                # swap in the sliding-mask attention over the big cache
+                from nnstreamer_tpu.models.serving import (
+                    batched_decode_step,
+                )
+
+                cb._step = jax.jit(
+                    lambda tok, pos, active, cache: batched_decode_step(
+                        params, tok, pos, active, cache, N_HEADS,
+                        attn_fn=sliding_attn,
+                    )
+                )
+            rid = cb.submit(prompt, n_new)
+            while cb.result(rid) is None:
+                cb.step()
+            outs[label] = cb.result(rid)
+        assert outs["ring"] == outs["reference"]
+
+    def test_ring_with_pallas_kernel(self, params):
+        """windowed composes with the Pallas kernel (its <=pos mask
+        saturates identically past the wrap)."""
+        prompt = _prompt(8, 73)
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=16,
+                                   prompt_len=16, windowed=True,
+                                   attn_impl=impl)
+            rid = cb.submit(prompt, 20)
+            while cb.result(rid) is None:
+                cb.step()
+            outs[impl] = cb.result(rid)
+        assert outs["xla"] == outs["pallas"]
