@@ -95,6 +95,7 @@ def verify_chunk(
     n_heads: int,
     ffn_fn: Optional[Callable] = None,
     compute_dtype=jnp.float32,
+    return_logits: bool = True,
 ):
     """Score a k-token candidate chunk in ONE forward against the cache.
 
@@ -140,6 +141,11 @@ def verify_chunk(
     x, (cache_k, cache_v) = jax.lax.scan(
         body, x, (params["blocks"], cache_k, cache_v)
     )
+    if not return_logits:
+        # cache-advance only (chunked prefill's non-final buckets): skip
+        # the ln_f + vocab-sized head projection, which dominates a
+        # short chunk's FLOPs
+        return None, (cache_k, cache_v), pos + kk_len
     x = tfm.rmsnorm(x, params["ln_f"])
     logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
     return logits, (cache_k, cache_v), pos + kk_len

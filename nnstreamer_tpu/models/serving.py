@@ -91,7 +91,8 @@ def batched_decode_step(
     tokens). K rows are stored already RoPE-rotated at their absolute
     position, so the softmax needs only the *set* of the last-W keys,
     never their ring order; ``pos`` keeps counting absolute tokens,
-    which is what keeps RoPE exact across arbitrarily long streams.
+    which keeps RoPE exact for as long as f32 can hold the position
+    (~16.7M tokens — rope() computes angles in float32).
     The same saturation argument makes windowed compose with attn_fn
     (the Pallas kernel's ``cols ≤ pos`` mask degenerates identically)."""
     quantized = isinstance(cache[0], tuple)
@@ -356,6 +357,28 @@ class ContinuousBatcher:
                 compute_dtype=compute_dtype,
             )
         )
+        # chunked-prefill programs (prompts longer than the bucket): a
+        # staging cache padded to a bucket multiple so every chunk write
+        # fits, advanced one verify_chunk per bucket
+        self._stage_len = -(-max_len // prompt_len) * prompt_len
+        self._prefill_stage = jax.jit(
+            lambda toks: dec.prefill(
+                params, toks, n_heads, self._stage_len,
+                compute_dtype=compute_dtype,
+            )
+        )
+        self._prefill_chunk = jax.jit(
+            lambda toks, cpos, cache: dec.verify_chunk(
+                params, toks, cpos, cache, n_heads,
+                compute_dtype=compute_dtype,
+            )
+        )
+        self._advance_chunk = jax.jit(
+            lambda toks, cpos, cache: dec.verify_chunk(
+                params, toks, cpos, cache, n_heads,
+                compute_dtype=compute_dtype, return_logits=False,
+            )[1]
+        )
         self._step = jax.jit(
             lambda tok, pos, active, cache: batched_decode_step(
                 params, tok, pos, active, cache, n_heads, compute_dtype,
@@ -374,9 +397,12 @@ class ContinuousBatcher:
         seed: Optional[int] = None,
         stop_token: Optional[int] = None,
     ) -> Optional[int]:
-        """Claim a free slot for ``prompt`` [T] (T ≤ prompt_len); returns a
-        request id, or None when the batch is full (caller queues/retries —
-        the admission queue is the caller's policy, not the batcher's).
+        """Claim a free slot for ``prompt`` [T]; returns a request id, or
+        None when the batch is full (caller queues/retries — the
+        admission queue is the caller's policy, not the batcher's).
+        Prompts longer than the prompt_len bucket prefill in bucket-sized
+        chunks (decode.verify_chunk), so T is bounded by the cache, not
+        the bucket.
 
         Sampling is per-request: temperature ≤ 0 is greedy; otherwise
         softmax sampling (optionally top-k truncated) with a deterministic
@@ -385,9 +411,17 @@ class ContinuousBatcher:
         t = prompt.shape[0]
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be ≥ 1, got {max_new_tokens}")
-        if t == 0 or t > self.prompt_len:
+        if t == 0:
+            raise ValueError("empty prompt")
+        if t > self.prompt_len and self.windowed:
             raise ValueError(
-                f"prompt length {t} not in [1, {self.prompt_len}]"
+                f"windowed batcher ingests at most prompt_len="
+                f"{self.prompt_len} prompt tokens (sliding prefill of "
+                f"longer prompts is not supported); got {t}"
+            )
+        if t > self.max_len:
+            raise ValueError(
+                f"prompt length {t} > max_len {self.max_len}"
             )
         if not self.windowed and t + max_new_tokens > self.max_len:
             raise ValueError(
@@ -415,10 +449,41 @@ class ContinuousBatcher:
             self._slots[slot] = req
 
         try:
-            padded = np.zeros((1, self.prompt_len), np.int32)
-            padded[0, :t] = prompt
-            logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
-            first = req.pick(np.asarray(logits[0, t - 1]))
+            P = self.prompt_len
+            if t <= P:
+                padded = np.zeros((1, P), np.int32)
+                padded[0, :t] = prompt
+                logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
+                logits = logits[:, t - 1 : t]
+            else:
+                # chunked prefill: first bucket fills a staging cache,
+                # each further bucket advances it via verify_chunk; pad
+                # K/V beyond the true length are overwritten by decode
+                # steps before the ≤pos mask can reach them
+                chunk0 = np.ascontiguousarray(prompt[:P])[None, :]
+                logits, stage, _ = self._prefill_stage(jnp.asarray(chunk0))
+                cpos = P
+                while cpos < t:
+                    n = min(P, t - cpos)
+                    chunk = np.zeros((1, P), np.int32)
+                    chunk[0, :n] = prompt[cpos : cpos + n]
+                    is_final = cpos + n >= t
+                    args = (
+                        jnp.asarray(chunk), jnp.asarray(cpos, jnp.int32),
+                        stage,
+                    )
+                    if is_final:
+                        logits, stage, _ = self._prefill_chunk(*args)
+                    else:
+                        # non-final buckets only advance the cache (no
+                        # vocab-head projection)
+                        stage = self._advance_chunk(*args)
+                    cpos += n
+                last = (t - 1) % P  # true last token's index in the chunk
+                logits = logits[:, last : last + 1]
+                ks = stage[0][:, :, : self.max_len]
+                vs = stage[1][:, :, : self.max_len]
+            first = req.pick(np.asarray(logits[0, -1]))
         except Exception:
             # release the claimed slot or n_slots failed prefills would
             # brick the server with every slot claimed-but-never-active

@@ -85,8 +85,8 @@ def test_slot_reuse_after_finish(params):
 def test_validation(params):
     cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
                            prompt_len=16)
-    with pytest.raises(ValueError, match="prompt length"):
-        cb.submit(np.zeros((20,), np.int32), 4)
+    with pytest.raises(ValueError, match="empty prompt"):
+        cb.submit(np.zeros((0,), np.int32), 4)
     with pytest.raises(ValueError, match="overflow"):
         cb.submit(np.ones((16,), np.int32), 200)
     with pytest.raises(ValueError, match="max_new_tokens"):
@@ -375,3 +375,29 @@ class TestSlidingWindow:
                 cb.step()
             outs[impl] = cb.result(rid)
         assert outs["xla"] == outs["pallas"]
+
+
+class TestChunkedPrefill:
+    @pytest.mark.parametrize("plen", [17, 32, 41])  # partial/exact/2.5 buckets
+    def test_long_prompt_matches_generate(self, params, plen):
+        """Prompts longer than the bucket prefill in chunks and still
+        yield exactly the solo-generation tokens."""
+        prompt = _prompt(plen, 80 + plen)
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=64,
+                               prompt_len=16)
+        rid = cb.submit(prompt, 6)
+        while cb.result(rid) is None:
+            cb.step()
+        assert cb.result(rid) == _alone(params, prompt, 6)
+
+    def test_prompt_beyond_cache_rejected(self, params):
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+                               prompt_len=16)
+        with pytest.raises(ValueError, match="> max_len"):
+            cb.submit(_prompt(40, 90), 2)
+
+    def test_windowed_long_prompt_rejected(self, params):
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+                               prompt_len=16, windowed=True)
+        with pytest.raises(ValueError, match="sliding prefill"):
+            cb.submit(_prompt(20, 91), 2)
