@@ -358,15 +358,11 @@ class ContinuousBatcher:
             )
         )
         # chunked-prefill programs (prompts longer than the bucket): a
-        # staging cache padded to a bucket multiple so every chunk write
-        # fits, advanced one verify_chunk per bucket
-        self._stage_len = -(-max_len // prompt_len) * prompt_len
-        self._prefill_stage = jax.jit(
-            lambda toks: dec.prefill(
-                params, toks, n_heads, self._stage_len,
-                compute_dtype=compute_dtype,
-            )
-        )
+        # staging cache padded to a bucket multiple — plus one spare
+        # bucket so chunk starts NOT aligned to the bucket (the prefix-
+        # caching path) still fit their full-width writes
+        self._stage_len = (-(-max_len // prompt_len) + 1) * prompt_len
+        self._stage_shape = (L, 1, self._stage_len, kv, hd)
         self._prefill_chunk = jax.jit(
             lambda toks, cpos, cache: dec.verify_chunk(
                 params, toks, cpos, cache, n_heads,
@@ -386,6 +382,77 @@ class ContinuousBatcher:
             )
         )
         self._insert = jax.jit(insert_slot)
+        self._load_prefix = jax.jit(
+            lambda stage, ks, vs: (
+                jax.lax.dynamic_update_slice(stage[0], ks, (0, 0, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(stage[1], vs, (0, 0, 0, 0, 0)),
+            )
+        )
+        # registered shared prefixes: id → ((ck, cv) trimmed to plen, plen)
+        self._prefixes: Dict[int, Tuple[Tuple[jax.Array, jax.Array], int]] = {}
+        self._next_prefix = 0
+
+    def _empty_stage(self):
+        return (
+            jnp.zeros(self._stage_shape, self.compute_dtype),
+            jnp.zeros(self._stage_shape, self.compute_dtype),
+        )
+
+    def _stage_chunks(self, tokens, base: int, stage, want_logits: bool):
+        """Advance a staging cache with ``tokens`` written at absolute
+        positions base..base+t-1, one prompt_len bucket per verify_chunk
+        call. Every copy of the chunked-prefill invariant (full-width pad
+        writes overwritten before masked; bucket-stride chunk starts;
+        verify_chunk's absolute pos) lives HERE. Returns (final chunk's
+        logits or None, advanced stage)."""
+        P = self.prompt_len
+        t = tokens.shape[0]
+        cpos = 0
+        logits = None
+        while cpos < t:
+            n = min(P, t - cpos)
+            chunk = np.zeros((1, P), np.int32)
+            chunk[0, :n] = tokens[cpos : cpos + n]
+            args = (
+                jnp.asarray(chunk), jnp.asarray(base + cpos, jnp.int32),
+                stage,
+            )
+            if want_logits and cpos + n >= t:
+                logits, stage, _ = self._prefill_chunk(*args)
+            else:
+                # non-final buckets only advance the cache (no
+                # vocab-head projection)
+                stage = self._advance_chunk(*args)
+            cpos += n
+        return logits, stage
+
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix (e.g. a system prompt) ONCE and
+        return its id; submit(prefix=id) starts from its K/V instead of
+        re-prefilling it per request — the admission cost of the shared
+        part is paid one time. Stored trimmed to the prefix length;
+        release with unregister_prefix when no longer needed."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        plen = tokens.shape[0]
+        if self.windowed:
+            raise ValueError("prefix caching needs an unwindowed cache")
+        if not (0 < plen < self.max_len):
+            raise ValueError(
+                f"prefix length {plen} not in (0, max_len={self.max_len})"
+            )
+        _, stage = self._stage_chunks(tokens, 0, self._empty_stage(), False)
+        trimmed = (stage[0][:, :, :plen], stage[1][:, :, :plen])
+        with self._lock:
+            pid = self._next_prefix
+            self._next_prefix += 1
+            self._prefixes[pid] = (trimmed, plen)
+        return pid
+
+    def unregister_prefix(self, pid: int) -> bool:
+        """Release a registered prefix's device memory (in-flight
+        requests are unaffected — their slot cache holds a copy)."""
+        with self._lock:
+            return self._prefixes.pop(pid, None) is not None
 
     # -- client API --------------------------------------------------------
     def submit(
@@ -396,6 +463,7 @@ class ContinuousBatcher:
         top_k: int = 0,
         seed: Optional[int] = None,
         stop_token: Optional[int] = None,
+        prefix: Optional[int] = None,
     ) -> Optional[int]:
         """Claim a free slot for ``prompt`` [T]; returns a request id, or
         None when the batch is full (caller queues/retries — the
@@ -419,15 +487,22 @@ class ContinuousBatcher:
                 f"{self.prompt_len} prompt tokens (sliding prefill of "
                 f"longer prompts is not supported); got {t}"
             )
-        if t > self.max_len:
+        plen = 0
+        pfx = None
+        if prefix is not None:
+            with self._lock:
+                if prefix not in self._prefixes:
+                    raise ValueError(f"unknown prefix id {prefix}")
+                pfx, plen = self._prefixes[prefix]
+        if plen + t > self.max_len:
             raise ValueError(
-                f"prompt length {t} > max_len {self.max_len}"
+                f"prefix({plen}) + prompt({t}) > max_len {self.max_len}"
             )
-        if not self.windowed and t + max_new_tokens > self.max_len:
+        if not self.windowed and plen + t + max_new_tokens > self.max_len:
             raise ValueError(
-                f"{t}+{max_new_tokens} tokens would overflow max_len="
-                f"{self.max_len} (windowed=True lifts this: the cache "
-                "becomes a sliding ring)"
+                f"{plen}+{t}+{max_new_tokens} tokens would overflow "
+                f"max_len={self.max_len} (windowed=True lifts this: the "
+                "cache becomes a sliding ring)"
             )
         with self._lock:
             # claim only — the slot is owned (so no other submit takes it)
@@ -450,35 +525,20 @@ class ContinuousBatcher:
 
         try:
             P = self.prompt_len
-            if t <= P:
+            if pfx is None and t <= P:
+                # single-program fast path for bucket-sized prompts
                 padded = np.zeros((1, P), np.int32)
                 padded[0, :t] = prompt
                 logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
                 logits = logits[:, t - 1 : t]
             else:
-                # chunked prefill: first bucket fills a staging cache,
-                # each further bucket advances it via verify_chunk; pad
-                # K/V beyond the true length are overwritten by decode
-                # steps before the ≤pos mask can reach them
-                chunk0 = np.ascontiguousarray(prompt[:P])[None, :]
-                logits, stage, _ = self._prefill_stage(jnp.asarray(chunk0))
-                cpos = P
-                while cpos < t:
-                    n = min(P, t - cpos)
-                    chunk = np.zeros((1, P), np.int32)
-                    chunk[0, :n] = prompt[cpos : cpos + n]
-                    is_final = cpos + n >= t
-                    args = (
-                        jnp.asarray(chunk), jnp.asarray(cpos, jnp.int32),
-                        stage,
-                    )
-                    if is_final:
-                        logits, stage, _ = self._prefill_chunk(*args)
-                    else:
-                        # non-final buckets only advance the cache (no
-                        # vocab-head projection)
-                        stage = self._advance_chunk(*args)
-                    cpos += n
+                # chunked prefill (_stage_chunks): the staging cache
+                # starts empty or preloaded with the registered prefix
+                if pfx is None:
+                    stage = self._empty_stage()
+                else:
+                    stage = self._load_prefix(self._empty_stage(), *pfx)
+                logits, stage = self._stage_chunks(prompt, plen, stage, True)
                 last = (t - 1) % P  # true last token's index in the chunk
                 logits = logits[:, last : last + 1]
                 ks = stage[0][:, :, : self.max_len]
@@ -494,7 +554,7 @@ class ContinuousBatcher:
         with self._lock:
             self._cache = self._insert(self._cache, ks, vs, slot)
             self._tok = self._pin(self._tok.at[slot].set(first))
-            self._pos = self._pin(self._pos.at[slot].set(t))
+            self._pos = self._pin(self._pos.at[slot].set(plen + t))
             self._active[slot] = True
             req.tokens.append(first)
             if req.finished():

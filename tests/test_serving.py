@@ -401,3 +401,60 @@ class TestChunkedPrefill:
                                prompt_len=16, windowed=True)
         with pytest.raises(ValueError, match="sliding prefill"):
             cb.submit(_prompt(20, 91), 2)
+
+
+class TestPrefixCaching:
+    def test_prefix_matches_concat_prompt(self, params):
+        """submit(prefix=id) yields exactly the tokens of solo generation
+        on prefix+prompt — for short, bucket-crossing, and multi-bucket
+        prefix lengths."""
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=96,
+                               prompt_len=16)
+        for plen, tlen in ((5, 7), (16, 10), (23, 20), (37, 4)):
+            pfx_toks = _prompt(plen, 100 + plen)
+            prompt = _prompt(tlen, 200 + tlen)
+            pid = cb.register_prefix(pfx_toks)
+            rid = cb.submit(prompt, 6, prefix=pid)
+            while cb.result(rid) is None:
+                cb.step()
+            full = np.concatenate([pfx_toks, prompt])
+            assert cb.result(rid) == _alone(params, full, 6), (
+                f"prefix {plen} + prompt {tlen} diverged"
+            )
+
+    def test_prefix_shared_across_requests(self, params):
+        """Two concurrent requests share one registered prefix."""
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=64,
+                               prompt_len=16)
+        pfx_toks = _prompt(12, 300)
+        pid = cb.register_prefix(pfx_toks)
+        pa, pb = _prompt(6, 301), _prompt(9, 302)
+        ra = cb.submit(pa, 5, prefix=pid)
+        rb = cb.submit(pb, 5, prefix=pid)
+        while cb.result(ra) is None or cb.result(rb) is None:
+            cb.step()
+        assert cb.result(ra) == _alone(params, np.concatenate([pfx_toks, pa]), 5)
+        assert cb.result(rb) == _alone(params, np.concatenate([pfx_toks, pb]), 5)
+
+    def test_prefix_validation(self, params):
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+                               prompt_len=16)
+        with pytest.raises(ValueError, match="unknown prefix"):
+            cb.submit(_prompt(4, 310), 2, prefix=99)
+        pid = cb.register_prefix(_prompt(20, 311))
+        with pytest.raises(ValueError, match="> max_len"):
+            cb.submit(_prompt(13, 312), 2, prefix=pid)
+        wcb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+                                prompt_len=16, windowed=True)
+        with pytest.raises(ValueError, match="unwindowed"):
+            wcb.register_prefix(_prompt(4, 313))
+
+
+def test_unregister_prefix_releases(params):
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+                           prompt_len=16)
+    pid = cb.register_prefix(_prompt(8, 320))
+    assert cb.unregister_prefix(pid)
+    assert not cb.unregister_prefix(pid)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        cb.submit(_prompt(4, 321), 2, prefix=pid)
