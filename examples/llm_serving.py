@@ -47,3 +47,23 @@ while any(cb.result(r) is None for r in (ra, rb, rc)):
 for name, rid in (("A", ra), ("B", rb), ("C", rc)):
     print(f"{name}: {cb.result(rid)}")
 print(f"free slots at end: {cb.n_free}/4")
+
+print("\n-- prefix caching: shared system prompt, prefilled once --")
+system = rng.integers(1, 1024, (24,))
+pid = cb.register_prefix(system)
+rd = cb.submit(rng.integers(1, 1024, (6,)), 6, prefix=pid)
+re_ = cb.submit(rng.integers(1, 1024, (9,)), 6, prefix=pid,
+                temperature=0.8, seed=42)  # sampled, deterministic per seed
+while cb.result(rd) is None or cb.result(re_) is None:
+    cb.step()
+print(f"D (greedy, shared prefix): {cb.result(rd)}")
+print(f"E (sampled t=0.8, shared prefix): {cb.result(re_)}")
+cb.unregister_prefix(pid)
+
+print("\n-- sliding window: 200 tokens through a 64-slot ring --")
+ring = ContinuousBatcher(params, n_heads=8, n_slots=1, max_len=64,
+                         prompt_len=32, windowed=True)
+rf = ring.submit(rng.integers(1, 1024, (20,)), 200)
+while ring.result(rf) is None:
+    ring.step()
+print(f"F: {len(ring.result(rf))} tokens decoded in a fixed 64-token cache")
