@@ -1,19 +1,50 @@
-"""Honor an explicit JAX_PLATFORMS env pin.
+"""Honor an explicit JAX_PLATFORMS env pin; avoid blocking on a dead relay.
 
 A site hook may force-set the hardware platform via ``jax.config``
 (which outranks the env var); a user who asked for ``JAX_PLATFORMS=cpu``
 must never block on an unavailable accelerator attachment. One shared
 implementation for the CLI and every example — call before the first
 device operation (jax backend init is lazy, so import order is enough).
+
+When a remote-accelerator platform is requested but its relay endpoint
+is unreachable, attach would BLOCK INDEFINITELY (the client retries
+connect in a sleep loop — the failure mode bench.py gates with
+``_tunnel_alive``). In that case fall back to CPU with a warning rather
+than hang whatever example or pipeline asked for a device.
 """
 
 from __future__ import annotations
 
 import os
+import sys
+
+
+def _relay_reachable() -> bool:
+    """True unless a remote-accelerator relay is configured AND down."""
+    ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if not ips:
+        return True  # topology unknown: don't second-guess
+    import socket
+
+    for host in (h.strip() for h in ips.split(",") if h.strip()):
+        try:
+            socket.create_connection((host, 8082), timeout=2).close()
+            return True
+        except OSError:
+            pass
+    return False
 
 
 def honor_jax_platforms_env() -> None:
     plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "cpu" != plat and not _relay_reachable():
+        print(
+            "[nnstreamer_tpu] accelerator relay unreachable; running on "
+            "CPU instead of blocking on attach",
+            file=sys.stderr,
+        )
+        plat = "cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
     if plat:
         import jax
 

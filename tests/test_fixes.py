@@ -92,3 +92,17 @@ def test_deterministic_element_names():
     a, b = Queue(), Queue()
     assert a.name != b.name
     assert a.name.startswith("queue")
+
+
+def test_platform_pin_falls_back_when_relay_dead(monkeypatch):
+    """A requested remote-accelerator platform with an unreachable relay
+    must fall back to CPU instead of blocking on attach forever."""
+    from nnstreamer_tpu import platform_pin
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(platform_pin, "_relay_reachable", lambda: False)
+    platform_pin.honor_jax_platforms_env()
+    import os
+
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
