@@ -391,6 +391,9 @@ class ContinuousBatcher:
         # registered shared prefixes: id → ((ck, cv) trimmed to plen, plen)
         self._prefixes: Dict[int, Tuple[Tuple[jax.Array, jax.Array], int]] = {}
         self._next_prefix = 0
+        self._n_steps = 0
+        self._n_tokens = 0
+        self._step_time_s = 0.0
 
     def _empty_stage(self):
         return (
@@ -563,6 +566,9 @@ class ContinuousBatcher:
 
     def step(self) -> Dict[int, int]:
         """Advance every active slot one token; returns {rid: token}."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._lock:
             if not self._active.any():
                 return {}
@@ -597,7 +603,32 @@ class ContinuousBatcher:
                 emitted[req.rid] = tok
                 if req.finished():
                     self._finish(slot)
+            self._n_steps += 1
+            self._n_tokens += len(emitted)
+            self._step_time_s += _time.perf_counter() - t0
             return emitted
+
+    def stats(self) -> Dict[str, float]:
+        """Serving counters — the token-world analogue of the filter
+        element's latency/throughput props (tensor_filter.c:334-433):
+        cumulative steps/tokens, decode rate, and current occupancy."""
+        with self._lock:
+            occupied = sum(r is not None for r in self._slots)
+            return {
+                "steps": self._n_steps,
+                "tokens_emitted": self._n_tokens,
+                "tokens_per_step": (
+                    self._n_tokens / self._n_steps if self._n_steps else 0.0
+                ),
+                "decode_tok_s": (
+                    self._n_tokens / self._step_time_s
+                    if self._step_time_s > 0 else 0.0
+                ),
+                "slots_occupied": occupied,
+                "slots_free": self.n_slots - occupied,
+                "results_pending_pickup": len(self._done_pool),
+                "prefixes_registered": len(self._prefixes),
+            }
 
     def _pin(self, x):
         """Keep per-slot vectors on their mesh sharding after eager

@@ -458,3 +458,18 @@ def test_unregister_prefix_releases(params):
     assert not cb.unregister_prefix(pid)
     with pytest.raises(ValueError, match="unknown prefix"):
         cb.submit(_prompt(4, 321), 2, prefix=pid)
+
+
+def test_stats_surface(params):
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=32,
+                           prompt_len=16)
+    assert cb.stats()["steps"] == 0
+    rid = cb.submit(_prompt(6, 400), 5)
+    while cb.result(rid) is None:
+        cb.step()
+    s = cb.stats()
+    assert s["steps"] == 4  # first token came from prefill
+    assert s["tokens_emitted"] == 4
+    assert s["decode_tok_s"] > 0
+    assert s["slots_free"] == 2
+    assert s["results_pending_pickup"] == 1
