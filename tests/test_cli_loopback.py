@@ -113,3 +113,57 @@ def test_edge_pubsub_two_processes(tmp_path):
                 pub.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 pub.kill()
+
+
+def test_llm_query_offload_two_processes(tmp_path):
+    """The among-device + LLM serving integration: a client pipeline
+    offloads a token prompt over the query transport; the server
+    pipeline generates via the continuous batcher and routes the reply
+    back by client_id. Output must equal solo generation."""
+    port = _free_port()
+    model = "vocab:211,d_model:32,n_heads:2,n_layers:2,seed:5"
+    server = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu.cli",
+         f"tensor_query_serversrc port={port} id=lq1 ! "
+         f'tensor_llm_serversink id=ls1 custom="{model}" '
+         "max-new-tokens=5 n-slots=2 max-len=32 prompt-len=8 "
+         "tensor_llm_serversrc id=ls1 ! tensor_query_serversink id=lq1",
+         "--timeout", "90", "-q"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        _wait_port(port)
+        out = tmp_path / "tokens.raw"
+        client = subprocess.run(
+            [sys.executable, "-m", "nnstreamer_tpu.cli",
+             "tensorsrc dimensions=6:1 types=int32 num-frames=1 "
+             "pattern=ones ! "
+             f"tensor_query_client dest-port={port} timeout=60 ! "
+             f"filesink location={out}",
+             "-q"],
+            env=_env(), capture_output=True, text=True, timeout=180,
+        )
+        assert client.returncode == 0, client.stderr[-600:]
+        got = np.frombuffer(out.read_bytes(), np.int32)
+        assert got.shape == (5,)
+        # reference: solo generation on the same prompt/model
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import decode as dec
+        from nnstreamer_tpu.models import transformer as tfm
+
+        params = tfm.init_params(
+            jax.random.PRNGKey(5), vocab=211, d_model=32, n_heads=2,
+            n_layers=2,
+        )
+        want = dec.generate(
+            params, jnp.ones((1, 6), jnp.int32), 2, 5
+        )
+        np.testing.assert_array_equal(got, np.asarray(want)[0])
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()

@@ -473,3 +473,23 @@ def test_stats_surface(params):
     assert s["decode_tok_s"] > 0
     assert s["slots_free"] == 2
     assert s["results_pending_pickup"] == 1
+
+
+def test_mesh_with_int8_cache(params):
+    """Slot sharding composes with the quantized cache (scale leaves
+    shard on the same slot axis)."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, axes=("dp",))
+    prompt = _prompt(6, 500)
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=8, max_len=32,
+                           prompt_len=16, mesh=mesh, cache_dtype="int8")
+    rid = cb.submit(prompt, 5)
+    while cb.result(rid) is None:
+        cb.step()
+    plain = ContinuousBatcher(params, N_HEADS, n_slots=8, max_len=32,
+                              prompt_len=16, cache_dtype="int8")
+    rid2 = plain.submit(prompt, 5)
+    while plain.result(rid2) is None:
+        plain.step()
+    assert cb.result(rid) == plain.result(rid2)
