@@ -111,6 +111,7 @@ class _LlmServer:
         kw = dict(
             temperature=float(frame.meta.get("temperature", 0.0)),
             top_k=int(frame.meta.get("top_k", 0)),
+            top_p=float(frame.meta.get("top_p", 1.0)),
         )
         if "seed" in frame.meta:
             kw["seed"] = int(frame.meta["seed"])

@@ -209,6 +209,7 @@ class _Request:
     budget: int
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     stop_token: Optional[int] = None
     rng: Optional[np.random.Generator] = None
     tokens: List[int] = field(default_factory=list)
@@ -233,6 +234,15 @@ class _Request:
         scaled -= scaled.max()
         p = np.exp(scaled)
         p /= p.sum()
+        if 0.0 < self.top_p < 1.0:
+            # nucleus: smallest probability mass ≥ top_p (most-probable
+            # first; the boundary token is kept)
+            order = np.argsort(p)[::-1]
+            csum = np.cumsum(p[order])
+            keep = order[: int(np.searchsorted(csum, self.top_p)) + 1]
+            mask = np.zeros_like(p)
+            mask[keep] = p[keep]
+            p = mask / mask.sum()
         return int(self.rng.choice(p.shape[0], p=p))
 
 
@@ -464,6 +474,7 @@ class ContinuousBatcher:
         max_new_tokens: int,
         temperature: float = 0.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         seed: Optional[int] = None,
         stop_token: Optional[int] = None,
         prefix: Optional[int] = None,
@@ -521,7 +532,7 @@ class ContinuousBatcher:
             self._next_rid += 1
             req = _Request(
                 rid, max_new_tokens, temperature=temperature, top_k=top_k,
-                stop_token=stop_token,
+                top_p=top_p, stop_token=stop_token,
                 rng=np.random.default_rng(rid if seed is None else seed),
             )
             self._slots[slot] = req

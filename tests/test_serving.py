@@ -493,3 +493,24 @@ def test_mesh_with_int8_cache(params):
     while plain.result(rid2) is None:
         plain.step()
     assert cb.result(rid) == plain.result(rid2)
+
+
+def test_top_p_tiny_is_greedy_and_deterministic(params):
+    """top_p small enough keeps only the argmax token → equals greedy;
+    and a mid-range top_p is deterministic per seed."""
+    p = _prompt(7, 600)
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=48,
+                           prompt_len=16)
+    rid = cb.submit(p, 6, temperature=0.7, top_p=1e-9, seed=3)
+    while cb.result(rid) is None:
+        cb.step()
+    assert cb.result(rid) == _alone(params, p, 6)
+    outs = []
+    for _ in range(2):
+        cb2 = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=48,
+                                prompt_len=16)
+        r = cb2.submit(p, 8, temperature=1.2, top_p=0.8, seed=9)
+        while cb2.result(r) is None:
+            cb2.step()
+        outs.append(cb2.result(r))
+    assert outs[0] == outs[1]
