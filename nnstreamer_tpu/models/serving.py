@@ -248,7 +248,7 @@ class _Request:
 
 class ContinuousBatcher:
     """Continuous-batching server over a fixed slot batch (greedy by
-    default; per-request temperature/top-k sampling via submit()).
+    default; per-request temperature/top-k/top-p sampling via submit()).
 
     submit() may be called at any time (thread-safe); step() advances every
     active slot by one token. Finished requests free their slot for the
@@ -487,8 +487,10 @@ class ContinuousBatcher:
         the bucket.
 
         Sampling is per-request: temperature ≤ 0 is greedy; otherwise
-        softmax sampling (optionally top-k truncated) with a deterministic
-        per-request stream seeded by ``seed`` (default: the request id)."""
+        softmax sampling, optionally top-k truncated and/or top-p
+        (nucleus) filtered (0 < top_p < 1; the boundary token is kept),
+        with a deterministic per-request stream seeded by ``seed``
+        (default: the request id)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
         if max_new_tokens < 1:

@@ -66,6 +66,77 @@ def _verify(params, cache, pos, chunk, n_heads, compute_dtype):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache  # [B, k]
 
 
+def _speculative_loop(
+    target_params: Dict,
+    prompt,
+    n_heads: int,
+    max_new_tokens: int,
+    k: int,
+    compute_dtype,
+    propose,
+    on_accept=None,
+    caller: str = "speculative_generate",
+):
+    """The one certified verify/accept/rollback loop shared by every
+    proposal source. ``propose(cur, context) -> np [k-1]`` supplies the
+    candidates (a draft model, an n-gram lookup, ...); ``on_accept(n_acc)``
+    lets stateful proposers (the draft cache) roll their state forward.
+
+    Invariants owned HERE: max_len carries k slack for chunk overshoot;
+    rejected K/V beyond the rolled-back pos are masked until overwritten;
+    the emitted stream is byte-identical to decode.generate on the
+    target, whatever the proposals were."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError(f"{caller} serves one stream (B=1)")
+    if k < 2:
+        raise ValueError("k must be ≥ 2 (one proposal + one correction)")
+    # chunk writes can overshoot the accepted point by up to k-1
+    max_len = t + max_new_tokens + k
+
+    t_logits, t_cache, t_pos = dec.prefill(
+        target_params, prompt, n_heads, max_len, compute_dtype=compute_dtype
+    )
+    cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
+    context = list(np.asarray(prompt)[0])
+
+    out = []
+    accept_lens = []
+    while len(out) < max_new_tokens:
+        out.append(int(cur[0]))  # cur is already target-certified
+        context.append(int(cur[0]))
+        if len(out) >= max_new_tokens:
+            break
+        props = np.asarray(
+            propose(cur, np.asarray(context, np.int32)), np.int32
+        ).reshape(-1)
+        chunk = jnp.concatenate(
+            [cur[:, None], jnp.asarray(props)[None, :]], axis=1
+        )  # [B, k]
+        preds, t_cache = _verify(
+            target_params, t_cache, t_pos, chunk, n_heads, compute_dtype
+        )
+
+        # longest prefix of proposals matching the target's own argmax
+        pn = np.asarray(preds[0])
+        n_acc = 0
+        while n_acc < k - 1 and props[n_acc] == pn[n_acc]:
+            n_acc += 1
+        accept_lens.append(n_acc)
+        out.extend(int(x) for x in props[:n_acc])
+        context.extend(int(x) for x in props[:n_acc])
+        cur = preds[:, n_acc]  # target's correction after the prefix
+        # roll back the target cache to the certified length (rejected
+        # K/V beyond pos are masked until overwritten)
+        t_pos = t_pos + n_acc + 1
+        if on_accept is not None:
+            on_accept(n_acc)
+
+    toks = jnp.asarray(np.asarray(out[:max_new_tokens], np.int32))[None, :]
+    return toks, accept_lens
+
+
 def speculative_generate(
     target_params: Dict,
     draft_params: Dict,
@@ -85,51 +156,63 @@ def speculative_generate(
     if draft_n_heads is None:
         draft_n_heads = n_heads
     prompt = jnp.asarray(prompt, jnp.int32)
-    b, t = prompt.shape
-    if b != 1:
-        raise ValueError("speculative_generate serves one stream (B=1)")
-    if k < 2:
-        raise ValueError("k must be ≥ 2 (one proposal + one correction)")
-    # chunk writes can overshoot the accepted point by up to k-1
+    t = prompt.shape[1]
     max_len = t + max_new_tokens + k
-
-    t_logits, t_cache, t_pos = dec.prefill(
-        target_params, prompt, n_heads, max_len, compute_dtype=compute_dtype
-    )
     _, d_cache, d_pos = dec.prefill(
         draft_params, prompt, draft_n_heads, max_len,
         compute_dtype=compute_dtype,
     )
-    cur = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # [B]
+    state = {"cache": d_cache, "pos": d_pos}
 
-    out = []
-    accept_lens = []
-    while len(out) < max_new_tokens:
-        out.append(int(cur[0]))  # cur is already target-certified
-        if len(out) >= max_new_tokens:
-            break
-        props, d_cache, _ = _draft_k(
-            draft_params, d_cache, d_pos, cur, k, draft_n_heads,
-            compute_dtype,
+    def propose(cur, _context):
+        props, state["cache"], _ = _draft_k(
+            draft_params, state["cache"], state["pos"], cur, k,
+            draft_n_heads, compute_dtype,
         )
-        chunk = jnp.concatenate([cur[:, None], props], axis=1)  # [B, k]
-        preds, t_cache = _verify(
-            target_params, t_cache, t_pos, chunk, n_heads, compute_dtype
-        )
+        return np.asarray(props[0])
 
-        # longest prefix of proposals matching the target's own argmax
-        pn = np.asarray(preds[0])
-        prn = np.asarray(props[0])
-        n_acc = 0
-        while n_acc < k - 1 and prn[n_acc] == pn[n_acc]:
-            n_acc += 1
-        accept_lens.append(n_acc)
-        out.extend(int(x) for x in prn[:n_acc])
-        cur = preds[:, n_acc]  # target's correction after the prefix
-        # roll back both caches to the certified length (rejected K/V
-        # beyond pos are masked until overwritten)
-        t_pos = t_pos + n_acc + 1
-        d_pos = d_pos + n_acc + 1
+    def on_accept(n_acc):
+        # roll the draft cache alongside the target's
+        state["pos"] = state["pos"] + n_acc + 1
 
-    toks = jnp.asarray(np.asarray(out[:max_new_tokens], np.int32))[None, :]
-    return toks, accept_lens
+    return _speculative_loop(
+        target_params, prompt, n_heads, max_new_tokens, k, compute_dtype,
+        propose, on_accept,
+    )
+
+
+def _ngram_propose(context: np.ndarray, k: int) -> np.ndarray:
+    """Prompt-lookup drafting: find the most recent earlier occurrence of
+    the context's last token and propose the k tokens that followed it.
+    Free (no draft model, no extra forward); worthless proposals cost one
+    verify round that still certifies ≥1 token."""
+    tail = context[-1]
+    # scan backwards, excluding the final position itself
+    idx = np.flatnonzero(context[:-1] == tail)
+    props = np.zeros((k,), np.int32)
+    if idx.size:
+        cand = context[idx[-1] + 1 : idx[-1] + 1 + k]
+        props[: cand.size] = cand
+    return props
+
+
+def ngram_speculative_generate(
+    target_params: Dict,
+    prompt,
+    n_heads: int,
+    max_new_tokens: int,
+    k: int = 4,
+    compute_dtype=jnp.float32,
+):
+    """Draft-model-free speculative decoding (prompt lookup): candidates
+    come from n-gram matches in the generated context instead of a draft
+    model. The verify step is the same chunked target forward, so the
+    output is still byte-identical to decode.generate on the target —
+    the proposal source only changes how many tokens each round
+    certifies. Shines on repetitive/structured text; never worse than
+    one certified token per round."""
+    return _speculative_loop(
+        target_params, prompt, n_heads, max_new_tokens, k, compute_dtype,
+        lambda cur, context: _ngram_propose(context, k - 1),
+        caller="ngram_speculative_generate",
+    )
