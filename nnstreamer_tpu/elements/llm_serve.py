@@ -218,8 +218,21 @@ class LlmServerSrc(Source):
 
     def stop(self) -> None:
         # pipeline teardown (drained or not) releases the server — model
-        # params and KV caches must not outlive the pipeline in _table
+        # params and KV caches must not outlive the pipeline in _table;
+        # keep a final stats snapshot for post-run --stats readers
+        with _table_lock:
+            srv = _table.get(self.srv_id)
+        if srv is not None:
+            self._final_stats = srv.cb.stats()
         _drop_server(self.srv_id)
+
+    def serving_stats(self):
+        """Batcher counters for the executor's --stats surface."""
+        with _table_lock:
+            srv = _table.get(self.srv_id)
+        if srv is not None:
+            return srv.cb.stats()
+        return getattr(self, "_final_stats", None)
 
     def output_spec(self) -> Spec:
         # generations vary in length per request → flexible
@@ -232,6 +245,7 @@ class LlmServerSrc(Source):
         item = srv.pop()
         if item is None:
             if srv.drained:
+                self._final_stats = srv.cb.stats()
                 _drop_server(self.srv_id)
                 return EOS_FRAME
             if not srv.pump():  # decode even while no prompts arrive

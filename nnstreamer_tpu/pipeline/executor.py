@@ -439,5 +439,12 @@ class Executor:
                 s["invoke_count"] = istats.total_invoke_num
                 s["invoke_latency_us"] = round(istats.latency_us, 1)
                 s["invoke_throughput_fps"] = round(istats.throughput_fps, 1)
+            # serving elements (tensor_llm_serversrc) surface the
+            # batcher's token-granularity counters the same way
+            sstats = getattr(elem, "serving_stats", None)
+            if callable(sstats):
+                got = sstats()
+                if got:
+                    s.update({f"serving_{k}": v for k, v in got.items()})
             out[n.name] = s
         return out

@@ -148,3 +148,26 @@ def test_sampling_meta_rides_through():
         finally:
             p.stop()
     assert outs[0] == outs[1]
+
+
+def test_serving_stats_in_cli_stats(tmp_path):
+    """--stats surfaces the batcher's token counters under the source
+    node (executor.stats serving_ prefix)."""
+    import json
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu.cli",
+         "tensorsrc dimensions=4:1 types=int32 num-frames=2 pattern=ones ! "
+         f'tensor_llm_serversink id=cs1 custom="{MODEL_OPTS}" '
+         "max-new-tokens=3 n-slots=2 max-len=32 prompt-len=8 "
+         "tensor_llm_serversrc id=cs1 ! tensor_sink",
+         "--stats", "-q"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    stats = json.loads(r.stdout)
+    src = next(v for k, v in stats.items() if "serversrc" in k)
+    assert src["serving_tokens_emitted"] == 4  # 2 reqs × (3-1 stepped)
+    assert src["serving_steps"] >= 2
