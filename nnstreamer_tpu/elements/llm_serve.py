@@ -71,9 +71,12 @@ def _get_server(srv_id: str, create_kw: Optional[dict] = None):
         return srv
 
 
-def _drop_server(srv_id: str) -> None:
+def _drop_server(srv_id: str, srv=None) -> None:
+    """Remove the table entry — but only if it is still ``srv`` (another
+    pipeline may have reused the id with a fresh server)."""
     with _table_lock:
-        _table.pop(srv_id, None)
+        if srv is None or _table.get(srv_id) is srv:
+            _table.pop(srv_id, None)
 
 
 class _LlmServer:
@@ -215,24 +218,27 @@ class LlmServerSrc(Source):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.srv_id = str(self.get_property("id", "0"))
+        # THIS run's server, held by object reference — the id string is
+        # reusable across pipelines, so it never identifies the server
+        self._server: Optional[_LlmServer] = None
+        self._final_stats: Optional[Dict] = None
 
     def stop(self) -> None:
         # pipeline teardown (drained or not) releases the server — model
         # params and KV caches must not outlive the pipeline in _table;
         # keep a final stats snapshot for post-run --stats readers
-        with _table_lock:
-            srv = _table.get(self.srv_id)
-        if srv is not None:
-            self._final_stats = srv.cb.stats()
-        _drop_server(self.srv_id)
+        if self._final_stats is None:
+            self._final_stats = self.serving_stats()
+        _drop_server(self.srv_id, self._server)
 
-    def serving_stats(self):
-        """Batcher counters for the executor's --stats surface."""
-        with _table_lock:
-            srv = _table.get(self.srv_id)
-        if srv is not None:
-            return srv.cb.stats()
-        return getattr(self, "_final_stats", None)
+    def serving_stats(self) -> Optional[Dict]:
+        """Batcher counters for the executor's --stats surface (this
+        run's server only, live or final snapshot)."""
+        if self._final_stats is not None:
+            return self._final_stats
+        if self._server is not None:
+            return self._server.cb.stats()
+        return None
 
     def output_spec(self) -> Spec:
         # generations vary in length per request → flexible
@@ -241,12 +247,14 @@ class LlmServerSrc(Source):
     def generate(self):
         import time as _time
 
-        srv = _get_server(self.srv_id)
+        srv = self._server
+        if srv is None:
+            srv = self._server = _get_server(self.srv_id)
         item = srv.pop()
         if item is None:
             if srv.drained:
                 self._final_stats = srv.cb.stats()
-                _drop_server(self.srv_id)
+                _drop_server(self.srv_id, srv)
                 return EOS_FRAME
             if not srv.pump():  # decode even while no prompts arrive
                 # idle (no active slots): the executor re-polls

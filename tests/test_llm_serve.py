@@ -150,7 +150,7 @@ def test_sampling_meta_rides_through():
     assert outs[0] == outs[1]
 
 
-def test_serving_stats_in_cli_stats(tmp_path):
+def test_serving_stats_in_cli_stats():
     """--stats surfaces the batcher's token counters under the source
     node (executor.stats serving_ prefix)."""
     import json
