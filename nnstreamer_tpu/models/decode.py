@@ -19,6 +19,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from nnstreamer_tpu.models import transformer as tfm
 
@@ -149,6 +150,82 @@ def verify_chunk(
     x = tfm.rmsnorm(x, params["ln_f"])
     logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
     return logits, (cache_k, cache_v), pos + kk_len
+
+
+def beam_search(
+    params: Dict,
+    prompt,
+    n_heads: int,
+    max_new_tokens: int,
+    beam_width: int = 4,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+):
+    """Beam search over the KV-cache decode path.
+
+    prompt [1, T] int32 → (tokens [1, max_new_tokens] int32 of the best
+    beam, its total log-prob). The beams ARE the cache batch dim: one
+    batched decode_step serves all beams per step, and beam reordering is
+    a gather on the cache's slot axis — the same fixed-shape machinery as
+    everything else, scanned over the token budget so the whole search is
+    one compiled program. All beams decode the full budget (no EOS
+    stopping), so scores compare directly; beam_width=1 reduces exactly
+    to greedy generate()."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError("beam_search serves one stream (B=1)")
+    W = beam_width
+    max_len = t + max_new_tokens
+    V = params["head"]["scale"].shape[-1] if isinstance(
+        params["head"], dict
+    ) else params["head"].shape[-1]
+
+    logits, (ck, cv), pos = prefill(
+        params, prompt, n_heads, max_len, ffn_fn, compute_dtype
+    )
+    # replicate the prompt cache across W beams
+    ck = jnp.repeat(ck, W, axis=1)
+    cv = jnp.repeat(cv, W, axis=1)
+    lp0 = jax.nn.log_softmax(logits[0, -1])
+    top0 = jax.lax.top_k(lp0, W)
+    tok = top0[1].astype(jnp.int32)          # [W]
+    scores = top0[0]                         # [W]
+
+    def step(carry, _):
+        tok, scores, ck, cv, pos = carry
+        logits, (ck, cv), pos = decode_step(
+            params, tok, pos, (ck, cv), n_heads, ffn_fn, compute_dtype
+        )
+        lp = jax.nn.log_softmax(logits, axis=-1)       # [W, V]
+        cand = scores[:, None] + lp                    # [W, V]
+        flat_scores, flat_idx = jax.lax.top_k(cand.reshape(-1), W)
+        beam_idx = (flat_idx // V).astype(jnp.int32)   # parent beam
+        tok = (flat_idx % V).astype(jnp.int32)
+        # reorder the caches to follow the surviving beams
+        ck = jnp.take(ck, beam_idx, axis=1)
+        cv = jnp.take(cv, beam_idx, axis=1)
+        return (tok, flat_scores, ck, cv, pos), (tok, beam_idx)
+
+    (tok, scores, *_), (toks, parents) = jax.lax.scan(
+        step, (tok, scores, ck, cv, pos), None, length=max_new_tokens - 1
+    )
+
+    # backtrack the best beam through the parent pointers (host side)
+    toks = np.asarray(toks)          # [steps, W]
+    parents = np.asarray(parents)    # [steps, W]
+    scores = np.asarray(scores)
+    beam = int(scores.argmax())
+    seq = []
+    for i in range(toks.shape[0] - 1, -1, -1):
+        seq.append(int(toks[i, beam]))
+        beam = int(parents[i, beam])
+    seq.append(int(np.asarray(top0[1])[beam]))
+    seq.reverse()
+    return (
+        jnp.asarray(np.asarray(seq, np.int32))[None, :],
+        float(scores.max()),
+    )
 
 
 def generate(

@@ -108,3 +108,48 @@ def test_lm_generation_pipeline():
         assert out.shape == (1, 5)
         assert out.dtype == np.int32
         assert np.all((out >= 0) & (out < 32))
+
+
+class TestBeamSearch:
+    def _seq_logprob(self, params, prompt, toks):
+        """Total log-prob of generated toks under teacher forcing."""
+        from nnstreamer_tpu.models import transformer as tfm
+
+        full = jnp.concatenate([prompt, jnp.asarray(toks)], axis=1)
+        logits = tfm.apply(params, full, H)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        t = prompt.shape[1]
+        total = 0.0
+        for i in range(toks.shape[1]):
+            total += float(lp[0, t + i - 1, int(toks[0, i])])
+        return total
+
+    def test_width_one_is_greedy(self, params):
+        from nnstreamer_tpu.models.decode import beam_search, generate
+
+        prompt = jnp.asarray(
+            np.random.default_rng(9).integers(1, V, (1, 7)), jnp.int32
+        )
+        toks, _ = beam_search(params, prompt, H, 6, beam_width=1)
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(generate(params, prompt, H, 6))
+        )
+
+    def test_beam_never_worse_than_greedy(self, params):
+        from nnstreamer_tpu.models.decode import beam_search, generate
+
+        prompt = jnp.asarray(
+            np.random.default_rng(10).integers(1, V, (1, 9)), jnp.int32
+        )
+        btoks, bscore = beam_search(params, prompt, H, 8, beam_width=4)
+        gtoks = generate(params, prompt, H, 8)
+        g_lp = self._seq_logprob(params, prompt, np.asarray(gtoks))
+        b_lp = self._seq_logprob(params, prompt, np.asarray(btoks))
+        assert b_lp >= g_lp - 1e-4
+        assert abs(b_lp - bscore) < 1e-3  # reported score is the log-prob
+
+    def test_b1_required(self, params):
+        from nnstreamer_tpu.models.decode import beam_search
+
+        with pytest.raises(ValueError, match="B=1"):
+            beam_search(params, jnp.zeros((2, 4), jnp.int32), H, 4)
