@@ -342,18 +342,48 @@ def _transformer_lm(**options) -> ZooModel:
     if gen_tokens > 0:
         # serving mode: prompt frames in, generated token frames out — the
         # whole KV-cache loop (models/decode.py) is one jitted program, so
-        # a tensor_filter stage becomes an LLM generation server
+        # a tensor_filter stage becomes an LLM generation server.
+        # decode strategies: greedy/sampled (default), beam search, or
+        # draft-free n-gram speculation
         from nnstreamer_tpu.models import decode as dec
 
+        strategy = options.get("decode", "greedy")
         temperature = float(options.get("temperature", 0.0))
         gen_seed = int(options.get("gen_seed", 0))
+        if strategy == "beam":
+            beam_width = int(options.get("beam_width", 4))
 
-        def fn(tokens):
-            return dec.generate(
-                params, tokens, n_heads, gen_tokens,
-                temperature=temperature,
-                rng=jax.random.PRNGKey(gen_seed),
-                compute_dtype=dtype,
+            def fn(tokens):
+                toks, _ = dec.beam_search(
+                    params, tokens, n_heads, gen_tokens,
+                    beam_width=beam_width, compute_dtype=dtype,
+                )
+                return toks
+        elif strategy == "ngram":
+            from nnstreamer_tpu.models.speculative import (
+                ngram_speculative_generate,
+            )
+
+            spec_k = int(options.get("spec_k", 4))
+
+            def fn(tokens):
+                toks, _ = ngram_speculative_generate(
+                    params, tokens, n_heads, gen_tokens, k=spec_k,
+                    compute_dtype=dtype,
+                )
+                return toks
+        elif strategy == "greedy":
+            def fn(tokens):
+                return dec.generate(
+                    params, tokens, n_heads, gen_tokens,
+                    temperature=temperature,
+                    rng=jax.random.PRNGKey(gen_seed),
+                    compute_dtype=dtype,
+                )
+        else:
+            raise KeyError(
+                f"transformer_lm: unknown decode strategy {strategy!r} "
+                "(greedy|beam|ngram)"
             )
         apply_fn = None
     else:

@@ -153,3 +153,41 @@ class TestBeamSearch:
 
         with pytest.raises(ValueError, match="B=1"):
             beam_search(params, jnp.zeros((2, 4), jnp.int32), H, 4)
+
+
+class TestZooDecodeStrategies:
+    """decode:beam / decode:ngram reachable from the filter surface."""
+
+    _KW = dict(vocab=str(V), d_model=str(D), n_heads=str(H),
+               n_layers=str(L), seqlen="8", generate="5")
+
+    def _toks(self):
+        return jnp.asarray(
+            np.random.default_rng(30).integers(1, V, (1, 8)), jnp.int32
+        )
+
+    def test_beam_via_zoo(self, params):
+        from nnstreamer_tpu.models import zoo
+        from nnstreamer_tpu.models.decode import beam_search
+
+        m = zoo.get("transformer_lm", decode="beam", beam_width="3",
+                    **self._KW)
+        toks = self._toks()
+        want, _ = beam_search(m.params, toks, H, 5, beam_width=3)
+        np.testing.assert_array_equal(np.asarray(m.fn(toks)), np.asarray(want))
+
+    def test_ngram_via_zoo_matches_greedy(self, params):
+        from nnstreamer_tpu.models import zoo
+
+        toks = self._toks()
+        g = zoo.get("transformer_lm", **self._KW)
+        n = zoo.get("transformer_lm", decode="ngram", **self._KW)
+        np.testing.assert_array_equal(
+            np.asarray(g.fn(toks)), np.asarray(n.fn(toks))
+        )
+
+    def test_unknown_strategy_rejected(self):
+        from nnstreamer_tpu.models import zoo
+
+        with pytest.raises(KeyError, match="decode strategy"):
+            zoo.get("transformer_lm", decode="magic", **self._KW)
