@@ -71,11 +71,13 @@ def _get_server(srv_id: str, create_kw: Optional[dict] = None):
         return srv
 
 
-def _drop_server(srv_id: str, srv=None) -> None:
-    """Remove the table entry — but only if it is still ``srv`` (another
-    pipeline may have reused the id with a fresh server)."""
+def _drop_server(srv_id: str, srv) -> None:
+    """Remove the table entry — but only if it is still ``srv``: another
+    pipeline may have reused the id with a fresh server, and a src that
+    stopped before ever acquiring its server (srv None) must not evict a
+    live entry another pipeline registered under the same id."""
     with _table_lock:
-        if srv is None or _table.get(srv_id) is srv:
+        if srv is not None and _table.get(srv_id) is srv:
             _table.pop(srv_id, None)
 
 
@@ -222,6 +224,16 @@ class LlmServerSrc(Source):
         # reusable across pipelines, so it never identifies the server
         self._server: Optional[_LlmServer] = None
         self._final_stats: Optional[Dict] = None
+
+    def start(self) -> None:
+        # acquire the paired server eagerly so teardown before the first
+        # generate() still releases it from the table (the sink creates
+        # it at negotiate, which precedes every element's start). If the
+        # id pairs across pipelines started out of order the table may
+        # still be empty here — generate() keeps the lazy fallback.
+        if self._server is None:
+            with _table_lock:
+                self._server = _table.get(self.srv_id)
 
     def stop(self) -> None:
         # pipeline teardown (drained or not) releases the server — model

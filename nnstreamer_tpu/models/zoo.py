@@ -101,7 +101,7 @@ def _mobilenet_v2(**options) -> ZooModel:
         folded = qz.fold_mobilenet(params)
         rng = np.random.default_rng(seed)
         calib = [
-            jnp.asarray(rng.integers(0, 255, (batch, size, size, 3), np.uint8))
+            jnp.asarray(rng.integers(0, 256, (batch, size, size, 3), np.uint8))
             for _ in range(int(options.get("calib_batches", 2)))
         ]
         qparams = qz.quantize_mobilenet(

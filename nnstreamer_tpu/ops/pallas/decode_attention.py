@@ -22,7 +22,7 @@ Blocks entirely beyond a slot's fill level are predicated off with
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +55,10 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         ) * scale                                  # [1, bk]
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(cols < live_len, s, NEG_INF)
+        # dead rows get softmax weight exp(NEG_INF - m) = 0, but a tail
+        # block past the cache length reads pad garbage for v, and
+        # 0 * NaN = NaN — zero those rows so the weighted sum stays clean
+        v = jnp.where(cols.reshape(-1, 1) < live_len, v, 0.0)
 
         m_prev = m_ref[:]                          # [1]
         l_prev = l_ref[:]
@@ -77,12 +81,15 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         ).astype(o_ref.dtype)
 
 
-def _pick_block(s_len: int, block_k: int) -> int:
-    """Largest divisor of s_len ≤ block_k (no padding pass needed)."""
-    for cand in range(min(block_k, s_len), 0, -1):
-        if s_len % cand == 0:
-            return cand
-    return s_len
+def _pick_block(s_len: int, block_k: int) -> Tuple[int, int]:
+    """(block size, grid length) covering s_len with ceil-division.
+
+    Blocks need not divide the cache length: Pallas pads the tail block,
+    and the kernel's ``cols < live_len`` mask (live_len ≤ s_len) already
+    neutralizes the pad columns — so a prime or odd cache length keeps
+    full-width blocks instead of degenerating to 1-row blocks."""
+    bk = min(block_k, s_len)
+    return bk, -(-s_len // bk)
 
 
 @functools.partial(
@@ -109,8 +116,7 @@ def decode_attention(
         raise ValueError(f"query heads {h} not divisible by kv heads {n_kv}")
     group = h // n_kv
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    bk = _pick_block(s_len, block_k)
-    n_k = s_len // bk
+    bk, n_k = _pick_block(s_len, block_k)
     kernel = functools.partial(_kernel, scale=scale, block_k=bk, n_k=n_k)
 
     from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets

@@ -75,7 +75,16 @@ class TestDecodeAttention:
         o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
         return o
 
-    @pytest.mark.parametrize("s_len,block_k", [(64, 16), (48, 16), (40, 128)])
+    @pytest.mark.parametrize(
+        "s_len,block_k",
+        [
+            (64, 16), (48, 16), (40, 128),
+            # non-dividing lengths: the grid ceil-covers the cache and
+            # masks the tail block — a prime length must keep full-width
+            # blocks, not degenerate to 1-row blocks (ADVICE r2)
+            (97, 32), (130, 128), (33, 16),
+        ],
+    )
     def test_matches_masked_softmax(self, s_len, block_k):
         from nnstreamer_tpu.ops.pallas.decode_attention import decode_attention
 

@@ -6,16 +6,13 @@ Strategy (reference parity target: tensor_filter_tensorflow_lite.cc):
   via jax2tf + TFLiteConverter, execute it with the in-tree tflite backend
   (TFLite/XNNPACK CPU kernels — an engine that shares no code with XLA),
   and compare outputs;
-- pin golden logits for the flagship model so pure math drift fails even
-  where tensorflow isn't installed;
-- exercise the params:<npz> overlay (the real-weights loading path) and the
-  torch backend (tensor_filter_pytorch.cc slot).
+- the tf-free parity tests (golden logits, params overlay, torch
+  backend) live in tests/test_parity_tf_free.py so drift detection
+  survives a tensorflow-less image.
 
-Skips cleanly when tensorflow/torch are absent (they are optional extras,
-like the reference's meson-gated subplugins).
+Skips cleanly when tensorflow is absent (an optional extra, like the
+reference's meson-gated subplugins).
 """
-
-import os
 
 import numpy as np
 import pytest
@@ -96,84 +93,3 @@ def test_tflite_framework_autodetect(tmp_path):
     with SingleShot(model=str(path)) as s:
         (out,) = s.invoke(np.ones(4, np.float32))
     np.testing.assert_allclose(np.asarray(out), np.full(4, 3.0))
-
-
-# -- golden logits: drift detection that needs no tensorflow ---------------
-
-# First 8 logits of zoo:mobilenet_v2 (seed 0, size 96, num_classes 16) on
-# the deterministic image below — recorded from the float32 CPU path. If
-# the model math, init, or preprocessing drifts, this fails.
-_GOLDEN_LOGITS = np.array(
-    [0.10145831, 3.574911, -1.5670481, 3.147415,
-     0.32970887, -1.3878971, 5.6172085, -1.5150919], np.float32
-)
-
-
-def test_mobilenet_golden_logits():
-    m = zoo.get("mobilenet_v2", size="96", num_classes="16")
-    img = _img((1, 96, 96, 3))
-    out = np.asarray(jax.jit(m.fn)(img))[0, :8]
-    np.testing.assert_allclose(out, _GOLDEN_LOGITS, rtol=5e-4, atol=5e-5)
-
-
-# -- params overlay: the real-weights loading path -------------------------
-
-def test_params_npz_overlay(tmp_path):
-    base = zoo.get("mobilenet_v2", size="96", num_classes="16")
-    leaves, _ = jax.tree_util.tree_flatten(base.params)
-    # overlay: replace the classifier weight (largest trailing leaf set)
-    # with a known constant and check the output becomes exactly the bias
-    # structure it implies
-    w_idx = next(
-        i for i, l in enumerate(leaves) if tuple(l.shape) == (1280, 16)
-    )
-    # tree_flatten orders dict keys alphabetically: classifier {"b","w"}
-    # flattens bias immediately before weight
-    b_idx = w_idx - 1
-    assert tuple(leaves[b_idx].shape) == (16,)
-    overlay = {
-        f"p{w_idx}": np.zeros((1280, 16), np.float32),
-        f"p{b_idx}": np.arange(16, dtype=np.float32),
-    }
-    path = tmp_path / "w.npz"
-    np.savez(path, **overlay)
-    m = zoo.get(
-        "mobilenet_v2", size="96", num_classes="16", params=str(path)
-    )
-    out = np.asarray(jax.jit(m.fn)(_img((1, 96, 96, 3))))
-    np.testing.assert_allclose(out[0], np.arange(16, dtype=np.float32),
-                               rtol=1e-5, atol=1e-5)
-
-
-# -- torch backend (tensor_filter_pytorch.cc slot) -------------------------
-
-def test_torch_backend_roundtrip(tmp_path):
-    torch = pytest.importorskip("torch")
-    from nnstreamer_tpu.tensors.spec import TensorsSpec
-
-    class Scale(torch.nn.Module):
-        def forward(self, x):
-            return x * 2.0 + 1.0
-
-    path = str(tmp_path / "scale.pt")
-    torch.jit.script(Scale()).save(path)
-    spec = TensorsSpec.from_strings("4:2", "float32")
-    with SingleShot(framework="torch", model=path, input_spec=spec) as s:
-        (out,) = s.invoke(np.ones((2, 4), np.float32))
-    np.testing.assert_allclose(out, np.full((2, 4), 3.0))
-
-
-def test_torch_framework_autodetect(tmp_path):
-    torch = pytest.importorskip("torch")
-    from nnstreamer_tpu.tensors.spec import TensorsSpec
-
-    class Neg(torch.nn.Module):
-        def forward(self, x):
-            return -x
-
-    path = str(tmp_path / "neg.pt")
-    torch.jit.script(Neg()).save(path)
-    spec = TensorsSpec.from_strings("3", "float32")
-    with SingleShot(model=path, input_spec=spec) as s:
-        (out,) = s.invoke(np.arange(3, dtype=np.float32))
-    np.testing.assert_allclose(out, -np.arange(3, dtype=np.float32))

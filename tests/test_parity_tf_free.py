@@ -1,0 +1,102 @@
+"""Parity tests that need NO tensorflow: golden-logits drift detection,
+the params:<npz> overlay path, and the torch backend slot.
+
+Split out of tests/test_parity.py, whose module-level
+``importorskip("tensorflow")`` would otherwise disable drift detection on
+any image without tensorflow — defeating the golden-logits test's whole
+purpose (it exists precisely so model-math drift fails even where the
+cross-engine comparison can't run).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nnstreamer_tpu.models import zoo
+from nnstreamer_tpu.single import SingleShot
+
+
+def _img(shape, seed=0):
+    return np.random.default_rng(seed).integers(0, 255, shape, np.uint8)
+
+
+# -- golden logits: drift detection that needs no tensorflow ---------------
+
+# First 8 logits of zoo:mobilenet_v2 (seed 0, size 96, num_classes 16) on
+# the deterministic image below — recorded from the float32 CPU path. If
+# the model math, init, or preprocessing drifts, this fails.
+_GOLDEN_LOGITS = np.array(
+    [0.10145831, 3.574911, -1.5670481, 3.147415,
+     0.32970887, -1.3878971, 5.6172085, -1.5150919], np.float32
+)
+
+
+def test_mobilenet_golden_logits():
+    m = zoo.get("mobilenet_v2", size="96", num_classes="16")
+    img = _img((1, 96, 96, 3))
+    out = np.asarray(jax.jit(m.fn)(img))[0, :8]
+    np.testing.assert_allclose(out, _GOLDEN_LOGITS, rtol=5e-4, atol=5e-5)
+
+
+# -- params overlay: the real-weights loading path -------------------------
+
+def test_params_npz_overlay(tmp_path):
+    base = zoo.get("mobilenet_v2", size="96", num_classes="16")
+    leaves, _ = jax.tree_util.tree_flatten(base.params)
+    # overlay: replace the classifier weight (largest trailing leaf set)
+    # with a known constant and check the output becomes exactly the bias
+    # structure it implies
+    w_idx = next(
+        i for i, l in enumerate(leaves) if tuple(l.shape) == (1280, 16)
+    )
+    # tree_flatten orders dict keys alphabetically: classifier {"b","w"}
+    # flattens bias immediately before weight
+    b_idx = w_idx - 1
+    assert tuple(leaves[b_idx].shape) == (16,)
+    overlay = {
+        f"p{w_idx}": np.zeros((1280, 16), np.float32),
+        f"p{b_idx}": np.arange(16, dtype=np.float32),
+    }
+    path = tmp_path / "w.npz"
+    np.savez(path, **overlay)
+    m = zoo.get(
+        "mobilenet_v2", size="96", num_classes="16", params=str(path)
+    )
+    out = np.asarray(jax.jit(m.fn)(_img((1, 96, 96, 3))))
+    np.testing.assert_allclose(out[0], np.arange(16, dtype=np.float32),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- torch backend (tensor_filter_pytorch.cc slot) -------------------------
+
+def test_torch_backend_roundtrip(tmp_path):
+    torch = pytest.importorskip("torch")
+    from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+    class Scale(torch.nn.Module):
+        def forward(self, x):
+            return x * 2.0 + 1.0
+
+    path = str(tmp_path / "scale.pt")
+    torch.jit.script(Scale()).save(path)
+    spec = TensorsSpec.from_strings("4:2", "float32")
+    with SingleShot(framework="torch", model=path, input_spec=spec) as s:
+        (out,) = s.invoke(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(out, np.full((2, 4), 3.0))
+
+
+def test_torch_framework_autodetect(tmp_path):
+    torch = pytest.importorskip("torch")
+    from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+    class Neg(torch.nn.Module):
+        def forward(self, x):
+            return -x
+
+    path = str(tmp_path / "neg.pt")
+    torch.jit.script(Neg()).save(path)
+    spec = TensorsSpec.from_strings("3", "float32")
+    with SingleShot(model=path, input_spec=spec) as s:
+        (out,) = s.invoke(np.arange(3, dtype=np.float32))
+    np.testing.assert_allclose(out, -np.arange(3, dtype=np.float32))
