@@ -152,6 +152,97 @@ def verify_chunk(
     return logits, (cache_k, cache_v), pos + kk_len
 
 
+def windowed_chunk(
+    params: Dict,
+    tokens,
+    pos,
+    valid_n,
+    cache: Tuple[jax.Array, jax.Array],
+    n_heads: int,
+    ffn_fn: Optional[Callable] = None,
+    compute_dtype=jnp.float32,
+    return_logits: bool = True,
+):
+    """Advance a RING cache by one chunk with EXACT sliding-window
+    semantics (windowed chunked prefill; Mistral-style rolling prefill).
+
+    ``cache`` k/v are rings [L, B, W, KV, Dh] over the last W tokens (the
+    layout batched_decode_step(windowed=True) consumes). tokens [B, k]
+    start at absolute position ``pos``; only the first ``valid_n`` rows
+    are real (the tail is pad — its writes are suppressed so live ring
+    entries are never clobbered, and causal masking keeps it out of every
+    valid query's key set). Returns (logits [B,k,V] or None, cache',
+    pos + valid_n).
+
+    Exactness: query i (absolute p = pos+i) must attend the previous
+    W-1 tokens and itself — including ring entries the chunk itself is
+    about to overwrite. So attention runs against the PRE-write ring
+    concatenated with the chunk's fresh K/V, and the ring is updated
+    after: ring slot s last held absolute position pos-1-d where
+    d = (wp-1-s) mod W (wp = pos % W), attendable by query i iff written
+    (d ≤ pos-1) and in-window (d ≤ W-2-i).
+
+    Precondition: wp + k ≤ W — no mid-chunk ring wrap. Callers align
+    chunk starts to bucket strides with W % bucket == 0 (checked when
+    ``pos`` is concrete)."""
+    cache_k, cache_v = cache
+    W = cache_k.shape[2]
+    b, k_len = tokens.shape
+    if not isinstance(pos, jax.core.Tracer) and int(pos) % W + k_len > W:
+        raise ValueError(
+            f"windowed_chunk: chunk [{int(pos)}, {int(pos) + k_len}) wraps "
+            f"the W={W} ring mid-chunk; align chunk starts to a bucket "
+            "size that divides the window"
+        )
+    pos = jnp.asarray(pos, jnp.int32)
+    valid_n = jnp.asarray(valid_n, jnp.int32)
+    wp = pos % W
+    x = tfm.embed_lookup(params["embed"], tokens, compute_dtype)
+    positions = pos + jnp.arange(k_len, dtype=jnp.int32)
+    row = jnp.arange(k_len, dtype=jnp.int32)
+    d = (wp - 1 - jnp.arange(W, dtype=jnp.int32)) % W  # [W] steps behind
+    ring_mask = d[None, :] <= jnp.minimum(pos - 1, W - 2 - row)[:, None]
+    chunk_mask = row[None, :] <= row[:, None]  # causal (pad rows are
+    # later rows, so no valid query ever attends one)
+    mask = jnp.concatenate([ring_mask, chunk_mask], axis=1)  # [k, W+k]
+    keep = (row < valid_n)[None, :, None, None]
+
+    def body(carry, layer):
+        x = carry
+        blk, ck, cv = layer
+        q, k, v = tfm.block_qkv(x, blk, n_heads, positions)
+        o = tfm.cache_attention(
+            q,
+            jnp.concatenate([ck, k.astype(ck.dtype)], axis=1),
+            jnp.concatenate([cv, v.astype(cv.dtype)], axis=1),
+            mask[None],
+        )
+        # write the chunk into the ring (contiguous by precondition),
+        # blending so pad rows keep the pre-chunk entries
+        tail = ck.shape[2:]
+        old_k = jax.lax.dynamic_slice(ck, (0, wp, 0, 0), (b, k_len) + tail)
+        old_v = jax.lax.dynamic_slice(cv, (0, wp, 0, 0), (b, k_len) + tail)
+        ck = jax.lax.dynamic_update_slice(
+            ck, jnp.where(keep, k.astype(ck.dtype), old_k), (0, wp, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, jnp.where(keep, v.astype(cv.dtype), old_v), (0, wp, 0, 0)
+        )
+        o = o.astype(x.dtype).reshape(b, k_len, -1)
+        x = x + o @ tfm.wt(blk["wo"], x.dtype)
+        x = tfm.block_ffn(x, blk, ffn_fn)
+        return x, (ck, cv)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache_k, cache_v)
+    )
+    if not return_logits:
+        return None, (cache_k, cache_v), pos + valid_n
+    x = tfm.rmsnorm(x, params["ln_f"])
+    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
+    return logits, (cache_k, cache_v), pos + valid_n
+
+
 def beam_search(
     params: Dict,
     prompt,

@@ -5,7 +5,7 @@ many streams of different lengths onto one chip. The TPU-shaped answer is
 slot-based continuous batching: a fixed [n_slots] batch of KV-cache slots,
 one batched decode program stepping ALL active slots per token, and
 requests joining/leaving between steps — shapes never change, so XLA
-compiles exactly two programs (prefill, step) for the server's lifetime.
+compiles a fixed handful of programs for the server's lifetime.
 
 This is the genuinely-new analogue of the reference's one-server-many-
 clients query path (tensor_query_serversrc client_id demultiplexing,
@@ -27,7 +27,15 @@ Design notes:
   beyond the true length is rewritten before the mask can include it);
 - ``cache_dtype="int8"`` stores the KV cache quantized (per-token-per-
   head scales, quantize_kv) — 4× less HBM than f32, i.e. 4× the live
-  context per chip, dequantized on the attention read.
+  context per chip, dequantized on the attention read (blockwise in VMEM
+  when the Pallas kernel runs, so HBM traffic stays at the int8 bytes);
+- sampling (temperature / top-k / top-p) runs INSIDE the step program
+  with per-slot parameters and per-slot fold_in(seed, position) keys —
+  one int32 per slot crosses to host per step, never [B, V] logits;
+- admission decouples from decode: submit() prefills outside the state
+  lock and queues a pending insert that the next step() applies, so the
+  compiled step runs with no lock held and admission never serializes
+  behind an in-flight device step.
 """
 
 from __future__ import annotations
@@ -78,7 +86,10 @@ def batched_decode_step(
     unchanged and their logits are garbage (callers must gate on
     ``active``). ``attn_fn(q, ck, cv, pos) -> [B,1,H,Dh]`` overrides the
     inline masked attention (the Pallas single-pass kernel,
-    ops/pallas/decode_attention.py; float caches only).
+    ops/pallas/decode_attention.py); with an int8 cache the attn_fn
+    receives the quantized entries ``(ck8, kscale)`` / ``(cv8, vscale)``
+    directly — the kernel dequantizes blockwise in VMEM, which is the
+    whole point of quantizing (HBM traffic stays at int8 bytes).
 
     ``cache`` is either ``(ck, cv)`` (float) or
     ``((ck8, kscale), (cv8, vscale))`` (int8, see quantize_kv).
@@ -96,11 +107,6 @@ def batched_decode_step(
     The same saturation argument makes windowed compose with attn_fn
     (the Pallas kernel's ``cols ≤ pos`` mask degenerates identically)."""
     quantized = isinstance(cache[0], tuple)
-    if quantized and attn_fn is not None:
-        raise ValueError(
-            "attn_fn needs a float cache (the kernel takes no scale "
-            "operand yet); use the inline XLA attention with int8 caches"
-        )
     max_len = (cache[0][0] if quantized else cache[0]).shape[2]
     b = tok.shape[0]
     x = tfm.embed_lookup(params["embed"], tok, compute_dtype)[:, None, :]
@@ -138,15 +144,19 @@ def batched_decode_step(
             ksc = write_scale(ksc, ks)
             cv8 = write(cv8, v8)
             vsc = write_scale(vsc, vs)
-            ck = dequantize_kv(ck8, ksc)
-            cv = dequantize_kv(cv8, vsc)
             out_layer = (ck8, ksc, cv8, vsc)
+            if attn_fn is None:
+                ck = dequantize_kv(ck8, ksc)
+                cv = dequantize_kv(cv8, vsc)
         else:
             ck = write(ck, k)
             cv = write(cv, v)
             out_layer = (ck, cv)
         if attn_fn is not None:
-            o = attn_fn(q, ck, cv, pos)  # [B,1,H,Dh] f32
+            if quantized:
+                o = attn_fn(q, (ck8, ksc), (cv8, vsc), pos)
+            else:
+                o = attn_fn(q, ck, cv, pos)  # [B,1,H,Dh] f32
         else:
             # liveness mask [B, max_len]: the ≤pos prefix — which
             # saturates to all-live past a ring wrap (windowed), exactly
@@ -172,6 +182,41 @@ def batched_decode_step(
     x = tfm.rmsnorm(x, params["ln_f"])
     logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)[:, 0]
     return logits, cache_out, pos + active.astype(jnp.int32)
+
+
+def sample_tokens(logits, temp, top_k, top_p, keys):
+    """Per-slot token selection INSIDE the step program.
+
+    logits [B, V] f32; temp [B] f32 (≤ 0 → greedy); top_k [B] int32
+    (0 → disabled); top_p [B] f32 (1.0 → disabled; the nucleus keeps the
+    smallest most-probable set with mass ≥ top_p, boundary token
+    included); keys [B, 2] uint32 per-slot PRNG keys → tok [B] int32.
+    Everything is branch-free so one compiled program serves any mix of
+    greedy and sampling slots — and only [B] token ids ever cross to the
+    host, never the [B, V] logits (at a 32k–128k vocab that transfer is
+    megabytes per step)."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    # top-k: threshold at the k-th largest value per row where enabled
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1
+    )
+    scaled = jnp.where((top_k > 0)[:, None] & (scaled < kth), -jnp.inf, scaled)
+    # top-p over the (possibly top-k-truncated) distribution
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sp = jnp.sort(probs, axis=-1)[:, ::-1]
+    csum = jnp.cumsum(sp, axis=-1)
+    n_keep = jnp.sum(csum < top_p[:, None], axis=-1) + 1
+    cutoff = jnp.take_along_axis(
+        sp, jnp.clip(n_keep - 1, 0, v - 1)[:, None], axis=-1
+    )
+    scaled = jnp.where(
+        (top_p < 1.0)[:, None] & (probs < cutoff), -jnp.inf, scaled
+    )
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
 
 
 def insert_slot(cache, ks, vs, slot):
@@ -211,7 +256,7 @@ class _Request:
     top_k: int = 0
     top_p: float = 1.0
     stop_token: Optional[int] = None
-    rng: Optional[np.random.Generator] = None
+    key: Optional[np.ndarray] = None  # base PRNG key [2] uint32
     tokens: List[int] = field(default_factory=list)
     done: bool = False
 
@@ -222,28 +267,19 @@ class _Request:
             return True
         return bool(self.tokens) and self.tokens[-1] == self.stop_token
 
-    def pick(self, logits_row: np.ndarray) -> int:
-        """Select this request's next token from its logits row (host-
-        side: sampling params are per-request, batches mix freely)."""
-        if self.temperature <= 0.0:
-            return int(logits_row.argmax())
-        scaled = logits_row.astype(np.float64) / self.temperature
-        if self.top_k > 0 and self.top_k < scaled.shape[0]:
-            kth = np.partition(scaled, -self.top_k)[-self.top_k]
-            scaled = np.where(scaled >= kth, scaled, -np.inf)
-        scaled -= scaled.max()
-        p = np.exp(scaled)
-        p /= p.sum()
-        if 0.0 < self.top_p < 1.0:
-            # nucleus: smallest probability mass ≥ top_p (most-probable
-            # first; the boundary token is kept)
-            order = np.argsort(p)[::-1]
-            csum = np.cumsum(p[order])
-            keep = order[: int(np.searchsorted(csum, self.top_p)) + 1]
-            mask = np.zeros_like(p)
-            mask[keep] = p[keep]
-            p = mask / mask.sum()
-        return int(self.rng.choice(p.shape[0], p=p))
+
+@dataclass
+class _PendingInsert:
+    """A prefilled request waiting for the next step() to splice its K/V
+    into the batch cache (submit never touches device state directly, so
+    the compiled step runs lock-free)."""
+
+    slot: int
+    ks: jax.Array
+    vs: jax.Array
+    first_tok: int
+    fill: int  # cache fill level (= absolute position count)
+    req: _Request
 
 
 class ContinuousBatcher:
@@ -271,26 +307,21 @@ class ContinuousBatcher:
         windowed: bool = False,
     ):
         """``windowed=True`` makes max_len a sliding attention window
-        over a ring-buffer cache: generations of ANY length run in the
-        fixed [max_len] cache, each token attending the previous max_len
-        (Mistral-style sliding-window attention — the time-axis sibling
-        of tensor_aggregator's bounded windows)."""
+        over a ring-buffer cache: generations AND prompts of any length
+        run in the fixed [max_len] cache, each token attending the
+        previous max_len (Mistral-style sliding-window attention — the
+        time-axis sibling of tensor_aggregator's bounded windows).
+
+        The full feature matrix composes: attn_impl="pallas" works with
+        cache_dtype="int8" (the kernel takes the scale operands and
+        dequantizes in VMEM), with mesh= (the step program is wrapped in
+        shard_map over the slot axis, so each device runs the kernel on
+        its local slots), and with windowed=True."""
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
         if cache_dtype not in ("auto", "int8"):
             raise ValueError(f"unknown cache_dtype {cache_dtype!r}")
         quantized_cache = cache_dtype == "int8"
-        if quantized_cache and attn_impl == "pallas":
-            raise ValueError(
-                "attn_impl='pallas' needs a float cache (the kernel takes "
-                "no scale operand yet); use cache_dtype='auto'"
-            )
-        if mesh is not None and attn_impl == "pallas":
-            raise ValueError(
-                "attn_impl='pallas' does not compose with mesh= (GSPMD "
-                "cannot partition the kernel's custom call over the slot-"
-                "sharded cache); use the default XLA attention"
-            )
         if attn_impl == "pallas":
             from nnstreamer_tpu.ops.pallas.decode_attention import (
                 make_decode_attention,
@@ -308,9 +339,11 @@ class ContinuousBatcher:
         self.windowed = windowed
         self.prompt_len = prompt_len
         self.compute_dtype = compute_dtype
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()       # host/device state
+        self._step_lock = threading.Lock()  # serializes device steps
         self._next_rid = 0
         self._slots: List[Optional[_Request]] = [None] * n_slots
+        self._pending: List[_PendingInsert] = []
         # finished requests await pickup here; bounded FIFO so a caller
         # that never collects cannot grow the host heap without limit
         self._done_pool: "OrderedDict[int, _Request]" = OrderedDict()
@@ -334,6 +367,12 @@ class ContinuousBatcher:
         self._tok = jnp.zeros((n_slots,), jnp.int32)
         self._pos = jnp.zeros((n_slots,), jnp.int32)
         self._active = np.zeros((n_slots,), bool)
+        # per-slot sampling state lives ON DEVICE so the step program
+        # samples in place (host sees one token id per slot per step)
+        self._temp = jnp.zeros((n_slots,), jnp.float32)
+        self._topk = jnp.zeros((n_slots,), jnp.int32)
+        self._topp = jnp.ones((n_slots,), jnp.float32)
+        self._keys = jnp.zeros((n_slots, 2), jnp.uint32)
 
         if mesh is not None:
             # shard the slot axis over the mesh: the batched step runs
@@ -358,6 +397,10 @@ class ContinuousBatcher:
             )
             self._tok = jax.device_put(self._tok, vec_sh)
             self._pos = jax.device_put(self._pos, vec_sh)
+            self._temp = jax.device_put(self._temp, vec_sh)
+            self._topk = jax.device_put(self._topk, vec_sh)
+            self._topp = jax.device_put(self._topp, vec_sh)
+            self._keys = jax.device_put(self._keys, vec_sh)
         else:
             self._vec_sh = None
 
@@ -385,11 +428,68 @@ class ContinuousBatcher:
                 compute_dtype=compute_dtype, return_logits=False,
             )[1]
         )
-        self._step = jax.jit(
-            lambda tok, pos, active, cache: batched_decode_step(
-                params, tok, pos, active, cache, n_heads, compute_dtype,
-                attn_fn=attn_fn, windowed=windowed,
+        # windowed (ring) chunked-prefill programs: exact sliding-window
+        # prefill for prompts of ANY length in the fixed W ring
+        self._ring_shape = (L, 1, max_len, kv, hd)
+        self._wchunk = jax.jit(
+            lambda toks, cpos, n, cache: dec.windowed_chunk(
+                params, toks, cpos, n, cache, n_heads,
+                compute_dtype=compute_dtype,
+            )[:2]
+        )
+        self._wadvance = jax.jit(
+            lambda toks, cpos, n, cache: dec.windowed_chunk(
+                params, toks, cpos, n, cache, n_heads,
+                compute_dtype=compute_dtype, return_logits=False,
+            )[1]
+        )
+
+        def step_impl(sampling):
+            def impl(tok, pos, active, cache, temp, topk, topp, keys):
+                logits, cache, pos2 = batched_decode_step(
+                    params, tok, pos, active, cache, n_heads,
+                    compute_dtype, attn_fn=attn_fn, windowed=windowed,
+                )
+                if sampling:
+                    # per-slot key = fold_in(base, fill level): token
+                    # streams are deterministic per (seed, position),
+                    # independent of batch composition
+                    sub = jax.vmap(jax.random.fold_in)(keys, pos2)
+                    new = sample_tokens(logits, temp, topk, topp, sub)
+                else:
+                    new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return jnp.where(active, new, tok), cache, pos2
+
+            return impl
+
+        if mesh is not None and attn_impl == "pallas":
+            # GSPMD cannot partition the kernel's custom call over the
+            # slot-sharded cache — but the step is slot-parallel by
+            # construction, so shard_map IS the partition: each device
+            # runs the whole step (kernel included) on its local slots
+            from jax.sharding import PartitionSpec as P
+
+            ax = slots_axis
+            vec, cac = P(ax), P(None, ax)
+            specs = dict(
+                in_specs=(vec, vec, vec, cac, vec, vec, vec, vec),
+                out_specs=(vec, cac, vec),
+                check_vma=False,
             )
+            self._step_greedy = jax.jit(
+                jax.shard_map(step_impl(False), mesh=mesh, **specs)
+            )
+            self._step_sampling = jax.jit(
+                jax.shard_map(step_impl(True), mesh=mesh, **specs)
+            )
+        else:
+            self._step_greedy = jax.jit(step_impl(False))
+            self._step_sampling = jax.jit(step_impl(True))
+        # first-token pick: same device sampler over the prefill logits
+        self._sample1 = jax.jit(
+            lambda logits, temp, topk, topp, key: sample_tokens(
+                logits[None, :], temp, topk, topp, key[None]
+            )[0]
         )
         self._insert = jax.jit(insert_slot)
         self._load_prefix = jax.jit(
@@ -439,6 +539,34 @@ class ContinuousBatcher:
             cpos += n
         return logits, stage
 
+    def _stage_ring(self, tokens):
+        """Windowed chunked prefill: advance a fresh W-ring with the
+        whole prompt, one bucket per windowed_chunk call (exact sliding-
+        window attention — decode.windowed_chunk). Returns (final
+        chunk's logits, ring (ks, vs), last-row index)."""
+        P = self.prompt_len  # max_len % P == 0 enforced at construction
+        ring = (
+            jnp.zeros(self._ring_shape, self.compute_dtype),
+            jnp.zeros(self._ring_shape, self.compute_dtype),
+        )
+        t = tokens.shape[0]
+        cpos = 0
+        logits = None
+        while cpos < t:
+            n = min(P, t - cpos)
+            chunk = np.zeros((1, P), np.int32)
+            chunk[0, :n] = tokens[cpos : cpos + n]
+            args = (
+                jnp.asarray(chunk), jnp.asarray(cpos, jnp.int32),
+                jnp.asarray(n, jnp.int32), ring,
+            )
+            if cpos + n >= t:
+                logits, ring = self._wchunk(*args)
+            else:
+                ring = self._wadvance(*args)
+            cpos += n
+        return logits, ring, (t - 1) % P  # last real row of the final chunk
+
     def register_prefix(self, tokens) -> int:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE and
         return its id; submit(prefix=id) starts from its K/V instead of
@@ -448,6 +576,9 @@ class ContinuousBatcher:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         plen = tokens.shape[0]
         if self.windowed:
+            # a prefix's ring placement depends on what follows it (its
+            # absolute positions shift per request), so the cached K/V
+            # cannot be spliced into a ring — fundamental, not a TODO
             raise ValueError("prefix caching needs an unwindowed cache")
         if not (0 < plen < self.max_len):
             raise ValueError(
@@ -483,26 +614,23 @@ class ContinuousBatcher:
         None when the batch is full (caller queues/retries — the
         admission queue is the caller's policy, not the batcher's).
         Prompts longer than the prompt_len bucket prefill in bucket-sized
-        chunks (decode.verify_chunk), so T is bounded by the cache, not
-        the bucket.
+        chunks (decode.verify_chunk; decode.windowed_chunk on a ring when
+        windowed), so T is bounded by the cache — or by nothing at all
+        when windowed (the ring retains the last max_len tokens, exactly
+        sliding-window semantics).
 
         Sampling is per-request: temperature ≤ 0 is greedy; otherwise
         softmax sampling, optionally top-k truncated and/or top-p
         (nucleus) filtered (0 < top_p < 1; the boundary token is kept),
-        with a deterministic per-request stream seeded by ``seed``
-        (default: the request id)."""
+        with a deterministic per-request stream: every token is keyed by
+        fold_in(PRNGKey(seed), fill-level), so the stream depends only on
+        (seed, position) — never on batch composition."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be ≥ 1, got {max_new_tokens}")
         if t == 0:
             raise ValueError("empty prompt")
-        if t > self.prompt_len and self.windowed:
-            raise ValueError(
-                f"windowed batcher ingests at most prompt_len="
-                f"{self.prompt_len} prompt tokens (sliding prefill of "
-                f"longer prompts is not supported); got {t}"
-            )
         plen = 0
         pfx = None
         if prefix is not None:
@@ -510,7 +638,17 @@ class ContinuousBatcher:
                 if prefix not in self._prefixes:
                     raise ValueError(f"unknown prefix id {prefix}")
                 pfx, plen = self._prefixes[prefix]
-        if plen + t > self.max_len:
+        if self.windowed and t > self.prompt_len and self.max_len % self.prompt_len:
+            # checked before any slot is claimed: ring chunked prefill
+            # needs bucket-aligned chunks (a mid-chunk ring wrap would
+            # corrupt live entries). Bucket-sized prompts never chunk, so
+            # unaligned windowed configs stay valid for them.
+            raise ValueError(
+                f"windowed long prompts need max_len({self.max_len}) to "
+                f"be a multiple of prompt_len({self.prompt_len}) so "
+                "prefill chunks never wrap the ring mid-chunk"
+            )
+        if not self.windowed and plen + t > self.max_len:
             raise ValueError(
                 f"prefix({plen}) + prompt({t}) > max_len {self.max_len}"
             )
@@ -535,7 +673,9 @@ class ContinuousBatcher:
             req = _Request(
                 rid, max_new_tokens, temperature=temperature, top_k=top_k,
                 top_p=top_p, stop_token=stop_token,
-                rng=np.random.default_rng(rid if seed is None else seed),
+                key=np.asarray(
+                    jax.random.PRNGKey(rid if seed is None else seed)
+                ),
             )
             self._slots[slot] = req
 
@@ -546,7 +686,12 @@ class ContinuousBatcher:
                 padded = np.zeros((1, P), np.int32)
                 padded[0, :t] = prompt
                 logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
-                logits = logits[:, t - 1 : t]
+                logits_row = logits[0, t - 1]
+            elif self.windowed:
+                # ring chunked prefill: exact sliding-window attention
+                # for prompts of any length (the ring keeps the last W)
+                logits, (ks, vs), last = self._stage_ring(prompt)
+                logits_row = logits[0, last]
             else:
                 # chunked prefill (_stage_chunks): the staging cache
                 # starts empty or preloaded with the registered prefix
@@ -556,10 +701,19 @@ class ContinuousBatcher:
                     stage = self._load_prefix(self._empty_stage(), *pfx)
                 logits, stage = self._stage_chunks(prompt, plen, stage, True)
                 last = (t - 1) % P  # true last token's index in the chunk
-                logits = logits[:, last : last + 1]
+                logits_row = logits[0, last]
                 ks = stage[0][:, :, : self.max_len]
                 vs = stage[1][:, :, : self.max_len]
-            first = req.pick(np.asarray(logits[0, -1]))
+            fill = plen + t
+            first = int(
+                self._sample1(
+                    logits_row,
+                    jnp.asarray([temperature], jnp.float32),
+                    jnp.asarray([top_k], jnp.int32),
+                    jnp.asarray([top_p], jnp.float32),
+                    jax.random.fold_in(jnp.asarray(req.key), fill),
+                )
+            )
         except Exception:
             # release the claimed slot or n_slots failed prefills would
             # brick the server with every slot claimed-but-never-active
@@ -568,58 +722,80 @@ class ContinuousBatcher:
             raise
 
         with self._lock:
-            self._cache = self._insert(self._cache, ks, vs, slot)
-            self._tok = self._pin(self._tok.at[slot].set(first))
-            self._pos = self._pin(self._pos.at[slot].set(plen + t))
-            self._active[slot] = True
             req.tokens.append(first)
             if req.finished():
                 self._finish(slot)
+            else:
+                self._pending.append(
+                    _PendingInsert(slot, ks, vs, first, fill, req)
+                )
         return rid
 
+    def _apply_pending_locked(self) -> None:
+        """Splice queued admissions into the device state (_lock held)."""
+        for p in self._pending:
+            if self._slots[p.slot] is not p.req:
+                continue  # request vanished (defensive; cannot happen)
+            self._cache = self._insert(self._cache, p.ks, p.vs, p.slot)
+            self._tok = self._pin(self._tok.at[p.slot].set(p.first_tok))
+            self._pos = self._pin(self._pos.at[p.slot].set(p.fill))
+            self._temp = self._pin(
+                self._temp.at[p.slot].set(p.req.temperature)
+            )
+            self._topk = self._pin(self._topk.at[p.slot].set(p.req.top_k))
+            self._topp = self._pin(self._topp.at[p.slot].set(p.req.top_p))
+            self._keys = self._pin(
+                self._keys.at[p.slot].set(jnp.asarray(p.req.key))
+            )
+            self._active[p.slot] = True
+        self._pending.clear()
+
     def step(self) -> Dict[int, int]:
-        """Advance every active slot one token; returns {rid: token}."""
+        """Advance every active slot one token; returns {rid: token}.
+
+        The compiled step runs OUTSIDE the state lock (admission only
+        needs the lock for its bookkeeping, so submit() never waits on an
+        in-flight device step); _step_lock serializes concurrent
+        steppers. Slots admitted while a step is in flight join at the
+        next step."""
         import time as _time
 
         t0 = _time.perf_counter()
-        with self._lock:
-            if not self._active.any():
-                return {}
-            active = jnp.asarray(self._active)
-            logits, self._cache, self._pos = self._step(
-                self._tok, self._pos, active, self._cache
-            )
-            sampling = any(
-                req is not None and self._active[s] and req.temperature > 0
-                for s, req in enumerate(self._slots)
-            )
-            emitted: Dict[int, int] = {}
-            if sampling:
-                # mixed batch: per-request pick on host logits
-                lg = np.asarray(logits)
-                toks = np.asarray(self._tok).copy()
+        with self._step_lock:
+            with self._lock:
+                self._apply_pending_locked()
+                if not self._active.any():
+                    return {}
+                active_np = self._active.copy()
+                sampling = any(
+                    req is not None and active_np[s] and req.temperature > 0
+                    for s, req in enumerate(self._slots)
+                )
+                args = (
+                    self._tok, self._pos, jnp.asarray(active_np),
+                    self._cache, self._temp, self._topk, self._topp,
+                    self._keys,
+                )
+            step_fn = self._step_sampling if sampling else self._step_greedy
+            new_tok, cache, pos = step_fn(*args)
+            toks = np.asarray(new_tok)  # [B] ids — the only host transfer
+            with self._lock:
+                self._cache = cache
+                self._pos = pos
+                self._tok = new_tok
+                emitted: Dict[int, int] = {}
                 for slot, req in enumerate(self._slots):
-                    if req is None or not self._active[slot]:
+                    if req is None or not active_np[slot]:
                         continue
-                    toks[slot] = req.pick(lg[slot])
-                self._tok = self._pin(jnp.asarray(toks))
-            else:
-                # all-greedy fast path: argmax on device, one transfer
-                new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                self._tok = self._pin(jnp.where(active, new_tok, self._tok))
-                toks = np.asarray(self._tok)
-            for slot, req in enumerate(self._slots):
-                if req is None or not self._active[slot]:
-                    continue
-                tok = int(toks[slot])
-                req.tokens.append(tok)
-                emitted[req.rid] = tok
-                if req.finished():
-                    self._finish(slot)
-            self._n_steps += 1
-            self._n_tokens += len(emitted)
-            self._step_time_s += _time.perf_counter() - t0
-            return emitted
+                    tok = int(toks[slot])
+                    req.tokens.append(tok)
+                    emitted[req.rid] = tok
+                    if req.finished():
+                        self._finish(slot)
+                self._n_steps += 1
+                self._n_tokens += len(emitted)
+                self._step_time_s += _time.perf_counter() - t0
+                return emitted
 
     def stats(self) -> Dict[str, float]:
         """Serving counters — the token-world analogue of the filter

@@ -17,6 +17,12 @@ online-softmax scratch (m, l, acc) carried across k iterations — the same
 recurrence as ops/pallas/flash_attention.py specialized to one query row.
 Blocks entirely beyond a slot's fill level are predicated off with
 @pl.when.
+
+Int8 caches: pass ``k_scale``/``v_scale`` [B, S, KV] (per-token-per-head
+symmetric scales, models/serving.quantize_kv layout) and int8 cache
+arrays — the kernel dequantizes per block in VMEM, so HBM traffic stays
+at the int8 byte count (the whole point of quantizing the cache: 4× less
+cache streaming per decode step than f32).
 """
 
 from __future__ import annotations
@@ -31,8 +37,12 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-            *, scale: float, block_k: int, n_k: int):
+def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
+            scale: float, block_k: int, n_k: int, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     ki = pl.program_id(2)
 
@@ -50,6 +60,10 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         q = q_ref[0, 0].astype(jnp.float32)       # [1, d]
         k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, d]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            # per-row dequant in VMEM: int8 payload × f32 scale [bk]
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                  # [1, bk]
@@ -100,6 +114,8 @@ def decode_attention(
     cache_k,
     cache_v,
     pos,
+    k_scale=None,
+    v_scale=None,
     scale: Optional[float] = None,
     block_k: int = 128,
     interpret: bool = False,
@@ -108,33 +124,44 @@ def decode_attention(
     in place; KV ≤ H under grouped-query attention — query head hi reads
     kv head hi//(H/KV) straight from the BlockSpec index map, no
     expansion pass), pos [B] → o [B,1,H,D] float32. Positions > pos[b]
-    are masked per slot."""
+    are masked per slot. With ``k_scale``/``v_scale`` [B,S,KV] the cache
+    arrays are int8 and dequantized blockwise in VMEM."""
     b, _, h, d = q.shape
     s_len = cache_k.shape[1]
     n_kv = cache_k.shape[2]
     if h % n_kv:
         raise ValueError(f"query heads {h} not divisible by kv heads {n_kv}")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
     group = h // n_kv
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bk, n_k = _pick_block(s_len, block_k)
-    kernel = functools.partial(_kernel, scale=scale, block_k=bk, n_k=n_k)
+    kernel = functools.partial(
+        _kernel, scale=scale, block_k=bk, n_k=n_k, quantized=quantized
+    )
 
     from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets
 
+    kv_spec = pl.BlockSpec(
+        (1, bk, 1, d), lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [pos.astype(jnp.int32), q, cache_k, cache_v]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, bk, 1), lambda bi, hi, kk, pos_ref: (bi, kk, hi // group)
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, h, n_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)),
-            pl.BlockSpec(
-                (1, bk, 1, d),
-                lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0),
-            ),
-            pl.BlockSpec(
-                (1, bk, 1, d),
-                lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)
         ),
@@ -152,16 +179,26 @@ def decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(pos.astype(jnp.int32), q, cache_k, cache_v)
+    )(*operands)
     return out
 
 
 def make_decode_attention(interpret: Optional[bool] = None, **kwargs):
-    """attn factory: real kernel on TPU, interpreter elsewhere."""
+    """attn factory: real kernel on TPU, interpreter elsewhere.
+
+    The returned ``attn(q, ck, cv, pos)`` accepts either float cache
+    arrays or the serving int8 cache entries ``(ck8, k_scale)`` /
+    ``(cv8, v_scale)`` (models/serving.py quantize_kv layout)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def attn(q, cache_k, cache_v, pos):
+        if isinstance(cache_k, tuple):
+            (k8, ks), (v8, vs) = cache_k, cache_v
+            return decode_attention(
+                q, k8, v8, pos, k_scale=ks, v_scale=vs,
+                interpret=interpret, **kwargs,
+            )
         return decode_attention(q, cache_k, cache_v, pos,
                                 interpret=interpret, **kwargs)
 

@@ -37,6 +37,51 @@ def _alone(params, prompt, n_new):
     return [int(t) for t in np.asarray(toks)[0]]
 
 
+def _sliding_reference(params, prompt, n_new, W):
+    """Greedy tokens under EXACT sliding-window attention: every token
+    (prompt ingestion included) attends precisely the previous W
+    positions, computed token-by-token on an UNBOUNDED cache with a
+    banded mask — the ground truth the W-ring implementations must
+    reproduce bit-exactly."""
+    import functools
+
+    from nnstreamer_tpu.models.serving import batched_decode_step
+
+    def attn(q, ck, cv, pos):
+        idx = jnp.arange(ck.shape[1])[None, :]
+        mask = (idx <= pos[:, None]) & (idx > pos[:, None] - W)
+        return tfm.cache_attention(q, ck, cv, mask[:, None, :])
+
+    step = jax.jit(
+        functools.partial(
+            batched_decode_step, params, n_heads=N_HEADS, attn_fn=attn
+        )
+    )
+    L, d = params["blocks"]["ln1"].shape
+    kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, N_HEADS)
+    hd = d // N_HEADS
+    max_len = len(prompt) + n_new + 1
+    cache = (
+        jnp.zeros((L, 1, max_len, kv, hd)),
+        jnp.zeros((L, 1, max_len, kv, hd)),
+    )
+    pos = jnp.asarray([0], jnp.int32)
+    active = jnp.asarray([True])
+    logits = None
+    for t in prompt:
+        logits, cache, pos = step(
+            jnp.asarray([int(t)], jnp.int32), pos, active, cache
+        )
+    out = []
+    for _ in range(n_new):
+        tok = int(np.asarray(jnp.argmax(logits[0])))
+        out.append(tok)
+        logits, cache, pos = step(
+            jnp.asarray([tok], jnp.int32), pos, active, cache
+        )
+    return out
+
+
 def test_single_request_matches_generate(params):
     cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=64,
                            prompt_len=16)
@@ -174,10 +219,21 @@ class TestInt8Cache:
         assert cb.result(ra)[0] == _alone(params, pa, 1)[0]
         assert len(cb.result(ra)) == 8 and len(cb.result(rb)) == 8
 
-    def test_pallas_plus_int8_rejected(self, params):
-        with pytest.raises(ValueError, match="float cache"):
-            ContinuousBatcher(params, N_HEADS, cache_dtype="int8",
-                              attn_impl="pallas")
+    def test_pallas_composes_with_int8(self, params):
+        """The decode kernel reads the int8 cache directly (scale
+        operands, VMEM dequant) — tokens match the inline-XLA int8 path
+        exactly (both attend the same dequantized values)."""
+        prompt = _prompt(9, 14)
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=48,
+                                   prompt_len=16, cache_dtype="int8",
+                                   attn_impl=impl)
+            rid = cb.submit(prompt, 8)
+            while cb.result(rid) is None:
+                cb.step()
+            outs[impl] = cb.result(rid)
+        assert outs["xla"] == outs["pallas"]
 
 
 def test_submit_releases_slot_when_prefill_fails(params):
@@ -219,13 +275,26 @@ def test_mesh_requires_divisible_slots(params):
                           mesh=make_mesh(8, axes=("dp",)))
 
 
-def test_mesh_plus_pallas_rejected(params):
+def test_mesh_plus_pallas_matches_unsharded(params):
+    """attn_impl='pallas' + mesh=: the step program is shard_mapped over
+    the slot axis, each device running the kernel on its local slots —
+    tokens match the unsharded pallas batcher."""
     from nnstreamer_tpu.parallel.mesh import make_mesh
 
-    with pytest.raises(ValueError, match="mesh"):
-        ContinuousBatcher(params, N_HEADS, n_slots=8,
-                          mesh=make_mesh(8, axes=("dp",)),
-                          attn_impl="pallas")
+    mesh = make_mesh(8, axes=("dp",))
+    prompts = [_prompt(5 + i, 35 + i) for i in range(2)]
+    outs = {}
+    for label, kw in (
+        ("plain", {}),
+        ("mesh", dict(mesh=mesh)),
+    ):
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=8, max_len=32,
+                               prompt_len=16, attn_impl="pallas", **kw)
+        rids = [cb.submit(p, 5) for p in prompts]
+        while any(cb.result(r) is None for r in rids):
+            cb.step()
+        outs[label] = [cb.result(r) for r in rids]
+    assert outs["plain"] == outs["mesh"]
 
 
 class TestSampling:
@@ -321,45 +390,17 @@ class TestSlidingWindow:
     def test_ring_matches_sliding_mask_on_unbounded_cache(self, params):
         """The real post-wrap check: the ring stream must equal a
         reference stream computed on an UNBOUNDED cache whose attention
-        is masked to exactly the last W positions (a sliding-mask
-        attn_fn) — byte-identical through many wrapped steps."""
-        from nnstreamer_tpu.models import transformer as tfm
-        from nnstreamer_tpu.models.serving import ContinuousBatcher
-
+        is masked to exactly the last W positions (_sliding_reference) —
+        byte-identical through many wrapped steps."""
         W = 16
         n_new = 40  # wraps the W-ring several times
-
-        def sliding_attn(q, ck, cv, pos):
-            s_len = ck.shape[1]
-            idx = jnp.arange(s_len)[None, :]
-            mask = (idx <= pos[:, None]) & (idx > pos[:, None] - W)
-            return tfm.cache_attention(q, ck, cv, mask[:, None, :])
-
         prompt = _prompt(10, 72)
-        outs = {}
-        for label, kw in (
-            ("ring", dict(max_len=W, windowed=True)),
-            ("reference", dict(max_len=96, attn_impl="xla")),
-        ):
-            cb = ContinuousBatcher(params, N_HEADS, n_slots=1,
-                                   prompt_len=16, **kw)
-            if label == "reference":
-                # swap in the sliding-mask attention over the big cache
-                from nnstreamer_tpu.models.serving import (
-                    batched_decode_step,
-                )
-
-                cb._step = jax.jit(
-                    lambda tok, pos, active, cache: batched_decode_step(
-                        params, tok, pos, active, cache, N_HEADS,
-                        attn_fn=sliding_attn,
-                    )
-                )
-            rid = cb.submit(prompt, n_new)
-            while cb.result(rid) is None:
-                cb.step()
-            outs[label] = cb.result(rid)
-        assert outs["ring"] == outs["reference"]
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=W,
+                               prompt_len=16, windowed=True)
+        rid = cb.submit(prompt, n_new)
+        while cb.result(rid) is None:
+            cb.step()
+        assert cb.result(rid) == _sliding_reference(params, prompt, n_new, W)
 
     def test_ring_with_pallas_kernel(self, params):
         """windowed composes with the Pallas kernel (its <=pos mask
@@ -396,11 +437,34 @@ class TestChunkedPrefill:
         with pytest.raises(ValueError, match="> max_len"):
             cb.submit(_prompt(40, 90), 2)
 
-    def test_windowed_long_prompt_rejected(self, params):
-        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
+    @pytest.mark.parametrize("plen", [20, 32, 50])  # ≤W, =W, wraps W
+    def test_windowed_long_prompt_matches_sliding_reference(
+        self, params, plen
+    ):
+        """Windowed chunked prefill (decode.windowed_chunk ring prefill)
+        matches a reference computed on an unbounded cache with an exact
+        sliding-window attention mask — including prompts LONGER than
+        the window (the ring keeps the last W prompt tokens)."""
+        W = 32
+        n_new = 6
+        prompt = _prompt(plen, 91 + plen)
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=W,
                                prompt_len=16, windowed=True)
-        with pytest.raises(ValueError, match="sliding prefill"):
-            cb.submit(_prompt(20, 91), 2)
+        rid = cb.submit(prompt, n_new)
+        while cb.result(rid) is None:
+            cb.step()
+        assert cb.result(rid) == _sliding_reference(
+            params, prompt, n_new, W
+        )
+
+    def test_windowed_chunk_alignment_required(self, params):
+        """Unaligned windowed configs serve bucket-sized prompts fine;
+        a LONG prompt is rejected before any slot is claimed."""
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=24,
+                               prompt_len=16, windowed=True)
+        with pytest.raises(ValueError, match="multiple of prompt_len"):
+            cb.submit(_prompt(20, 95), 2)
+        assert cb.n_free == 1  # nothing claimed by the rejected submit
 
 
 class TestPrefixCaching:
