@@ -202,8 +202,68 @@ def _run() -> None:
     mb_fps = iters8 * mb / (time.perf_counter() - t0)
 
     _mark("mb8 measured")
+
+    # ---- THE PIPELINE METRIC (BASELINE.md's actual target) ----
+    # Everything above measures raw jitted invokes; BASELINE.md's bar is
+    # the gst-launch-equivalent *pipeline*: videotestsrc !
+    # tensor_converter ! tensor_filter ! tensor_decoder ! tensor_sink
+    # through the streaming executor (threads, queues, Frame wrapping,
+    # sink fencing — every cost the framework itself adds). The
+    # converter/filter/decoder chain FUSES into one XLA program
+    # (pipeline/graph.py), the decoder's argmax runs on device, and the
+    # sink fences a sync-window — so the steady state is one async
+    # dispatch per frame with no per-frame host round-trip.
+    def _pipeline_fps(device_src, fpt, n_frames, window, timeout=900.0):
+        """Steady-state pipeline FPS: frames after the first completed
+        render burst / wall time (excludes compile+warmup)."""
+        from nnstreamer_tpu.pipeline.executor import SinkNode
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        conv = "tensor_converter" + (
+            f" frames-per-tensor={fpt}" if fpt > 1 else ""
+        )
+        desc = (
+            f"videotestsrc pattern=gradient device="
+            f"{'true' if device_src else 'false'} "
+            f"num-frames={n_frames} width=224 height=224 ! {conv} ! "
+            f"tensor_filter framework=jax model=zoo:mobilenet_v2 "
+            f'custom="batch:{fpt},compute_dtype:bfloat16" ! '
+            "tensor_decoder mode=image_labeling ! "
+            f"tensor_sink sync-window={window} queue-size=128"
+        )
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=timeout)
+        sink = next(n for n in ex.nodes if isinstance(n, SinkNode))
+        steady = sink.frames_rendered - sink.first_burst_n
+        if (
+            sink.t_first_render is None
+            or sink.t_last_render is None
+            or steady < 1
+            or sink.t_last_render <= sink.t_first_render
+        ):
+            return None
+        return steady * fpt / (sink.t_last_render - sink.t_first_render)
+
+    # device-resident source: the framework + compute ceiling (frames
+    # born on device, as in a chained-filter pipeline — BASELINE.md's
+    # "device-resident tensors across chained filters, no host readback").
+    # Guarded: a stalled executor or node error must degrade to a null
+    # cell, never discard the raw metrics already measured above (the
+    # round-1 rc:1 failure mode).
+    def _pipeline_fps_safe(*args, **kw):
+        try:
+            return _pipeline_fps(*args, **kw)
+        except Exception as exc:  # noqa: BLE001 — any pipeline failure
+            print(f"[bench] pipeline variant failed: {exc!r}", file=sys.stderr)
+            return None
+
+    n_pipe = 2048 if on_tpu else 40
+    pipe_window = 64 if on_tpu else 8
+    pipeline_fps = _pipeline_fps_safe(True, 1, n_pipe, pipe_window)
+    _mark("pipeline measured")
+
     # Optional sections below run inside a soft budget: the primary
-    # metric is already measured, and a slow tunnel day must not turn a
+    # metrics are already measured, and a slow tunnel day must not turn a
     # recorded number into an rc:1 (the round-1 failure mode).
     soft_budget = float(os.environ.get("BENCH_SOFT_BUDGET_S", "700"))
 
@@ -211,6 +271,24 @@ def _run() -> None:
         # optional sections are TPU evidence; the CPU fallback records the
         # primary diagnostics only
         return (not on_tpu) or time.perf_counter() - run_start > soft_budget
+
+    # host-ingest pipeline variants: per-frame upload (honest camera-path
+    # number — tunnel-RTT-bound when remote-attached) and frames-per-
+    # tensor batched ingest (the converter batches 8/32 frames per
+    # tensor, amortizing the per-transfer cost; reference
+    # gsttensor_converter.c frames_per_tensor)
+    pipeline_h2d_fps = (
+        None if _over_budget() else _pipeline_fps_safe(False, 1, 256, 16)
+    )
+    _mark("pipeline-h2d measured")
+    pipeline_mb8_fps = (
+        None if _over_budget() else _pipeline_fps_safe(False, 8, 1024, 16)
+    )
+    _mark("pipeline-mb8 measured")
+    pipeline_mb32_fps = (
+        None if _over_budget() else _pipeline_fps_safe(False, 32, 2048, 8)
+    )
+    _mark("pipeline-mb32 measured")
 
     # batched-ingest variant: fresh host frames, but 8 per transfer (the
     # converter's frames-per-tensor batching) — one device_put per invoke
@@ -238,39 +316,39 @@ def _run() -> None:
     _mark("h2d-batched8 measured")
 
     # composite face→crop→landmark pipeline (BASELINE config #5) through
-    # the real pipeline executor; on a single chip both stages share the
-    # device, on a slice they pin via custom="device:N"
-    def _composite(n_frames: int) -> float:
+    # the real pipeline executor, with the DEVICE-RESIDENT crop
+    # (tensor_crop out-size=: fixed-size crop+resample in HBM, static
+    # downstream spec — elements/control.py). No host hop at the crop:
+    # regions stay device arrays, the landmark net compiles once and
+    # serves all 16 crop slots as one MXU batch. This is the element
+    # cascade measured against the fused single-program form below —
+    # r2's 860x cliff (1.8 vs 1547 fps) came from host readbacks +
+    # per-shape recompiles; the device crop removes both.
+    def _composite(n_frames: int, device_src: bool) -> float:
         from nnstreamer_tpu.pipeline.parse import parse_pipeline
 
-        # pattern=solid: identical frames → one crop-shape set, so the
-        # invoke-dynamic landmark stage compiles once instead of
-        # retracing per frame (compiles dominate over a tunneled device)
         desc = (
-            f"videotestsrc pattern=solid num-frames={n_frames} "
+            f"videotestsrc pattern=gradient num-frames={n_frames} "
+            f"device={'true' if device_src else 'false'} "
             "width=128 height=128 ! "
             "tensor_converter ! tee name=t "
             "t. ! queue ! tensor_filter framework=jax model=zoo:face_detect "
             'custom="output:regions,threshold:0.0,frame_size:128:128" ! '
             "crop.sink_1 "
             "t. ! queue ! crop.sink_0 "
-            "tensor_crop name=crop ! "
+            "tensor_crop name=crop out-size=112:112 max-crops=16 ! "
             "tensor_filter framework=jax model=zoo:face_landmark "
-            "invoke-dynamic=true input-combination=0 ! fakesink"
+            'custom="batch:16" ! fakesink sync-window=16'
         )
         p = parse_pipeline(desc)
         t = time.perf_counter()
         p.run(timeout=600)
         return n_frames / (time.perf_counter() - t)
 
-    # NOTE: the composite path crosses the host at crop (data-dependent
-    # regions) — on a remote-attached device every frame pays the tunnel
-    # RTT, so keep the frame count small; the number reports the
-    # host-in-the-loop pipeline rate, not pure device throughput.
     composite_fps = None
     if not _over_budget():
-        _composite(2)  # warm: compile detect + landmark executables
-        composite_fps = _composite(16)
+        _composite(2, on_tpu)  # warm: compile detect + crop + landmark
+        composite_fps = _composite(128 if on_tpu else 8, on_tpu)
 
     _mark("composite measured")
     # fused form of the same cascade: detect→crop+resize→landmark as ONE
@@ -413,13 +491,26 @@ def _run() -> None:
             if flops32:
                 mfu32 = mb32_fps * (flops32 / mb32) / (peak * 1e12)
 
+    # BASELINE.md's bar is the PIPELINE number; lead with it when the
+    # pipeline section produced one (raw invoke stays as its own field)
+    if pipeline_fps is not None:
+        metric, value = (
+            "mobilenet_v2_224_pipeline_fps_per_chip", pipeline_fps
+        )
+    else:
+        metric, value = "mobilenet_v2_224_bs1_fps_per_chip", fps
     print(
         json.dumps(
             {
-                "metric": "mobilenet_v2_224_bs1_fps_per_chip",
-                "value": round(fps, 1),
+                "metric": metric,
+                "value": round(value, 1),
                 "unit": "fps",
-                "vs_baseline": round(fps / 1000.0, 3),
+                "vs_baseline": round(value / 1000.0, 3),
+                "pipeline_fps": _round(pipeline_fps),
+                "pipeline_h2d_fps": _round(pipeline_h2d_fps),
+                "pipeline_mb8_fps": _round(pipeline_mb8_fps),
+                "pipeline_mb32_fps": _round(pipeline_mb32_fps),
+                "raw_invoke_bs1_fps": round(fps, 1),
                 "p50_sync_latency_ms": round(p50, 3),
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
                 "h2d_streaming_fps": round(h2d_fps, 1),

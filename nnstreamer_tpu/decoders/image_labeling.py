@@ -49,6 +49,24 @@ class ImageLabelingDecoder:
             rate=in_spec.rate,
         )
 
+    def make_fn(self, in_spec: TensorsSpec, options: dict):
+        """Traceable argmax (tensor_decoder fuses it into the upstream
+        filter's XLA program) — available when no labels file is set;
+        label-string lookup needs the host, so option1 keeps the host
+        path."""
+        if self._labels:
+            return None
+        import jax.numpy as jnp
+
+        def fn(tensors):
+            scores = tensors[0]
+            if scores.ndim == 1:
+                scores = scores[None, :]
+            flat = scores.reshape(scores.shape[0], -1)
+            return (jnp.argmax(flat, axis=-1).astype(jnp.uint32),)
+
+        return fn
+
     def decode(self, frame: Frame, options: dict) -> Frame:
         scores = np.asarray(frame.tensors[0])
         if scores.ndim == 1:

@@ -167,10 +167,16 @@ class TensorOp(Element):
         (e.g. tensor_filter with a host-library backend)."""
         return True
 
-    def host_process(self, frame: Frame) -> Frame:
-        """Host-path execution for non-traceable TensorOps."""
+    def host_process(self, frame: Frame) -> Union[Frame, List[Frame], None]:
+        """Host-path execution for non-traceable TensorOps. May return
+        None (frame absorbed, e.g. a batching element mid-window) or a
+        list (fan-out), mirroring HostElement.process."""
         out = self.make_fn()(frame.tensors)
         return self.transform_meta(frame.with_tensors(out))
+
+    def flush(self) -> List[Frame]:
+        """Called at EOS on the host path; emit any buffered frames."""
+        return []
 
     def transform_meta(self, frame: Frame) -> Frame:
         """Optional per-frame metadata/timestamp adjustment applied outside

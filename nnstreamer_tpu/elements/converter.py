@@ -24,10 +24,10 @@ import numpy as np
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
-    HostElement,
     MediaSpec,
     NegotiationError,
     Spec,
+    TensorOp,
 )
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import DType, TensorFormat, TensorSpec, TensorsSpec
@@ -52,7 +52,13 @@ def unregister_custom_converter(name: str) -> bool:
 
 
 @registry.element("tensor_converter")
-class TensorConverter(HostElement):
+class TensorConverter(TensorOp):
+    """A TensorOp so the hot ingress paths FUSE into the downstream XLA
+    program (the batch-dim reshape happens inside the same compiled
+    segment as the filter — SURVEY §7's device-resident mandate); the
+    stateful/byte-level paths (frames-per-tensor batching, octet framing,
+    subplugins, flexible→static) run as a host node instead."""
+
     FACTORY_NAME = "tensor_converter"
 
     def __init__(self, name=None, **props):
@@ -65,10 +71,12 @@ class TensorConverter(HostElement):
         self._batch_pts = None
         self._subplugin = None
         self._custom_fn = None
+        self._traceable_fn = None
 
     # -- negotiation -------------------------------------------------------
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs
+        self._traceable_fn = None
         if self.mode and self.mode.startswith("custom-code"):
             _, _, name = self.mode.partition(":")
             with _custom_lock:
@@ -103,6 +111,10 @@ class TensorConverter(HostElement):
                     (self.frames_per_tensor, spec.height, spec.width, c), DType.UINT8
                 )
                 rate = spec.rate / self.frames_per_tensor if spec.rate else None
+                if self.frames_per_tensor == 1:
+                    # HWC → NHWC is one reshape: fuse it into the
+                    # downstream XLA program (no host copy, no queue hop)
+                    self._traceable_fn = lambda tensors: (tensors[0][None, ...],)
                 return [TensorsSpec.of(out, rate=rate)]
             if spec.media_type == "audio":
                 if spec.channels is None:
@@ -132,11 +144,19 @@ class TensorConverter(HostElement):
                         f"{self.name}: flexible→static needs input-dim="
                     )
                 return [TensorsSpec.from_strings(self.input_dims, self.input_types)]
+            self._traceable_fn = lambda tensors: tensors
             return [spec]  # static passthrough
         raise NegotiationError(f"{self.name}: cannot convert {spec!r}")
 
-    # -- streaming ---------------------------------------------------------
-    def process(self, frame: Frame) -> Union[Frame, List[Frame], None]:
+    # -- execution classification -------------------------------------------
+    def is_traceable(self) -> bool:
+        return self._traceable_fn is not None
+
+    def make_fn(self):
+        return self._traceable_fn
+
+    # -- streaming (host path: batching/subplugins/byte framing) -----------
+    def host_process(self, frame: Frame) -> Union[Frame, List[Frame], None]:
         if self._custom_fn is not None:
             return self._custom_fn(frame, dict(self.props))
         if self._subplugin is not None:

@@ -18,7 +18,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import HostElement, NegotiationError, Spec
+from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
 
@@ -38,7 +38,15 @@ def unregister_custom_decoder(name: str) -> bool:
 
 
 @registry.element("tensor_decoder")
-class TensorDecoder(HostElement):
+class TensorDecoder(TensorOp):
+    """A TensorOp so device-computable decodes (e.g. image_labeling's
+    argmax) FUSE into the upstream filter's XLA program — the egress
+    payload shrinks to the decoded result ([1] uint32 instead of [1, V]
+    logits) before it ever leaves the device, and the pipeline never
+    blocks per frame on a host readback. Subplugins opt in by exposing
+    ``make_fn(in_spec, options) -> traceable fn | None``; everything else
+    (host rasterization, label lookup, byte codecs) runs as a host node."""
+
     FACTORY_NAME = "tensor_decoder"
 
     def __init__(self, name=None, **props):
@@ -51,9 +59,11 @@ class TensorDecoder(HostElement):
         }
         self._sub = None
         self._custom_fn = None
+        self._traceable_fn = None
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs
+        self._traceable_fn = None
         if not isinstance(spec, TensorsSpec):
             raise NegotiationError(f"{self.name}: needs tensor input, got {spec}")
         if self.mode == "custom-code":
@@ -68,9 +78,19 @@ class TensorDecoder(HostElement):
             return [spec]  # custom decoders declare no static out spec
         sub = registry.get(registry.KIND_DECODER, self.mode)
         self._sub = sub() if isinstance(sub, type) else sub
-        return [self._sub.negotiate(spec, self.options)]
+        out = [self._sub.negotiate(spec, self.options)]
+        mk = getattr(self._sub, "make_fn", None)
+        if mk is not None:
+            self._traceable_fn = mk(spec, self.options)
+        return out
 
-    def process(self, frame: Frame):
+    def is_traceable(self) -> bool:
+        return self._traceable_fn is not None
+
+    def make_fn(self):
+        return self._traceable_fn
+
+    def host_process(self, frame: Frame):
         if self._custom_fn is not None:
             return self._custom_fn(frame, self.options)
         return self._sub.decode(frame, self.options)

@@ -45,6 +45,7 @@ class SyncCombiner:
         self.n = n_pads
         self.queues: List[Deque[Frame]] = [deque() for _ in range(n_pads)]
         self.last: List[Optional[Frame]] = [None] * n_pads
+        self.eos: List[bool] = [False] * n_pads
         self.base_pad = 0
         self.base_slack = 0
         if mode == "basepad" and option:
@@ -60,24 +61,59 @@ class SyncCombiner:
     def push(self, pad: int, frame: Frame) -> List[List[Frame]]:
         """Feed one frame; return list of combined frame-groups ready."""
         self.queues[pad].append(frame)
-        self.last[pad] = frame
+        if self.mode != "refresh":
+            self.last[pad] = frame
         out = []
         while True:
             group = self._try_combine(pad)
             if group is None:
                 break
             out.append(group)
-            if self.mode == "refresh":
-                break  # refresh emits once per incoming frame
         return out
+
+    def mark_eos(self, pad: int) -> List[List[Frame]]:
+        """A pad reached EOS; release any groups it was gating."""
+        self.eos[pad] = True
+        out = []
+        while True:
+            group = self._try_combine(pad)
+            if group is None:
+                return out
+            out.append(group)
+
+    def _refresh_combine(self) -> Optional[List[Frame]]:
+        """Deterministic PTS-merged refresh: pads' timelines merge in pts
+        order and one group emits per distinct instant, each pad
+        contributing its newest frame at-or-before that instant. The
+        gate (every pad queued or EOS) mirrors the reference's
+        GstCollectPads discipline — tensor_mux's collected callback only
+        fires once all pads have data — and makes the policy independent
+        of thread arrival order (the executor's streaming threads race;
+        a golden test must not)."""
+        if any(not self.queues[i] and not self.eos[i] for i in range(self.n)):
+            return None
+        while True:
+            heads = [
+                (-1 if q[0].pts is None else q[0].pts, i)
+                for i, q in enumerate(self.queues)
+                if q
+            ]
+            if not heads:
+                return None
+            t = min(h[0] for h in heads)
+            for pts, i in heads:
+                if pts == t:
+                    self.last[i] = self.queues[i].popleft()
+            if all(l is not None for l in self.last):
+                return list(self.last)
+            # priming: frames before every pad has delivered produce no
+            # output — keep merging
+            if any(not self.queues[i] and not self.eos[i] for i in range(self.n)):
+                return None
 
     def _try_combine(self, trigger_pad: int) -> Optional[List[Frame]]:
         if self.mode == "refresh":
-            if any(l is None for l in self.last):
-                return None
-            group = [self.queues[i].popleft() if self.queues[i] else self.last[i]
-                     for i in range(self.n)]
-            return group
+            return self._refresh_combine()
         if any(not q for q in self.queues):
             return None
         if self.mode == "nosync":
@@ -166,9 +202,9 @@ class TensorMux(Routing):
         rate = _combined_rate(self.sync_mode, self._comb.base_pad, in_specs)
         return [TensorsSpec(tuple(tensors), rate=rate)]
 
-    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+    def _frames(self, groups) -> List[Tuple[int, Frame]]:
         out = []
-        for group in self._comb.push(pad, frame):
+        for group in groups:
             tensors = tuple(t for f in group for t in f.tensors)
             pts, dur = _combined_pts(group)
             meta = {}
@@ -176,6 +212,13 @@ class TensorMux(Routing):
                 meta.update(f.meta)
             out.append((0, Frame(tensors, pts=pts, duration=dur, meta=meta)))
         return out
+
+    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+        return self._frames(self._comb.push(pad, frame))
+
+    def eos(self, pad: int) -> List[Tuple[int, Frame]]:
+        # refresh groups gated on this pad having data release at its EOS
+        return self._frames(self._comb.mark_eos(pad))
 
 
 @registry.element("tensor_merge")
@@ -226,15 +269,21 @@ class TensorMerge(Routing):
         rate = _combined_rate(self.sync_mode, self._comb.base_pad, in_specs)
         return [TensorsSpec.of(TensorSpec(tuple(base), specs[0].dtype), rate=rate)]
 
-    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+    def _frames(self, groups) -> List[Tuple[int, Frame]]:
         import jax.numpy as jnp
 
         out = []
-        for group in self._comb.push(pad, frame):
+        for group in groups:
             merged = jnp.concatenate([f.tensors[0] for f in group], axis=self._axis)
             pts, dur = _combined_pts(group)
             out.append((0, Frame((merged,), pts=pts, duration=dur)))
         return out
+
+    def receive(self, pad: int, frame: Frame) -> List[Tuple[int, Frame]]:
+        return self._frames(self._comb.push(pad, frame))
+
+    def eos(self, pad: int) -> List[Tuple[int, Frame]]:
+        return self._frames(self._comb.mark_eos(pad))
 
 
 @registry.element("tensor_demux")

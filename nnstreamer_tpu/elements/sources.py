@@ -52,8 +52,20 @@ class VideoTestSrc(Source):
         self.pattern = str(self.get_property("pattern", "gradient"))
         self.rate = Fraction(str(self.get_property("framerate", "30/1")))
         self.seed = int(self.get_property("seed", 0))
+        # device=true: frames are born device-resident (pattern math runs
+        # as one tiny async device op per frame), so a fused downstream
+        # segment never pays a host→device copy — the TPU-native answer
+        # to "the test source must not be the bottleneck at 1000 fps".
+        # `random` keeps host generation (+ upload) — rng streams are a
+        # host concept here.
+        from nnstreamer_tpu.elements.base import _parse_bool
+
+        self.device = _parse_bool(self.get_property("device", False))
         self._i = 0
         self._rng = np.random.default_rng(self.seed)
+        self._base = None      # host pattern base (uint8, wraps mod 256)
+        self._dev_base = None  # device-resident base / cached solid frame
+        self._dev_fn = None
 
     def output_spec(self) -> Spec:
         return MediaSpec(
@@ -67,25 +79,65 @@ class VideoTestSrc(Source):
     def start(self) -> None:
         self._i = 0
         self._rng = np.random.default_rng(self.seed)
+        c = MediaSpec("video", format=self.format).channels_per_pixel
+        h, w = self.height, self.width
+        if self.pattern in ("smpte", "gradient"):
+            # uint8 addition wraps mod 256, so (base + i) reproduces the
+            # per-frame shifted gradient with ONE vectorized add instead
+            # of a meshgrid rebuild per frame
+            yy, xx = np.meshgrid(
+                np.arange(h, dtype=np.uint16),
+                np.arange(w, dtype=np.uint16),
+                indexing="ij",
+            )
+            base = (xx + yy)[..., None] + np.arange(c, dtype=np.uint16) * 37
+            self._base = (base % 256).astype(np.uint8)
+        elif self.pattern == "solid":
+            color = int(self.get_property("foreground-color", 128))
+            self._base = np.full((h, w, c), color, np.uint8)
+        elif self.pattern in ("counter", "random"):
+            self._base = None
+        else:
+            raise ValueError(f"unknown pattern {self.pattern!r}")
+        if self.device:
+            import jax
+            import jax.numpy as jnp
+
+            if self._base is not None:
+                self._dev_base = jnp.asarray(self._base)
+            if self.pattern in ("smpte", "gradient"):
+                self._dev_fn = jax.jit(lambda b, s: b + s)
+            elif self.pattern == "counter":
+                self._dev_fn = jax.jit(
+                    lambda s: jnp.full((h, w, c), s, jnp.uint8)
+                )
 
     def generate(self):
         if 0 <= self.num_frames <= self._i:
             return EOS_FRAME
         c = MediaSpec("video", format=self.format).channels_per_pixel
         h, w = self.height, self.width
+        shift = np.uint8(self._i % 256)
         if self.pattern in ("smpte", "gradient"):
-            yy, xx = np.meshgrid(
-                np.arange(h, dtype=np.uint16), np.arange(w, dtype=np.uint16), indexing="ij"
+            img = (
+                self._dev_fn(self._dev_base, shift)
+                if self.device
+                else self._base + shift
             )
-            base = (xx + yy + self._i)[..., None] + np.arange(c, dtype=np.uint16) * 37
-            img = (base % 256).astype(np.uint8)
         elif self.pattern == "solid":
-            color = int(self.get_property("foreground-color", 128))
-            img = np.full((h, w, c), color, np.uint8)
+            img = self._dev_base if self.device else self._base
         elif self.pattern == "random":
             img = self._rng.integers(0, 256, (h, w, c), dtype=np.uint8)
+            if self.device:
+                import jax.numpy as jnp
+
+                img = jnp.asarray(img)
         elif self.pattern == "counter":
-            img = np.full((h, w, c), self._i % 256, np.uint8)
+            img = (
+                self._dev_fn(shift)
+                if self.device
+                else np.full((h, w, c), self._i % 256, np.uint8)
+            )
         else:
             raise ValueError(f"unknown pattern {self.pattern!r}")
         pts, dur = _frame_pts(self._i, self.rate)

@@ -184,6 +184,8 @@ class TensorOpHostNode(Node):
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
+                for f in self.elem.flush():
+                    self.push_out(0, f)
                 break
             if self.elem.qos_would_drop(item):
                 for q in self.elem.qos_sources:
@@ -192,7 +194,10 @@ class TensorOpHostNode(Node):
             t0 = time.perf_counter()
             out = self.elem.host_process(item)
             self.stat(t0)
-            self.push_out(0, out)
+            if out is None:  # absorbed (e.g. batching mid-window)
+                continue
+            for f in out if isinstance(out, list) else [out]:
+                self.push_out(0, f)
         self.broadcast_eos()
 
 
@@ -273,6 +278,22 @@ class SinkNode(Node):
     def __init__(self, ex, elem: Sink) -> None:
         super().__init__(ex, elem.name)
         self.elem = elem
+        # wall-clock of the first/last completed render burst + frames
+        # rendered: lets callers compute steady-state pipeline FPS with
+        # the compile/warmup window excluded ((n_after_first)/(t_last -
+        # t_first) — bench.py pipeline metrics)
+        self.t_first_render: Optional[float] = None
+        self.t_last_render: Optional[float] = None
+        self.frames_rendered = 0
+        self.first_burst_n = 0
+
+    def _mark_render(self, n: int) -> None:
+        now = time.perf_counter()
+        if self.t_first_render is None:
+            self.t_first_render = now
+            self.first_burst_n = n
+        self.t_last_render = now
+        self.frames_rendered += n
 
     def run(self) -> None:
         window = getattr(self.elem, "sync_window", 1)
@@ -304,10 +325,12 @@ class SinkNode(Node):
                 newest_per_device[_dev_key(f)] = f
             for f in newest_per_device.values():
                 f.block_until_ready()
+            n = len(pending)
             for f in pending:
                 f.mark_synced()
                 self.elem.render(f)
             pending.clear()
+            self._mark_render(n)
 
         while True:
             item = self.pop(0)
@@ -323,6 +346,7 @@ class SinkNode(Node):
                     flush()
             else:
                 self.elem.render(item)
+                self._mark_render(1)
             self.stat(t0)
         self.ex.sink_done(self)
 

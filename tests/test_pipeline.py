@@ -136,8 +136,9 @@ class TestFilterInPipeline:
         sink = TensorSink()
         p = Pipeline().chain(src, conv, tr, filt, sink)
         plan = p.compile_plan()
-        # transform + filter fuse into one segment
-        assert any(len(seg.ops) == 2 for seg in plan.segments)
+        # converter + transform + filter fuse into ONE segment (the
+        # converter's HWC→NHWC reshape is traceable since r3)
+        assert any(len(seg.ops) == 3 for seg in plan.segments)
         p.run(timeout=60)
         assert sink.rendered == 4
 
@@ -365,3 +366,62 @@ class TestDevicePlacement:
         with pytest.raises(Exception, match="out of range"):
             SingleShot(framework="jax", model="zoo:add",
                        custom="dims:4,device:99").open()
+
+
+class TestDeviceResidentPath:
+    """r3: device-born sources and device-computed decodes — the
+    zero-host-copy pipeline spine behind the pipeline_fps bench."""
+
+    @pytest.mark.parametrize("pattern", ["gradient", "counter", "solid"])
+    def test_videotestsrc_device_matches_host(self, pattern):
+        """device=true frames are byte-identical to the host pattern
+        (golden tests stay valid whichever side generates)."""
+        kw = {"num-frames": 3, "width": 8, "height": 6, "pattern": pattern}
+        host = VideoTestSrc(**kw)
+        dev = VideoTestSrc(device=True, **kw)
+        host.start()
+        dev.start()
+        for _ in range(3):
+            a, b = host.generate(), dev.generate()
+            np.testing.assert_array_equal(
+                np.asarray(a.tensors[0]), np.asarray(b.tensors[0])
+            )
+
+    def test_decoder_fuses_into_filter_segment(self):
+        """tensor_decoder mode=image_labeling (no labels file) is
+        traceable: conv+filter+decoder compile to ONE segment, and the
+        fused argmax matches the host decode path."""
+        from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+        def build(device):
+            src = VideoTestSrc(
+                width=16, height=16, device=device, **{"num-frames": 4}
+            )
+            conv = TensorConverter()
+            tr = TensorTransform(mode="typecast", option="float32")
+            filt = TensorFilter(framework="scaler", custom="factor:0.5")
+            dec = TensorDecoder(mode="image_labeling")
+            sink = TensorSink()
+            p = Pipeline().chain(src, conv, tr, filt, dec, sink)
+            return p, sink
+
+        p, sink = build(device=True)
+        plan = p.compile_plan()
+        assert any(len(seg.ops) == 4 for seg in plan.segments)
+        p.run(timeout=60)
+        fused_out = [np.asarray(f.tensors[0]) for f in sink.frames]
+
+        # host reference: same logits through the subplugin's decode()
+        p2, sink2 = build(device=False)
+        dec2 = p2["tensor_decoder1"] if "tensor_decoder1" in getattr(
+            p2, "_by_name", {}
+        ) else next(
+            e for e in p2.elements if e.FACTORY_NAME == "tensor_decoder"
+        )
+        dec2._traceable_fn = None  # force the host path
+        p2.run(timeout=60)
+        host_out = [np.asarray(f.tensors[0]) for f in sink2.frames]
+        assert len(fused_out) == len(host_out) == 4
+        for a, b in zip(fused_out, host_out):
+            assert a.dtype == np.uint32
+            np.testing.assert_array_equal(a, b)

@@ -66,14 +66,27 @@ class TestMux:
         assert [fr.pts for fr in groups[0]] == [100_000_000, 50_000_000]
 
     def test_refresh_policy(self):
+        """Deterministic PTS-merged refresh (r3): groups emit per distinct
+        timeline instant once every pad is queued or EOS (the reference's
+        GstCollectPads gate) — output no longer depends on which streaming
+        thread happened to arrive first."""
         comb = SyncCombiner("refresh", "", 2)
         f = lambda pts: Frame((np.zeros(1),), pts=pts)
         assert comb.push(0, f(0)) == []
         g = comb.push(1, f(0))
         assert len(g) == 1
-        # new frame on pad1 only → reuses last of pad0
-        g = comb.push(1, f(10))
+        assert [fr.pts for fr in g[0]] == [0, 0]
+        # new frame on pad1 only: gated until pad0 is queued or EOS (we
+        # cannot yet know pad0 won't deliver an earlier instant)
+        assert comb.push(1, f(10)) == []
+        # pad0 delivers pts 5 < 10 → instant 5 emits with pad1's stale 0
+        g = comb.push(0, f(5))
         assert len(g) == 1
+        assert [fr.pts for fr in g[0]] == [5, 0]
+        # pad0 EOS releases the gated instant 10 (pad0 reuses its last)
+        g = comb.mark_eos(0)
+        assert len(g) == 1
+        assert [fr.pts for fr in g[0]] == [5, 10]
 
     def test_mux_in_description(self):
         p = parse_pipeline(
