@@ -60,3 +60,82 @@ def test_composite_device_pinning_matches_unpinned():
     unpinned = _run_composite(det_dev="", lmk_dev="")
     for x, y in zip(pinned, unpinned):
         np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+
+
+# ---- device-resident crop (out-size=) — the r3 cascade-cliff fix ----
+
+DEVICE_COMPOSITE = (
+    "videotestsrc pattern=gradient num-frames={n} width=128 height=128 ! "
+    "tensor_converter ! tee name=t "
+    "t. ! queue ! tensor_filter framework=jax model=zoo:face_detect "
+    'custom="output:regions,threshold:0.0,frame_size:128:128" ! '
+    "crop.sink_1 "
+    "t. ! queue ! crop.sink_0 "
+    "tensor_crop name=crop out-size=112:112 max-crops=16 ! "
+    "tensor_filter framework=jax model=zoo:face_landmark "
+    'custom="batch:16" ! tensor_sink name=out'
+)
+
+
+def test_device_crop_static_cascade():
+    """out-size= crop: static [16,112,112,3] spec, landmark runs all
+    crops as one batch, outputs finite landmarks per crop slot."""
+    p = parse_pipeline(DEVICE_COMPOSITE.format(n=3))
+    p.run(timeout=240)
+    sink = next(e for e in p.elements if isinstance(e, TensorSink))
+    assert len(sink.frames) == 3
+    for f in sink.frames:
+        lm = np.asarray(f.tensors[0])
+        assert lm.shape == (16, 136)
+        assert np.all(np.isfinite(lm))
+
+
+def test_device_crop_no_host_readback():
+    """The device crop path must keep everything in device buffers: with
+    a device-born source and a discarding sink, the whole cascade runs
+    under a device->host transfer guard — any per-frame readback (the r2
+    cliff's cause) raises."""
+    import jax
+
+    desc = DEVICE_COMPOSITE.format(n=2).replace(
+        "videotestsrc ", "videotestsrc device=true "
+    ).replace("tensor_sink name=out", "fakesink")
+    with jax.transfer_guard_device_to_host("disallow"):
+        p = parse_pipeline(desc)
+        p.run(timeout=240)
+
+
+def test_device_crop_matches_ops_reference():
+    """The element cascade (detect -> device crop -> landmark through the
+    executor) computes exactly what the underlying ops compute when
+    invoked directly — the pipeline adds plumbing, not numerics."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.elements.sources import VideoTestSrc
+    from nnstreamer_tpu.models import zoo
+    from nnstreamer_tpu.ops.image import crop_and_resize
+
+    p = parse_pipeline(DEVICE_COMPOSITE.format(n=1))
+    p.run(timeout=240)
+    sink = next(e for e in p.elements if isinstance(e, TensorSink))
+    elem_lm = np.asarray(sink.frames[0].tensors[0])  # [16, 136]
+
+    src = VideoTestSrc(width=128, height=128, **{"num-frames": 1})
+    src.start()
+    img = np.asarray(src.generate().tensors[0])[None]
+    det = zoo.get(
+        "face_detect", output="regions", threshold="0.0",
+        frame_size="128:128",
+    )
+    regions = jax.jit(det.fn)(jnp.asarray(img)).astype(jnp.float32)
+    xyxy = jnp.concatenate(
+        [regions[:, :2], regions[:, :2] + regions[:, 2:4]], axis=-1
+    )
+    crops = crop_and_resize(jnp.asarray(img[0], jnp.float32), xyxy, 112, 112)
+    crops_u8 = jnp.clip(jnp.round(crops), 0, 255).astype(jnp.uint8)
+    lmk = zoo.get("face_landmark", batch="16")
+    want = np.asarray(jax.jit(lmk.fn)(crops_u8))
+    # separately-jitted programs may fuse float math differently; the
+    # tolerance covers compiler reassociation, nothing else
+    np.testing.assert_allclose(elem_lm, want, atol=1e-4)
