@@ -1,13 +1,19 @@
 """gRPC tensor bridge: tensor_src_grpc / tensor_sink_grpc elements.
 
 Reference: ext/nnstreamer/extra/nnstreamer_grpc_*.cc (NNStreamerRPC class,
-nnstreamer_grpc_common.h:32-83) + tensor_src_grpc.c / tensor_sink_grpc.c —
-each element runs as gRPC *server or client* per property, streaming
-``Tensors`` messages (protobuf IDL; the reference also offers flatbuf —
-here protobuf only, the wire-compatible schema in proto/nns_tensors.proto).
+nnstreamer_grpc_common.h:32-83, protobuf AND flatbuf IDL variants in
+nnstreamer_grpc_protobuf.cc / nnstreamer_grpc_flatbuf.cc) +
+tensor_src_grpc.c / tensor_sink_grpc.c — each element runs as gRPC
+*server or client* per property, streaming tensor messages. Both IDLs are
+offered here too (``idl=protobuf`` default, ``idl=flatbuf``): protobuf
+rides the wire-compatible schema in proto/nns_tensors.proto; flatbuf
+reuses the converters/flatbuf.py codec (nnstreamer.fbs schema) with the
+flatbuffer bytes streamed verbatim. The two IDLs register distinct
+service names (as the reference does), so a mismatched pair fails loudly
+instead of mis-parsing.
 
 No generated stubs are needed: the service is registered with
-``grpc.method_handlers_generic_handler`` using the pb2 message serializers
+``grpc.method_handlers_generic_handler`` using the IDL's serializers
 (grpcio-tools is not in the image — same codegen-free approach as the
 flatbuf codec).
 """
@@ -32,9 +38,6 @@ from nnstreamer_tpu.elements.base import (
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
 from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
 
-SERVICE = "nnstreamer_tpu.proto.TensorService"
-
-
 def _require_grpc():
     try:
         import grpc  # noqa: F401
@@ -47,32 +50,98 @@ def _require_grpc():
         ) from exc
 
 
-def _pb():
-    from nnstreamer_tpu.proto import nns_tensors_pb2 as pb
+class _ProtobufIdl:
+    """Wire = proto/nns_tensors.proto messages (reference
+    nnstreamer_grpc_protobuf.cc slot)."""
 
-    return pb
+    name = "protobuf"
+    service = "nnstreamer_tpu.proto.TensorService"
+
+    def __init__(self):
+        from nnstreamer_tpu.proto import nns_tensors_pb2 as pb
+
+        self._pb = pb
+        self.tensors_ser = pb.Tensors.SerializeToString
+        self.tensors_des = pb.Tensors.FromString
+        self.empty_ser = pb.Empty.SerializeToString
+        self.empty_des = pb.Empty.FromString
+
+    def empty(self):
+        return self._pb.Empty()
+
+    def frame_to_wire(self, frame: Frame):
+        return frame_to_message(frame.to_host())
+
+    def wire_to_frame(self, msg) -> Frame:
+        return Frame(message_to_tensors(msg))
 
 
-def _service_handler(grpc, pb, send_handler=None, recv_handler=None):
-    """Build the generic service handler with pb serializers."""
+class _FlatbufIdl:
+    """Wire = flatbuffer-serialized Tensors (converters/flatbuf.py codec,
+    nnstreamer.fbs schema — reference nnstreamer_grpc_flatbuf.cc slot).
+    The buffer bytes stream verbatim; Empty is the empty byte string."""
+
+    name = "flatbuf"
+    service = "nnstreamer_tpu.flatbuf.TensorService"
+
+    def __init__(self):
+        import flatbuffers  # noqa: F401 — gate like the reference meson option
+
+        ident = lambda b: b  # noqa: E731
+        self.tensors_ser = ident
+        self.tensors_des = ident
+        self.empty_ser = lambda _b: b""
+        self.empty_des = lambda _b: b""
+
+    def empty(self):
+        return b""
+
+    def frame_to_wire(self, frame: Frame):
+        from nnstreamer_tpu.converters.flatbuf import encode_flatbuf
+
+        import numpy as np
+
+        return encode_flatbuf(
+            [np.asarray(t) for t in frame.to_host().tensors]
+        )
+
+    def wire_to_frame(self, data) -> Frame:
+        from nnstreamer_tpu.converters.flatbuf import decode_flatbuf
+
+        tensors, _rate = decode_flatbuf(data)
+        return Frame(tuple(tensors))
+
+
+_IDLS = {"protobuf": _ProtobufIdl, "flatbuf": _FlatbufIdl}
+
+
+def _make_idl(name: str):
+    try:
+        return _IDLS[name]()
+    except KeyError:
+        raise ElementError(
+            f"unknown idl {name!r} (choose protobuf or flatbuf)"
+        ) from None
+    except ImportError as exc:
+        raise ElementError(f"idl {name!r} unavailable: {exc}") from exc
+
+
+def _service_handler(grpc, idl, send_handler=None, recv_handler=None):
+    """Build the generic service handler with the IDL's serializers."""
     handlers = {}
     if send_handler is not None:  # client streams Tensors at us
         handlers["SendTensors"] = grpc.stream_unary_rpc_method_handler(
             send_handler,
-            request_deserializer=pb.Tensors.FromString,
-            response_serializer=pb.Empty.SerializeToString,
+            request_deserializer=idl.tensors_des,
+            response_serializer=idl.empty_ser,
         )
     if recv_handler is not None:  # we stream Tensors to the client
         handlers["RecvTensors"] = grpc.unary_stream_rpc_method_handler(
             recv_handler,
-            request_deserializer=pb.Empty.FromString,
-            response_serializer=pb.Tensors.SerializeToString,
+            request_deserializer=idl.empty_des,
+            response_serializer=idl.tensors_ser,
         )
-    return grpc.method_handlers_generic_handler(SERVICE, handlers)
-
-
-def _frame_from_msg(msg) -> Frame:
-    return Frame(message_to_tensors(msg))
+    return grpc.method_handlers_generic_handler(idl.service, handlers)
 
 
 def _bounded_put(q: "queue_mod.Queue", item, should_abort) -> bool:
@@ -109,6 +178,7 @@ class GrpcTensorSrc(Source):
         self.is_server = _parse_bool(self.get_property("server", True))
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", 0))
+        self.idl_name = str(self.get_property("idl", "protobuf"))
         self.bound_port: Optional[int] = None
         self._queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=64)
         self._server = None
@@ -121,7 +191,7 @@ class GrpcTensorSrc(Source):
         return TensorsSpec(format=TensorFormat.FLEXIBLE)
 
     # -- server mode: clients push streams at us ---------------------------
-    def _start_server(self, grpc, pb) -> None:
+    def _start_server(self, grpc, idl) -> None:
         src = self
 
         def send_tensors(request_iterator, context):
@@ -131,12 +201,14 @@ class GrpcTensorSrc(Source):
             for msg in request_iterator:
                 if src._stopped.is_set():
                     break
-                _put_unless_stopped(src._queue, _frame_from_msg(msg), src._stopped)
-            return pb.Empty()
+                _put_unless_stopped(
+                    src._queue, idl.wire_to_frame(msg), src._stopped
+                )
+            return idl.empty()
 
         self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
         self._server.add_generic_rpc_handlers(
-            (_service_handler(grpc, pb, send_handler=send_tensors),)
+            (_service_handler(grpc, idl, send_handler=send_tensors),)
         )
         self.bound_port = self._server.add_insecure_port(
             f"{self.host}:{self.port}"
@@ -146,7 +218,7 @@ class GrpcTensorSrc(Source):
         self._server.start()
 
     # -- client mode: we pull a stream from a remote sink ------------------
-    def _start_client(self, grpc, pb) -> None:
+    def _start_client(self, grpc, idl) -> None:
         self._channel = grpc.insecure_channel(f"{self.host}:{self.port}")
         try:  # fail fast on unreachable server, like EdgeSrc.start
             grpc.channel_ready_future(self._channel).result(
@@ -160,17 +232,19 @@ class GrpcTensorSrc(Source):
                 f"{self.host}:{self.port}"
             ) from exc
         call = self._channel.unary_stream(
-            f"/{SERVICE}/RecvTensors",
-            request_serializer=pb.Empty.SerializeToString,
-            response_deserializer=pb.Tensors.FromString,
+            f"/{idl.service}/RecvTensors",
+            request_serializer=idl.empty_ser,
+            response_deserializer=idl.tensors_des,
         )
 
         def pull():
             try:
-                for msg in call(pb.Empty()):
+                for msg in call(idl.empty()):
                     if self._stopped.is_set():
                         break
-                    _put_unless_stopped(self._queue, _frame_from_msg(msg), self._stopped)
+                    _put_unless_stopped(
+                        self._queue, idl.wire_to_frame(msg), self._stopped
+                    )
             except grpc.RpcError as exc:
                 if not self._stopped.is_set():
                     self._error = f"stream broke: {exc.code()}"
@@ -181,12 +255,12 @@ class GrpcTensorSrc(Source):
 
     def start(self) -> None:
         grpc = _require_grpc()
-        pb = _pb()
+        idl = _make_idl(self.idl_name)
         self._stopped.clear()
         if self.is_server:
-            self._start_server(grpc, pb)
+            self._start_server(grpc, idl)
         else:
-            self._start_client(grpc, pb)
+            self._start_client(grpc, idl)
 
     def stop(self) -> None:
         self._stopped.set()
@@ -221,7 +295,9 @@ class GrpcTensorSink(Sink):
         self.is_server = _parse_bool(self.get_property("server", True))
         self.host = str(self.get_property("host", "127.0.0.1"))
         self.port = int(self.get_property("port", 0))
+        self.idl_name = str(self.get_property("idl", "protobuf"))
         self.bound_port: Optional[int] = None
+        self._idl = None
         self._server = None
         self._channel = None
         self._push_queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=64)
@@ -240,7 +316,7 @@ class GrpcTensorSink(Sink):
         return self._stopping.is_set() or (done is not None and done.is_set())
 
     # -- server mode: subscribers pull a stream ----------------------------
-    def _start_server(self, grpc, pb) -> None:
+    def _start_server(self, grpc, idl) -> None:
         sink = self
 
         def recv_tensors(request, context):
@@ -260,7 +336,7 @@ class GrpcTensorSink(Sink):
 
         self._server = grpc.server(ThreadPoolExecutor(max_workers=4))
         self._server.add_generic_rpc_handlers(
-            (_service_handler(grpc, pb, recv_handler=recv_tensors),)
+            (_service_handler(grpc, idl, recv_handler=recv_tensors),)
         )
         self.bound_port = self._server.add_insecure_port(
             f"{self.host}:{self.port}"
@@ -270,7 +346,7 @@ class GrpcTensorSink(Sink):
         self._server.start()
 
     # -- client mode: we push a stream to a remote src ---------------------
-    def _start_client(self, grpc, pb) -> None:
+    def _start_client(self, grpc, idl) -> None:
         self._channel = grpc.insecure_channel(f"{self.host}:{self.port}")
         try:  # fail fast on unreachable server, like GrpcTensorSrc
             grpc.channel_ready_future(self._channel).result(
@@ -284,9 +360,9 @@ class GrpcTensorSink(Sink):
                 f"{self.host}:{self.port}"
             ) from exc
         call = self._channel.stream_unary(
-            f"/{SERVICE}/SendTensors",
-            request_serializer=pb.Tensors.SerializeToString,
-            response_deserializer=pb.Empty.FromString,
+            f"/{idl.service}/SendTensors",
+            request_serializer=idl.tensors_ser,
+            response_deserializer=idl.empty_des,
         )
 
         def feed():
@@ -309,11 +385,11 @@ class GrpcTensorSink(Sink):
 
     def start(self) -> None:
         grpc = _require_grpc()
-        pb = _pb()
+        self._idl = _make_idl(self.idl_name)
         if self.is_server:
-            self._start_server(grpc, pb)
+            self._start_server(grpc, self._idl)
         else:
-            self._start_client(grpc, pb)
+            self._start_client(grpc, self._idl)
 
     def stop(self) -> None:
         self._stopping.set()
@@ -328,7 +404,7 @@ class GrpcTensorSink(Sink):
             self._channel = None
 
     def render(self, frame: Frame) -> None:
-        msg = frame_to_message(frame.to_host())
+        msg = self._idl.frame_to_wire(frame)
         if self.is_server:
             with self._sub_lock:
                 subs = list(self._subscribers)

@@ -266,14 +266,16 @@ def test_edge_pubsub_pipeline():
 
 
 # ------------------------------------------------------------------ gRPC
-def test_grpc_push_pull():
-    """Client-mode sink pushes into a server-mode src (SendTensors path)."""
+@pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+def test_grpc_push_pull(idl):
+    """Client-mode sink pushes into a server-mode src (SendTensors path),
+    over both IDLs (reference nnstreamer_grpc_{protobuf,flatbuf}.cc)."""
     pytest.importorskip("grpc")
     from nnstreamer_tpu.edge.grpc_bridge import GrpcTensorSink, GrpcTensorSrc
 
-    src = GrpcTensorSrc("gsrc", server="true", port=0)
+    src = GrpcTensorSrc("gsrc", server="true", port=0, idl=idl)
     src.start()
-    sink = GrpcTensorSink("gsink", server="false", port=src.bound_port)
+    sink = GrpcTensorSink("gsink", server="false", port=src.bound_port, idl=idl)
     sink.start()
     try:
         sink.render(Frame((np.arange(6, dtype=np.float32).reshape(2, 3),)))
@@ -291,14 +293,16 @@ def test_grpc_push_pull():
         src.stop()
 
 
-def test_grpc_serve_stream():
-    """Server-mode sink streams to a client-mode src (RecvTensors path)."""
+@pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+def test_grpc_serve_stream(idl):
+    """Server-mode sink streams to a client-mode src (RecvTensors path),
+    over both IDLs."""
     pytest.importorskip("grpc")
     from nnstreamer_tpu.edge.grpc_bridge import GrpcTensorSink, GrpcTensorSrc
 
-    sink = GrpcTensorSink("gsink2", server="true", port=0)
+    sink = GrpcTensorSink("gsink2", server="true", port=0, idl=idl)
     sink.start()
-    src = GrpcTensorSrc("gsrc2", server="false", port=sink.bound_port)
+    src = GrpcTensorSrc("gsrc2", server="false", port=sink.bound_port, idl=idl)
     src.start()
     try:
         # wait for the subscriber's RecvTensors stream to attach
@@ -344,6 +348,40 @@ def test_broadcast_survives_dead_subscriber(impl):
     finally:
         alive.close()
         server.close()
+
+
+def test_grpc_idl_mismatch_fails_loudly():
+    """A protobuf client against a flatbuf server must error (distinct
+    service names), not silently mis-parse — reference behavior."""
+    pytest.importorskip("grpc")
+    from nnstreamer_tpu.edge.grpc_bridge import GrpcTensorSink, GrpcTensorSrc
+    from nnstreamer_tpu.elements.base import ElementError
+
+    src = GrpcTensorSrc("gsrc3", server="true", port=0, idl="flatbuf")
+    src.start()
+    sink = GrpcTensorSink(
+        "gsink3", server="false", port=src.bound_port, idl="protobuf"
+    )
+    sink.start()
+    try:
+        with pytest.raises(ElementError):
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                sink.render(Frame((np.zeros(2, np.float32),)))
+                time.sleep(0.05)
+    finally:
+        sink.stop()
+        src.stop()
+
+
+def test_grpc_unknown_idl_rejected():
+    pytest.importorskip("grpc")
+    from nnstreamer_tpu.edge.grpc_bridge import GrpcTensorSrc
+    from nnstreamer_tpu.elements.base import ElementError
+
+    bad = GrpcTensorSrc("gsrc4", server="true", port=0, idl="capnproto")
+    with pytest.raises(ElementError, match="unknown idl"):
+        bad.start()
 
 
 def test_grpc_client_unreachable_raises():
