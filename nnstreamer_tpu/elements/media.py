@@ -1,0 +1,265 @@
+"""Real media ingress: encoded video files, still images, V4L2 cameras.
+
+Reference: a gst-launch pipeline starts at ``v4l2src`` or ``filesrc !
+decodebin`` and ``tensor_converter`` ingests decoded video/x-raw frames
+with stride handling (gst/nnstreamer/elements/gsttensor_converter.c:
+1046-1270). This framework's analogue decodes on host via OpenCV's
+ffmpeg-backed VideoCapture (gated like the reference's meson options) and
+emits tight RGB/BGR HWC uint8 frames into the normal video path — the
+converter/filter chain downstream is identical to the synthetic-source
+case, so a camera pipeline and a videotestsrc pipeline share every
+compiled program.
+
+Elements:
+
+- ``videofilesrc location=clip.mp4``: decode a video file (any
+  container/codec the image's OpenCV+ffmpeg build supports), or a still
+  image (png/jpg/bmp — emitted once, or repeatedly with num-frames=N).
+  Props: format=RGB|BGR|GRAY8 (default RGB), loop=true (rewind at EOF),
+  framerate override, num-frames cap.
+- ``v4l2src device=/dev/video0``: live camera capture through the same
+  OpenCV backend. Props: device (path or index), width/height/framerate
+  requests, format, num-frames.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import (
+    ElementError,
+    MediaSpec,
+    Source,
+    Spec,
+    _parse_bool,
+)
+from nnstreamer_tpu.elements.sources import _frame_pts
+from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp", ".tif", ".tiff")
+
+
+def _require_cv2():
+    try:
+        import cv2
+
+        return cv2
+    except ImportError as exc:
+        raise ElementError(
+            "opencv (cv2) unavailable; media file/camera sources are gated "
+            "(like the reference's meson-gated decodebin path)"
+        ) from exc
+
+
+def _to_format(cv2, bgr: np.ndarray, fmt: str) -> np.ndarray:
+    """BGR decode buffer → requested format, tight layout (the stride-
+    handling contract: whatever the decoder's layout, the emitted tensor
+    is contiguous — the converter never sees padded rows)."""
+    if fmt == "BGR":
+        out = bgr
+    elif fmt == "RGB":
+        out = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+    elif fmt == "GRAY8":
+        out = cv2.cvtColor(bgr, cv2.COLOR_BGR2GRAY)[..., None]
+    else:
+        raise ElementError(f"unsupported format {fmt!r} (RGB/BGR/GRAY8)")
+    return np.ascontiguousarray(out)
+
+
+@registry.element("videofilesrc")
+class VideoFileSrc(Source):
+    """Decode an encoded video (or still image) file into video frames."""
+
+    FACTORY_NAME = "videofilesrc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.location = str(self.get_property("location", ""))
+        self.format = str(self.get_property("format", "RGB")).upper()
+        self.loop = _parse_bool(self.get_property("loop", False))
+        self.num_frames = int(self.get_property("num-frames", -1))
+        self._rate_override = self.get_property("framerate")
+        if not self.location:
+            raise ValueError(f"{self.name}: videofilesrc needs location=")
+        self._cap = None
+        self._image: Optional[np.ndarray] = None
+        self._i = 0
+        # probe at build time so negotiation has real width/height/rate
+        # (the reference's decodebin caps become known the same way)
+        self._probe()
+
+    def _is_image(self) -> bool:
+        return self.location.lower().endswith(_IMAGE_EXTS)
+
+    def _probe(self) -> None:
+        cv2 = _require_cv2()
+        if self._is_image():
+            bgr = cv2.imread(self.location, cv2.IMREAD_COLOR)
+            if bgr is None:
+                raise ElementError(
+                    f"{self.name}: cannot decode image {self.location!r}"
+                )
+            self._image = _to_format(cv2, bgr, self.format)
+            h, w = self._image.shape[:2]
+            self._size = (w, h)
+            self._rate = (
+                Fraction(str(self._rate_override))
+                if self._rate_override
+                else None
+            )
+            if self.num_frames < 0:
+                self.num_frames = 1
+            return
+        cap = cv2.VideoCapture(self.location)
+        if not cap.isOpened():
+            raise ElementError(
+                f"{self.name}: cannot open video {self.location!r}"
+            )
+        w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+        h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+        fps = cap.get(cv2.CAP_PROP_FPS) or 0.0
+        cap.release()
+        if w <= 0 or h <= 0:
+            raise ElementError(
+                f"{self.name}: {self.location!r} reports no frame size"
+            )
+        self._size = (w, h)
+        if self._rate_override:
+            self._rate = Fraction(str(self._rate_override))
+        else:
+            self._rate = (
+                Fraction(fps).limit_denominator(1000) if fps > 0 else None
+            )
+
+    def output_spec(self) -> Spec:
+        w, h = self._size
+        return MediaSpec(
+            "video", width=w, height=h, format=self.format, rate=self._rate
+        )
+
+    def start(self) -> None:
+        self._i = 0
+        if self._image is None:
+            cv2 = _require_cv2()
+            self._cap = cv2.VideoCapture(self.location)
+            if not self._cap.isOpened():
+                raise ElementError(
+                    f"{self.name}: cannot open video {self.location!r}"
+                )
+
+    def stop(self) -> None:
+        if self._cap is not None:
+            self._cap.release()
+            self._cap = None
+
+    def generate(self):
+        if 0 <= self.num_frames <= self._i:
+            return EOS_FRAME
+        if self._image is not None:
+            img = self._image
+        else:
+            cv2 = _require_cv2()
+            ret, bgr = self._cap.read()
+            if not ret:
+                if self.loop and self._i > 0:
+                    self._cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
+                    ret, bgr = self._cap.read()
+                if not ret:
+                    return EOS_FRAME
+            img = _to_format(cv2, bgr, self.format)
+        pts, dur = _frame_pts(self._i, self._rate)
+        self._i += 1
+        return Frame((img,), pts=pts, duration=dur, meta={"media_type": "video"})
+
+
+@registry.element("v4l2src")
+class V4l2Src(Source):
+    """Live camera capture (V4L2 device or camera index) via OpenCV."""
+
+    FACTORY_NAME = "v4l2src"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        dev = self.get_property("device", 0)
+        try:
+            self.device = int(dev)
+        except (TypeError, ValueError):
+            self.device = str(dev)
+        self.format = str(self.get_property("format", "RGB")).upper()
+        self.num_frames = int(self.get_property("num-frames", -1))
+        self.req_width = int(self.get_property("width", 0))
+        self.req_height = int(self.get_property("height", 0))
+        self._rate_override = self.get_property("framerate")
+        self._cap = None
+        self._i = 0
+        self._probe()
+
+    def _open_cap(self):
+        """Open the device and (re)apply the requested capture geometry —
+        a released camera reverts to driver defaults, so every reopen
+        must re-set the props or frames stop matching the negotiated
+        spec."""
+        cv2 = _require_cv2()
+        cap = cv2.VideoCapture(self.device)
+        if not cap.isOpened():
+            raise ElementError(
+                f"{self.name}: cannot open camera {self.device!r}"
+            )
+        if self.req_width:
+            cap.set(cv2.CAP_PROP_FRAME_WIDTH, self.req_width)
+        if self.req_height:
+            cap.set(cv2.CAP_PROP_FRAME_HEIGHT, self.req_height)
+        return cap
+
+    def _probe(self) -> None:
+        cv2 = _require_cv2()
+        cap = self._open_cap()
+        w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+        h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+        fps = cap.get(cv2.CAP_PROP_FPS) or 0.0
+        self._cap = cap  # keep the claim: cameras are exclusive devices
+        if w <= 0 or h <= 0:
+            cap.release()
+            self._cap = None
+            raise ElementError(
+                f"{self.name}: camera {self.device!r} reports no frame size"
+            )
+        self._size = (w, h)
+        if self._rate_override:
+            self._rate = Fraction(str(self._rate_override))
+        else:
+            self._rate = (
+                Fraction(fps).limit_denominator(1000) if fps > 0 else None
+            )
+
+    def output_spec(self) -> Spec:
+        w, h = self._size
+        return MediaSpec(
+            "video", width=w, height=h, format=self.format, rate=self._rate
+        )
+
+    def start(self) -> None:
+        self._i = 0
+        if self._cap is None:
+            self._cap = self._open_cap()
+
+    def stop(self) -> None:
+        if self._cap is not None:
+            self._cap.release()
+            self._cap = None
+
+    def generate(self):
+        if 0 <= self.num_frames <= self._i:
+            return EOS_FRAME
+        cv2 = _require_cv2()
+        ret, bgr = self._cap.read()
+        if not ret:
+            return EOS_FRAME
+        img = _to_format(cv2, bgr, self.format)
+        pts, dur = _frame_pts(self._i, self._rate)
+        self._i += 1
+        return Frame((img,), pts=pts, duration=dur, meta={"media_type": "video"})

@@ -1,0 +1,123 @@
+"""Real media ingress tests: videofilesrc (encoded video + still image),
+v4l2src error paths. Reference analogue: v4l2src/decodebin feeding
+tensor_converter's video path (gsttensor_converter.c:1046-1270).
+
+The clip fixture is generated at test time (OpenCV mp4v) rather than
+checked in — codecs are lossy and encoder bytes are not stable across
+builds, so assertions are on structure + content proximity, the same
+posture as the reference's camera tests."""
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2", reason="media sources are cv2-gated")
+
+from nnstreamer_tpu.elements.base import ElementError
+from nnstreamer_tpu.elements.media import V4l2Src, VideoFileSrc
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+from nnstreamer_tpu.tensors.frame import EOS_FRAME
+
+W, H, N_FRAMES = 64, 48, 6
+
+
+@pytest.fixture(scope="module")
+def clip(tmp_path_factory):
+    """mp4v clip: frame i is a solid level i*30 (lossy-codec friendly)."""
+    path = str(tmp_path_factory.mktemp("media") / "clip.mp4")
+    w = cv2.VideoWriter(
+        path, cv2.VideoWriter_fourcc(*"mp4v"), 10.0, (W, H)
+    )
+    assert w.isOpened(), "image's OpenCV build cannot encode mp4v"
+    for i in range(N_FRAMES):
+        w.write(np.full((H, W, 3), i * 30, np.uint8))
+    w.release()
+    return path
+
+
+@pytest.fixture(scope="module")
+def still(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("media") / "img.png")
+    img = np.zeros((H, W, 3), np.uint8)
+    img[:, :, 2] = 200  # red in BGR order (png is lossless)
+    assert cv2.imwrite(path, img)
+    return path
+
+
+def test_videofilesrc_decodes_clip(clip):
+    src = VideoFileSrc(location=clip)
+    assert src.output_spec().width == W and src.output_spec().height == H
+    src.start()
+    frames = []
+    while True:
+        f = src.generate()
+        if f is EOS_FRAME:
+            break
+        if f is not None:
+            frames.append(f)
+    src.stop()
+    assert len(frames) == N_FRAMES
+    for i, f in enumerate(frames):
+        img = np.asarray(f.tensors[0])
+        assert img.shape == (H, W, 3) and img.dtype == np.uint8
+        assert img.flags["C_CONTIGUOUS"]  # stride handling: tight layout
+        # mp4v is lossy; solid frames survive within a few code levels
+        assert abs(float(img.mean()) - i * 30) < 6, (i, img.mean())
+    # pts synthesized from the container fps (10/1)
+    assert frames[1].pts == 100_000_000
+
+
+def test_videofilesrc_through_pipeline(clip):
+    """nns-launch-style: videofilesrc ! tensor_converter ! tensor_filter !
+    tensor_sink — the VERDICT's done-criterion pipeline."""
+    p = parse_pipeline(
+        f"videofilesrc location={clip} ! tensor_converter ! "
+        "tensor_filter framework=passthrough ! tensor_sink name=out"
+    )
+    p.run(timeout=120)
+    sink = p["out"]
+    assert sink.rendered == N_FRAMES
+    assert np.asarray(sink.frames[0].tensors[0]).shape == (1, H, W, 3)
+
+
+def test_videofilesrc_loop_caps_at_num_frames(clip):
+    src = VideoFileSrc(location=clip, loop="true", **{"num-frames": 10})
+    src.start()
+    n = 0
+    while True:
+        f = src.generate()
+        if f is EOS_FRAME:
+            break
+        if f is not None:
+            n += 1
+    src.stop()
+    assert n == 10  # 6-frame clip looped past EOF, capped by num-frames
+
+
+def test_videofilesrc_still_image(still):
+    src = VideoFileSrc(location=still, format="RGB")
+    src.start()
+    f = src.generate()
+    assert src.generate() is EOS_FRAME  # stills emit once by default
+    img = np.asarray(f.tensors[0])
+    assert img.shape == (H, W, 3)
+    assert img[0, 0, 0] == 200 and img[0, 0, 2] == 0  # BGR→RGB converted
+    src.stop()
+
+
+def test_videofilesrc_gray8(clip):
+    src = VideoFileSrc(location=clip, format="GRAY8")
+    assert src.output_spec().channels_per_pixel == 1
+    src.start()
+    f = src.generate()
+    assert np.asarray(f.tensors[0]).shape == (H, W, 1)
+    src.stop()
+
+
+def test_videofilesrc_missing_file_raises(tmp_path):
+    with pytest.raises(ElementError, match="cannot"):
+        VideoFileSrc(location=str(tmp_path / "nope.mp4"))
+
+
+def test_v4l2src_missing_device_raises():
+    with pytest.raises(ElementError, match="cannot open camera"):
+        V4l2Src(device="/dev/video99")
