@@ -1,0 +1,139 @@
+"""Multi-host dryrun worker: one PROCESS of a simulated pod slice.
+
+Run as::
+
+    python -m nnstreamer_tpu.parallel._multihost_worker \
+        <phase> <pid> <nprocs> <coordinator> <workdir> [devices_per_proc]
+
+Each process pins a virtual CPU platform with ``devices_per_proc``
+devices, joins the jax.distributed runtime at ``coordinator``, and builds
+ONE GLOBAL dp×tp mesh spanning every process — the single-machine
+stand-in for a TPU pod (SURVEY.md §5.8: hosts rendezvous, jax.devices()
+goes global, collectives ride DCN between processes).
+
+Phases (the checkpoint/restart drill, §5.4 applied across hosts):
+
+- ``fresh``:  run one sharded training step, checkpoint the state from
+  ALL processes (orbax multihost save), record the post-step eval loss.
+- ``resume``: a brand-new process set (the simulated host restart)
+  restores the checkpoint directly onto the mesh shardings, verifies the
+  eval loss matches the recorded one bit-for-bit, then trains one more
+  step — proving the pod resumes where it left off.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def run_phase(
+    phase: str,
+    pid: int,
+    nprocs: int,
+    coordinator: str,
+    workdir: str,
+    devices_per_proc: int = 4,
+) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices_per_proc}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp  # noqa: F401
+    import numpy as np
+
+    from nnstreamer_tpu.models import mobilenet_v2
+    from nnstreamer_tpu.parallel import checkpoint as ckpt
+    from nnstreamer_tpu.parallel import multihost
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+    from nnstreamer_tpu.parallel.train import (
+        loss_fn,
+        make_train_step,
+        param_shardings,
+    )
+
+    multihost.initialize(
+        coordinator_address=coordinator, num_processes=nprocs, process_id=pid
+    )
+    assert jax.process_count() == nprocs, jax.process_count()
+    n_global = len(jax.devices())
+    assert n_global == nprocs * devices_per_proc, n_global
+
+    mesh = make_mesh(n_global, axes=("dp", "tp"))
+    dp = mesh.shape["dp"]
+    batch = max(2 * dp, dp)
+    params0 = mobilenet_v2.init_params(jax.random.PRNGKey(0), num_classes=16)
+    p_shard = param_shardings(mesh, params0)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_shard = NamedSharding(mesh, P("dp"))
+
+    def global_batch(seed, shape, hi, dtype):
+        # identical host data on every process; each contributes the
+        # shards it addresses
+        full = np.random.default_rng(seed).integers(0, hi, shape).astype(dtype)
+        return jax.make_array_from_callback(
+            shape, batch_shard, lambda idx: full[idx]
+        )
+
+    images = global_batch(0, (batch, 32, 32, 3), 255, np.uint8)
+    labels = global_batch(1, (batch,), 16, np.int32)
+    images2 = global_batch(2, (batch, 32, 32, 3), 255, np.uint8)
+    labels2 = global_batch(3, (batch,), 16, np.int32)
+
+    eval_loss = jax.jit(loss_fn)
+    ckpt_path = os.path.join(workdir, "pod_ckpt")
+    loss_file = os.path.join(workdir, "eval_loss.txt")
+
+    if phase == "fresh":
+        step, params, opt_state = make_train_step(mesh, params0)
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        assert np.isfinite(float(loss)), f"non-finite loss {loss}"
+        ckpt.save(ckpt_path, {"params": params})
+        l2 = float(eval_loss(params, images2, labels2))
+        if multihost.is_primary():
+            with open(loss_file, "w") as f:
+                f.write(repr(l2))
+        multihost.barrier("fresh-saved")
+        print(f"proc{pid} fresh ok loss={float(loss):.6f} eval={l2:.6f}",
+              flush=True)
+    elif phase == "resume":
+        # simulated host restart: nothing survives but the checkpoint —
+        # restore it straight onto this (new) process set's mesh shardings
+        restored = ckpt.restore(
+            ckpt_path, like={"params": params0}, shardings={"params": p_shard}
+        )["params"]
+        l2 = float(eval_loss(restored, images2, labels2))
+        with open(loss_file) as f:
+            want = float(f.read())
+        assert abs(l2 - want) < 1e-6, (l2, want)
+        # training continues from the restored state
+        step, params, opt_state = make_train_step(mesh, restored)
+        params, opt_state, loss = step(params, opt_state, images2, labels2)
+        jax.block_until_ready(loss)
+        assert np.isfinite(float(loss)), f"non-finite resumed loss {loss}"
+        print(f"proc{pid} resume ok eval={l2:.6f} next={float(loss):.6f}",
+              flush=True)
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+    multihost.shutdown()
+
+
+if __name__ == "__main__":
+    run_phase(
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+        sys.argv[5],
+        int(sys.argv[6]) if len(sys.argv) > 6 else 4,
+    )

@@ -66,6 +66,19 @@ def shutdown() -> None:
         _initialized = False
 
 
+def barrier(tag: str = "barrier") -> None:
+    """Block until EVERY process reaches this point (a psum over the
+    global device set — rides DCN between hosts). The pod-level fence for
+    ordering singleton work: e.g. every process must finish its
+    checkpoint shards before the primary records the step as durable, and
+    a restarted pod must not read a checkpoint mid-write. A missing host
+    surfaces as this call timing out at the collective layer — the
+    failure-detection primitive of the multi-host runtime."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
 def is_primary() -> bool:
     """True on the process that should do singleton work (logging, golden
     dumps, checkpoint writes)."""

@@ -89,3 +89,50 @@ def test_two_process_mesh_psum(tmp_path):
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"proc{i} failed:\n{err[-800:]}"
         assert f"proc{i} ok" in out
+
+
+def _spawn_phase(phase, coord, workdir, nprocs=2, dpp=2, timeout=300):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "nnstreamer_tpu.parallel._multihost_worker",
+             phase, str(i), str(nprocs), coord, workdir, str(dpp)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO,
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"{phase} proc{i} failed:\n{err[-1200:]}"
+        assert f"proc{i} {phase} ok" in out, out
+    return outs
+
+
+def _free_coord():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    return coord
+
+
+def test_checkpoint_resume_across_host_restart(tmp_path):
+    """The pod-restart drill (SURVEY §5.4 across §5.8): two processes
+    train one sharded step and checkpoint from ALL hosts; a brand-new
+    process set restores the state straight onto the mesh shardings,
+    reproduces the recorded eval loss, and keeps training."""
+    workdir = str(tmp_path)
+    _spawn_phase("fresh", _free_coord(), workdir)
+    # the simulated restart: completely new processes + new coordinator
+    _spawn_phase("resume", _free_coord(), workdir)
