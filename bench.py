@@ -219,7 +219,10 @@ def _run() -> None:
         from nnstreamer_tpu.pipeline.executor import SinkNode
         from nnstreamer_tpu.pipeline.parse import parse_pipeline
 
-        conv = "tensor_converter" + (
+        # queue-size on the converter sizes the fused node's input queue
+        # (the source→segment edge): deep dispatch-ahead lets the source
+        # run ahead of the device stream instead of stalling at 4 frames
+        conv = "tensor_converter queue-size=128" + (
             f" frames-per-tensor={fpt}" if fpt > 1 else ""
         )
         desc = (
