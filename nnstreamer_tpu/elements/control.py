@@ -145,11 +145,14 @@ class TensorIf(HostElement):
 
     # -- predicate ---------------------------------------------------------
     def _compared_value(self, frame: Frame) -> float:
+        # SURVEY §7: data-dependent control flow syncs on SMALL values —
+        # index/reduce the (possibly device-resident) tensor in place and
+        # transfer one scalar, never the whole payload
         if self.cv == "A_VALUE":
             bits = self.cv_option.split(",")
             coords_ref = [int(x) for x in bits[0].split(":")] if bits[0] else [0]
             nth = int(bits[1]) if len(bits) > 1 else 0
-            a = np.asarray(frame.tensors[nth])
+            a = frame.tensors[nth]
             coords = tuple(reversed(coords_ref))  # innermost-first → canonical
             # pad missing leading coords with 0
             while len(coords) < a.ndim:
@@ -166,8 +169,24 @@ class TensorIf(HostElement):
                     )
             return float(a[coords])
         if self.cv == "TENSOR_AVERAGE_VALUE":
-            nth = int(self.cv_option or 0)
-            return tdata.tensor_average(frame.tensors[nth])
+            # option = tensor index; tolerate the A_VALUE-style default
+            # ("coords,N") an unset option falls back to
+            nth = int((self.cv_option or "0").split(",")[-1])
+            t = frame.tensors[nth]
+            if hasattr(t, "devices"):  # jax array: reduce on device
+                import jax
+                import jax.numpy as jnp
+
+                # match the host path's float64 accumulation when x64 is
+                # on; otherwise accumulate in float32 (TPUs have no f64)
+                # — the documented tolerance of the device branch
+                acc = (
+                    jnp.float64
+                    if jax.config.jax_enable_x64
+                    else jnp.float32
+                )
+                return float(jnp.mean(t, dtype=acc))
+            return tdata.tensor_average(t)
         if self.cv == "CUSTOM":
             with _if_custom_lock:
                 fn = _if_custom.get(self.cv_option)

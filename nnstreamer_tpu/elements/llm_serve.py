@@ -106,6 +106,11 @@ class _LlmServer:
         self._out: deque = deque()
         self.eos = False
         self.stopped = False
+        # token streaming (serversrc stream=true): emit one frame per NEW
+        # token as it decodes, then a final done frame — the SSE-style
+        # serving surface in the pipeline idiom
+        self.stream = False
+        self._sent: Dict[int, int] = {}  # rid -> tokens already streamed
 
     def submit(self, frame: Frame) -> None:
         import time as _time
@@ -136,18 +141,47 @@ class _LlmServer:
             self._pending[rid] = dict(frame.meta)
 
     def pump(self) -> bool:
-        """One decode step; harvest finished requests. True if anything
-        advanced (steps happened or results were collected)."""
+        """One decode step; harvest finished requests (and, in streaming
+        mode, every new token). True if anything advanced."""
         emitted = self.cb.step()
         harvested = False
         with self._lock:
+            if self.stream:
+                # count-based catch-up off cb.partials() (one batcher
+                # lock pass for all pending rids): robust to tokens
+                # emitted by ANY thread's step between two pumps
+                parts = self.cb.partials(list(self._pending))
+                for rid, meta in self._pending.items():
+                    toks = parts.get(rid)
+                    if toks is None:
+                        continue
+                    harvested |= self._stream_new_locked(rid, meta, toks)
             for rid in list(self._pending):
                 toks = self.cb.result(rid)
                 if toks is not None:
                     meta = self._pending.pop(rid)
+                    if self.stream:
+                        # a concurrent pump's step may have finished the
+                        # request AFTER our catch-up pass above — emit the
+                        # tail tokens per-frame before the done frame so
+                        # the one-frame-per-token contract holds
+                        self._stream_new_locked(rid, meta, toks)
+                        meta = {**meta, "stream": True, "done": True}
+                    self._sent.pop(rid, None)
                     self._out.append((toks, meta))
                     harvested = True
         return bool(emitted) or harvested
+
+    def _stream_new_locked(self, rid: int, meta: dict, toks) -> bool:
+        """Emit per-token frames for tokens not yet streamed (_lock held)."""
+        n0 = self._sent.get(rid, 0)
+        for i in range(n0, len(toks)):
+            self._out.append((
+                [toks[i]],
+                {**meta, "stream": True, "done": False, "token_index": i},
+            ))
+        self._sent[rid] = len(toks)
+        return len(toks) > n0
 
     def pop(self):
         with self._lock:
@@ -219,11 +253,22 @@ class LlmServerSrc(Source):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        from nnstreamer_tpu.elements.base import _parse_bool
+
         self.srv_id = str(self.get_property("id", "0"))
+        # stream=true: one frame per NEW token (meta: stream/done/
+        # token_index + the request frame's meta incl. client_id), then a
+        # final done frame carrying the full generation
+        self.stream = _parse_bool(self.get_property("stream", False))
         # THIS run's server, held by object reference — the id string is
         # reusable across pipelines, so it never identifies the server
         self._server: Optional[_LlmServer] = None
         self._final_stats: Optional[Dict] = None
+
+    def _acquired(self, srv: Optional[_LlmServer]) -> Optional[_LlmServer]:
+        if srv is not None and self.stream:
+            srv.stream = True
+        return srv
 
     def start(self) -> None:
         # acquire the paired server eagerly so teardown before the first
@@ -233,7 +278,7 @@ class LlmServerSrc(Source):
         # still be empty here — generate() keeps the lazy fallback.
         if self._server is None:
             with _table_lock:
-                self._server = _table.get(self.srv_id)
+                self._server = self._acquired(_table.get(self.srv_id))
 
     def stop(self) -> None:
         # pipeline teardown (drained or not) releases the server — model
@@ -261,7 +306,7 @@ class LlmServerSrc(Source):
 
         srv = self._server
         if srv is None:
-            srv = self._server = _get_server(self.srv_id)
+            srv = self._server = self._acquired(_get_server(self.srv_id))
         item = srv.pop()
         if item is None:
             if srv.drained:

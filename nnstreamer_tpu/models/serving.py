@@ -840,6 +840,30 @@ class ContinuousBatcher:
                 return list(self._done_pool[rid].tokens)
             return None
 
+    def partial(self, rid: int) -> Optional[List[int]]:
+        """Tokens emitted SO FAR for ``rid`` (running or finished) — the
+        token-streaming read surface. None for unknown/evicted ids."""
+        return self.partials([rid]).get(rid)
+
+    def partials(self, rids) -> Dict[int, List[int]]:
+        """Batched partial(): {rid: tokens-so-far} for every known rid,
+        in ONE lock acquisition and one pass over slots/pending/done —
+        the per-token streaming hot path polls every pending request per
+        decode step, so the per-rid scan must not multiply."""
+        want = set(rids)
+        out: Dict[int, List[int]] = {}
+        with self._lock:
+            for req in self._slots:
+                if req is not None and req.rid in want:
+                    out[req.rid] = list(req.tokens)
+            for p in self._pending:
+                if p.req.rid in want:
+                    out[p.req.rid] = list(p.req.tokens)
+            for rid in want - out.keys():
+                if rid in self._done_pool:
+                    out[rid] = list(self._done_pool[rid].tokens)
+        return out
+
     @property
     def n_free(self) -> int:
         with self._lock:

@@ -171,3 +171,48 @@ def test_serving_stats_in_cli_stats():
     src = next(v for k, v in stats.items() if "serversrc" in k)
     assert src["serving_tokens_emitted"] == 4  # 2 reqs × (3-1 stepped)
     assert src["serving_steps"] >= 2
+
+
+def test_token_streaming_mode():
+    """serversrc stream=true: one frame per NEW token (stream/done/
+    token_index meta + request meta), then a done frame with the full
+    generation; the streamed tokens concatenate to exactly the done
+    frame's tokens, which match solo generation."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    prompt = np.asarray([5, 9, 2, 44], np.int32)
+    src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+    sink = LlmServerSink(
+        **{"id": "stream0", "model": "zoo:transformer_lm",
+           "custom": MODEL_OPTS, "n-slots": 1, "max-len": 32,
+           "prompt-len": 8, "max-new-tokens": 5}
+    )
+    out_src = LlmServerSrc(**{"id": "stream0", "stream": "true"})
+    out_sink = AppSink()
+    p = Pipeline().chain(src, sink)
+    p.chain(out_src, out_sink)
+    p.start()
+    try:
+        src.push(Frame((prompt,), meta={"req": "s"}))
+        src.end_of_stream()
+        streamed, done = [], None
+        while done is None:
+            f = out_sink.pop(timeout=120)
+            assert f is not None, "stream drained early"
+            assert f.meta["stream"] is True and f.meta["req"] == "s"
+            toks = [int(t) for t in np.asarray(f.tensors[0])[0]]
+            if f.meta["done"]:
+                done = toks
+            else:
+                assert f.meta["token_index"] == len(streamed)
+                assert len(toks) == 1
+                streamed.append(toks[0])
+        assert streamed == done
+        assert len(done) == 5
+    finally:
+        p.stop()
