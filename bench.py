@@ -265,6 +265,41 @@ def _run() -> None:
     pipeline_fps = _pipeline_fps_safe(True, 1, n_pipe, pipe_window)
     _mark("pipeline measured")
 
+    # p50 END-TO-END frame latency through the pipeline (BASELINE's
+    # tracked-latency config): wall-stamped frames, per-frame sink sync
+    # (sync-window=1 — the latency-honest configuration; on a tunneled
+    # device this includes the RTT every frame, by design)
+    def _pipeline_p50_ms():
+        from nnstreamer_tpu.pipeline.executor import SinkNode
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        n = 64 if on_tpu else 8
+        desc = (
+            f"videotestsrc pattern=gradient device=true stamp-wall=true "
+            f"num-frames={n} width=224 height=224 ! tensor_converter ! "
+            "tensor_filter framework=jax model=zoo:mobilenet_v2 "
+            'custom="batch:1,compute_dtype:bfloat16" ! '
+            "tensor_decoder mode=image_labeling ! tensor_sink sync-window=1"
+        )
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=600)
+        sink = next(nd for nd in ex.nodes if isinstance(nd, SinkNode))
+        # drop the first renders (compile/warmup rides on them), then
+        # take the median of the steady tail
+        all_lats = list(sink.latencies)
+        lats = all_lats[max(2, len(all_lats) // 8):]
+        if not lats:
+            return None
+        lats.sort()
+        return 1000.0 * lats[len(lats) // 2]
+
+    try:
+        pipeline_p50_ms = _pipeline_p50_ms()
+    except Exception as exc:  # noqa: BLE001
+        print(f"[bench] pipeline p50 failed: {exc!r}", file=sys.stderr)
+        pipeline_p50_ms = None
+    _mark("pipeline p50 measured")
+
     # Optional sections below run inside a soft budget: the primary
     # metrics are already measured, and a slow tunnel day must not turn a
     # recorded number into an rc:1 (the round-1 failure mode).
@@ -510,6 +545,7 @@ def _run() -> None:
                 "unit": "fps",
                 "vs_baseline": round(value / 1000.0, 3),
                 "pipeline_fps": _round(pipeline_fps),
+                "pipeline_p50_e2e_ms": _round(pipeline_p50_ms, 3),
                 "pipeline_h2d_fps": _round(pipeline_h2d_fps),
                 "pipeline_mb8_fps": _round(pipeline_mb8_fps),
                 "pipeline_mb32_fps": _round(pipeline_mb32_fps),

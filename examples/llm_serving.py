@@ -67,3 +67,25 @@ rf = ring.submit(rng.integers(1, 1024, (20,)), 200)
 while ring.result(rf) is None:
     ring.step()
 print(f"F: {len(ring.result(rf))} tokens decoded in a fixed 64-token cache")
+
+print("\n-- token streaming: partials() while slots decode --")
+sb = ContinuousBatcher(params, n_heads=8, n_slots=2, max_len=96,
+                       prompt_len=32)
+rg = sb.submit(rng.integers(1, 1024, (12,)), 10)
+seen = 0
+while sb.result(rg) is None:
+    sb.step()
+    toks = sb.partials([rg]).get(rg, [])
+    if len(toks) > seen:
+        print(f"  streamed: +{toks[seen:]}")
+        seen = len(toks)
+print(f"G: {seen} tokens streamed as they decoded")
+
+print("\n-- windowed long prompt: 150-token prompt into a 64 ring --")
+wp = ContinuousBatcher(params, n_heads=8, n_slots=1, max_len=64,
+                       prompt_len=32, windowed=True)
+rh = wp.submit(rng.integers(1, 1024, (150,)), 8)
+while wp.result(rh) is None:
+    wp.step()
+print(f"H: prompt 150 > ring 64 — exact sliding-window prefill, "
+      f"{len(wp.result(rh))} tokens out")

@@ -85,7 +85,8 @@ class _LlmServer:
     """Shared state between the sink (submit) and src (pump/emit)."""
 
     def __init__(self, model: str, options: Dict[str, str], n_slots: int,
-                 max_len: int, prompt_len: int, default_new: int):
+                 max_len: int, prompt_len: int, default_new: int,
+                 stream: bool = False):
         from nnstreamer_tpu.models import zoo
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
@@ -106,10 +107,14 @@ class _LlmServer:
         self._out: deque = deque()
         self.eos = False
         self.stopped = False
-        # token streaming (serversrc stream=true): emit one frame per NEW
-        # token as it decodes, then a final done frame — the SSE-style
-        # serving surface in the pipeline idiom
-        self.stream = False
+        # token streaming: emit one frame per NEW token as it decodes,
+        # then a final done frame — the SSE-style serving surface in the
+        # pipeline idiom. Authoritative when set at creation (the sink's
+        # stream prop); the serversrc's stream=true also flips it at
+        # acquisition, which is race-free only in the single-pipeline
+        # layout (all elements start before any frame flows) — paired
+        # ACROSS pipelines, set it on the sink.
+        self.stream = stream
         self._sent: Dict[int, int] = {}  # rid -> tokens already streamed
 
     def submit(self, frame: Frame) -> None:
@@ -214,6 +219,8 @@ class LlmServerSink(Sink):
         options = FilterProps(
             custom=str(self.get_property("custom", ""))
         ).custom_dict()
+        from nnstreamer_tpu.elements.base import _parse_bool
+
         self._create_kw = dict(
             model=str(self.get_property("model", "zoo:transformer_lm")),
             options=options,
@@ -221,6 +228,7 @@ class LlmServerSink(Sink):
             max_len=int(self.get_property("max-len", 256)),
             prompt_len=int(self.get_property("prompt-len", 64)),
             default_new=int(self.get_property("max-new-tokens", 16)),
+            stream=_parse_bool(self.get_property("stream", False)),
         )
         self._server: Optional[_LlmServer] = None
 

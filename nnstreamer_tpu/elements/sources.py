@@ -61,6 +61,10 @@ class VideoTestSrc(Source):
         from nnstreamer_tpu.elements.base import _parse_bool
 
         self.device = _parse_bool(self.get_property("device", False))
+        # stamp-wall=true: record the generation wall-clock in frame meta
+        # so sinks can report true end-to-end frame latency (BASELINE's
+        # "p50 e2e frame latency tracked per config")
+        self.stamp_wall = _parse_bool(self.get_property("stamp-wall", False))
         self._i = 0
         self._rng = np.random.default_rng(self.seed)
         self._base = None      # host pattern base (uint8, wraps mod 256)
@@ -142,7 +146,12 @@ class VideoTestSrc(Source):
             raise ValueError(f"unknown pattern {self.pattern!r}")
         pts, dur = _frame_pts(self._i, self.rate)
         self._i += 1
-        return Frame((img,), pts=pts, duration=dur, meta={"media_type": "video"})
+        meta = {"media_type": "video"}
+        if self.stamp_wall:
+            import time
+
+            meta["wall_t0"] = time.perf_counter()
+        return Frame((img,), pts=pts, duration=dur, meta=meta)
 
 
 @registry.element("audiotestsrc")

@@ -24,6 +24,7 @@ from __future__ import annotations
 import queue as queue_mod
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.elements.base import (
@@ -286,14 +287,24 @@ class SinkNode(Node):
         self.t_last_render: Optional[float] = None
         self.frames_rendered = 0
         self.first_burst_n = 0
+        # per-frame e2e latencies (seconds) for wall-stamped frames
+        # (videotestsrc stamp-wall=true): render time − generation time.
+        # Bounded: a live pipeline renders forever, a per-frame float
+        # list must not grow with it (the newest window is what p50
+        # readers want anyway).
+        self.latencies: deque = deque(maxlen=4096)
 
-    def _mark_render(self, n: int) -> None:
+    def _mark_render(self, n: int, frames=()) -> None:
         now = time.perf_counter()
         if self.t_first_render is None:
             self.t_first_render = now
             self.first_burst_n = n
         self.t_last_render = now
         self.frames_rendered += n
+        for f in frames:
+            t0 = f.meta.get("wall_t0")
+            if t0 is not None:
+                self.latencies.append(now - t0)
 
     def run(self) -> None:
         window = getattr(self.elem, "sync_window", 1)
@@ -329,8 +340,8 @@ class SinkNode(Node):
             for f in pending:
                 f.mark_synced()
                 self.elem.render(f)
+            self._mark_render(n, pending)
             pending.clear()
-            self._mark_render(n)
 
         while True:
             item = self.pop(0)
@@ -346,7 +357,7 @@ class SinkNode(Node):
                     flush()
             else:
                 self.elem.render(item)
-                self._mark_render(1)
+                self._mark_render(1, (item,))
             self.stat(t0)
         self.ex.sink_done(self)
 

@@ -285,6 +285,35 @@ PIPELINES = {
         'model=zoo:face_composite custom="threshold:0.0" ! '
         "filesink location={out}"
     ),
+    # DEVICE-RESIDENT crop cascade (r3): tensor_crop out-size= keeps the
+    # whole element cascade in HBM with a static downstream spec
+    "composite_device_crop": (
+        "videotestsrc pattern=gradient num-frames=2 width=128 height=128 ! "
+        "tensor_converter ! tee name=t "
+        "t. ! queue ! tensor_filter framework=jax model=zoo:face_detect "
+        'custom="output:regions,threshold:0.0,frame_size:128:128" ! '
+        "crop.sink_1 "
+        "t. ! queue ! crop.sink_0 "
+        "tensor_crop name=crop out-size=112:112 max-crops=16 ! "
+        "tensor_filter framework=jax model=zoo:face_landmark "
+        'custom="batch:16" ! filesink location={out}'
+    ),
+    # device-born source must be byte-identical to the host pattern
+    # (videotestsrc device=true; the pipeline_fps bench's source)
+    "videotestsrc_device": (
+        "videotestsrc pattern=gradient device=true num-frames=3 "
+        "width=8 height=8 ! tensor_converter ! "
+        "tensor_transform mode=typecast option=uint8 ! "
+        "filesink location={out}"
+    ),
+    # device-computed decode (image_labeling argmax fused into the filter
+    # program — [N] uint32 indices on the wire, never [N,V] logits)
+    "decoder_label_fused": (
+        "videotestsrc pattern=gradient num-frames=2 width=96 height=96 ! "
+        "tensor_converter ! tensor_filter framework=jax "
+        'model=zoo:mobilenet_v2 custom="size:96,num_classes:16" ! '
+        "tensor_decoder mode=image_labeling ! filesink location={out}"
+    ),
 }
 
 # "expect fail" golden cases (reference gstTest "expect fail" flags): the

@@ -192,7 +192,7 @@ def test_token_streaming_mode():
            "custom": MODEL_OPTS, "n-slots": 1, "max-len": 32,
            "prompt-len": 8, "max-new-tokens": 5}
     )
-    out_src = LlmServerSrc(**{"id": "stream0", "stream": "true"})
+    out_src = LlmServerSrc(**{"id": "stream0", "stream": "true"})  # src-side
     out_sink = AppSink()
     p = Pipeline().chain(src, sink)
     p.chain(out_src, out_sink)
@@ -216,3 +216,33 @@ def test_token_streaming_mode():
         assert len(done) == 5
     finally:
         p.stop()
+
+
+def test_stream_prop_on_sink_covers_early_finishers():
+    """stream=true on the SINK configures streaming at server creation —
+    requests that finish during the sink's backpressure pumps (before any
+    src exists) still get per-token + done framing."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    sink = LlmServerSink(
+        **{"id": "stream1", "model": "zoo:transformer_lm",
+           "custom": MODEL_OPTS, "n-slots": 1, "max-len": 32,
+           "prompt-len": 8, "max-new-tokens": 3, "stream": "true"}
+    )
+    sink.negotiate([TensorsSpec(format=TensorFormat.FLEXIBLE)])
+    srv = sink._server
+    assert srv.stream is True
+    sink.render(Frame((np.asarray([7, 8, 9], np.int32),), meta={"req": "x"}))
+    # drive to completion with NO src attached (the early-finisher case)
+    while not srv._out or not any(m.get("done") for _, m in list(srv._out)):
+        srv.pump()
+    frames = list(srv._out)
+    assert all(m.get("stream") is True for _, m in frames)
+    done = [t for t, m in frames if m.get("done")]
+    streamed = [t[0] for t, m in frames if not m.get("done")]
+    assert len(done) == 1 and streamed == done[0]
+    sink.stop()
+    src_el = LlmServerSrc(**{"id": "stream1"})
+    src_el.stop()

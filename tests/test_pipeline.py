@@ -425,3 +425,27 @@ class TestDeviceResidentPath:
         for a, b in zip(fused_out, host_out):
             assert a.dtype == np.uint32
             np.testing.assert_array_equal(a, b)
+
+
+def test_sink_collects_e2e_latency_for_stamped_frames():
+    """videotestsrc stamp-wall=true → SinkNode records one e2e latency
+    per rendered frame (the bench's pipeline_p50_e2e_ms source)."""
+    from nnstreamer_tpu.pipeline.executor import SinkNode
+
+    src = VideoTestSrc(width=8, height=8,
+                       **{"num-frames": 5, "stamp-wall": "true"})
+    conv = TensorConverter()
+    sink = TensorSink()
+    p = Pipeline().chain(src, conv, sink)
+    ex = p.run(timeout=30)
+    node = next(n for n in ex.nodes if isinstance(n, SinkNode))
+    assert len(node.latencies) == 5
+    assert all(l >= 0 for l in node.latencies)
+    # unstamped pipelines collect nothing
+    p2 = Pipeline().chain(
+        VideoTestSrc(width=8, height=8, **{"num-frames": 2}),
+        TensorConverter(), TensorSink(),
+    )
+    ex2 = p2.run(timeout=30)
+    node2 = next(n for n in ex2.nodes if isinstance(n, SinkNode))
+    assert not node2.latencies
