@@ -86,7 +86,7 @@ class _LlmServer:
 
     def __init__(self, model: str, options: Dict[str, str], n_slots: int,
                  max_len: int, prompt_len: int, default_new: int,
-                 stream: bool = False):
+                 stream: bool = False, speculate: int = 0):
         from nnstreamer_tpu.models import zoo
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
@@ -115,6 +115,10 @@ class _LlmServer:
         # layout (all elements start before any frame flows) — paired
         # ACROSS pipelines, set it on the sink.
         self.stream = stream
+        # speculate=k: pump via spec_step(k) — prompt-lookup speculation
+        # batched over slots (greedy slots emit several tokens per
+        # program launch when the guesses land; exact equivalence)
+        self.speculate = speculate
         self._sent: Dict[int, int] = {}  # rid -> tokens already streamed
 
     def submit(self, frame: Frame) -> None:
@@ -148,7 +152,10 @@ class _LlmServer:
     def pump(self) -> bool:
         """One decode step; harvest finished requests (and, in streaming
         mode, every new token). True if anything advanced."""
-        emitted = self.cb.step()
+        if self.speculate > 1:
+            emitted = self.cb.spec_step(k=self.speculate)
+        else:
+            emitted = self.cb.step()
         harvested = False
         with self._lock:
             if self.stream:
@@ -229,6 +236,7 @@ class LlmServerSink(Sink):
             prompt_len=int(self.get_property("prompt-len", 64)),
             default_new=int(self.get_property("max-new-tokens", 16)),
             stream=_parse_bool(self.get_property("stream", False)),
+            speculate=int(self.get_property("speculate", 0)),
         )
         self._server: Optional[_LlmServer] = None
 

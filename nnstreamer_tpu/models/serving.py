@@ -51,6 +51,7 @@ import numpy as np
 
 from nnstreamer_tpu.models import decode as dec
 from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.models.speculative import ngram_propose
 
 
 def quantize_kv(t):
@@ -184,6 +185,97 @@ def batched_decode_step(
     return logits, cache_out, pos + active.astype(jnp.int32)
 
 
+def batched_verify_step(
+    params: Dict,
+    toks,
+    pos,
+    active,
+    cache: Tuple[jax.Array, jax.Array],
+    n_heads: int,
+    compute_dtype=jnp.float32,
+):
+    """Score per-slot k-token candidate chunks in ONE forward — the
+    continuous-batching speculation verify (models/speculative.py's
+    _verify generalized to per-slot positions, the same way
+    batched_decode_step generalizes decode_step).
+
+    toks [B, k] int32 (row 0 = the slot's pending token, rows 1..k-1 =
+    proposals), pos [B] (per-slot fill), active [B] →
+    (logits [B, k, V] f32, cache'). Chunk K/V land at per-slot positions
+    pos..pos+k-1, gated on ``active``; the caller advances each slot's
+    pos by its accepted count — rejected positions are overwritten
+    before any mask can reach them (verify_chunk's invariant, held
+    per slot). Caller must guarantee pos + k ≤ max_len for every active
+    slot (dynamic_update_slice would clamp and corrupt otherwise)."""
+    quantized = isinstance(cache[0], tuple)
+    max_len = (cache[0][0] if quantized else cache[0]).shape[2]
+    b, k = toks.shape
+    x = tfm.embed_lookup(params["embed"], toks, compute_dtype)  # [B,k,D]
+    positions = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    gate = active[:, None, None, None]
+
+    def write_chunk(c, new):
+        """c [B,max_len,H,Dh] ← new [B,k,H,Dh] at per-slot pos."""
+        written = jax.vmap(
+            lambda cb, nb, p: jax.lax.dynamic_update_slice(cb, nb, (p, 0, 0))
+        )(c, new.astype(c.dtype), pos)
+        return jnp.where(gate, written, c)
+
+    def write_scale_chunk(sc, new):
+        written = jax.vmap(
+            lambda sb, nb, p: jax.lax.dynamic_update_slice(sb, nb, (p, 0))
+        )(sc, new, pos)
+        return jnp.where(gate[..., 0], written, sc)
+
+    # per-slot causal mask over the cache: query i attends ≤ pos_b + i
+    mask = (
+        jnp.arange(max_len)[None, None, :] <= positions[:, :, None]
+    )  # [B, k, max_len]
+
+    def body(carry, layer):
+        x = carry
+        if quantized:
+            blk, ck8, ksc, cv8, vsc = layer
+        else:
+            blk, ck, cv = layer
+        bsz = x.shape[0]
+        q, kk, v = tfm.block_qkv(x, blk, n_heads, positions)
+        if quantized:
+            k8, ks = quantize_kv(kk)
+            v8, vs = quantize_kv(v)
+            ck8 = write_chunk(ck8, k8)
+            ksc = write_scale_chunk(ksc, ks)
+            cv8 = write_chunk(cv8, v8)
+            vsc = write_scale_chunk(vsc, vs)
+            ck = dequantize_kv(ck8, ksc)
+            cv = dequantize_kv(cv8, vsc)
+            out_layer = (ck8, ksc, cv8, vsc)
+        else:
+            ck = write_chunk(ck, kk)
+            cv = write_chunk(cv, v)
+            out_layer = (ck, cv)
+        o = tfm.cache_attention(q, ck, cv, mask)
+        o = o.astype(x.dtype).reshape(bsz, k, -1)
+        x = x + o @ tfm.wt(blk["wo"], x.dtype)
+        x = tfm.block_ffn(x, blk)
+        return x, out_layer
+
+    if quantized:
+        (ck8, ksc), (cv8, vsc) = cache
+        xs = (params["blocks"], ck8, ksc, cv8, vsc)
+    else:
+        xs = (params["blocks"],) + tuple(cache)
+    x, out_layers = jax.lax.scan(body, x, xs)
+    if quantized:
+        ck8, ksc, cv8, vsc = out_layers
+        cache_out = ((ck8, ksc), (cv8, vsc))
+    else:
+        cache_out = out_layers
+    x = tfm.rmsnorm(x, params["ln_f"])
+    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
+    return logits, cache_out
+
+
 def sample_tokens(logits, temp, top_k, top_p, keys):
     """Per-slot token selection INSIDE the step program.
 
@@ -257,6 +349,7 @@ class _Request:
     top_p: float = 1.0
     stop_token: Optional[int] = None
     key: Optional[np.ndarray] = None  # base PRNG key [2] uint32
+    prompt: Optional[np.ndarray] = None  # spec_step's proposal context
     tokens: List[int] = field(default_factory=list)
     done: bool = False
 
@@ -337,6 +430,7 @@ class ContinuousBatcher:
         self.n_slots = n_slots
         self.max_len = max_len
         self.windowed = windowed
+        self._attn_impl = attn_impl
         self.prompt_len = prompt_len
         self.compute_dtype = compute_dtype
         self._lock = threading.Lock()       # host/device state
@@ -492,6 +586,13 @@ class ContinuousBatcher:
             )[0]
         )
         self._insert = jax.jit(insert_slot)
+        # speculative verify: per-slot k-chunk scoring (spec_step); jit
+        # caches one program per distinct chunk width
+        self._verify = jax.jit(
+            lambda toks, pos_, active, cache: batched_verify_step(
+                params, toks, pos_, active, cache, n_heads, compute_dtype
+            )
+        )
         self._load_prefix = jax.jit(
             lambda stage, ks, vs: (
                 jax.lax.dynamic_update_slice(stage[0], ks, (0, 0, 0, 0, 0)),
@@ -503,6 +604,8 @@ class ContinuousBatcher:
         self._next_prefix = 0
         self._n_steps = 0
         self._n_tokens = 0
+        self._n_spec_rounds = 0
+        self._n_spec_accepted = 0
         self._step_time_s = 0.0
 
     def _empty_stage(self):
@@ -676,6 +779,7 @@ class ContinuousBatcher:
                 key=np.asarray(
                     jax.random.PRNGKey(rid if seed is None else seed)
                 ),
+                prompt=prompt,
             )
             self._slots[slot] = req
 
@@ -762,6 +866,65 @@ class ContinuousBatcher:
 
         t0 = _time.perf_counter()
         with self._step_lock:
+            return self._plain_step_locked(t0)
+
+    def _plain_step_locked(self, t0) -> Dict[int, int]:
+        """step() body; caller holds _step_lock."""
+        import time as _time
+
+        with self._lock:
+            self._apply_pending_locked()
+            if not self._active.any():
+                return {}
+            active_np = self._active.copy()
+            sampling = any(
+                req is not None and active_np[s] and req.temperature > 0
+                for s, req in enumerate(self._slots)
+            )
+            args = (
+                self._tok, self._pos, jnp.asarray(active_np),
+                self._cache, self._temp, self._topk, self._topp,
+                self._keys,
+            )
+        step_fn = self._step_sampling if sampling else self._step_greedy
+        new_tok, cache, pos = step_fn(*args)
+        toks = np.asarray(new_tok)  # [B] ids — the only host transfer
+        with self._lock:
+            self._cache = cache
+            self._pos = pos
+            self._tok = new_tok
+            emitted: Dict[int, int] = {}
+            for slot, req in enumerate(self._slots):
+                if req is None or not active_np[slot]:
+                    continue
+                tok = int(toks[slot])
+                req.tokens.append(tok)
+                emitted[req.rid] = tok
+                if req.finished():
+                    self._finish(slot)
+            self._n_steps += 1
+            self._n_tokens += len(emitted)
+            self._step_time_s += _time.perf_counter() - t0
+            return emitted
+
+    def spec_step(self, k: int = 4, ngram: int = 2) -> Dict[int, int]:
+        """One SPECULATIVE round: every active slot verifies k-1 guessed
+        continuation tokens in one batched forward and commits its
+        accepted prefix plus one bonus token — several tokens per program
+        launch when the guesses land. Proposals are prompt-lookup
+        (n-gram) from each slot's own context (vLLM-style self-drafting:
+        no draft model; models/speculative.py's scheme batched over
+        slots). Exact greedy equivalence with step() by construction —
+        verification IS the greedy model, wrong guesses only waste their
+        verify columns. Falls back to a plain step when speculation
+        can't apply (a sampling slot, a windowed ring cache, a Pallas
+        batcher — its kernel's accumulation order differs from the
+        verify forward's — or no room for a chunk). Returns {rid: last emitted token}; use partials()
+        for the full per-round stream."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with self._step_lock:
             with self._lock:
                 self._apply_pending_locked()
                 if not self._active.any():
@@ -771,29 +934,79 @@ class ContinuousBatcher:
                     req is not None and active_np[s] and req.temperature > 0
                     for s, req in enumerate(self._slots)
                 )
-                args = (
-                    self._tok, self._pos, jnp.asarray(active_np),
-                    self._cache, self._temp, self._topk, self._topp,
-                    self._keys,
-                )
-            step_fn = self._step_sampling if sampling else self._step_greedy
-            new_tok, cache, pos = step_fn(*args)
-            toks = np.asarray(new_tok)  # [B] ids — the only host transfer
+                k_round = 1
+                # pallas batchers fall back too: the verify forward uses
+                # inline XLA attention, whose accumulation order differs
+                # from the Pallas decode kernel's — mixing them inside
+                # one generation would break the exact-equivalence
+                # promise on near-tied logits
+                if (
+                    not self.windowed and not sampling
+                    and self._attn_impl != "pallas"
+                ):
+                    pos_np = np.asarray(self._pos)
+                    room = min(
+                        int(self.max_len - pos_np[s])
+                        for s in range(self.n_slots) if active_np[s]
+                    )
+                    k_round = max(1, min(k, room))
+                if k_round >= 2:
+                    toks_host = np.zeros((self.n_slots, k_round), np.int32)
+                    tok_np = np.asarray(self._tok)
+                    for s, req in enumerate(self._slots):
+                        if req is None or not active_np[s]:
+                            continue
+                        toks_host[s, 0] = tok_np[s]
+                        ctx = np.concatenate(
+                            [req.prompt, np.asarray(req.tokens, np.int32)]
+                        )
+                        toks_host[s, 1:] = ngram_propose(
+                            ctx, k_round - 1, ngram
+                        )
+                    args = (
+                        jnp.asarray(toks_host), self._pos,
+                        jnp.asarray(active_np), self._cache,
+                    )
+            if k_round < 2:
+                # outside self._lock — _plain_step_locked reacquires it
+                return self._plain_step_locked(t0)
+            logits, cache = self._verify(*args)
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, k]
             with self._lock:
                 self._cache = cache
-                self._pos = pos
-                self._tok = new_tok
                 emitted: Dict[int, int] = {}
-                for slot, req in enumerate(self._slots):
-                    if req is None or not active_np[slot]:
+                new_tok = tok_np.copy()
+                new_pos = pos_np.copy()
+                n_emitted = 0
+                accepted = 0
+                for s, req in enumerate(self._slots):
+                    if req is None or not active_np[s]:
                         continue
-                    tok = int(toks[slot])
-                    req.tokens.append(tok)
-                    emitted[req.rid] = tok
+                    m = 1
+                    while (
+                        m < k_round
+                        and greedy[s, m - 1] == toks_host[s, m]
+                    ):
+                        m += 1
+                    accepted += m - 1
+                    planned = [int(t) for t in toks_host[s, 1:m]]
+                    planned.append(int(greedy[s, m - 1]))
+                    for t in planned:
+                        req.tokens.append(t)
+                        emitted[req.rid] = t
+                        n_emitted += 1
+                        if req.finished():
+                            break
+                    new_tok[s] = req.tokens[-1]
+                    new_pos[s] = pos_np[s] + m
                     if req.finished():
-                        self._finish(slot)
+                        self._finish(s)
+                self._tok = self._pin(jnp.asarray(new_tok))
+                self._pos = self._pin(jnp.asarray(new_pos, jnp.int32))
                 self._n_steps += 1
-                self._n_tokens += len(emitted)
+                self._n_tokens += n_emitted
+                self._n_spec_rounds += 1
+                self._n_spec_accepted += accepted
                 self._step_time_s += _time.perf_counter() - t0
                 return emitted
 
@@ -813,6 +1026,8 @@ class ContinuousBatcher:
                     self._n_tokens / self._step_time_s
                     if self._step_time_s > 0 else 0.0
                 ),
+                "spec_rounds": self._n_spec_rounds,
+                "spec_accepted_tokens": self._n_spec_accepted,
                 "slots_occupied": occupied,
                 "slots_free": self.n_slots - occupied,
                 "results_pending_pickup": len(self._done_pool),

@@ -181,19 +181,29 @@ def speculative_generate(
     )
 
 
-def _ngram_propose(context: np.ndarray, k: int) -> np.ndarray:
+def ngram_propose(context: np.ndarray, k: int, ngram: int = 1) -> np.ndarray:
     """Prompt-lookup drafting: find the most recent earlier occurrence of
-    the context's last token and propose the k tokens that followed it.
-    Free (no draft model, no extra forward); worthless proposals cost one
-    verify round that still certifies ≥1 token."""
-    tail = context[-1]
-    # scan backwards, excluding the final position itself
-    idx = np.flatnonzero(context[:-1] == tail)
+    the context's final ``ngram`` tokens and propose the k tokens that
+    followed it. Free (no draft model, no extra forward); worthless
+    proposals cost only their verify columns, which still certify ≥1
+    token. Vectorized rolling-window match — shared by the single-stream
+    generator here and the continuous batcher's spec_step."""
     props = np.zeros((k,), np.int32)
-    if idx.size:
-        cand = context[idx[-1] + 1 : idx[-1] + 1 + k]
+    n = context.shape[0]
+    if n < ngram + 1:
+        return props
+    tail = context[n - ngram:]
+    # windows over context[:-1]: starts 0..n-1-ngram, which excludes the
+    # tail's own start (n-ngram) by construction
+    windows = np.lib.stride_tricks.sliding_window_view(context[:-1], ngram)
+    hits = np.flatnonzero((windows == tail).all(axis=1))
+    if hits.size:
+        cand = context[hits[-1] + ngram : hits[-1] + ngram + k]
         props[: cand.size] = cand
     return props
+
+
+_ngram_propose = ngram_propose  # historical name
 
 
 def ngram_speculative_generate(

@@ -246,3 +246,43 @@ def test_stream_prop_on_sink_covers_early_finishers():
     sink.stop()
     src_el = LlmServerSrc(**{"id": "stream1"})
     src_el.stop()
+
+
+def test_speculate_prop_matches_plain_serving():
+    """tensor_llm_serversink speculate=4 pumps via spec_step — same
+    tokens as the non-speculative pipeline (exact greedy equivalence),
+    with spec rounds visible in the serving stats."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    prompt = np.asarray([3, 4, 3, 4, 3, 4, 3], np.int32)
+
+    def run(srv_id, extra):
+        src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+        sink = LlmServerSink(
+            **{"id": srv_id, "model": "zoo:transformer_lm",
+               "custom": MODEL_OPTS, "n-slots": 1, "max-len": 64,
+               "prompt-len": 16, "max-new-tokens": 8, **extra}
+        )
+        out_src = LlmServerSrc(**{"id": srv_id})
+        out_sink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.chain(out_src, out_sink)
+        p.start()
+        try:
+            src.push(Frame((prompt,), meta={"req": "x"}))
+            src.end_of_stream()
+            f = out_sink.pop(timeout=120)
+            stats = out_src.serving_stats() or {}
+            return [int(t) for t in np.asarray(f.tensors[0])[0]], stats
+        finally:
+            p.stop()
+
+    plain, _ = run("specA", {})
+    spec, stats = run("specB", {"speculate": 4})
+    assert spec == plain
+    assert stats.get("spec_rounds", 0) > 0
