@@ -363,7 +363,10 @@ class TensorCrop(Routing):
             valid = (b[:, 2] > 0) & (b[:, 3] > 0)
             crops = jnp.where(valid[:, None, None, None], crops, 0.0)
             if np.dtype(np_dtype).kind in "ui":
-                crops = jnp.clip(jnp.round(crops), 0, 255)
+                # clip to the dtype's own range: 0..255 would wrap int8
+                # on astype and clamp valid uint16 values above 255
+                info = np.iinfo(np_dtype)
+                crops = jnp.clip(jnp.round(crops), info.min, info.max)
             return crops.astype(np_dtype), b.astype(jnp.int32)
 
         self._jit_crop = jax.jit(fn)

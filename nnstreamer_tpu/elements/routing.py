@@ -60,9 +60,10 @@ class SyncCombiner:
 
     def push(self, pad: int, frame: Frame) -> List[List[Frame]]:
         """Feed one frame; return list of combined frame-groups ready."""
+        if self.mode == "refresh":
+            return self._refresh_push(pad, frame)
         self.queues[pad].append(frame)
-        if self.mode != "refresh":
-            self.last[pad] = frame
+        self.last[pad] = frame
         out = []
         while True:
             group = self._try_combine(pad)
@@ -74,6 +75,8 @@ class SyncCombiner:
     def mark_eos(self, pad: int) -> List[List[Frame]]:
         """A pad reached EOS; release any groups it was gating."""
         self.eos[pad] = True
+        if self.mode == "refresh":
+            return self._refresh_drain()
         out = []
         while True:
             group = self._try_combine(pad)
@@ -81,39 +84,56 @@ class SyncCombiner:
                 return out
             out.append(group)
 
-    def _refresh_combine(self) -> Optional[List[Frame]]:
-        """Deterministic PTS-merged refresh: pads' timelines merge in pts
-        order and one group emits per distinct instant, each pad
-        contributing its newest frame at-or-before that instant. The
-        gate (every pad queued or EOS) mirrors the reference's
-        GstCollectPads discipline — tensor_mux's collected callback only
-        fires once all pads have data — and makes the policy independent
-        of thread arrival order (the executor's streaming threads race;
-        a golden test must not)."""
-        if any(not self.queues[i] and not self.eos[i] for i in range(self.n)):
-            return None
+    def _refresh_primed(self) -> bool:
+        return all(l is not None for l in self.last)
+
+    def _refresh_push(self, pad: int, frame: Frame) -> List[List[Frame]]:
+        """SYNC_REFRESH: once every pad has delivered ("primed"), a new
+        frame on ANY pad emits a group immediately, the other pads
+        contributing their last (possibly stale) frame — the reference
+        marks refresh collect-pads non-waiting
+        (nnstreamer_plugin_api_impl.c SYNC_REFRESH pop/reuse path), so a
+        fast pad is never gated on a slow one and nothing queues after
+        priming (a live mixed-rate mux stays bounded at one frame per
+        pad). Priming itself is PTS-merged lock-step (below) — the one
+        deliberate divergence (docs/PARITY.md): the reference's pre-roll
+        also waits on every pad, but in arrival order; merging by PTS
+        keeps the executor's racing source threads out of golden
+        outputs."""
+        if self._refresh_primed():
+            self.last[pad] = frame
+            return [list(self.last)]
+        self.queues[pad].append(frame)
+        return self._refresh_drain()
+
+    def _refresh_drain(self) -> List[List[Frame]]:
+        """PTS-merged drain of queued (pre-priming) frames: pads'
+        timelines merge in pts order, one group per distinct instant,
+        each pad contributing its newest frame at-or-before that
+        instant. Instants before every pad has delivered produce no
+        output (priming); once primed, remaining queued frames emit
+        per-instant without any gate."""
+        out: List[List[Frame]] = []
         while True:
+            if not self._refresh_primed() and any(
+                not self.queues[i] and not self.eos[i] for i in range(self.n)
+            ):
+                return out  # still priming and a pad may yet deliver
             heads = [
                 (-1 if q[0].pts is None else q[0].pts, i)
                 for i, q in enumerate(self.queues)
                 if q
             ]
             if not heads:
-                return None
+                return out
             t = min(h[0] for h in heads)
             for pts, i in heads:
                 if pts == t:
                     self.last[i] = self.queues[i].popleft()
-            if all(l is not None for l in self.last):
-                return list(self.last)
-            # priming: frames before every pad has delivered produce no
-            # output — keep merging
-            if any(not self.queues[i] and not self.eos[i] for i in range(self.n)):
-                return None
+            if self._refresh_primed():
+                out.append(list(self.last))
 
     def _try_combine(self, trigger_pad: int) -> Optional[List[Frame]]:
-        if self.mode == "refresh":
-            return self._refresh_combine()
         if any(not q for q in self.queues):
             return None
         if self.mode == "nosync":

@@ -51,7 +51,7 @@ import numpy as np
 
 from nnstreamer_tpu.models import decode as dec
 from nnstreamer_tpu.models import transformer as tfm
-from nnstreamer_tpu.models.speculative import ngram_propose
+from nnstreamer_tpu.models.speculative import ngram_lookup
 
 
 def quantize_kv(t):
@@ -599,8 +599,11 @@ class ContinuousBatcher:
                 jax.lax.dynamic_update_slice(stage[1], vs, (0, 0, 0, 0, 0)),
             )
         )
-        # registered shared prefixes: id → ((ck, cv) trimmed to plen, plen)
-        self._prefixes: Dict[int, Tuple[Tuple[jax.Array, jax.Array], int]] = {}
+        # registered shared prefixes:
+        # id → ((ck, cv) trimmed to plen, plen, prefix tokens)
+        self._prefixes: Dict[
+            int, Tuple[Tuple[jax.Array, jax.Array], int, np.ndarray]
+        ] = {}
         self._next_prefix = 0
         self._n_steps = 0
         self._n_tokens = 0
@@ -647,7 +650,10 @@ class ContinuousBatcher:
         whole prompt, one bucket per windowed_chunk call (exact sliding-
         window attention — decode.windowed_chunk). Returns (final
         chunk's logits, ring (ks, vs), last-row index)."""
-        P = self.prompt_len  # max_len % P == 0 enforced at construction
+        # submit() enforces max_len % P == 0 before any prompt longer
+        # than one bucket reaches here (bucket-sized prompts never chunk,
+        # so unaligned windowed configs stay valid for them)
+        P = self.prompt_len
         ring = (
             jnp.zeros(self._ring_shape, self.compute_dtype),
             jnp.zeros(self._ring_shape, self.compute_dtype),
@@ -692,7 +698,10 @@ class ContinuousBatcher:
         with self._lock:
             pid = self._next_prefix
             self._next_prefix += 1
-            self._prefixes[pid] = (trimmed, plen)
+            # tokens ride along so spec_step's prompt-lookup context
+            # covers the shared prefix too (proposal quality, not
+            # correctness — n-gram matches often live in a system prompt)
+            self._prefixes[pid] = (trimmed, plen, tokens)
         return pid
 
     def unregister_prefix(self, pid: int) -> bool:
@@ -736,11 +745,12 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         plen = 0
         pfx = None
+        pfx_tokens = None
         if prefix is not None:
             with self._lock:
                 if prefix not in self._prefixes:
                     raise ValueError(f"unknown prefix id {prefix}")
-                pfx, plen = self._prefixes[prefix]
+                pfx, plen, pfx_tokens = self._prefixes[prefix]
         if self.windowed and t > self.prompt_len and self.max_len % self.prompt_len:
             # checked before any slot is claimed: ring chunked prefill
             # needs bucket-aligned chunks (a mid-chunk ring wrap would
@@ -779,7 +789,12 @@ class ContinuousBatcher:
                 key=np.asarray(
                     jax.random.PRNGKey(rid if seed is None else seed)
                 ),
-                prompt=prompt,
+                # spec_step's proposal context — the prefix's tokens are
+                # part of the stream the n-gram lookup should mine
+                prompt=(
+                    prompt if pfx_tokens is None
+                    else np.concatenate([pfx_tokens, prompt])
+                ),
             )
             self._slots[slot] = req
 
@@ -919,8 +934,8 @@ class ContinuousBatcher:
         verify columns. Falls back to a plain step when speculation
         can't apply (a sampling slot, a windowed ring cache, a Pallas
         batcher — its kernel's accumulation order differs from the
-        verify forward's — or no room for a chunk). Returns {rid: last emitted token}; use partials()
-        for the full per-round stream."""
+        verify forward's — or no room for a chunk). Returns {rid: last
+        emitted token}; use partials() for the full per-round stream."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -953,6 +968,7 @@ class ContinuousBatcher:
                 if k_round >= 2:
                     toks_host = np.zeros((self.n_slots, k_round), np.int32)
                     tok_np = np.asarray(self._tok)
+                    any_found = False
                     for s, req in enumerate(self._slots):
                         if req is None or not active_np[s]:
                             continue
@@ -960,9 +976,24 @@ class ContinuousBatcher:
                         ctx = np.concatenate(
                             [req.prompt, np.asarray(req.tokens, np.int32)]
                         )
-                        toks_host[s, 1:] = ngram_propose(
-                            ctx, k_round - 1, ngram
-                        )
+                        cand = ngram_lookup(ctx, k_round - 1, ngram)
+                        # -1 sentinel for found-nothing columns: a real
+                        # greedy token (≥ 0) can never match it, so the
+                        # acceptance scan stops at the pending token
+                        # instead of crediting accidental token-0 hits
+                        # (zero-fill is indistinguishable from proposing
+                        # token 0); XLA's gather clamps the embed lookup
+                        toks_host[s, 1:] = -1
+                        if cand is not None and cand.size:
+                            toks_host[s, 1 : 1 + cand.size] = cand
+                            any_found = True
+                    if not any_found:
+                        # no slot proposed anything: the verify forward
+                        # would certify exactly one token per slot at k×
+                        # the column cost — a plain step is the same
+                        # result cheaper
+                        k_round = 1
+                if k_round >= 2:
                     args = (
                         jnp.asarray(toks_host), self._pos,
                         jnp.asarray(active_np), self._cache,

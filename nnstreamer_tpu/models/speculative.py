@@ -181,24 +181,36 @@ def speculative_generate(
     )
 
 
-def ngram_propose(context: np.ndarray, k: int, ngram: int = 1) -> np.ndarray:
-    """Prompt-lookup drafting: find the most recent earlier occurrence of
-    the context's final ``ngram`` tokens and propose the k tokens that
-    followed it. Free (no draft model, no extra forward); worthless
-    proposals cost only their verify columns, which still certify ≥1
-    token. Vectorized rolling-window match — shared by the single-stream
-    generator here and the continuous batcher's spec_step."""
-    props = np.zeros((k,), np.int32)
+def ngram_lookup(
+    context: np.ndarray, k: int, ngram: int = 1
+) -> Optional[np.ndarray]:
+    """Prompt-lookup core: the (up to k) tokens that followed the most
+    recent earlier occurrence of the context's final ``ngram`` tokens —
+    or None when the tail has no earlier occurrence. Callers that batch
+    proposals over slots (ContinuousBatcher.spec_step) use the None to
+    skip verify columns for slots with nothing to propose (a zero-fill
+    would be indistinguishable from genuinely proposing token 0)."""
     n = context.shape[0]
     if n < ngram + 1:
-        return props
+        return None
     tail = context[n - ngram:]
     # windows over context[:-1]: starts 0..n-1-ngram, which excludes the
     # tail's own start (n-ngram) by construction
     windows = np.lib.stride_tricks.sliding_window_view(context[:-1], ngram)
     hits = np.flatnonzero((windows == tail).all(axis=1))
-    if hits.size:
-        cand = context[hits[-1] + ngram : hits[-1] + ngram + k]
+    if not hits.size:
+        return None
+    return context[hits[-1] + ngram : hits[-1] + ngram + k]
+
+
+def ngram_propose(context: np.ndarray, k: int, ngram: int = 1) -> np.ndarray:
+    """Prompt-lookup drafting: ngram_lookup zero-padded to a fixed [k]
+    (the single-stream generator's chunk shape). Free (no draft model,
+    no extra forward); worthless proposals cost only their verify
+    columns, which still certify ≥1 token."""
+    props = np.zeros((k,), np.int32)
+    cand = ngram_lookup(context, k, ngram)
+    if cand is not None:
         props[: cand.size] = cand
     return props
 

@@ -38,7 +38,8 @@ NEG_INF = -1e30
 
 
 def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
-            scale: float, block_k: int, n_k: int, quantized: bool):
+            scale: float, block_k: int, n_k: int, s_len: int,
+            quantized: bool):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -53,7 +54,12 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     k_start = ki * block_k
-    live_len = pos_ref[b] + 1  # positions 0..pos inclusive are attendable
+    # positions 0..pos inclusive are attendable; a windowed ring passes
+    # ABSOLUTE pos, so after a wrap pos+1 exceeds the cache length and
+    # every row is live — clamp to the static cache length so the tail
+    # block's pad columns (cols in [s_len, n_k*block_k)) stay masked
+    # instead of streaming pad garbage into the softmax.
+    live_len = jnp.minimum(pos_ref[b] + 1, s_len)
 
     @pl.when(k_start < live_len)
     def _block():
@@ -138,7 +144,8 @@ def decode_attention(
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bk, n_k = _pick_block(s_len, block_k)
     kernel = functools.partial(
-        _kernel, scale=scale, block_k=bk, n_k=n_k, quantized=quantized
+        _kernel, scale=scale, block_k=bk, n_k=n_k, s_len=s_len,
+        quantized=quantized,
     )
 
     from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets

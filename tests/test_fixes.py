@@ -106,3 +106,72 @@ def test_platform_pin_falls_back_when_relay_dead(monkeypatch):
     import os
 
     assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+
+def test_device_crop_clips_to_dtype_range():
+    """Integer crop outputs clip to the DTYPE's range, not 0..255 —
+    0..255 would wrap int8 on astype and clamp valid uint16 values
+    (ADVICE r3)."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.elements.control import TensorCrop
+    from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+    for dt, lo, hi in (("int8", -128, 127), ("uint16", 0, 65535)):
+        crop = TensorCrop(**{"out-size": "2:2", "max-crops": 1})
+        crop.negotiate(
+            [
+                TensorsSpec.from_strings("3:8:8:1", dt),
+                TensorsSpec.from_strings("4:1", "uint32"),
+            ]
+        )
+        # a bright uint16 image must survive >255; a negative int8 image
+        # must keep its sign (the old clip(0,255) floor zeroed it)
+        fill = 300.0 if dt == "uint16" else -100.0
+        img = jnp.full((1, 8, 8, 3), fill, dt)
+        boxes = jnp.asarray([[0, 0, 4, 4]], jnp.float32)
+        crops, _ = crop._jit_crop(img, boxes)
+        assert crops.dtype == np.dtype(dt)
+        vals = np.asarray(crops)
+        if dt == "uint16":
+            assert vals.max() == 300  # preserved, not clamped to 255
+        else:
+            assert vals.min() == -100  # preserved, not floored at 0
+
+
+def test_ngram_lookup_distinguishes_no_match():
+    """ngram_lookup returns None (not zeros) when the context tail has
+    no earlier occurrence — spec_step uses this to skip wasted verify
+    columns (ADVICE r3)."""
+    from nnstreamer_tpu.models.speculative import ngram_lookup, ngram_propose
+
+    ctx = np.asarray([5, 6, 7, 8], np.int32)  # tail [8] appears once only
+    assert ngram_lookup(ctx, 3, 1) is None
+    assert list(ngram_propose(ctx, 3, 1)) == [0, 0, 0]  # padded form
+    rep = np.asarray([1, 2, 9, 1, 2], np.int32)  # tail [2] seen earlier
+    got = ngram_lookup(rep, 2, 1)
+    assert got is not None and list(got) == [9, 1]
+
+
+def test_spec_context_includes_prefix_tokens():
+    """submit(prefix=id) requests carry the PREFIX tokens in their
+    spec_step proposal context (ADVICE r3: n-gram matches often live in
+    the shared system prompt)."""
+    import jax
+
+    from nnstreamer_tpu.models import transformer as tfm
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    params = tfm.init_params(
+        jax.random.PRNGKey(0), vocab=64, d_model=32, n_heads=2, n_layers=1
+    )
+    cb = ContinuousBatcher(params, 2, n_slots=1, max_len=64, prompt_len=8)
+    pfx_toks = np.asarray([3, 4, 5, 6, 7, 9, 11, 13], np.int32)
+    pid = cb.register_prefix(pfx_toks)
+    rid = cb.submit(np.asarray([1, 2], np.int32), 2, prefix=pid)
+    (req,) = [r for r in cb._slots if r is not None] or [
+        p.req for p in cb._pending
+    ]
+    assert list(req.prompt[: len(pfx_toks)]) == list(pfx_toks)
+    while cb.result(rid) is None:
+        cb.spec_step(k=3)

@@ -167,11 +167,15 @@ PIPELINES = {
         "tensor_mux name=mux sync-mode=nosync ! "
         "tensor_demux tensorpick=0:1 ! filesink location={out}"
     ),
-    # refresh policy: emit on every new frame, reusing the other pad's last
+    # refresh policy: emit on every new frame, reusing the other pad's
+    # last. The slow pad contributes a SINGLE frame so every thread
+    # interleaving yields identical bytes (live refresh is arrival-
+    # driven; with one slow-pad frame at pts 0, priming plus stale reuse
+    # gives the same 4 groups in any order — see test_routing.py)
     "mux_refresh": (
         "videotestsrc pattern=counter num-frames=4 width=4 height=4 "
         "framerate=20/1 ! tensor_converter ! mux.sink_0 "
-        "videotestsrc pattern=gradient num-frames=2 width=4 height=4 "
+        "videotestsrc pattern=gradient num-frames=1 width=4 height=4 "
         "framerate=10/1 ! tensor_converter ! mux.sink_1 "
         "tensor_mux name=mux sync-mode=refresh ! filesink location={out}"
     ),

@@ -99,6 +99,30 @@ class TestDecodeAttention:
             np.asarray(out), np.asarray(self._ref(q, ck, cv, pos)), atol=2e-5
         )
 
+    @pytest.mark.parametrize("s_len,block_k", [(200, 128), (33, 16)])
+    def test_windowed_wrap_absolute_pos(self, s_len, block_k):
+        """After a ring wrap the batcher passes ABSOLUTE pos (pos+1 >
+        s_len, the all-live saturation); with a non-dividing cache
+        length the tail block's pad columns must stay masked — the
+        kernel clamps live_len to the static cache length (ADVICE r3:
+        unclamped, pad columns in [s_len, n_k*block_k) read garbage
+        K/V into the softmax)."""
+        from nnstreamer_tpu.ops.pallas.decode_attention import decode_attention
+
+        rng = np.random.default_rng(7)
+        b, h, d = 2, 2, 16
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        ck = jnp.asarray(rng.standard_normal((b, s_len, h, d)), jnp.float32)
+        cv = jnp.asarray(rng.standard_normal((b, s_len, h, d)), jnp.float32)
+        # wrapped: absolute positions far past the cache length, and one
+        # exactly at the wrap boundary
+        pos = jnp.asarray([s_len, 3 * s_len + 7], jnp.int32)
+        out = decode_attention(q, ck, cv, pos, block_k=block_k, interpret=True)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(q, ck, cv, pos)), atol=2e-5
+        )
+
     def test_bfloat16_cache(self):
         from nnstreamer_tpu.ops.pallas.decode_attention import decode_attention
 

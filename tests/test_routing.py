@@ -66,27 +66,56 @@ class TestMux:
         assert [fr.pts for fr in groups[0]] == [100_000_000, 50_000_000]
 
     def test_refresh_policy(self):
-        """Deterministic PTS-merged refresh (r3): groups emit per distinct
-        timeline instant once every pad is queued or EOS (the reference's
-        GstCollectPads gate) — output no longer depends on which streaming
-        thread happened to arrive first."""
+        """Live SYNC_REFRESH (r4, reference non-waiting collect pads):
+        after PTS-merged priming, a new frame on ANY pad emits a group
+        immediately, other pads reusing their last frame — a fast pad is
+        never gated on a slow one and nothing queues after priming."""
         comb = SyncCombiner("refresh", "", 2)
         f = lambda pts: Frame((np.zeros(1),), pts=pts)
-        assert comb.push(0, f(0)) == []
+        assert comb.push(0, f(0)) == []  # priming: pad1 not yet delivered
         g = comb.push(1, f(0))
         assert len(g) == 1
         assert [fr.pts for fr in g[0]] == [0, 0]
-        # new frame on pad1 only: gated until pad0 is queued or EOS (we
-        # cannot yet know pad0 won't deliver an earlier instant)
-        assert comb.push(1, f(10)) == []
-        # pad0 delivers pts 5 < 10 → instant 5 emits with pad1's stale 0
+        # primed: pad1's new frame emits immediately with pad0's stale 0
+        g = comb.push(1, f(10))
+        assert len(g) == 1
+        assert [fr.pts for fr in g[0]] == [0, 10]
+        # pad0 delivers pts 5 → emits with pad1's newest (10); refresh is
+        # arrival-driven, not timeline-merged, once live
         g = comb.push(0, f(5))
         assert len(g) == 1
-        assert [fr.pts for fr in g[0]] == [5, 0]
-        # pad0 EOS releases the gated instant 10 (pad0 reuses its last)
-        g = comb.mark_eos(0)
-        assert len(g) == 1
         assert [fr.pts for fr in g[0]] == [5, 10]
+        # nothing queued after priming: EOS has nothing to release
+        assert comb.mark_eos(0) == []
+
+    def test_refresh_fast_pad_never_gated(self):
+        """The r3 regression case: a fast pad with a stalled slow peer
+        must keep emitting (and must not queue unboundedly)."""
+        comb = SyncCombiner("refresh", "", 2)
+        f = lambda pts: Frame((np.zeros(1),), pts=pts)
+        comb.push(0, f(0))
+        comb.push(1, f(0))  # primed
+        for k in range(1, 50):  # slow pad silent from here on
+            g = comb.push(0, f(k * 10))
+            assert len(g) == 1
+            assert [fr.pts for fr in g[0]] == [k * 10, 0]
+        assert all(not q for q in comb.queues)  # nothing buffered
+
+    def test_refresh_priming_is_pts_merged(self):
+        """Pre-priming frames queue and drain deterministically in PTS
+        order regardless of arrival interleaving (golden-test guarantee;
+        divergence from the reference's arrival-order pre-roll is
+        documented in docs/PARITY.md)."""
+        comb = SyncCombiner("refresh", "", 2)
+        f = lambda pts: Frame((np.zeros(1),), pts=pts)
+        # pad0 races ahead with 3 frames before pad1's first
+        assert comb.push(0, f(0)) == []
+        assert comb.push(0, f(10)) == []
+        assert comb.push(0, f(20)) == []
+        g = comb.push(1, f(0))
+        assert [[fr.pts for fr in grp] for grp in g] == [
+            [0, 0], [10, 0], [20, 0]
+        ]
 
     def test_mux_in_description(self):
         p = parse_pipeline(
