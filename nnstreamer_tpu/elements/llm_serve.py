@@ -86,7 +86,8 @@ class _LlmServer:
 
     def __init__(self, model: str, options: Dict[str, str], n_slots: int,
                  max_len: int, prompt_len: int, default_new: int,
-                 stream: bool = False, speculate: int = 0):
+                 stream: bool = False, speculate: int = 0,
+                 speculate_model: str = ""):
         from nnstreamer_tpu.models import zoo
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
@@ -97,9 +98,37 @@ class _LlmServer:
             )
         m = zoo.get(model[len("zoo:"):], **options)
         n_heads = int(options.get("n_heads", 8))
+        draft_kw = {}
+        if speculate_model and speculate < 2:
+            # a draft model exists ONLY to propose speculate=k chunks;
+            # without this, every request would pay the draft prefill
+            # for a proposer the plain-step pump never consults
+            speculate = 4
+        if speculate_model:
+            # speculate-model=zoo:<name>: a draft model proposes the
+            # speculate=k chunks instead of prompt-lookup. Its config
+            # rides in the same custom dict under draft_-prefixed keys
+            # (draft_d_model, draft_n_layers, draft_n_heads, ...); the
+            # vocab must match the target's.
+            if not speculate_model.startswith("zoo:"):
+                raise ElementError(
+                    f"tensor_llm_serversink: speculate-model must be "
+                    f"zoo:<name>, got {speculate_model!r}"
+                )
+            d_opts = {
+                k[len("draft_"):]: v for k, v in options.items()
+                if k.startswith("draft_")
+            }
+            if "vocab" in options and "vocab" not in d_opts:
+                d_opts["vocab"] = options["vocab"]
+            dm = zoo.get(speculate_model[len("zoo:"):], **d_opts)
+            draft_kw = dict(
+                draft_params=dm.params,
+                draft_n_heads=int(d_opts.get("n_heads", 8)),
+            )
         self.cb = ContinuousBatcher(
             m.params, n_heads, n_slots=n_slots, max_len=max_len,
-            prompt_len=prompt_len,
+            prompt_len=prompt_len, **draft_kw,
         )
         self.default_new = default_new
         self._lock = threading.Lock()
@@ -212,7 +241,15 @@ class LlmServerSink(Sink):
     Props: id (pairing key), model (zoo:transformer_lm), custom
     (model options, filter-style "k:v,k2:v2"), n-slots, max-len,
     prompt-len, max-new-tokens (per-request default; per-frame
-    ``max_new_tokens`` meta overrides)."""
+    ``max_new_tokens`` meta overrides), stream (one frame per NEW
+    token then a done frame), speculate (=k: pump via spec_step —
+    prompt-lookup speculation batched over slots, working across
+    sampling/windowed/Pallas configurations), speculate-model
+    (zoo:<name>: a DRAFT model proposes the speculate=k chunks instead
+    of prompt-lookup; configure it with draft_-prefixed keys in the
+    custom dict, e.g. draft_d_model/draft_n_layers/draft_n_heads —
+    vocab is inherited from the target; implies speculate=4 when
+    speculate is unset)."""
 
     FACTORY_NAME = "tensor_llm_serversink"
 
@@ -237,6 +274,7 @@ class LlmServerSink(Sink):
             default_new=int(self.get_property("max-new-tokens", 16)),
             stream=_parse_bool(self.get_property("stream", False)),
             speculate=int(self.get_property("speculate", 0)),
+            speculate_model=str(self.get_property("speculate-model", "")),
         )
         self._server: Optional[_LlmServer] = None
 

@@ -276,19 +276,242 @@ def batched_verify_step(
     return logits, cache_out
 
 
-def sample_tokens(logits, temp, top_k, top_p, keys):
-    """Per-slot token selection INSIDE the step program.
+def batched_windowed_verify(
+    params: Dict,
+    toks,
+    pos,
+    active,
+    cache,
+    n_heads: int,
+    compute_dtype=jnp.float32,
+):
+    """Per-slot k-chunk scoring against a RING cache WITHOUT writing it.
 
-    logits [B, V] f32; temp [B] f32 (≤ 0 → greedy); top_k [B] int32
-    (0 → disabled); top_p [B] f32 (1.0 → disabled; the nucleus keeps the
-    smallest most-probable set with mass ≥ top_p, boundary token
-    included); keys [B, 2] uint32 per-slot PRNG keys → tok [B] int32.
-    Everything is branch-free so one compiled program serves any mix of
-    greedy and sampling slots — and only [B] token ids ever cross to the
-    host, never the [B, V] logits (at a 32k–128k vocab that transfer is
-    megabytes per step)."""
+    The windowed sibling of batched_verify_step. In-place chunk writes
+    on a ring would clobber live history: column j's row (pos+j) % W
+    still holds absolute position pos+j-W, which stays inside the
+    attention window of every query before pos+j — so the forward runs
+    against the PRE-write ring concatenated with the chunk's own fresh
+    K/V (decode.windowed_chunk's formulation, generalized to per-slot
+    positions), and returns the chunk K/V for commit_ring_chunk to
+    write AFTER acceptance is known (only accepted columns land, so
+    rejected proposals never destroy window content).
+
+    toks [B, k], pos [B] (absolute fill), ring cache [L, B, W, KV, Dh]
+    (float, or the int8 ((ck8, ksc), (cv8, vsc)) layout) →
+    (logits [B, k, V] f32, chunk_ks [L, B, k, KV, Dh],
+    chunk_vs [L, B, k, KV, Dh]) — chunk K/V in compute dtype.
+
+    Masking (per slot b, query row i at absolute p = pos_b + i):
+    ring row s last held absolute position pos_b - 1 - d where
+    d = (wp_b - 1 - s) mod W (wp_b = pos_b % W); it is attendable iff
+    written (d ≤ pos_b - 1) and inside the window (d ≤ W - 2 - i).
+    Chunk rows are causal (j ≤ i; k ≤ W keeps them all in-window)."""
+    quantized = isinstance(cache[0], tuple)
+    ring_k = cache[0][0] if quantized else cache[0]
+    W = ring_k.shape[2]
+    b, k = toks.shape
+    x = tfm.embed_lookup(params["embed"], toks, compute_dtype)  # [B,k,D]
+    positions = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    wp = pos % W  # [B]
+    row = jnp.arange(k, dtype=jnp.int32)
+    d = (wp[:, None] - 1 - jnp.arange(W, dtype=jnp.int32)[None, :]) % W
+    ring_mask = (
+        d[:, None, :]
+        <= jnp.minimum(pos[:, None] - 1, W - 2 - row[None, :])[:, :, None]
+    )  # [B, k, W]
+    chunk_mask = jnp.broadcast_to(
+        row[None, None, :] <= row[None, :, None], (b, k, k)
+    )
+    mask = jnp.concatenate([ring_mask, chunk_mask], axis=2)  # [B, k, W+k]
+
+    def body(carry, layer):
+        x = carry
+        if quantized:
+            blk, ck8, ksc, cv8, vsc = layer
+            ck = dequantize_kv(ck8, ksc)
+            cv = dequantize_kv(cv8, vsc)
+        else:
+            blk, ck, cv = layer
+        q, kk, v = tfm.block_qkv(x, blk, n_heads, positions)
+        if quantized:
+            # attend the quantize→dequantize roundtrip of the fresh
+            # chunk K/V — exactly what a plain int8 step attends after
+            # its pre-attention cache write, so greedy spec rounds stay
+            # byte-identical to plain int8 stepping (commit re-quantizes
+            # the raw K/V, which lands the same int8 payload)
+            ka = dequantize_kv(*quantize_kv(kk)).astype(kk.dtype)
+            va = dequantize_kv(*quantize_kv(v)).astype(v.dtype)
+        else:
+            ka, va = kk, v
+        o = tfm.cache_attention(
+            q,
+            jnp.concatenate([ck.astype(kk.dtype), ka], axis=1),
+            jnp.concatenate([cv.astype(v.dtype), va], axis=1),
+            mask,
+        )
+        o = o.astype(x.dtype).reshape(b, k, -1)
+        x = x + o @ tfm.wt(blk["wo"], x.dtype)
+        x = tfm.block_ffn(x, blk)
+        return x, (kk, v)
+
+    if quantized:
+        (ck8, ksc), (cv8, vsc) = cache
+        xs = (params["blocks"], ck8, ksc, cv8, vsc)
+    else:
+        xs = (params["blocks"],) + tuple(cache)
+    x, (chunk_ks, chunk_vs) = jax.lax.scan(body, x, xs)
+    x = tfm.rmsnorm(x, params["ln_f"])
+    logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
+    return logits, chunk_ks, chunk_vs
+
+
+def commit_ring_chunk(cache, chunk_ks, chunk_vs, pos, n_commit, active):
+    """Write the first ``n_commit[b]`` chunk columns into the ring at
+    rows (pos_b + j) % W, gated on ``active`` — the post-acceptance
+    commit paired with batched_windowed_verify (only certified columns
+    may overwrite window history). Handles the per-column ring wrap
+    (unlike the contiguous prefill write, a decode-time chunk may start
+    anywhere in the ring). Quantizes when the cache is int8."""
+    quantized = isinstance(cache[0], tuple)
+    ring_k = cache[0][0] if quantized else cache[0]
+    W = ring_k.shape[2]
+    k = chunk_ks.shape[2]
+
+    def write_col(c, col, rows, keep):
+        """c [L,B,W,...] ← col [L,B,...] at per-slot ring row, gated."""
+        cb = jnp.moveaxis(c, 1, 0)  # [B, L, W, ...]
+        nb = jnp.moveaxis(col[:, :, None], 1, 0)  # [B, L, 1, ...]
+        start = (0,) * (cb.ndim - 2)
+        written = jax.vmap(
+            lambda cs, ns, r: jax.lax.dynamic_update_slice(
+                cs, ns.astype(cs.dtype), (0, r) + start[1:]
+            )
+        )(cb, nb, rows)
+        gate = keep.reshape((-1,) + (1,) * (cb.ndim - 1))
+        return jnp.moveaxis(jnp.where(gate, written, cb), 0, 1)
+
+    for j in range(k):
+        rows = (pos + j) % W
+        keep = active & (j < n_commit)
+        kj = chunk_ks[:, :, j]  # [L, B, KV, Dh]
+        vj = chunk_vs[:, :, j]
+        if quantized:
+            (ck8, ksc), (cv8, vsc) = cache
+            k8, ks = quantize_kv(kj)
+            v8, vs = quantize_kv(vj)
+            cache = (
+                (write_col(ck8, k8, rows, keep),
+                 write_col(ksc, ks, rows, keep)),
+                (write_col(cv8, v8, rows, keep),
+                 write_col(vsc, vs, rows, keep)),
+            )
+        else:
+            ck, cv = cache
+            cache = (
+                write_col(ck, kj, rows, keep),
+                write_col(cv, vj, rows, keep),
+            )
+    return cache
+
+
+def spec_accept(logits, toks, temp, topk, topp, keys, pos, sampling: bool):
+    """Device-side acceptance for one speculative round.
+
+    logits [B, k, V] (column j conditioned on toks[:, :j+1]), toks
+    [B, k] (column 0 = the pending token, columns 1.. = proposals; -1
+    marks a no-proposal column), per-slot sampling params, base keys
+    [B, 2], pos [B] → (m [B] int32, final [B] int32). ``m`` is the
+    count of committed chunk columns (1 + accepted proposals); the
+    round emits toks[:, 1:m] then ``final``.
+
+    Greedy slots (temp ≤ 0) accept while the previous column's argmax
+    equals the proposal — byte-identical to plain step()s by
+    construction. Sampling slots use point-mass rejection sampling
+    (Leviathan et al. with a deterministic draft): accept proposal x
+    with probability p̃(x) under the SAME filtered distribution
+    sample_tokens draws from, else resample from the renormalized
+    remainder (p̃ with x removed) — every emitted token is distributed
+    exactly as a plain sampling step's, though the stream is keyed
+    per (seed, fill, draw) rather than (seed, fill), so it is
+    distribution-exact, not byte-identical, to step() output.
+    ``sampling`` is a static flag: the greedy-only program compiles
+    without the filtering/PRNG work."""
+    b, k, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k]
+    if not sampling:
+        props = toks[:, 1:]  # [B, k-1]
+        match = props == greedy[:, :-1]
+        # m-1 = length of the accepted prefix of proposals
+        acc_len = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        m = 1 + acc_len.astype(jnp.int32)
+        final = jnp.take_along_axis(greedy, (m - 1)[:, None], axis=1)[:, 0]
+        return m, final
+
+    is_sampling = temp > 0  # [B] — mixed batches certify per slot
+    logits_t = jnp.moveaxis(logits, 1, 0)  # [k, B, V]
+    toks_t = toks.T  # [k, B]
+
+    def col(carry, xs):
+        m, done, final = carry
+        j, lg, prop = xs  # column j ∈ 1..k-1; lg = logits[:, j-1]
+        greedy_col = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        valid = prop >= 0
+        kj = jax.vmap(jax.random.fold_in)(keys, pos + j)
+        k_acc = jax.vmap(jax.random.fold_in)(kj, jnp.ones((b,), jnp.int32))
+        k_res = jax.vmap(jax.random.fold_in)(
+            kj, jnp.full((b,), 2, jnp.int32)
+        )
+        filt = _filtered_logits(lg, temp, topk, topp)
+        probs = jax.nn.softmax(filt, axis=-1)
+        p_prop = jnp.take_along_axis(
+            probs, jnp.clip(prop, 0, v - 1)[:, None], axis=-1
+        )[:, 0]
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(k_acc)
+        acc = jnp.where(is_sampling, u < p_prop, greedy_col == prop) & valid
+        # rejection final: residual distribution (p̃ minus the point
+        # mass) for a real proposal; a plain p̃ sample for a
+        # no-proposal column (that column IS a plain step)
+        residual = jnp.where(
+            jax.nn.one_hot(jnp.clip(prop, 0, v - 1), v, dtype=bool)
+            & valid[:, None],
+            -jnp.inf,
+            filt,
+        )
+        resampled = jax.vmap(jax.random.categorical)(
+            k_res, residual
+        ).astype(jnp.int32)
+        final_rej = jnp.where(is_sampling, resampled, greedy_col)
+        rejecting = (~done) & (~acc)
+        final = jnp.where(rejecting, final_rej, final)
+        m = m + ((~done) & acc).astype(jnp.int32)
+        done = done | rejecting
+        return (m, done, final), None
+
+    init = (
+        jnp.ones((b,), jnp.int32),
+        jnp.zeros((b,), bool),
+        jnp.zeros((b,), jnp.int32),
+    )
+    (m, done, final), _ = jax.lax.scan(
+        col,
+        init,
+        (jnp.arange(1, k, dtype=jnp.int32), logits_t[:-1], toks_t[1:]),
+    )
+    # full acceptance: bonus token from the last column at fill pos+k
+    kb = jax.vmap(jax.random.fold_in)(keys, pos + k)
+    k_bonus = jax.vmap(jax.random.fold_in)(kb, jnp.ones((b,), jnp.int32))
+    bonus = sample_tokens(logits[:, k - 1], temp, topk, topp, k_bonus)
+    return m, jnp.where(done, final, bonus)
+
+
+def _filtered_logits(logits, temp, top_k, top_p):
+    """Temperature-scaled, top-k/top-p-filtered logits [B, V] — the
+    distribution every sampling decision (plain step, speculative
+    acceptance, rejection resample) draws from, factored out so the
+    speculative path certifies against EXACTLY what sample_tokens would
+    have sampled."""
     v = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
     # top-k: threshold at the k-th largest value per row where enabled
     desc = jnp.sort(scaled, axis=-1)[:, ::-1]
@@ -304,9 +527,24 @@ def sample_tokens(logits, temp, top_k, top_p, keys):
     cutoff = jnp.take_along_axis(
         sp, jnp.clip(n_keep - 1, 0, v - 1)[:, None], axis=-1
     )
-    scaled = jnp.where(
+    return jnp.where(
         (top_p < 1.0)[:, None] & (probs < cutoff), -jnp.inf, scaled
     )
+
+
+def sample_tokens(logits, temp, top_k, top_p, keys):
+    """Per-slot token selection INSIDE the step program.
+
+    logits [B, V] f32; temp [B] f32 (≤ 0 → greedy); top_k [B] int32
+    (0 → disabled); top_p [B] f32 (1.0 → disabled; the nucleus keeps the
+    smallest most-probable set with mass ≥ top_p, boundary token
+    included); keys [B, 2] uint32 per-slot PRNG keys → tok [B] int32.
+    Everything is branch-free so one compiled program serves any mix of
+    greedy and sampling slots — and only [B] token ids ever cross to the
+    host, never the [B, V] logits (at a 32k–128k vocab that transfer is
+    megabytes per step)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = _filtered_logits(logits, temp, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
     return jnp.where(temp > 0, sampled, greedy)
 
@@ -373,6 +611,113 @@ class _PendingInsert:
     first_tok: int
     fill: int  # cache fill level (= absolute position count)
     req: _Request
+    draft_kv: Optional[Tuple[jax.Array, jax.Array]] = None
+
+
+class _DraftEngine:
+    """Batched draft-model proposer for spec_step: ONE small model
+    stepping ALL active slots greedily k-1 times per round, with its own
+    slot cache mirroring the target's per-slot positions — draft-model
+    speculation at serving scale (the single-stream analogue is
+    models/speculative.speculative_generate; the acceptance logic is the
+    shared spec_accept, since a greedy draft is a point-mass proposer
+    exactly like prompt lookup).
+
+    Rollback is positional, like the target's: after a round the caller
+    resumes from the target's accepted pos — accepted positions hold the
+    draft's own proposals (it wrote them while proposing), and rejected
+    positions are overwritten before any mask reaches them. That
+    invariant needs a LINEAR cache: on a ring, rejected draft writes
+    would clobber live window history (the target survives this by
+    verifying pre-write and committing post-acceptance; a draft gains
+    nothing from that machinery, so windowed servers use prompt-lookup
+    instead — enforced at construction)."""
+
+    def __init__(self, params, n_heads, n_slots, max_len, prompt_len,
+                 compute_dtype):
+        self.params = params
+        self.n_heads = n_heads
+        self.prompt_len = prompt_len
+        self.max_len = max_len
+        self.compute_dtype = compute_dtype
+        L, d = params["blocks"]["ln1"].shape
+        hd = d // n_heads
+        kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, n_heads)
+        self._cache = (
+            jnp.zeros((L, n_slots, max_len, kv, hd), compute_dtype),
+            jnp.zeros((L, n_slots, max_len, kv, hd), compute_dtype),
+        )
+        stage_len = (-(-max_len // prompt_len) + 1) * prompt_len
+        self._stage_shape = (L, 1, stage_len, kv, hd)
+        self._advance = jax.jit(
+            lambda toks, cpos, cache: dec.verify_chunk(
+                params, toks, cpos, cache, n_heads,
+                compute_dtype=compute_dtype, return_logits=False,
+            )[1]
+        )
+        self._insert = jax.jit(insert_slot)
+
+        def step(tok, pos, active, cache):
+            logits, cache, pos2 = batched_decode_step(
+                params, tok, pos, active, cache, n_heads, compute_dtype
+            )
+            return jnp.argmax(logits, -1).astype(jnp.int32), cache, pos2
+
+        self._step = jax.jit(step)
+
+    def prefill_tokens(self, tokens: np.ndarray):
+        """Draft-prefill a request's FULL context (prefix + prompt) in
+        prompt_len buckets → (ks, vs) [L, 1, max_len, KV, Dh] ready for
+        insert_slot. No logits: the first pending token is the target's,
+        the draft only ever continues from certified tokens."""
+        P = self.prompt_len
+        t = tokens.shape[0]
+        stage = (
+            jnp.zeros(self._stage_shape, self.compute_dtype),
+            jnp.zeros(self._stage_shape, self.compute_dtype),
+        )
+        cpos = 0
+        while cpos < t:
+            n = min(P, t - cpos)
+            chunk = np.zeros((1, P), np.int32)
+            chunk[0, :n] = tokens[cpos : cpos + n]
+            stage = self._advance(
+                jnp.asarray(chunk), jnp.asarray(cpos, jnp.int32), stage
+            )
+            cpos += n
+        return stage[0][:, :, : self.max_len], stage[1][:, :, : self.max_len]
+
+    def admit(self, slot: int, draft_kv) -> None:
+        self._cache = self._insert(self._cache, *draft_kv, slot)
+
+    def propose(self, tok, pos, active, k: int) -> np.ndarray:
+        """k sequential greedy draft steps from the pending tokens →
+        proposals [B, k-1] (np; the k-th emission is discarded). Each
+        step is one batched forward over all slots; the draft cache
+        advances in place — accepted positions keep these very writes,
+        rejected ones are overwritten next round. The extra step exists
+        for its WRITE, not its emission: on full acceptance the last
+        proposal's K/V must be in the cache (position pos+k-1), or the
+        next round would attend an unwritten hole there (the same
+        invariant as the single-stream _draft_k scan)."""
+        cache = self._cache
+        cur, p = tok, pos
+        props = []
+        for _ in range(k):
+            cur, cache, p = self._step(cur, p, active, cache)
+            props.append(cur)
+        self._cache = cache
+        return np.stack([np.asarray(c) for c in props[: k - 1]], axis=1)
+
+    def advance_one(self, tok, pos, active) -> None:
+        """Write the pending tokens' K/V into the draft cache WITHOUT
+        proposing — the sync path for rounds the target advances by a
+        plain step (no chunk room, nothing proposed, or a direct
+        step() call on a draft batcher). Skipping it would leave
+        permanent holes at the plain-stepped positions: every later
+        propose() would condition on garbage K/V there and acceptance
+        would silently collapse for the rest of the generation."""
+        _, self._cache, _ = self._step(tok, pos, active, self._cache)
 
 
 class ContinuousBatcher:
@@ -398,6 +743,8 @@ class ContinuousBatcher:
         mesh=None,
         slots_axis: str = "dp",
         windowed: bool = False,
+        draft_params: Optional[Dict] = None,
+        draft_n_heads: Optional[int] = None,
     ):
         """``windowed=True`` makes max_len a sliding attention window
         over a ring-buffer cache: generations AND prompts of any length
@@ -409,11 +756,25 @@ class ContinuousBatcher:
         cache_dtype="int8" (the kernel takes the scale operands and
         dequantizes in VMEM), with mesh= (the step program is wrapped in
         shard_map over the slot axis, so each device runs the kernel on
-        its local slots), and with windowed=True."""
+        its local slots), and with windowed=True.
+
+        ``draft_params`` plugs a DRAFT MODEL into spec_step: instead of
+        prompt-lookup, a small model proposes k-1 tokens per slot per
+        round (k-1 cheap batched forwards), verified by the same chunked
+        target forward and accepted by the same point-mass logic — the
+        serving-scale form of models/speculative.speculative_generate.
+        The draft must share the target's vocabulary; linear caches only
+        (windowed servers use prompt-lookup — see _DraftEngine)."""
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
         if cache_dtype not in ("auto", "int8"):
             raise ValueError(f"unknown cache_dtype {cache_dtype!r}")
+        if draft_params is not None and windowed:
+            raise ValueError(
+                "draft speculation needs an unwindowed cache: rejected "
+                "draft writes would clobber ring window history "
+                "(prompt-lookup speculation covers windowed servers)"
+            )
         quantized_cache = cache_dtype == "int8"
         if attn_impl == "pallas":
             from nnstreamer_tpu.ops.pallas.decode_attention import (
@@ -586,12 +947,46 @@ class ContinuousBatcher:
             )[0]
         )
         self._insert = jax.jit(insert_slot)
-        # speculative verify: per-slot k-chunk scoring (spec_step); jit
-        # caches one program per distinct chunk width
-        self._verify = jax.jit(
-            lambda toks, pos_, active, cache: batched_verify_step(
-                params, toks, pos_, active, cache, n_heads, compute_dtype
+
+        # one speculative round = verify + device-side acceptance (+ ring
+        # commit of accepted columns when windowed) in ONE program; jit
+        # caches one program per distinct chunk width. Only [B] m-counts
+        # and [B] final tokens cross to the host — never [B, k, V]
+        # logits (sampling acceptance needs the full distributions,
+        # which at a 32k+ vocab must not ship per round).
+        def spec_round_impl(spec_sampling):
+            def impl(toks, pos_, active, cache, temp, topk, topp, keys):
+                if windowed:
+                    logits, cks, cvs = batched_windowed_verify(
+                        params, toks, pos_, active, cache, n_heads,
+                        compute_dtype,
+                    )
+                else:
+                    logits, cache = batched_verify_step(
+                        params, toks, pos_, active, cache, n_heads,
+                        compute_dtype,
+                    )
+                m, final = spec_accept(
+                    logits, toks, temp, topk, topp, keys, pos_,
+                    spec_sampling,
+                )
+                m = jnp.where(active, m, 0)
+                if windowed:
+                    cache = commit_ring_chunk(
+                        cache, cks, cvs, pos_, m, active
+                    )
+                return m, final, cache, pos_ + m
+
+            return impl
+
+        self._spec_round_greedy = jax.jit(spec_round_impl(False))
+        self._spec_round_sampling = jax.jit(spec_round_impl(True))
+        self._draft = (
+            _DraftEngine(
+                draft_params, draft_n_heads or n_heads, n_slots, max_len,
+                prompt_len, compute_dtype,
             )
+            if draft_params is not None else None
         )
         self._load_prefix = jax.jit(
             lambda stage, ks, vs: (
@@ -645,19 +1040,25 @@ class ContinuousBatcher:
             cpos += n
         return logits, stage
 
-    def _stage_ring(self, tokens):
-        """Windowed chunked prefill: advance a fresh W-ring with the
-        whole prompt, one bucket per windowed_chunk call (exact sliding-
-        window attention — decode.windowed_chunk). Returns (final
-        chunk's logits, ring (ks, vs), last-row index)."""
-        # submit() enforces max_len % P == 0 before any prompt longer
-        # than one bucket reaches here (bucket-sized prompts never chunk,
-        # so unaligned windowed configs stay valid for them)
+    def _stage_ring(self, tokens, base: int = 0, ring=None,
+                    want_logits: bool = True):
+        """Windowed chunked prefill: advance a W-ring with ``tokens``
+        written at absolute positions base..base+t-1, one bucket per
+        windowed_chunk call (exact sliding-window attention —
+        decode.windowed_chunk). ``ring`` seeds the cache (a registered
+        prefix's ring; fresh zeros when None); ``base`` must be a bucket
+        multiple (enforced by register_prefix, whose prefix lengths are
+        the only nonzero bases) so chunks never wrap mid-write. Returns
+        (final chunk's logits or None, ring (ks, vs), last-row index)."""
+        # submit()/register_prefix enforce max_len % P == 0 before any
+        # chunking reaches here (bucket-sized prefixless prompts never
+        # chunk, so unaligned windowed configs stay valid for them)
         P = self.prompt_len
-        ring = (
-            jnp.zeros(self._ring_shape, self.compute_dtype),
-            jnp.zeros(self._ring_shape, self.compute_dtype),
-        )
+        if ring is None:
+            ring = (
+                jnp.zeros(self._ring_shape, self.compute_dtype),
+                jnp.zeros(self._ring_shape, self.compute_dtype),
+            )
         t = tokens.shape[0]
         cpos = 0
         logits = None
@@ -666,10 +1067,10 @@ class ContinuousBatcher:
             chunk = np.zeros((1, P), np.int32)
             chunk[0, :n] = tokens[cpos : cpos + n]
             args = (
-                jnp.asarray(chunk), jnp.asarray(cpos, jnp.int32),
+                jnp.asarray(chunk), jnp.asarray(base + cpos, jnp.int32),
                 jnp.asarray(n, jnp.int32), ring,
             )
-            if cpos + n >= t:
+            if want_logits and cpos + n >= t:
                 logits, ring = self._wchunk(*args)
             else:
                 ring = self._wadvance(*args)
@@ -680,28 +1081,52 @@ class ContinuousBatcher:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE and
         return its id; submit(prefix=id) starts from its K/V instead of
         re-prefilling it per request — the admission cost of the shared
-        part is paid one time. Stored trimmed to the prefix length;
-        release with unregister_prefix when no longer needed."""
+        part is paid one time. Release with unregister_prefix when no
+        longer needed.
+
+        Unwindowed caches store the staged K/V trimmed to the prefix
+        length. Windowed caches store the prefix's RING: a prefix always
+        starts at absolute position 0, so its ring placement is the same
+        for every request — the one alignment requirement is that the
+        prefix length be a bucket (prompt_len) multiple, so the
+        per-request continuation chunks stay bucket-aligned and never
+        wrap the ring mid-write (a windowed prefix may even EXCEED
+        max_len: the ring then holds its last W tokens, exactly
+        sliding-window semantics)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         plen = tokens.shape[0]
         if self.windowed:
-            # a prefix's ring placement depends on what follows it (its
-            # absolute positions shift per request), so the cached K/V
-            # cannot be spliced into a ring — fundamental, not a TODO
-            raise ValueError("prefix caching needs an unwindowed cache")
-        if not (0 < plen < self.max_len):
-            raise ValueError(
-                f"prefix length {plen} not in (0, max_len={self.max_len})"
+            P = self.prompt_len
+            if plen <= 0 or plen % P:
+                raise ValueError(
+                    f"windowed prefix length {plen} must be a positive "
+                    f"multiple of prompt_len({P}) so per-request "
+                    "continuation chunks stay bucket-aligned"
+                )
+            if self.max_len % P:
+                raise ValueError(
+                    f"windowed prefix caching needs max_len"
+                    f"({self.max_len}) to be a multiple of "
+                    f"prompt_len({P})"
+                )
+            _, ring, _ = self._stage_ring(tokens, 0, None, False)
+            stored = ring
+        else:
+            if not (0 < plen < self.max_len):
+                raise ValueError(
+                    f"prefix length {plen} not in (0, max_len={self.max_len})"
+                )
+            _, stage = self._stage_chunks(
+                tokens, 0, self._empty_stage(), False
             )
-        _, stage = self._stage_chunks(tokens, 0, self._empty_stage(), False)
-        trimmed = (stage[0][:, :, :plen], stage[1][:, :, :plen])
+            stored = (stage[0][:, :, :plen], stage[1][:, :, :plen])
         with self._lock:
             pid = self._next_prefix
             self._next_prefix += 1
             # tokens ride along so spec_step's prompt-lookup context
             # covers the shared prefix too (proposal quality, not
             # correctness — n-gram matches often live in a system prompt)
-            self._prefixes[pid] = (trimmed, plen, tokens)
+            self._prefixes[pid] = (stored, plen, tokens)
         return pid
 
     def unregister_prefix(self, pid: int) -> bool:
@@ -751,11 +1176,17 @@ class ContinuousBatcher:
                 if prefix not in self._prefixes:
                     raise ValueError(f"unknown prefix id {prefix}")
                 pfx, plen, pfx_tokens = self._prefixes[prefix]
-        if self.windowed and t > self.prompt_len and self.max_len % self.prompt_len:
+        if (
+            self.windowed
+            and (t > self.prompt_len or pfx is not None)
+            and self.max_len % self.prompt_len
+        ):
             # checked before any slot is claimed: ring chunked prefill
-            # needs bucket-aligned chunks (a mid-chunk ring wrap would
-            # corrupt live entries). Bucket-sized prompts never chunk, so
-            # unaligned windowed configs stay valid for them.
+            # (long prompts, and any prefix continuation — it starts at
+            # base=plen) needs bucket-aligned chunks (a mid-chunk ring
+            # wrap would corrupt live entries). Bucket-sized prefixless
+            # prompts never chunk, so unaligned windowed configs stay
+            # valid for them.
             raise ValueError(
                 f"windowed long prompts need max_len({self.max_len}) to "
                 f"be a multiple of prompt_len({self.prompt_len}) so "
@@ -808,8 +1239,13 @@ class ContinuousBatcher:
                 logits_row = logits[0, t - 1]
             elif self.windowed:
                 # ring chunked prefill: exact sliding-window attention
-                # for prompts of any length (the ring keeps the last W)
-                logits, (ks, vs), last = self._stage_ring(prompt)
+                # for prompts of any length (the ring keeps the last W);
+                # a registered prefix seeds the ring and the prompt
+                # continues at absolute position plen (a bucket
+                # multiple, so chunks stay wrap-free)
+                logits, (ks, vs), last = self._stage_ring(
+                    prompt, base=plen, ring=pfx
+                )
                 logits_row = logits[0, last]
             else:
                 # chunked prefill (_stage_chunks): the staging cache
@@ -833,6 +1269,13 @@ class ContinuousBatcher:
                     jax.random.fold_in(jnp.asarray(req.key), fill),
                 )
             )
+            # draft-prefill the full context (req.prompt already carries
+            # prefix + prompt) OUTSIDE the state lock, like the target's
+            # prefill — admission must never serialize device steps
+            draft_kv = (
+                self._draft.prefill_tokens(req.prompt)
+                if self._draft is not None else None
+            )
         except Exception:
             # release the claimed slot or n_slots failed prefills would
             # brick the server with every slot claimed-but-never-active
@@ -846,7 +1289,8 @@ class ContinuousBatcher:
                 self._finish(slot)
             else:
                 self._pending.append(
-                    _PendingInsert(slot, ks, vs, first, fill, req)
+                    _PendingInsert(slot, ks, vs, first, fill, req,
+                                   draft_kv=draft_kv)
                 )
         return rid
 
@@ -866,6 +1310,8 @@ class ContinuousBatcher:
             self._keys = self._pin(
                 self._keys.at[p.slot].set(jnp.asarray(p.req.key))
             )
+            if p.draft_kv is not None and self._draft is not None:
+                self._draft.admit(p.slot, p.draft_kv)
             self._active[p.slot] = True
         self._pending.clear()
 
@@ -901,6 +1347,11 @@ class ContinuousBatcher:
                 self._cache, self._temp, self._topk, self._topp,
                 self._keys,
             )
+        if self._draft is not None:
+            # keep the draft cache position-synced with the target:
+            # this plain step writes the pending token's K/V on the
+            # target; the draft must mirror it (see advance_one)
+            self._draft.advance_one(args[0], args[1], args[2])
         step_fn = self._step_sampling if sampling else self._step_greedy
         new_tok, cache, pos = step_fn(*args)
         toks = np.asarray(new_tok)  # [B] ids — the only host transfer
@@ -925,17 +1376,30 @@ class ContinuousBatcher:
     def spec_step(self, k: int = 4, ngram: int = 2) -> Dict[int, int]:
         """One SPECULATIVE round: every active slot verifies k-1 guessed
         continuation tokens in one batched forward and commits its
-        accepted prefix plus one bonus token — several tokens per program
-        launch when the guesses land. Proposals are prompt-lookup
-        (n-gram) from each slot's own context (vLLM-style self-drafting:
-        no draft model; models/speculative.py's scheme batched over
-        slots). Exact greedy equivalence with step() by construction —
-        verification IS the greedy model, wrong guesses only waste their
-        verify columns. Falls back to a plain step when speculation
-        can't apply (a sampling slot, a windowed ring cache, a Pallas
-        batcher — its kernel's accumulation order differs from the
-        verify forward's — or no room for a chunk). Returns {rid: last
-        emitted token}; use partials() for the full per-round stream."""
+        accepted prefix plus one correction/bonus token — several tokens
+        per program launch when the guesses land. Proposals are
+        prompt-lookup (n-gram) from each slot's own context (vLLM-style
+        self-drafting: no draft model; models/speculative.py's scheme
+        batched over slots).
+
+        Works across the full serving matrix: greedy slots are EXACTLY
+        equivalent to step() by construction (verification is the greedy
+        model); sampling slots accept by point-mass rejection sampling
+        against the same filtered distribution sample_tokens uses, so
+        every emitted token is distributed exactly as a plain sampling
+        step's (distribution-exact, not byte-identical — see
+        spec_accept); windowed ring caches verify against the pre-write
+        ring and commit only accepted columns (batched_windowed_verify /
+        commit_ring_chunk), so rejected proposals never clobber window
+        history; Pallas batchers speculate too — the verify forward uses
+        inline XLA attention, so a generation mixing step() and
+        spec_step() calls could diverge on near-tied logits (the kernel's
+        accumulation order differs), but a server pumping spec_step
+        exclusively (speculate=k) is self-consistent: every committed
+        token is certified by the same verify program. Falls back to a
+        plain step only when no slot has room for a chunk or no slot
+        proposed anything. Returns {rid: last emitted token}; use
+        partials() for the full per-round stream."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -949,17 +1413,11 @@ class ContinuousBatcher:
                     req is not None and active_np[s] and req.temperature > 0
                     for s, req in enumerate(self._slots)
                 )
-                k_round = 1
-                # pallas batchers fall back too: the verify forward uses
-                # inline XLA attention, whose accumulation order differs
-                # from the Pallas decode kernel's — mixing them inside
-                # one generation would break the exact-equivalence
-                # promise on near-tied logits
-                if (
-                    not self.windowed and not sampling
-                    and self._attn_impl != "pallas"
-                ):
-                    pos_np = np.asarray(self._pos)
+                pos_np = np.asarray(self._pos)
+                if self.windowed:
+                    # a ring has no end: the only bound is the window
+                    k_round = max(1, min(k, self.max_len - 1))
+                else:
                     room = min(
                         int(self.max_len - pos_np[s])
                         for s in range(self.n_slots) if active_np[s]
@@ -968,60 +1426,75 @@ class ContinuousBatcher:
                 if k_round >= 2:
                     toks_host = np.zeros((self.n_slots, k_round), np.int32)
                     tok_np = np.asarray(self._tok)
-                    any_found = False
-                    for s, req in enumerate(self._slots):
-                        if req is None or not active_np[s]:
-                            continue
-                        toks_host[s, 0] = tok_np[s]
-                        ctx = np.concatenate(
-                            [req.prompt, np.asarray(req.tokens, np.int32)]
-                        )
-                        cand = ngram_lookup(ctx, k_round - 1, ngram)
-                        # -1 sentinel for found-nothing columns: a real
-                        # greedy token (≥ 0) can never match it, so the
-                        # acceptance scan stops at the pending token
-                        # instead of crediting accidental token-0 hits
-                        # (zero-fill is indistinguishable from proposing
-                        # token 0); XLA's gather clamps the embed lookup
-                        toks_host[s, 1:] = -1
-                        if cand is not None and cand.size:
-                            toks_host[s, 1 : 1 + cand.size] = cand
-                            any_found = True
-                    if not any_found:
-                        # no slot proposed anything: the verify forward
-                        # would certify exactly one token per slot at k×
-                        # the column cost — a plain step is the same
-                        # result cheaper
-                        k_round = 1
-                if k_round >= 2:
-                    args = (
-                        jnp.asarray(toks_host), self._pos,
-                        jnp.asarray(active_np), self._cache,
-                    )
+                    toks_host[:, 0] = tok_np
+                    if self._draft is None:
+                        any_found = False
+                        for s, req in enumerate(self._slots):
+                            if req is None or not active_np[s]:
+                                continue
+                            ctx = np.concatenate(
+                                [req.prompt,
+                                 np.asarray(req.tokens, np.int32)]
+                            )
+                            cand = ngram_lookup(ctx, k_round - 1, ngram)
+                            # -1 sentinel for found-nothing columns: a
+                            # real greedy token (≥ 0) can never match
+                            # it, so the acceptance scan stops at the
+                            # pending token instead of crediting
+                            # accidental token-0 hits (zero-fill is
+                            # indistinguishable from proposing token 0);
+                            # XLA's gather clamps the embed lookup
+                            toks_host[s, 1:] = -1
+                            if cand is not None and cand.size:
+                                toks_host[s, 1 : 1 + cand.size] = cand
+                                any_found = True
+                        if not any_found:
+                            # no slot proposed anything: the verify
+                            # forward would certify exactly one token
+                            # per slot at k× the column cost — a plain
+                            # step is the same result cheaper
+                            k_round = 1
             if k_round < 2:
                 # outside self._lock — _plain_step_locked reacquires it
                 return self._plain_step_locked(t0)
-            logits, cache = self._verify(*args)
-            greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, k]
+            if self._draft is not None:
+                # k-1 batched draft forwards propose for every slot at
+                # once; a draft always proposes, so there is no
+                # found-nothing fallback. Safe outside self._lock: the
+                # draft cache and per-slot device vectors are only
+                # touched under _step_lock (held here) — submits may
+                # queue pending inserts concurrently, but those join at
+                # the next round's _apply_pending_locked.
+                toks_host[:, 1:] = self._draft.propose(
+                    self._tok, self._pos, jnp.asarray(active_np), k_round
+                )
+            args = (
+                jnp.asarray(toks_host), self._pos,
+                jnp.asarray(active_np), self._cache,
+                self._temp, self._topk, self._topp, self._keys,
+            )
+            round_fn = (
+                self._spec_round_sampling if sampling
+                else self._spec_round_greedy
+            )
+            m_dev, final_dev, cache, pos2 = round_fn(*args)
+            # [B] counts + [B] tokens — the only host transfers
+            m_np = np.asarray(m_dev)
+            final_np = np.asarray(final_dev)
             with self._lock:
                 self._cache = cache
+                self._pos = self._pin(pos2)
                 emitted: Dict[int, int] = {}
                 new_tok = tok_np.copy()
-                new_pos = pos_np.copy()
                 n_emitted = 0
                 accepted = 0
                 for s, req in enumerate(self._slots):
                     if req is None or not active_np[s]:
                         continue
-                    m = 1
-                    while (
-                        m < k_round
-                        and greedy[s, m - 1] == toks_host[s, m]
-                    ):
-                        m += 1
+                    m = int(m_np[s])
                     accepted += m - 1
                     planned = [int(t) for t in toks_host[s, 1:m]]
-                    planned.append(int(greedy[s, m - 1]))
+                    planned.append(int(final_np[s]))
                     for t in planned:
                         req.tokens.append(t)
                         emitted[req.rid] = t
@@ -1029,11 +1502,9 @@ class ContinuousBatcher:
                         if req.finished():
                             break
                     new_tok[s] = req.tokens[-1]
-                    new_pos[s] = pos_np[s] + m
                     if req.finished():
                         self._finish(s)
                 self._tok = self._pin(jnp.asarray(new_tok))
-                self._pos = self._pin(jnp.asarray(new_pos, jnp.int32))
                 self._n_steps += 1
                 self._n_tokens += n_emitted
                 self._n_spec_rounds += 1

@@ -286,3 +286,50 @@ def test_speculate_prop_matches_plain_serving():
     spec, stats = run("specB", {"speculate": 4})
     assert spec == plain
     assert stats.get("spec_rounds", 0) > 0
+
+
+def test_speculate_model_prop_draft_speculation():
+    """speculate-model=zoo:... plugs a draft model into the speculate=k
+    pump (draft_-prefixed keys in the custom dict configure it) — same
+    tokens as plain serving, with spec rounds in the stats."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    prompt = np.asarray([3, 4, 3, 4, 3, 4, 3], np.int32)
+    draft_opts = (
+        MODEL_OPTS
+        + ",draft_d_model:32,draft_n_layers:1,draft_n_heads:2,draft_seed:9"
+    )
+
+    def run(srv_id, extra):
+        src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+        sink = LlmServerSink(
+            **{"id": srv_id, "model": "zoo:transformer_lm",
+               "custom": draft_opts, "n-slots": 1, "max-len": 64,
+               "prompt-len": 16, "max-new-tokens": 8, **extra}
+        )
+        out_src = LlmServerSrc(**{"id": srv_id})
+        out_sink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.chain(out_src, out_sink)
+        p.start()
+        try:
+            src.push(Frame((prompt,), meta={"req": "x"}))
+            src.end_of_stream()
+            f = out_sink.pop(timeout=120)
+            stats = out_src.serving_stats() or {}
+            return [int(t) for t in np.asarray(f.tensors[0])[0]], stats
+        finally:
+            p.stop()
+
+    plain, _ = run("draftA", {})
+    spec, stats = run(
+        "draftB",
+        {"speculate": 4, "speculate-model": "zoo:transformer_lm"},
+    )
+    assert spec == plain
+    assert stats.get("spec_rounds", 0) > 0

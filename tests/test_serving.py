@@ -510,8 +510,74 @@ class TestPrefixCaching:
             cb.submit(_prompt(13, 312), 2, prefix=pid)
         wcb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=32,
                                 prompt_len=16, windowed=True)
-        with pytest.raises(ValueError, match="unwindowed"):
+        # windowed prefixes must be bucket-aligned (continuation chunks
+        # start at base=plen and must not wrap the ring mid-write)
+        with pytest.raises(ValueError, match="multiple of prompt_len"):
             wcb.register_prefix(_prompt(4, 313))
+        assert wcb.register_prefix(_prompt(16, 314)) is not None
+
+    def test_windowed_prefix_matches_concat_prompt(self, params):
+        """windowed × prefix caching (r4): a prefix always starts at
+        absolute position 0, so its ring placement is request-invariant
+        — submit(prefix=id) must equal submitting the concatenated
+        prompt to a fresh windowed batcher, including through ring
+        wraps during generation."""
+        W = 32
+        pfx_toks = _prompt(16, 330)
+        tail = _prompt(6, 331)
+        n_new = 30  # 16 + 6 + 30 wraps the W=32 ring
+        wcb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=W,
+                                prompt_len=16, windowed=True)
+        pid = wcb.register_prefix(pfx_toks)
+        rid = wcb.submit(tail, n_new, prefix=pid)
+        while wcb.result(rid) is None:
+            wcb.step()
+        ref = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=W,
+                                prompt_len=16, windowed=True)
+        rr = ref.submit(np.concatenate([pfx_toks, tail]), n_new)
+        while ref.result(rr) is None:
+            ref.step()
+        assert wcb.result(rid) == ref.result(rr)
+        # and both equal the exact sliding-window ground truth
+        assert wcb.result(rid) == _sliding_reference(
+            params, np.concatenate([pfx_toks, tail]), n_new, W
+        )
+
+    def test_windowed_prefix_longer_than_window(self, params):
+        """A windowed prefix may exceed the window: the stored ring
+        holds its last W tokens — exactly what sliding-window semantics
+        prescribe for any prefix that long."""
+        W = 32
+        pfx_toks = _prompt(48, 332)  # 1.5× the window, 3 buckets
+        tail = _prompt(5, 333)
+        wcb = ContinuousBatcher(params, N_HEADS, n_slots=1, max_len=W,
+                                prompt_len=16, windowed=True)
+        pid = wcb.register_prefix(pfx_toks)
+        rid = wcb.submit(tail, 8, prefix=pid)
+        while wcb.result(rid) is None:
+            wcb.step()
+        assert wcb.result(rid) == _sliding_reference(
+            params, np.concatenate([pfx_toks, tail]), 8, W
+        )
+
+    def test_windowed_prefix_with_spec_step(self, params):
+        """prefix × windowed × speculation all compose: the spec pump
+        serves a prefixed windowed request and matches the plain pump."""
+        W = 32
+        pfx_toks = np.tile(np.asarray([3, 4, 5, 6], np.int32), 4)  # 16
+        tail = np.asarray([3, 4, 5], np.int32)
+
+        def run(spec):
+            wcb = ContinuousBatcher(params, N_HEADS, n_slots=1,
+                                    max_len=W, prompt_len=16,
+                                    windowed=True)
+            pid = wcb.register_prefix(pfx_toks)
+            rid = wcb.submit(tail, 20, prefix=pid)
+            while wcb.result(rid) is None:
+                wcb.spec_step(ngram=1) if spec else wcb.step()
+            return wcb.result(rid)
+
+        assert run(True) == run(False)
 
 
 def test_unregister_prefix_releases(params):

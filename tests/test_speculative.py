@@ -203,26 +203,85 @@ class TestBatcherSpeculation:
         if st["spec_accepted_tokens"] > 0:
             assert st["steps"] < st["tokens_emitted"]
 
-    def test_spec_falls_back_for_sampling_and_windowed(self):
+    def test_spec_sampling_slots_speculate(self):
+        """Sampling slots now speculate (r4): rejection-sampling
+        acceptance — runs are deterministic per seed, and a
+        near-zero temperature (≈ delta distribution) reproduces the
+        greedy stream exactly through the acceptance path."""
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
         params = self._params()
-        rng = np.random.default_rng(6)
-        p = rng.integers(1, 97, (6,)).astype(np.int32)
-        # sampling slot → plain-step path, still completes + deterministic
-        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=64,
-                               prompt_len=16)
-        rid = cb.submit(p, 6, temperature=0.8, seed=1)
-        while cb.result(rid) is None:
-            cb.spec_step()
-        assert cb.stats()["spec_rounds"] == 0
-        # windowed ring → plain-step path
-        cbw = ContinuousBatcher(params, 4, n_slots=1, max_len=32,
+        pattern = np.asarray([7, 8, 9, 7, 8, 9, 7, 8, 9, 7, 8], np.int32)
+
+        def run(temp, seed):
+            cb = ContinuousBatcher(params, 4, n_slots=2, max_len=96,
+                                   prompt_len=16)
+            rid = cb.submit(pattern, 10, temperature=temp, seed=seed)
+            # a greedy repetitive neighbor guarantees lookups land, so
+            # rounds go through the verify path WITH a sampling slot
+            # active — the exact case that used to force a whole-batch
+            # plain-step fallback
+            rg = cb.submit(pattern, 10)
+            while cb.result(rid) is None or cb.result(rg) is None:
+                cb.spec_step(k=4, ngram=1)
+            return cb.result(rid), cb.stats()
+
+        a, st = run(0.8, 11)
+        b, _ = run(0.8, 11)
+        assert a == b  # deterministic per (seed, fill, draw)
+        assert st["spec_rounds"] > 0  # no more sampling fallback
+        # temp → 0: the filtered distribution is a point mass at the
+        # argmax, so rejection acceptance degenerates to greedy — the
+        # stream must equal plain greedy decoding exactly
+        tiny, _ = run(1e-6, 12)
+        greedy = self._serve(
+            ContinuousBatcher(params, 4, n_slots=1, max_len=96,
+                              prompt_len=16),
+            [pattern], 10, spec=False,
+        )[0]
+        assert tiny == greedy
+
+    def test_spec_windowed_matches_sliding_reference(self):
+        """Windowed rings now speculate (r4): verify runs against the
+        pre-write ring + fresh chunk K/V and only accepted columns
+        commit, so the stream matches the exact sliding-window
+        reference through many ring wraps."""
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        W = 16
+        pattern = np.asarray([7, 8, 9, 7, 8, 9, 7, 8, 9, 7], np.int32)
+        cbw = ContinuousBatcher(params, 4, n_slots=1, max_len=W,
                                 prompt_len=16, windowed=True)
-        rid = cbw.submit(p, 8)
+        rid = cbw.submit(pattern, 30)  # wraps the ring repeatedly
         while cbw.result(rid) is None:
-            cbw.spec_step()
-        assert cbw.stats()["spec_rounds"] == 0
+            cbw.spec_step(k=4)
+        assert cbw.stats()["spec_rounds"] > 0
+        from tests.test_serving import _sliding_reference
+
+        assert cbw.result(rid) == _sliding_reference(
+            params, pattern, 30, W
+        )
+
+    def test_spec_mixed_batch_greedy_slot_unaffected(self):
+        """A greedy slot sharing spec rounds with a sampling slot emits
+        exactly its solo-greedy stream (per-slot acceptance isolation)."""
+        from nnstreamer_tpu.models import decode as dec
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        g_prompt = np.asarray([7, 8, 9, 7, 8, 9, 7], np.int32)
+        s_prompt = np.asarray([3, 4, 3, 4, 3], np.int32)
+        cb = ContinuousBatcher(params, 4, n_slots=2, max_len=96,
+                               prompt_len=16)
+        rg = cb.submit(g_prompt, 10)
+        rs = cb.submit(s_prompt, 10, temperature=0.9, seed=5)
+        while cb.result(rg) is None or cb.result(rs) is None:
+            cb.spec_step(k=4)
+        alone = dec.generate(
+            params, np.asarray(g_prompt)[None], 4, 10
+        )
+        assert cb.result(rg) == [int(t) for t in np.asarray(alone)[0]]
 
     def test_spec_with_int8_cache_matches_plain_int8(self):
         from nnstreamer_tpu.models.serving import ContinuousBatcher
@@ -285,13 +344,225 @@ class TestBatcherSpeculation:
         assert a == b
         assert b[-1] == stop and stop not in b[:-1] or len(b) == 8
 
-    def test_spec_pallas_batcher_falls_back(self):
+    def test_spec_pallas_batcher_speculates(self):
+        """Pallas batchers now speculate (r4): a server pumped
+        exclusively by spec_step certifies every token with the same
+        XLA verify forward, so the stream is impl-independent."""
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
         params = self._params()
-        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=64,
-                               prompt_len=16, attn_impl="pallas")
-        rid = cb.submit(np.asarray([5, 6, 5, 6, 5], np.int32), 6)
+        pattern = np.asarray([5, 6, 5, 6, 5], np.int32)
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cb = ContinuousBatcher(params, 4, n_slots=1, max_len=64,
+                                   prompt_len=16, attn_impl=impl)
+            rid = cb.submit(pattern, 6)
+            while cb.result(rid) is None:
+                cb.spec_step(ngram=1)
+            outs[impl] = cb.result(rid)
+            assert cb.stats()["spec_rounds"] > 0
+        assert outs["xla"] == outs["pallas"]
+
+    def test_spec_windowed_int8_matches_plain(self):
+        """windowed × int8 × speculation: the verify forward attends the
+        quantize→dequantize roundtrip of its own chunk K/V (what a plain
+        int8 step attends), so greedy spec stays byte-identical to plain
+        int8 ring stepping."""
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        pattern = np.asarray([7, 8, 9, 7, 8, 9, 7, 8], np.int32)
+
+        def run(spec):
+            cb = ContinuousBatcher(params, 4, n_slots=1, max_len=32,
+                                   prompt_len=16, windowed=True,
+                                   cache_dtype="int8")
+            rid = cb.submit(pattern, 20)
+            while cb.result(rid) is None:
+                cb.spec_step(k=4) if spec else cb.step()
+            return cb.result(rid)
+
+        assert run(True) == run(False)
+
+    def test_spec_accepted_in_pallas_windowed_server(self):
+        """The production-shaped configuration (Pallas fast kernel +
+        sliding-window ring) pumped by speculate=k actually ACCEPTS
+        speculated tokens on a repetitive stream (VERDICT r3 done
+        criterion: spec_accepted_tokens > 0, not a silent fallback)."""
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        pattern = np.tile(np.asarray([11, 12, 13], np.int32), 5)
+        cb = ContinuousBatcher(params, 4, n_slots=2, max_len=32,
+                               prompt_len=16, windowed=True,
+                               attn_impl="pallas")
+        rid = cb.submit(pattern, 24)
         while cb.result(rid) is None:
-            cb.spec_step()
-        assert cb.stats()["spec_rounds"] == 0  # plain-path fallback
+            cb.spec_step(k=4)
+        st = cb.stats()
+        assert st["spec_rounds"] > 0
+        assert st["spec_accepted_tokens"] > 0
+        assert st["tokens_emitted"] > st["steps"]  # multi-token rounds
+
+    def test_rejection_sampler_matches_target_distribution(self):
+        """Unit-level distribution check of spec_accept's point-mass
+        rejection sampling: over many independent slots (same logits,
+        different keys), the FIRST emitted token's empirical
+        distribution must match the filtered target distribution —
+        whether the proposal is likely, unlikely, or absent."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.serving import (
+            _filtered_logits, spec_accept,
+        )
+
+        rng = np.random.default_rng(0)
+        n, v, k = 4000, 8, 3
+        base_logits = jnp.asarray(rng.standard_normal((v,)), jnp.float32)
+        logits = jnp.broadcast_to(base_logits, (n, k, v))
+        temp = jnp.ones((n,), jnp.float32)
+        topk = jnp.zeros((n,), jnp.int32)
+        topp = jnp.ones((n,), jnp.float32)
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(n, dtype=jnp.uint32))
+        pos = jnp.zeros((n,), jnp.int32)
+        target = np.asarray(
+            jax.nn.softmax(
+                _filtered_logits(base_logits[None], temp[:1], topk[:1],
+                                 topp[:1])[0]
+            )
+        )
+        for prop in (int(np.argmax(target)), int(np.argmin(target)), -1):
+            toks = jnp.broadcast_to(
+                jnp.asarray([1, prop, 2], jnp.int32), (n, k)
+            )
+            m, final = spec_accept(
+                logits, toks, temp, topk, topp, keys, pos, True
+            )
+            m, final = np.asarray(m), np.asarray(final)
+            first = np.where(m >= 2, prop, final)
+            emp = np.bincount(first, minlength=v) / n
+            np.testing.assert_allclose(emp, target, atol=0.035)
+
+
+class TestDraftBatcherSpeculation:
+    """Draft-model speculation over slots (r4): one small model proposes
+    k-1 tokens for every active slot per round (batched draft forwards),
+    verified by the shared target verify + point-mass acceptance."""
+
+    def _params(self, seed=3, layers=2):
+        return tfm.init_params(
+            jax.random.PRNGKey(seed), vocab=97, d_model=64, n_heads=4,
+            n_layers=layers,
+        )
+
+    def _draft_params(self):
+        # smaller net, same vocab — the real deployment shape
+        return tfm.init_params(
+            jax.random.PRNGKey(9), vocab=97, d_model=32, n_heads=2,
+            n_layers=1,
+        )
+
+    def test_draft_spec_matches_plain_steps(self):
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        rng = np.random.default_rng(21)
+        prompts = [
+            rng.integers(1, 97, (n,)).astype(np.int32) for n in (5, 20, 3)
+        ]
+
+        def run(draft):
+            kw = {}
+            if draft:
+                kw = dict(draft_params=self._draft_params(),
+                          draft_n_heads=2)
+            cb = ContinuousBatcher(params, 4, n_slots=4, max_len=96,
+                                   prompt_len=16, **kw)
+            rids = [cb.submit(p, 10) for p in prompts]
+            while any(cb.result(r) is None for r in rids):
+                cb.spec_step(k=4) if draft else cb.step()
+            return [cb.result(r) for r in rids], cb.stats()
+
+        plain, _ = run(False)
+        spec, st = run(True)
+        assert spec == plain
+        assert st["spec_rounds"] > 0  # a draft always proposes
+
+    def test_self_draft_accepts_everything(self):
+        """Draft == target: every proposal is the target's own greedy
+        choice, so every round commits all k columns (the sanity bound
+        on the acceptance plumbing)."""
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        p = np.random.default_rng(22).integers(1, 97, (6,)).astype(np.int32)
+        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=96,
+                               prompt_len=16, draft_params=params,
+                               draft_n_heads=4)
+        rid = cb.submit(p, 12)
+        while cb.result(rid) is None:
+            cb.spec_step(k=4)
+        st = cb.stats()
+        assert cb.result(rid) == _alone_97(params, p, 12)
+        # 12 tokens in 3 rounds of k=4 (1 at submit + 11 over rounds,
+        # each committing 4): acceptance must be perfect
+        assert st["spec_accepted_tokens"] == st["spec_rounds"] * 3
+
+    def test_draft_spec_with_sampling_slot(self):
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        p = np.random.default_rng(23).integers(1, 97, (5,)).astype(np.int32)
+
+        def run():
+            cb = ContinuousBatcher(params, 4, n_slots=2, max_len=64,
+                                   prompt_len=16,
+                                   draft_params=self._draft_params(),
+                                   draft_n_heads=2)
+            rs = cb.submit(p, 8, temperature=0.7, seed=4)
+            rg = cb.submit(p, 8)
+            while cb.result(rs) is None or cb.result(rg) is None:
+                cb.spec_step(k=3)
+            return cb.result(rs), cb.result(rg)
+
+        s1, g1 = run()
+        s2, g2 = run()
+        assert (s1, g1) == (s2, g2)  # deterministic per seed
+        assert g1 == _alone_97(params, p, 8)  # greedy slot exact
+
+    def test_draft_windowed_rejected(self):
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        with pytest.raises(ValueError, match="unwindowed"):
+            ContinuousBatcher(self._params(), 4, n_slots=1, max_len=32,
+                              prompt_len=16, windowed=True,
+                              draft_params=self._draft_params())
+
+    def test_draft_spec_with_prefix(self):
+        """Draft admission prefills the FULL context (prefix + prompt),
+        so prefixed requests speculate correctly too."""
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        pfx = np.random.default_rng(24).integers(1, 97, (10,)).astype(np.int32)
+        tail = np.random.default_rng(25).integers(1, 97, (4,)).astype(np.int32)
+        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=96,
+                               prompt_len=16, draft_params=params,
+                               draft_n_heads=4)
+        pid = cb.register_prefix(pfx)
+        rid = cb.submit(tail, 8, prefix=pid)
+        while cb.result(rid) is None:
+            cb.spec_step(k=4)
+        assert cb.result(rid) == _alone_97(
+            params, np.concatenate([pfx, tail]), 8
+        )
+        # self-draft over the full context: perfect acceptance proves
+        # the draft cache saw the prefix
+        st = cb.stats()
+        assert st["spec_accepted_tokens"] == st["spec_rounds"] * 3
+
+
+def _alone_97(params, prompt, n_new):
+    toks = dec.generate(params, np.asarray(prompt)[None], 4, n_new)
+    return [int(t) for t in np.asarray(toks)[0]]
