@@ -89,8 +89,14 @@ class Element:
         self.in_specs: List[Spec] = []
         self.out_specs: List[Spec] = []
         # queue size for this element's input pads (the reference's
-        # queue-element analogue; see executor)
-        self.queue_size = int(props.pop("queue-size", props.pop("queue_size", 4)))
+        # queue-element analogue; see executor). 64 deep: a short queue
+        # parks both neighbor threads at its edges every few frames, and
+        # the context-switch ping-pong — not the per-frame work — then
+        # dominates the host budget (GStreamer's queue defaults to 200
+        # buffers for the same reason). Frames are array *handles*;
+        # in-flight device work is exactly the dispatch-ahead pipelining
+        # the executor exists for.
+        self.queue_size = int(props.pop("queue-size", props.pop("queue_size", 64)))
         self.silent = _parse_bool(props.pop("silent", True))
         # downstream QoS publishers (tensor_rate upstream-throttle analogue,
         # gsttensor_rate.c:27-36,452): producers consult these and skip

@@ -21,7 +21,6 @@ Executor.errors and poison the pipeline (stop event) so threads unwind.
 
 from __future__ import annotations
 
-import queue as queue_mod
 import threading
 import time
 from collections import deque
@@ -47,39 +46,131 @@ class _Stop(Exception):
     pass
 
 
+_EMPTY = object()  # _Chan.get_nowait sentinel (frames are never None-like)
+
+
+class _Chan:
+    """Bounded SPSC channel for inter-node frames.
+
+    Every executor link has exactly one producer node and one consumer
+    node (pads fan out to distinct queues), so the synchronized
+    queue.Queue — whose mutex + condvar dance costs several µs per
+    put/get — is overkill: deque.append/popleft are GIL-atomic, making
+    the non-blocking fast path lock-free (~1 µs per hop).
+
+    Parking discipline: the waiter advertises itself in a _*_waiting
+    flag BEFORE re-checking the deque, and the other side checks the
+    flag AFTER its deque op — under the GIL this Dekker-style pairing
+    means either the waiter sees the data/space or the mover sees the
+    flag, so no wake is ever missed — and in steady flow (nobody
+    parked) NO Event is touched at all. Wakes themselves are the
+    expensive part (each one is a context switch; a wake per frame at
+    a full/empty edge costs more than the frame's own host work), so
+    the full edge wakes a parked producer only at the LOW-WATER mark
+    (half-drained, or empty): the producer then refills in one burst,
+    amortizing the switch over maxsize/2 frames. The empty edge wakes
+    on the first item — a parked consumer is the frame path, and
+    delaying it would add latency. All waits are bounded (50 ms) so
+    any missed edge degrades to a beat, never a hang."""
+
+    __slots__ = ("_d", "_max", "_data", "_space", "_get_waiting",
+                 "_put_waiting")
+
+    def __init__(self, maxsize: int) -> None:
+        self._d: deque = deque()
+        self._max = max(1, maxsize)
+        self._data = threading.Event()   # set: items may be available
+        self._space = threading.Event()  # set: space may be available
+        self._get_waiting = False
+        self._put_waiting = False
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def put(self, item, stop_event) -> None:
+        d = self._d
+        if len(d) >= self._max:
+            while True:
+                if stop_event.is_set():
+                    raise _Stop()
+                self._space.clear()
+                self._put_waiting = True
+                # recheck after advertising: a pop between the len
+                # check and the flag set either leaves items visible
+                # here or sees the flag and wakes us
+                if len(d) < self._max:
+                    self._put_waiting = False
+                    break
+                self._space.wait(0.05)
+                self._put_waiting = False
+                if len(d) < self._max:
+                    break
+        d.append(item)
+        if self._get_waiting:
+            self._data.set()
+
+    def _wake_put(self, d) -> None:
+        # low-water wake: burst-refill beats a switch per pop
+        if self._put_waiting and (len(d) * 2 <= self._max or not d):
+            self._space.set()
+
+    def get(self, stop_event):
+        d = self._d
+        if not d:
+            while True:
+                if stop_event.is_set():
+                    raise _Stop()
+                self._data.clear()
+                self._get_waiting = True
+                if d:
+                    self._get_waiting = False
+                    break
+                self._data.wait(0.05)
+                self._get_waiting = False
+                if d:
+                    break
+        item = d.popleft()
+        self._wake_put(d)
+        return item
+
+    def get_nowait(self):
+        """Pop without blocking; returns _EMPTY when nothing is queued."""
+        d = self._d
+        if not d:
+            return _EMPTY
+        item = d.popleft()
+        self._wake_put(d)
+        return item
+
+
 class Node:
     def __init__(self, ex: "Executor", name: str) -> None:
         self.ex = ex
         self.name = name
-        self.in_queues: List[queue_mod.Queue] = []
+        self.in_queues: List[_Chan] = []
         # out pad -> (dst node, dst pad)
         self.outs: Dict[int, Tuple["Node", int]] = {}
         self.thread: Optional[threading.Thread] = None
         self.frames_processed = 0
         self.proc_time_ema_ms = 0.0
+        self._needs_notify = False  # set for multi-pad scheduler nodes
 
     def add_in_queue(self, size: int) -> int:
-        self.in_queues.append(queue_mod.Queue(maxsize=max(1, size)))
+        self.in_queues.append(_Chan(size))
         return len(self.in_queues) - 1
 
     # -- data movement ----------------------------------------------------
     def push_out(self, pad: int, item) -> None:
         dst, dst_pad = self.outs[pad]
-        q = dst.in_queues[dst_pad]
-        while True:
-            if self.ex.stop_event.is_set():
-                raise _Stop()
-            try:
-                q.put(item, timeout=0.1)
-                dst.notify()
-                return
-            except queue_mod.Full:
-                continue
+        dst.in_queues[dst_pad].put(item, self.ex.stop_event)
+        if dst._needs_notify:
+            dst.notify()
 
     def notify(self) -> None:
         """Data arrived on one of this node's input queues. Nodes that
-        block on a single queue don't need it (queue.get wakes them);
-        multi-pad nodes override to wake their scheduler."""
+        block on a single queue don't need it (chan.get wakes them);
+        multi-pad nodes override to wake their scheduler and set
+        _needs_notify so producers know to call it."""
 
     def broadcast_eos(self) -> None:
         for pad in self.outs:
@@ -89,14 +180,7 @@ class Node:
                 pass
 
     def pop(self, pad: int = 0):
-        q = self.in_queues[pad]
-        while True:
-            if self.ex.stop_event.is_set():
-                raise _Stop()
-            try:
-                return q.get(timeout=0.1)
-            except queue_mod.Empty:
-                continue
+        return self.in_queues[pad].get(self.ex.stop_event)
 
     # -- thread ------------------------------------------------------------
     def start(self) -> None:
@@ -117,12 +201,19 @@ class Node:
         raise NotImplementedError
 
     def stat(self, t0: float) -> None:
+        self.frames_processed += 1
+        tracer = trace.get()
+        if tracer is None and (self.frames_processed & 7):
+            # sampled EMA (1-in-8): the per-frame timing arithmetic is a
+            # measurable slice of the host budget at multi-kfps rates,
+            # and an EMA over every 8th frame reads the same. With a
+            # tracer attached every frame records (completeness matters
+            # more than throughput when profiling).
+            return
         now = time.perf_counter()
         dt = (now - t0) * 1000.0
-        self.frames_processed += 1
         a = 0.2
         self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
-        tracer = trace.get()
         if tracer is not None:
             tracer.complete(
                 self.name, type(self).__name__, t0, now - t0,
@@ -236,6 +327,7 @@ class RoutingNode(Node):
         # actually data, instead of busy-polling every pad on a 20 ms beat
         # (O(pads) idle wakeups/sec on wide mux fan-ins)
         self._wake = threading.Event()
+        self._needs_notify = True
 
     def notify(self) -> None:
         self._wake.set()
@@ -252,9 +344,8 @@ class RoutingNode(Node):
                 if eos_seen[pad]:
                     continue
                 while True:  # drain the pad without per-item timeouts
-                    try:
-                        item = self.in_queues[pad].get_nowait()
-                    except queue_mod.Empty:
+                    item = self.in_queues[pad].get_nowait()
+                    if item is _EMPTY:
                         break
                     progressed = True
                     if item is EOS_FRAME:

@@ -64,8 +64,22 @@ class Frame:
 
     def with_tensors(self, tensors: Sequence[Any]) -> "Frame":
         """New frame with same timing/meta but different payload (the common
-        element output path — timing metadata rides along unchanged)."""
-        return replace(self, tensors=tuple(tensors))
+        element output path — timing metadata rides along unchanged).
+
+        Hand-rolled rather than dataclasses.replace(): this runs once per
+        element per frame, and replace() pays __init__ + __post_init__
+        dispatch (~7 µs) where direct attribute writes pay ~1 µs — at
+        multi-kfps pipeline rates that difference is a measurable slice
+        of the per-frame host budget. Semantics match replace(): meta is
+        SHARED (same dict object), seq is fresh, _synced resets."""
+        f = Frame.__new__(Frame)
+        f.tensors = tuple(tensors)
+        f.pts = self.pts
+        f.duration = self.duration
+        f.meta = self.meta
+        f.seq = next(_frame_seq)
+        f._synced = False
+        return f
 
     def with_meta(self, **kw) -> "Frame":
         m = dict(self.meta)
