@@ -56,7 +56,7 @@ def _peak_tflops(device_kind: str) -> float | None:
     return None
 
 
-def _flops_per_frame(fn, example) -> float | None:
+def _cost_analysis(fn, example) -> dict:
     """XLA's own cost analysis for one invoke, if available."""
     try:
         import jax
@@ -64,10 +64,21 @@ def _flops_per_frame(fn, example) -> float | None:
         cost = jax.jit(fn).lower(example).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
-        f = float(cost.get("flops", 0.0))
-        return f if f > 0 else None
+        return dict(cost) if cost else {}
     except Exception:
-        return None
+        return {}
+
+
+def _flops_per_frame(fn, example) -> float | None:
+    f = float(_cost_analysis(fn, example).get("flops", 0.0))
+    return f if f > 0 else None
+
+
+def _bytes_per_invoke(fn, example) -> float | None:
+    """XLA 'bytes accessed' for one invoke — the numerator of the
+    roofline arithmetic-intensity argument (docs/BENCH_NOTES.md)."""
+    b = float(_cost_analysis(fn, example).get("bytes accessed", 0.0))
+    return b if b > 0 else None
 
 
 def _mark(label: str, _t=[None]) -> None:
@@ -82,6 +93,24 @@ def _mark(label: str, _t=[None]) -> None:
 
 def _round(v, nd=1):
     return round(v, nd) if v is not None else None
+
+
+def _steady_fps(ex, scale: float = 1.0) -> float | None:
+    """Steady-state sink FPS: frames after the first completed render
+    burst / wall time (compile + warmup excluded). One definition for
+    every pipeline cell — the steady window must not drift per cell."""
+    from nnstreamer_tpu.pipeline.executor import SinkNode
+
+    sink = next(n for n in ex.nodes if isinstance(n, SinkNode))
+    steady = sink.frames_rendered - sink.first_burst_n
+    if (
+        sink.t_first_render is None
+        or sink.t_last_render is None
+        or steady < 1
+        or sink.t_last_render <= sink.t_first_render
+    ):
+        return None
+    return steady * scale / (sink.t_last_render - sink.t_first_render)
 
 
 def _run() -> None:
@@ -235,17 +264,7 @@ def _run() -> None:
             f"tensor_sink sync-window={window} queue-size=128"
         )
         p = parse_pipeline(desc)
-        ex = p.run(timeout=timeout)
-        sink = next(n for n in ex.nodes if isinstance(n, SinkNode))
-        steady = sink.frames_rendered - sink.first_burst_n
-        if (
-            sink.t_first_render is None
-            or sink.t_last_render is None
-            or steady < 1
-            or sink.t_last_render <= sink.t_first_render
-        ):
-            return None
-        return steady * fpt / (sink.t_last_render - sink.t_first_render)
+        return _steady_fps(p.run(timeout=timeout), scale=fpt)
 
     # device-resident source: the framework + compute ceiling (frames
     # born on device, as in a chained-filter pipeline — BASELINE.md's
@@ -327,6 +346,92 @@ def _run() -> None:
         None if _over_budget() else _pipeline_fps_safe(False, 32, 2048, 8)
     )
     _mark("pipeline-mb32 measured")
+
+    # BRANCHED pipeline (reference parallelism construct #2, SURVEY
+    # §2.6): tee → two model branches → mux(slowest) → sink. Unlike the
+    # linear chain, nothing fuses across the tee/mux, so every frame
+    # pays real multi-node executor traffic (2 extra nodes + 3 extra
+    # queue hops + sync-policy grouping) on top of two model dispatches
+    # — the host-path pressure case the linear pipeline_fps hides.
+    def _pipeline_branched_fps(n_frames: int) -> float | None:
+        from nnstreamer_tpu.pipeline.executor import SinkNode
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        desc = (
+            f"videotestsrc pattern=gradient device=true "
+            f"num-frames={n_frames} width=224 height=224 ! "
+            "tensor_converter queue-size=128 ! tee name=t "
+            "t. ! queue ! tensor_filter framework=jax "
+            'model=zoo:mobilenet_v2 custom="compute_dtype:bfloat16" ! '
+            "m.sink_0 "
+            "t. ! queue ! tensor_filter framework=jax "
+            'model=zoo:mobilenet_v2 custom="compute_dtype:bfloat16" ! '
+            "m.sink_1 "
+            "tensor_mux name=m sync-mode=slowest ! "
+            "tensor_demux tensorpick=0 ! tensor_decoder "
+            "mode=image_labeling ! tensor_sink sync-window=16 "
+            "queue-size=128"
+        )
+        p = parse_pipeline(desc)
+        return _steady_fps(p.run(timeout=900))
+
+    pipeline_branched_fps = None
+    if not _over_budget():
+        try:
+            pipeline_branched_fps = _pipeline_branched_fps(
+                512 if on_tpu else 16
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"[bench] branched pipeline failed: {exc!r}",
+                  file=sys.stderr)
+    _mark("pipeline-branched measured")
+
+    # REAL-MEDIA pipeline: encoded clip → videofilesrc (decode-ahead
+    # thread) → converter → mobilenet → decoder → sink. The honest
+    # camera-path number including actual ffmpeg decode, with decode
+    # overlapped against upload/inference (elements/media.py r4).
+    def _pipeline_media_fps(n_frames: int) -> float | None:
+        import tempfile
+
+        try:
+            import cv2
+        except ImportError:
+            return None
+        from nnstreamer_tpu.pipeline.executor import SinkNode
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        path = os.path.join(tempfile.mkdtemp(), "bench_clip.mp4")
+        wr = cv2.VideoWriter(
+            path, cv2.VideoWriter_fourcc(*"mp4v"), 30.0, (224, 224)
+        )
+        if not wr.isOpened():
+            return None
+        clip_len = 120
+        for i in range(clip_len):
+            wr.write(
+                rng.integers(0, 255, (224, 224, 3), np.uint8)
+                if i % 30 == 0 else np.full((224, 224, 3), i, np.uint8)
+            )
+        wr.release()
+        desc = (
+            f"videofilesrc location={path} loop=true "
+            f"num-frames={n_frames} queue-size=128 ! "
+            "tensor_converter queue-size=128 ! "
+            "tensor_filter framework=jax model=zoo:mobilenet_v2 "
+            'custom="compute_dtype:bfloat16" ! '
+            "tensor_decoder mode=image_labeling ! "
+            "tensor_sink sync-window=16 queue-size=128"
+        )
+        p = parse_pipeline(desc)
+        return _steady_fps(p.run(timeout=900))
+
+    pipeline_media_fps = None
+    if not _over_budget():
+        try:
+            pipeline_media_fps = _pipeline_media_fps(512 if on_tpu else 16)
+        except Exception as exc:  # noqa: BLE001
+            print(f"[bench] media pipeline failed: {exc!r}", file=sys.stderr)
+    _mark("pipeline-media measured")
 
     # batched-ingest variant: fresh host frames, but 8 per transfer (the
     # converter's frames-per-tensor batching) — one device_put per invoke
@@ -442,31 +547,55 @@ def _run() -> None:
     # continuous batching (models/serving.py): 4 slots decoding together —
     # one batched step program amortizes the per-token dispatch + weight
     # reads over every active stream
-    lm_cb_tok_s = None
+    lm_cb_tok_s = lm_cb_spec_ngram_tok_s = lm_cb_spec_draft_tok_s = None
     if not _over_budget():
         from nnstreamer_tpu.models import serving as srv
 
         mlm = zoo.get("transformer_lm", **lm_kw)
-        cb = srv.ContinuousBatcher(
-            mlm.params, 8, n_slots=4, max_len=192, prompt_len=64,
-            compute_dtype=jnp.bfloat16,
-        )
-        prompts = [
-            rng.integers(1, 32000, (48,)).astype(np.int32) for _ in range(4)
-        ]
+        # repetitive prompts so prompt-lookup proposals can land (the
+        # spec cells measure the MACHINERY's throughput; acceptance on
+        # a random-weight model is the worst case for ngram)
+        base = rng.integers(1, 32000, (12,)).astype(np.int32)
+        prompts = [np.tile(base, 4) for _ in range(4)]
 
-        def _drain(budget):
-            rids = [cb.submit(p, budget) for p in prompts]
-            while any(cb.result(r) is None for r in rids):
-                cb.step()
-            return 4 * budget
+        def _cb_tok_s(pump, **cb_kw):
+            cb = srv.ContinuousBatcher(
+                mlm.params, 8, n_slots=4, max_len=448, prompt_len=64,
+                compute_dtype=jnp.bfloat16, **cb_kw,
+            )
 
-        _drain(4)  # compile prefill + batched step
-        t0 = time.perf_counter()
-        n = _drain(64)
-        lm_cb_tok_s = n / (time.perf_counter() - t0)
+            def _drain(budget):
+                rids = [cb.submit(p, budget) for p in prompts]
+                while any(cb.result(r) is None for r in rids):
+                    pump(cb)
+                return 4 * budget
 
-    _mark("lm-cb4 measured")
+            _drain(4)  # compile prefill + step/verify programs
+            t0 = time.perf_counter()
+            n = _drain(64)
+            return n / (time.perf_counter() - t0)
+
+        lm_cb_tok_s = _cb_tok_s(lambda cb: cb.step())
+        _mark("lm-cb4 measured")
+        # speculative pumps: prompt-lookup (free proposals) vs a draft
+        # model (d128/L2 proposing for the d512/L4 target) — the tok/s
+        # comparison VERDICT r3 #5 asks for
+        if not _over_budget():
+            lm_cb_spec_ngram_tok_s = _cb_tok_s(
+                lambda cb: cb.spec_step(k=4, ngram=1)
+            )
+            _mark("lm-cb4-spec-ngram measured")
+        if not _over_budget():
+            mdraft = zoo.get(
+                "transformer_lm", vocab="32000", d_model="128",
+                n_heads="8", n_layers="2", seqlen="128",
+                compute_dtype="bfloat16",
+            )
+            lm_cb_spec_draft_tok_s = _cb_tok_s(
+                lambda cb: cb.spec_step(k=4),
+                draft_params=mdraft.params, draft_n_heads=8,
+            )
+            _mark("lm-cb4-spec-draft measured")
     # deep microbatch: 32 frames/invoke — past the dispatch-bound knee,
     # so this is the number that reflects device compute, not per-call
     # overhead (and the MFU that is fair to judge the chip against)
@@ -493,6 +622,34 @@ def _run() -> None:
         mb32_fps = iters32 * mb32 / (time.perf_counter() - t0)
 
     _mark("mb32 measured")
+    # compute-dense config: ViT-S/16. MobileNet-v2's depthwise convs
+    # are MXU-hostile (9 MACs/output on a 128×128 systolic array) and
+    # its 1×1 convs are bandwidth-bound at small batch — its MFU
+    # ceiling is architectural, not a framework defect (roofline in
+    # docs/BENCH_NOTES.md). A ViT is wall-to-wall dense matmuls, so
+    # THIS cell is the one that can show the MXU actually fed.
+    vit32_fps = None
+    vit_flops = None
+    if not _over_budget():
+        mv = zoo.get("vit", batch=str(mb32), compute_dtype="bfloat16")
+        fnv = jax.jit(mv.fn)
+        vframes = [
+            jnp.asarray(rng.integers(0, 255, (mb32, 224, 224, 3), np.uint8))
+            for _ in range(2)
+        ]
+        jax.block_until_ready(fnv(vframes[0]))
+        iters_v = 64
+        t0 = time.perf_counter()
+        out = None
+        for i in range(iters_v):
+            out = fnv(vframes[i % 2])
+            if (i + 1) % 16 == 0:
+                out.block_until_ready()
+        out.block_until_ready()
+        vit32_fps = iters_v * mb32 / (time.perf_counter() - t0)
+        vit_flops = _flops_per_frame(mv.fn, vframes[0])
+
+    _mark("vit-mb32 measured")
     # int8 serving path (models/quantize.py): the reference's
     # *_quant.tflite slot on the MXU's s8×s8→s32 units — same microbatch
     # as mb8 so the two numbers isolate the dtype effect
@@ -515,10 +672,59 @@ def _run() -> None:
         int8_fps = iters_i * mb / (time.perf_counter() - t0)
 
     _mark("int8 measured")
+
+    # HOST-PATH EXECUTOR CEILINGS (platform-independent): trivial
+    # pipelines over host tensors measure what the executor itself —
+    # threads, channels, Frame plumbing, sync policies — costs per
+    # frame, i.e. the fps/core ceiling it imposes on any pipeline.
+    # Runs in a CPU-pinned subprocess so a TPU-attached bench process
+    # doesn't turn the trivial jit into a tunnel round-trip. Chain =
+    # 3 nodes / 2 hops; branched = tee → 2 branches → mux(slowest) =
+    # 6 nodes / 7 hops + grouping (the multi-branch pressure case).
+    def _executor_ceilings():
+        import subprocess
+
+        code = r"""
+import time, jax
+jax.config.update("jax_platforms", "cpu")
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+N = 20000
+chain = (f"tensorsrc dimensions=4 num-frames={N} ! "
+         "tensor_filter framework=passthrough ! tensor_sink sync-window=64")
+branched = (f"tensorsrc dimensions=4 num-frames={N // 2} ! tee name=t "
+            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_0 "
+            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_1 "
+            "tensor_mux name=m sync-mode=slowest ! tensor_sink "
+            "sync-window=64")
+for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
+    p = parse_pipeline(desc)
+    t0 = time.perf_counter()
+    p.run(timeout=600)
+    print(f"{label} {n / (time.perf_counter() - t0):.1f}")
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=900, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        vals = {}
+        for line in out.stdout.splitlines():
+            bits = line.split()
+            if len(bits) == 2:
+                vals[bits[0]] = float(bits[1])
+        return vals.get("chain"), vals.get("branched")
+
+    executor_chain_fps = executor_branched_fps = None
+    try:
+        executor_chain_fps, executor_branched_fps = _executor_ceilings()
+    except Exception as exc:  # noqa: BLE001
+        print(f"[bench] executor ceilings failed: {exc!r}", file=sys.stderr)
+    _mark("executor ceilings measured")
+
     # achieved MFU from XLA cost analysis + public per-chip peak
     flops = _flops_per_frame(m.fn, frames[0])
     peak = _peak_tflops(str(dev.device_kind))
-    mfu = mfu8 = mfu32 = None
+    mfu = mfu8 = mfu32 = mfu_vit32 = None
     if flops and peak:
         mfu = fps * flops / (peak * 1e12)
         flops8 = _flops_per_frame(m8.fn, frames8[0])
@@ -528,6 +734,15 @@ def _run() -> None:
             flops32 = _flops_per_frame(m32.fn, frames32[0])
             if flops32:
                 mfu32 = mb32_fps * (flops32 / mb32) / (peak * 1e12)
+    if peak and vit32_fps and vit_flops:
+        mfu_vit32 = vit32_fps * (vit_flops / mb32) / (peak * 1e12)
+    # roofline inputs (docs/BENCH_NOTES.md): XLA bytes-accessed for the
+    # mb32 programs → arithmetic intensity vs the chip's ridge point
+    mbv2_bytes32 = vit_bytes32 = None
+    if mb32_fps:
+        mbv2_bytes32 = _bytes_per_invoke(m32.fn, frames32[0])
+    if vit32_fps:
+        vit_bytes32 = _bytes_per_invoke(mv.fn, vframes[0])
 
     # BASELINE.md's bar is the PIPELINE number; lead with it when the
     # pipeline section produced one (raw invoke stays as its own field)
@@ -549,6 +764,10 @@ def _run() -> None:
                 "pipeline_h2d_fps": _round(pipeline_h2d_fps),
                 "pipeline_mb8_fps": _round(pipeline_mb8_fps),
                 "pipeline_mb32_fps": _round(pipeline_mb32_fps),
+                "pipeline_branched_fps": _round(pipeline_branched_fps),
+                "pipeline_media_fps": _round(pipeline_media_fps),
+                "executor_chain_fps": _round(executor_chain_fps),
+                "executor_branched_fps": _round(executor_branched_fps),
                 "raw_invoke_bs1_fps": round(fps, 1),
                 "p50_sync_latency_ms": round(p50, 3),
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
@@ -556,16 +775,24 @@ def _run() -> None:
                 "h2d_batched8_fps": _round(h2d_b8_fps),
                 "microbatch8_fps": round(mb_fps, 1),
                 "microbatch32_fps": _round(mb32_fps),
+                "vit_mb32_fps": _round(vit32_fps),
                 "int8_mb8_fps": _round(int8_fps),
                 "composite_face_fps": _round(composite_fps),
                 "composite_fused_fps": _round(fused_fps),
                 "lm_decode_tok_s": _round(lm_tok_s),
                 "lm_decode_int8w_tok_s": _round(lm_int8w_tok_s),
                 "lm_cb4_tok_s": _round(lm_cb_tok_s),
+                "lm_cb4_spec_ngram_tok_s": _round(lm_cb_spec_ngram_tok_s),
+                "lm_cb4_spec_draft_tok_s": _round(lm_cb_spec_draft_tok_s),
                 "flops_per_frame": flops,
                 "mfu_bs1": round(mfu, 4) if mfu is not None else None,
                 "mfu_mb8": round(mfu8, 4) if mfu8 is not None else None,
                 "mfu_mb32": round(mfu32, 4) if mfu32 is not None else None,
+                "mfu_vit_mb32": (
+                    round(mfu_vit32, 4) if mfu_vit32 is not None else None
+                ),
+                "mbv2_mb32_bytes_accessed": mbv2_bytes32,
+                "vit_mb32_bytes_accessed": vit_bytes32,
                 "platform": dev.platform,
                 "device": str(dev.device_kind),
             }

@@ -24,8 +24,10 @@ Elements:
 
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
 from fractions import Fraction
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -70,9 +72,93 @@ def _to_format(cv2, bgr: np.ndarray, fmt: str) -> np.ndarray:
     return np.ascontiguousarray(out)
 
 
+_EOF = object()
+
+
+class _DecodeAhead:
+    """Decode-ahead thread + bounded frame queue.
+
+    Synchronous decode on the source thread serializes decode with the
+    pipeline's per-frame host work — at target rates the decoder must
+    run WHILE the previous frame uploads/infers, the role the kernel's
+    buffer queue plays for the reference's v4l2src (its converter is
+    handed already-queued buffers, gsttensor_converter.c:1046-1270).
+    A single dedicated thread pulls frames from ``read_fn`` into a
+    bounded FIFO; the source's generate() pops. Order and PTS are
+    preserved by construction: one decoder thread + one FIFO means
+    frames leave in decode order, and the consumer stamps PTS from its
+    own monotone counter — overlap can neither reorder nor re-stamp.
+
+    ``depth`` bounds decoded-but-unconsumed frames (memory AND, for a
+    live camera, the staleness window)."""
+
+    def __init__(self, read_fn: Callable[[], Optional[np.ndarray]],
+                 depth: int = 8) -> None:
+        self._read = read_fn  # returns a decoded frame or None at EOF
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="decode-ahead"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.is_set():
+            img = self._read()
+            item = _EOF if img is None else img
+            while True:
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue_mod.Full:
+                    if self._stop_evt.is_set():
+                        return
+            if item is _EOF:
+                return
+
+    def get(self, timeout: float = 0.1):
+        """Next decoded frame; _EOF at end of stream; None when the
+        decoder hasn't produced one yet (caller re-polls — the Source
+        contract's no-data-yet value)."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+
+    def stop(self) -> bool:
+        """Stop the decode thread. Returns True when it actually
+        joined — False means it is still blocked inside the decoder
+        (e.g. a wedged camera read), and the CALLER MUST NOT release
+        the underlying capture handle (a native read racing release()
+        is a use-after-free inside the decoder; leaking the handle to
+        the daemon thread is the safe failure)."""
+        self._stop_evt.set()
+        if self._thread is None:
+            return True
+        # unblock a put() stuck on a full queue, then join
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue_mod.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        joined = not self._thread.is_alive()
+        if joined:
+            self._thread = None
+        return joined
+
+
 @registry.element("videofilesrc")
 class VideoFileSrc(Source):
-    """Decode an encoded video (or still image) file into video frames."""
+    """Decode an encoded video (or still image) file into video frames.
+
+    Decoding runs on a decode-ahead thread (prop ``decode-ahead``, the
+    queue depth, default 8; 0 = synchronous decode on the source
+    thread), overlapping decode with downstream upload/inference."""
 
     FACTORY_NAME = "videofilesrc"
 
@@ -82,10 +168,12 @@ class VideoFileSrc(Source):
         self.format = str(self.get_property("format", "RGB")).upper()
         self.loop = _parse_bool(self.get_property("loop", False))
         self.num_frames = int(self.get_property("num-frames", -1))
+        self.decode_ahead = int(self.get_property("decode-ahead", 8))
         self._rate_override = self.get_property("framerate")
         if not self.location:
             raise ValueError(f"{self.name}: videofilesrc needs location=")
         self._cap = None
+        self._ahead: Optional[_DecodeAhead] = None
         self._image: Optional[np.ndarray] = None
         self._i = 0
         # probe at build time so negotiation has real width/height/rate
@@ -150,27 +238,52 @@ class VideoFileSrc(Source):
                 raise ElementError(
                     f"{self.name}: cannot open video {self.location!r}"
                 )
+            if self.decode_ahead > 0:
+                self._ahead = _DecodeAhead(
+                    self._read_one, depth=self.decode_ahead
+                )
+                self._ahead.start()
 
     def stop(self) -> None:
+        joined = True
+        if self._ahead is not None:
+            joined = self._ahead.stop()
+            self._ahead = None
         if self._cap is not None:
-            self._cap.release()
+            if joined:
+                self._cap.release()
+            # else: the decode thread is still inside read() — leak the
+            # handle to it rather than race a native read with release()
             self._cap = None
+
+    def _read_one(self) -> Optional[np.ndarray]:
+        """Decode the next frame (loop-rewinding at EOF); runs on the
+        decode-ahead thread when enabled, else the source thread."""
+        cv2 = _require_cv2()
+        ret, bgr = self._cap.read()
+        if not ret:
+            if self.loop:
+                self._cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
+                ret, bgr = self._cap.read()
+            if not ret:
+                return None
+        return _to_format(cv2, bgr, self.format)
 
     def generate(self):
         if 0 <= self.num_frames <= self._i:
             return EOS_FRAME
         if self._image is not None:
             img = self._image
+        elif self._ahead is not None:
+            img = self._ahead.get()
+            if img is None:
+                return None  # decoder busy: no data yet, re-poll
+            if img is _EOF:
+                return EOS_FRAME
         else:
-            cv2 = _require_cv2()
-            ret, bgr = self._cap.read()
-            if not ret:
-                if self.loop and self._i > 0:
-                    self._cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
-                    ret, bgr = self._cap.read()
-                if not ret:
-                    return EOS_FRAME
-            img = _to_format(cv2, bgr, self.format)
+            img = self._read_one()
+            if img is None:
+                return EOS_FRAME
         pts, dur = _frame_pts(self._i, self._rate)
         self._i += 1
         return Frame((img,), pts=pts, duration=dur, meta={"media_type": "video"})
@@ -178,7 +291,11 @@ class VideoFileSrc(Source):
 
 @registry.element("v4l2src")
 class V4l2Src(Source):
-    """Live camera capture (V4L2 device or camera index) via OpenCV."""
+    """Live camera capture (V4L2 device or camera index) via OpenCV.
+
+    Capture runs on a decode-ahead thread (prop ``decode-ahead``, queue
+    depth, default 4 — small: for a LIVE source the queue depth is also
+    the staleness window; 0 = synchronous capture)."""
 
     FACTORY_NAME = "v4l2src"
 
@@ -193,8 +310,10 @@ class V4l2Src(Source):
         self.num_frames = int(self.get_property("num-frames", -1))
         self.req_width = int(self.get_property("width", 0))
         self.req_height = int(self.get_property("height", 0))
+        self.decode_ahead = int(self.get_property("decode-ahead", 4))
         self._rate_override = self.get_property("framerate")
         self._cap = None
+        self._ahead: Optional[_DecodeAhead] = None
         self._i = 0
         self._probe()
 
@@ -246,20 +365,43 @@ class V4l2Src(Source):
         self._i = 0
         if self._cap is None:
             self._cap = self._open_cap()
+        if self.decode_ahead > 0 and self._ahead is None:
+            self._ahead = _DecodeAhead(
+                self._read_one, depth=self.decode_ahead
+            )
+            self._ahead.start()
 
     def stop(self) -> None:
+        joined = True
+        if self._ahead is not None:
+            joined = self._ahead.stop()
+            self._ahead = None
         if self._cap is not None:
-            self._cap.release()
+            if joined:
+                self._cap.release()
+            # else: wedged camera read in flight — leak, don't race
             self._cap = None
+
+    def _read_one(self) -> Optional[np.ndarray]:
+        cv2 = _require_cv2()
+        ret, bgr = self._cap.read()
+        if not ret:
+            return None
+        return _to_format(cv2, bgr, self.format)
 
     def generate(self):
         if 0 <= self.num_frames <= self._i:
             return EOS_FRAME
-        cv2 = _require_cv2()
-        ret, bgr = self._cap.read()
-        if not ret:
-            return EOS_FRAME
-        img = _to_format(cv2, bgr, self.format)
+        if self._ahead is not None:
+            img = self._ahead.get()
+            if img is None:
+                return None  # capture in flight: no data yet, re-poll
+            if img is _EOF:
+                return EOS_FRAME
+        else:
+            img = self._read_one()
+            if img is None:
+                return EOS_FRAME
         pts, dur = _frame_pts(self._i, self._rate)
         self._i += 1
         return Frame((img,), pts=pts, duration=dur, meta={"media_type": "video"})
