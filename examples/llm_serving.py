@@ -89,3 +89,28 @@ while wp.result(rh) is None:
     wp.step()
 print(f"H: prompt 150 > ring 64 — exact sliding-window prefill, "
       f"{len(wp.result(rh))} tokens out")
+
+print("\n-- speculative rounds: prompt-lookup, then a draft model --")
+pattern = np.tile(np.asarray([5, 9, 13], np.int32), 6)
+sp = ContinuousBatcher(params, n_heads=8, n_slots=2, max_len=128,
+                       prompt_len=32)
+ri = sp.submit(pattern, 16)
+rj = sp.submit(rng.integers(1, 1024, (8,)), 8, temperature=0.7, seed=7)
+while sp.result(ri) is None or sp.result(rj) is None:
+    sp.spec_step(k=4, ngram=1)  # greedy exact; sampled distribution-exact
+st = sp.stats()
+print(f"I/J: {st['tokens_emitted']} tokens in {st['spec_rounds']} "
+      f"verify rounds ({st['spec_accepted_tokens']} speculated tokens "
+      "accepted)")
+
+draft = tfm.init_params(
+    jax.random.PRNGKey(9), vocab=1024, d_model=64, n_heads=4, n_layers=1
+)
+ds = ContinuousBatcher(params, n_heads=8, n_slots=2, max_len=128,
+                       prompt_len=32, draft_params=draft, draft_n_heads=4)
+rk = ds.submit(rng.integers(1, 1024, (10,)), 12)
+while ds.result(rk) is None:
+    ds.spec_step(k=4)
+st = ds.stats()
+print(f"K (draft model proposes): {st['tokens_emitted']} tokens, "
+      f"{st['spec_accepted_tokens']} draft proposals accepted")

@@ -57,6 +57,26 @@ def _init_persistent_cache() -> None:
         return
     cache_dir = os.path.expanduser(cache_dir)
     try:
+        # CPU AOT cache entries embed the COMPILING host's feature set
+        # yet reload on any host (cpu_aot_loader then warns about
+        # mismatched machine features and may SIGILL mid-inference) —
+        # key the directory by a host fingerprint so a cache baked on
+        # one machine is never replayed on a different one. TPU entries
+        # key on the device kind already; this only fences the CPU side.
+        import hashlib
+        import platform as _platform
+
+        fp = _platform.machine()
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = next(
+                    (ln for ln in f if ln.startswith("flags")), ""
+                )
+            if flags:
+                fp += "-" + hashlib.sha1(flags.encode()).hexdigest()[:12]
+        except OSError:
+            pass
+        cache_dir = os.path.join(cache_dir, fp)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

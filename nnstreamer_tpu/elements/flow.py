@@ -48,7 +48,9 @@ class Queue(TensorOp):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self.queue_size = int(self.get_property("max-size-buffers", 4))
+        # matches the executor's default channel depth (elements/base.py):
+        # an explicit queue should not silently SHRINK the link it tunes
+        self.queue_size = int(self.get_property("max-size-buffers", 64))
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         return list(in_specs)

@@ -99,7 +99,7 @@ class _LlmServer:
         m = zoo.get(model[len("zoo:"):], **options)
         n_heads = int(options.get("n_heads", 8))
         draft_kw = {}
-        if speculate_model and speculate < 2:
+        if speculate_model and speculate != -1 and speculate < 2:
             # a draft model exists ONLY to propose speculate=k chunks;
             # without this, every request would pay the draft prefill
             # for a proposer the plain-step pump never consults
@@ -146,8 +146,14 @@ class _LlmServer:
         self.stream = stream
         # speculate=k: pump via spec_step(k) — prompt-lookup speculation
         # batched over slots (greedy slots emit several tokens per
-        # program launch when the guesses land; exact equivalence)
+        # program launch when the guesses land; exact equivalence).
+        # speculate=auto (-1): k adapts to the measured acceptance rate
+        # (EMA) between 2 and 8 — long chunks when guesses land, minimal
+        # verify width when they don't.
         self.speculate = speculate
+        self._spec_k = 4
+        self._acc_ema = 0.5
+        self._spec_seen = (0, 0)  # (rounds, accepted) at last adapt
         self._sent: Dict[int, int] = {}  # rid -> tokens already streamed
 
     def submit(self, frame: Frame) -> None:
@@ -181,7 +187,21 @@ class _LlmServer:
     def pump(self) -> bool:
         """One decode step; harvest finished requests (and, in streaming
         mode, every new token). True if anything advanced."""
-        if self.speculate > 1:
+        if self.speculate == -1:
+            emitted = self.cb.spec_step(k=self._spec_k)
+            st = self.cb.stats()
+            rounds, acc = st["spec_rounds"], st["spec_accepted_tokens"]
+            dr = rounds - self._spec_seen[0]
+            if dr > 0:
+                rate = (acc - self._spec_seen[1]) / (
+                    dr * max(1, self._spec_k - 1)
+                )
+                self._acc_ema = 0.7 * self._acc_ema + 0.3 * rate
+                self._spec_k = min(
+                    8, max(2, 2 + int(round(self._acc_ema * 6)))
+                )
+                self._spec_seen = (rounds, acc)
+        elif self.speculate > 1:
             emitted = self.cb.spec_step(k=self.speculate)
         else:
             emitted = self.cb.step()
@@ -244,7 +264,8 @@ class LlmServerSink(Sink):
     ``max_new_tokens`` meta overrides), stream (one frame per NEW
     token then a done frame), speculate (=k: pump via spec_step —
     prompt-lookup speculation batched over slots, working across
-    sampling/windowed/Pallas configurations), speculate-model
+    sampling/windowed/Pallas configurations; =auto adapts k to the
+    measured acceptance rate), speculate-model
     (zoo:<name>: a DRAFT model proposes the speculate=k chunks instead
     of prompt-lookup; configure it with draft_-prefixed keys in the
     custom dict, e.g. draft_d_model/draft_n_layers/draft_n_heads —
@@ -273,7 +294,10 @@ class LlmServerSink(Sink):
             prompt_len=int(self.get_property("prompt-len", 64)),
             default_new=int(self.get_property("max-new-tokens", 16)),
             stream=_parse_bool(self.get_property("stream", False)),
-            speculate=int(self.get_property("speculate", 0)),
+            speculate=(
+                -1 if str(self.get_property("speculate", 0)) == "auto"
+                else int(self.get_property("speculate", 0))
+            ),
             speculate_model=str(self.get_property("speculate-model", "")),
         )
         self._server: Optional[_LlmServer] = None

@@ -252,9 +252,11 @@ class VideoFileSrc(Source):
         if self._cap is not None:
             if joined:
                 self._cap.release()
-            # else: the decode thread is still inside read() — leak the
-            # handle to it rather than race a native read with release()
-            self._cap = None
+                self._cap = None
+            # else: the decode thread is still inside read() — leave the
+            # handle with it (release() racing a native read is a
+            # use-after-free, and the thread's rewind path still
+            # dereferences self._cap when the read returns)
 
     def _read_one(self) -> Optional[np.ndarray]:
         """Decode the next frame (loop-rewinding at EOF); runs on the
@@ -379,8 +381,9 @@ class V4l2Src(Source):
         if self._cap is not None:
             if joined:
                 self._cap.release()
-            # else: wedged camera read in flight — leak, don't race
-            self._cap = None
+                self._cap = None
+            # else: wedged camera read in flight — leave the handle with
+            # the thread (leak, don't race)
 
     def _read_one(self) -> Optional[np.ndarray]:
         cv2 = _require_cv2()

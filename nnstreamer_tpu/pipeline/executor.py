@@ -148,8 +148,8 @@ class Node:
         self.ex = ex
         self.name = name
         self.in_queues: List[_Chan] = []
-        # out pad -> (dst node, dst pad)
-        self.outs: Dict[int, Tuple["Node", int]] = {}
+        # out pad -> consumers [(dst node, dst pad), ...]
+        self.outs: Dict[int, List[Tuple["Node", int]]] = {}
         self.thread: Optional[threading.Thread] = None
         self.frames_processed = 0
         self.proc_time_ema_ms = 0.0
@@ -161,10 +161,12 @@ class Node:
 
     # -- data movement ----------------------------------------------------
     def push_out(self, pad: int, item) -> None:
-        dst, dst_pad = self.outs[pad]
-        dst.in_queues[dst_pad].put(item, self.ex.stop_event)
-        if dst._needs_notify:
-            dst.notify()
+        # an out pad may feed several consumers (eliminated tee fan-out);
+        # frames are immutable, so every consumer shares the same object
+        for dst, dst_pad in self.outs[pad]:
+            dst.in_queues[dst_pad].put(item, self.ex.stop_event)
+            if dst._needs_notify:
+                dst.notify()
 
     def notify(self) -> None:
         """Data arrived on one of this node's input queues. Nodes that
@@ -470,8 +472,42 @@ class Executor:
     # -- construction ------------------------------------------------------
     def _build(self) -> None:
         p = self.plan.pipeline
+        from nnstreamer_tpu.elements.flow import Queue as _QueueElem
+        from nnstreamer_tpu.elements.flow import Tee as _TeeElem
+
+        # ---- forwarding-element elimination ----
+        # tee and queue do no per-frame WORK: tee re-emits the same
+        # immutable frame to every branch, queue forwards 1:1. As nodes
+        # they'd each cost a thread + an extra channel hop per frame —
+        # pure overhead on exactly the branched pipelines where host
+        # budget is tightest. Their PLANNING roles survive elimination:
+        # queue already split fusion segments at plan time (its two
+        # sides stay separate threads), and its max-size-buffers rides
+        # along as the rewritten link's channel depth; tee becomes
+        # multi-consumer fan-out on the producer's out pad.
+        links = [[l.src, l.src_pad, l.dst, l.dst_pad, None] for l in p.links]
+        eliminated = set()
+        for e in p.elements:
+            if type(e) not in (_TeeElem, _QueueElem):
+                continue
+            ins = [L for L in links if L[2] is e]
+            outs_ = [L for L in links if L[0] is e]
+            if len(ins) != 1 or not outs_:
+                continue  # odd wiring: keep the real node
+            src, src_pad, _, _, in_size = ins[0]
+            size = e.queue_size if type(e) is _QueueElem else in_size
+            links = [L for L in links if L[0] is not e and L[2] is not e]
+            for o in outs_:
+                links.append(
+                    [src, src_pad, o[2], o[3],
+                     size if size is not None else o[4]]
+                )
+            eliminated.add(e)
+
         # create nodes
         for e in p.elements:
+            if e in eliminated:
+                continue
             if isinstance(e, TensorOp):
                 seg = self.plan.seg_of.get(e)
                 if seg is None:  # non-traceable: host-path adapter
@@ -494,18 +530,20 @@ class Executor:
                 raise TypeError(f"cannot execute element {e!r}")
             self._node_of[e] = node
         self.nodes = list(dict.fromkeys(self._node_of.values()))
-        # wire queues: only links that cross node boundaries materialize
-        for l in p.links:
-            src_node = self._node_of[l.src]
-            dst_node = self._node_of[l.dst]
+        # wire channels: only links that cross node boundaries materialize
+        for src, src_pad, dst, dst_pad, size in links:
+            src_node = self._node_of[src]
+            dst_node = self._node_of[dst]
             if src_node is dst_node:
                 continue  # intra-segment link (fused away)
             # node-level pad indices: fused nodes expose single in/out pad
-            src_pad = 0 if isinstance(src_node, FusedNode) else l.src_pad
-            dst_pad = 0 if isinstance(dst_node, FusedNode) else l.dst_pad
-            while len(dst_node.in_queues) <= dst_pad:
-                dst_node.add_in_queue(l.dst.queue_size)
-            src_node.outs[src_pad] = (dst_node, dst_pad)
+            sp = 0 if isinstance(src_node, FusedNode) else src_pad
+            dp = 0 if isinstance(dst_node, FusedNode) else dst_pad
+            while len(dst_node.in_queues) <= dp:
+                dst_node.add_in_queue(dst.queue_size)
+            if size is not None:  # an eliminated queue's depth override
+                dst_node.in_queues[dp] = _Chan(size)
+            src_node.outs.setdefault(sp, []).append((dst_node, dp))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

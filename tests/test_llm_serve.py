@@ -333,3 +333,43 @@ def test_speculate_model_prop_draft_speculation():
     )
     assert spec == plain
     assert stats.get("spec_rounds", 0) > 0
+
+
+def test_speculate_auto_adapts_and_matches_plain():
+    """speculate=auto: the pump picks its own chunk width from the
+    measured acceptance EMA — same tokens as plain serving, k stays in
+    the documented [2, 8] band."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    prompt = np.asarray([3, 4, 3, 4, 3, 4, 3], np.int32)
+
+    def run(srv_id, extra):
+        src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+        sink = LlmServerSink(
+            **{"id": srv_id, "model": "zoo:transformer_lm",
+               "custom": MODEL_OPTS, "n-slots": 1, "max-len": 64,
+               "prompt-len": 16, "max-new-tokens": 8, **extra}
+        )
+        out_src = LlmServerSrc(**{"id": srv_id})
+        out_sink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.chain(out_src, out_sink)
+        p.start()
+        try:
+            src.push(Frame((prompt,), meta={"req": "x"}))
+            src.end_of_stream()
+            f = out_sink.pop(timeout=120)
+            srv = sink._server
+            return [int(t) for t in np.asarray(f.tensors[0])[0]], srv
+        finally:
+            p.stop()
+
+    plain, _ = run("autoA", {})
+    spec, srv = run("autoB", {"speculate": "auto"})
+    assert spec == plain
+    assert 2 <= srv._spec_k <= 8

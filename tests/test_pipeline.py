@@ -449,3 +449,88 @@ def test_sink_collects_e2e_latency_for_stamped_frames():
     ex2 = p2.run(timeout=30)
     node2 = next(n for n in ex2.nodes if isinstance(n, SinkNode))
     assert not node2.latencies
+
+
+class TestForwardingElimination:
+    """tee and queue do no per-frame work; the executor wires their
+    producers straight to their consumers (r4) — same frames, fewer
+    threads and hops."""
+
+    def test_tee_and_queue_leave_no_nodes(self):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        p = parse_pipeline(
+            "tensorsrc dimensions=4 num-frames=6 ! tee name=t "
+            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_0 "
+            "t. ! queue ! tensor_filter framework=scaler "
+            "custom=factor:2.0 ! m.sink_1 "
+            "tensor_mux name=m sync-mode=nosync ! tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        names = {n.name for n in ex.nodes}
+        assert not any("tee" in n or "queue" in n for n in names)
+        # src, 2 fused filters, mux, sink
+        assert len(ex.nodes) == 5
+        sink = p["out"]
+        assert sink.rendered == 6
+        # branch 0 passthrough vs branch 1 scaled ×2 of the same frame
+        for f in sink.frames:
+            a, b = np.asarray(f.tensors[0]), np.asarray(f.tensors[1])
+            np.testing.assert_allclose(b, a * 2.0)
+
+    def test_queue_sizes_rewritten_channel(self):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        p = parse_pipeline(
+            "tensorsrc dimensions=2 num-frames=3 ! "
+            "queue max-size-buffers=7 ! "
+            "tensor_filter framework=passthrough ! tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        fused = next(n for n in ex.nodes if "filter" in n.name)
+        assert fused.in_queues[0]._max == 7
+        assert p["out"].rendered == 3
+
+    def test_queue_still_splits_fusion(self):
+        """An explicit queue between traceable ops must keep forcing a
+        segment split (its planning role) even though its node is gone."""
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        p = parse_pipeline(
+            "tensorsrc dimensions=2 num-frames=2 ! "
+            "tensor_filter framework=passthrough ! queue ! "
+            "tensor_filter framework=passthrough ! tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        from nnstreamer_tpu.pipeline.executor import FusedNode
+
+        fused = [n for n in ex.nodes if isinstance(n, FusedNode)]
+        assert len(fused) == 2  # split held
+        assert p["out"].rendered == 2
+
+
+def test_chan_stress_no_loss_no_deadlock():
+    """Hammer the SPSC channel's park/wake edges (Dekker flags +
+    low-water hysteresis) from two threads with adversarial sizes:
+    every item must arrive, in order, without deadlock."""
+    import threading
+
+    from nnstreamer_tpu.pipeline.executor import _Chan
+
+    for maxsize in (1, 2, 3, 64):
+        ch = _Chan(maxsize)
+        stop = threading.Event()
+        N = 20000
+        got = []
+
+        def consume():
+            while len(got) < N:
+                got.append(ch.get(stop))
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        for i in range(N):
+            ch.put(i, stop)
+        t.join(timeout=60)
+        assert not t.is_alive(), f"consumer deadlocked at maxsize={maxsize}"
+        assert got == list(range(N)), f"loss/reorder at maxsize={maxsize}"

@@ -566,3 +566,51 @@ class TestDraftBatcherSpeculation:
 def _alone_97(params, prompt, n_new):
     toks = dec.generate(params, np.asarray(prompt)[None], 4, n_new)
     return [int(t) for t in np.asarray(toks)[0]]
+
+
+def test_spec_windowed_gqa_matches_plain():
+    """Grouped-query attention composes with windowed speculation: the
+    ring verify's concat attention is GQA-aware (KV < H heads)."""
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    params = tfm.init_params(
+        jax.random.PRNGKey(13), vocab=97, d_model=64, n_heads=4,
+        n_layers=2, n_kv_heads=2,
+    )
+    pattern = np.tile(np.asarray([5, 9, 11], np.int32), 4)
+
+    def run(spec):
+        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=32,
+                               prompt_len=16, windowed=True)
+        rid = cb.submit(pattern, 24)
+        while cb.result(rid) is None:
+            cb.spec_step(k=4, ngram=1) if spec else cb.step()
+        return cb.result(rid)
+
+    assert run(True) == run(False)
+
+
+def test_spec_windowed_int8_prefix_composes():
+    """The deepest composition: int8 ring cache × registered prefix ×
+    speculation — byte-identical to plain int8 ring stepping of the
+    same prefixed request."""
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    params = tfm.init_params(
+        jax.random.PRNGKey(14), vocab=97, d_model=64, n_heads=4,
+        n_layers=2,
+    )
+    pfx = np.tile(np.asarray([3, 4, 5, 6], np.int32), 4)  # 16 = bucket
+    tail = np.asarray([3, 4, 5], np.int32)
+
+    def run(spec):
+        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=32,
+                               prompt_len=16, windowed=True,
+                               cache_dtype="int8")
+        pid = cb.register_prefix(pfx)
+        rid = cb.submit(tail, 20, prefix=pid)
+        while cb.result(rid) is None:
+            cb.spec_step(k=4, ngram=1) if spec else cb.step()
+        return cb.result(rid)
+
+    assert run(True) == run(False)
