@@ -775,17 +775,17 @@ for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
         flops8 = _flops_per_frame(m8.fn, frames8[0])
         if flops8:
             mfu8 = mb_fps * (flops8 / mb) / (peak * 1e12)
-        if mb32_fps:
-            # ONE lowering serves both the MFU numerator and the
-            # roofline bytes (a second .compile() of the batch-32
-            # program would cost multi-second XLA time in-budget)
-            cost32 = _cost_analysis(m32.fn, frames32[0])
-            flops32 = float(cost32.get("flops", 0.0)) or None
-            mbv2_bytes32 = (
-                float(cost32.get("bytes accessed", 0.0)) or None
-            )
-            if flops32:
-                mfu32 = mb32_fps * (flops32 / mb32) / (peak * 1e12)
+    if mb32_fps:
+        # ONE lowering serves both the MFU numerator and the roofline
+        # bytes (a second .compile() of the batch-32 program would cost
+        # multi-second XLA time in-budget). Outside the peak gate: the
+        # roofline bytes must record even on a chip generation missing
+        # from _PEAK_TFLOPS.
+        cost32 = _cost_analysis(m32.fn, frames32[0])
+        flops32 = float(cost32.get("flops", 0.0)) or None
+        mbv2_bytes32 = float(cost32.get("bytes accessed", 0.0)) or None
+        if flops32 and peak:
+            mfu32 = mb32_fps * (flops32 / mb32) / (peak * 1e12)
     if peak and vit32_fps and vit_flops:
         mfu_vit32 = vit32_fps * (vit_flops / mb32) / (peak * 1e12)
     vit_bytes32 = vit_bytes[0]

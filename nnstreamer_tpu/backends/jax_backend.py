@@ -62,21 +62,27 @@ def _init_persistent_cache() -> None:
         # mismatched machine features and may SIGILL mid-inference) —
         # key the directory by a host fingerprint so a cache baked on
         # one machine is never replayed on a different one. TPU entries
-        # key on the device kind already; this only fences the CPU side.
-        import hashlib
-        import platform as _platform
+        # key on the device kind already and stay SHARED (a fleet
+        # cache over NFS must not recompile per host CPU stepping), so
+        # the fingerprint applies only when the backend compiling into
+        # this cache is the CPU.
+        if jax.default_backend() == "cpu":
+            import hashlib
+            import platform as _platform
 
-        fp = _platform.machine()
-        try:
-            with open("/proc/cpuinfo") as f:
-                flags = next(
-                    (ln for ln in f if ln.startswith("flags")), ""
-                )
-            if flags:
-                fp += "-" + hashlib.sha1(flags.encode()).hexdigest()[:12]
-        except OSError:
-            pass
-        cache_dir = os.path.join(cache_dir, fp)
+            fp = _platform.machine()
+            try:
+                with open("/proc/cpuinfo") as f:
+                    flags = next(
+                        (ln for ln in f if ln.startswith("flags")), ""
+                    )
+                if flags:
+                    fp += (
+                        "-" + hashlib.sha1(flags.encode()).hexdigest()[:12]
+                    )
+            except OSError:
+                pass
+            cache_dir = os.path.join(cache_dir, fp)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -153,7 +153,7 @@ class _LlmServer:
         self.speculate = speculate
         self._spec_k = 4
         self._acc_ema = 0.5
-        self._spec_seen = (0, 0)  # (rounds, accepted) at last adapt
+        self._spec_seen = (0, 0)  # (columns, accepted) at last adapt
         self._sent: Dict[int, int] = {}  # rid -> tokens already streamed
 
     def submit(self, frame: Frame) -> None:
@@ -190,17 +190,19 @@ class _LlmServer:
         if self.speculate == -1:
             emitted = self.cb.spec_step(k=self._spec_k)
             st = self.cb.stats()
-            rounds, acc = st["spec_rounds"], st["spec_accepted_tokens"]
-            dr = rounds - self._spec_seen[0]
-            if dr > 0:
-                rate = (acc - self._spec_seen[1]) / (
-                    dr * max(1, self._spec_k - 1)
-                )
+            # normalize by proposal COLUMNS, not rounds: a round offers
+            # active_slots×(k-1) proposals, so a rounds-based rate would
+            # saturate on multi-slot servers and pin k at max exactly
+            # when acceptance is poor
+            cols, acc = st["spec_columns"], st["spec_accepted_tokens"]
+            dc = cols - self._spec_seen[0]
+            if dc > 0:
+                rate = (acc - self._spec_seen[1]) / dc
                 self._acc_ema = 0.7 * self._acc_ema + 0.3 * rate
                 self._spec_k = min(
                     8, max(2, 2 + int(round(self._acc_ema * 6)))
                 )
-                self._spec_seen = (rounds, acc)
+                self._spec_seen = (cols, acc)
         elif self.speculate > 1:
             emitted = self.cb.spec_step(k=self.speculate)
         else:

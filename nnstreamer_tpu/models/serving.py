@@ -1004,6 +1004,7 @@ class ContinuousBatcher:
         self._n_tokens = 0
         self._n_spec_rounds = 0
         self._n_spec_accepted = 0
+        self._n_spec_columns = 0  # proposal columns offered (normalizer)
         self._step_time_s = 0.0
 
     def _empty_stage(self):
@@ -1509,6 +1510,7 @@ class ContinuousBatcher:
                 self._n_tokens += n_emitted
                 self._n_spec_rounds += 1
                 self._n_spec_accepted += accepted
+                self._n_spec_columns += int(active_np.sum()) * (k_round - 1)
                 self._step_time_s += _time.perf_counter() - t0
                 return emitted
 
@@ -1530,6 +1532,9 @@ class ContinuousBatcher:
                 ),
                 "spec_rounds": self._n_spec_rounds,
                 "spec_accepted_tokens": self._n_spec_accepted,
+                # accepted/columns is the true per-proposal acceptance
+                # rate whatever the slot occupancy or k was per round
+                "spec_columns": self._n_spec_columns,
                 "slots_occupied": occupied,
                 "slots_free": self.n_slots - occupied,
                 "results_pending_pickup": len(self._done_pool),

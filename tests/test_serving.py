@@ -669,3 +669,71 @@ def test_device_sampling_at_real_vocab(params):
         assert all(0 <= t < 32768 for t in cb.result(rs))
         outs.append((cb.result(rs), cb.result(rg)))
     assert outs[0] == outs[1]  # deterministic per (seed, position)
+
+
+def test_concurrent_submit_spec_and_streaming_soak(params):
+    """Concurrency soak: one thread pumps spec rounds, one pumps plain
+    steps, two submitter threads race admissions, and a reader polls
+    partials — no deadlock, every request completes, and every greedy
+    result matches its solo generation (slot isolation under real
+    thread interleaving, the Python-side analogue of the TSAN suites)."""
+    import threading
+
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=4, max_len=96,
+                           prompt_len=16)
+    prompts = [_prompt(4 + i % 9, 400 + i) for i in range(12)]
+    rids: dict = {}
+    rid_lock = threading.Lock()
+    stop = threading.Event()
+
+    def pump(spec):
+        while not stop.is_set():
+            (cb.spec_step(k=3, ngram=1) if spec else cb.step())
+
+    def submitter(idx0):
+        for i in range(idx0, len(prompts), 2):
+            while True:
+                rid = cb.submit(prompts[i], 6)
+                if rid is not None:
+                    with rid_lock:
+                        rids[i] = rid
+                    break
+                cb.step()  # batch full: pumping IS the backpressure
+
+    def reader():
+        while not stop.is_set():
+            with rid_lock:
+                known = list(rids.values())
+            cb.partials(known)
+
+    threads = [
+        threading.Thread(target=pump, args=(True,), daemon=True),
+        threading.Thread(target=pump, args=(False,), daemon=True),
+        threading.Thread(target=reader, daemon=True),
+    ]
+    subs = [
+        threading.Thread(target=submitter, args=(k,), daemon=True)
+        for k in (0, 1)
+    ]
+    for t in threads + subs:
+        t.start()
+    for t in subs:
+        t.join(timeout=300)
+        assert not t.is_alive(), "submitter deadlocked"
+    deadline = __import__("time").monotonic() + 300
+    while True:
+        with rid_lock:
+            done = (
+                len(rids) == len(prompts)
+                and all(cb.result(r) is not None for r in rids.values())
+            )
+        if done:
+            break
+        assert __import__("time").monotonic() < deadline, "requests stuck"
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    for i, rid in rids.items():
+        assert cb.result(rid) == _alone(params, prompts[i], 6), (
+            f"request {i} diverged under concurrency"
+        )
