@@ -415,6 +415,99 @@ def commit_ring_chunk(cache, chunk_ks, chunk_vs, pos, n_commit, active):
     return cache
 
 
+def draft_windowed_propose(
+    params: Dict,
+    tok,
+    pos,
+    cache,
+    n_heads: int,
+    k: int,
+    compute_dtype=jnp.float32,
+):
+    """k-1 greedy draft proposals per slot against a RING cache WITHOUT
+    writing it — the draft-side sibling of batched_windowed_verify.
+
+    A draft stepping a ring in place would clobber window history with
+    K/V of proposals the target then rejects (the same hazard the
+    target's verify avoids). So the whole k-step chain runs in one
+    program against the PRE-write ring plus the chain's own fresh chunk
+    K/V (column j attends ring rows inside position pos+j's window and
+    chunk columns ≤ j), accumulating the chunk in a fixed [L, B, k]
+    buffer; commit_ring_chunk later lands only the accepted columns.
+
+    tok [B] (pending tokens, chunk column 0), pos [B] absolute fill →
+    (props [B, k-1] int32, chunk_ks, chunk_vs [L, B, k, KV, Dh]).
+    Inactive slots are NOT gated here — their proposals are garbage the
+    caller ignores, and commit_ring_chunk's ``active`` gate keeps their
+    writes out of the ring (the draft ring is always float; a quantized
+    target cache never makes the draft's quantized)."""
+    ring_k = cache[0]
+    L = ring_k.shape[0]
+    W = ring_k.shape[2]
+    b = tok.shape[0]
+    kv = ring_k.shape[3]
+    hd = ring_k.shape[4]
+    wp = pos % W
+    d_steps = (wp[:, None] - 1 - jnp.arange(W, dtype=jnp.int32)[None, :]) % W
+
+    chunk_ks = jnp.zeros((L, b, k, kv, hd), compute_dtype)
+    chunk_vs = jnp.zeros((L, b, k, kv, hd), compute_dtype)
+    toks0 = jnp.zeros((b, k), jnp.int32).at[:, 0].set(tok)
+
+    def step(carry, j):
+        cur, cks, cvs, toks = carry
+        x = tfm.embed_lookup(params["embed"], cur, compute_dtype)[:, None, :]
+        positions = (pos + j)[:, None]
+        # ring rows live for column j: written (d ≤ pos-1) and inside
+        # the window of absolute position pos+j (d ≤ W-2-j)
+        ring_mask = (
+            d_steps <= jnp.minimum(pos - 1, W - 2 - j)[:, None]
+        )[:, None, :]  # [B, 1, W]
+        chunk_mask = (
+            jnp.arange(k, dtype=jnp.int32)[None, None, :] <= j
+        )  # [1, 1, k] — columns ≤ j (col j written below before attend)
+        mask = jnp.concatenate(
+            [ring_mask, jnp.broadcast_to(chunk_mask, (b, 1, k))], axis=2
+        )
+
+        def body(xc, layer):
+            x = xc
+            blk, ck, cv, cks_l, cvs_l = layer
+            q, kk, v = tfm.block_qkv(x, blk, n_heads, positions)
+            cks_l = jax.lax.dynamic_update_slice(
+                cks_l, kk.astype(cks_l.dtype), (0, j, 0, 0)
+            )
+            cvs_l = jax.lax.dynamic_update_slice(
+                cvs_l, v.astype(cvs_l.dtype), (0, j, 0, 0)
+            )
+            o = tfm.cache_attention(
+                q,
+                jnp.concatenate([ck.astype(cks_l.dtype), cks_l], axis=1),
+                jnp.concatenate([cv.astype(cvs_l.dtype), cvs_l], axis=1),
+                mask,
+            )
+            o = o.astype(x.dtype).reshape(b, 1, -1)
+            x = x + o @ tfm.wt(blk["wo"], x.dtype)
+            x = tfm.block_ffn(x, blk)
+            return x, (cks_l, cvs_l)
+
+        xs = (params["blocks"],) + tuple(cache) + (cks, cvs)
+        x, (cks, cvs) = jax.lax.scan(body, x, xs)
+        x = tfm.rmsnorm(x, params["ln_f"])
+        logits = (x @ tfm.wt(params["head"], x.dtype)).astype(jnp.float32)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        toks = jnp.where(
+            (j + 1 < k), toks.at[:, jnp.minimum(j + 1, k - 1)].set(nxt), toks
+        )
+        return (nxt, cks, cvs, toks), None
+
+    (_, chunk_ks, chunk_vs, toks), _ = jax.lax.scan(
+        step, (tok, chunk_ks, chunk_vs, toks0),
+        jnp.arange(k, dtype=jnp.int32),
+    )
+    return toks[:, 1:], chunk_ks, chunk_vs
+
+
 def spec_accept(logits, toks, temp, topk, topp, keys, pos, sampling: bool):
     """Device-side acceptance for one speculative round.
 
@@ -624,22 +717,23 @@ class _DraftEngine:
     exactly like prompt lookup).
 
     Rollback is positional, like the target's: after a round the caller
-    resumes from the target's accepted pos — accepted positions hold the
-    draft's own proposals (it wrote them while proposing), and rejected
-    positions are overwritten before any mask reaches them. That
-    invariant needs a LINEAR cache: on a ring, rejected draft writes
-    would clobber live window history (the target survives this by
-    verifying pre-write and committing post-acceptance; a draft gains
-    nothing from that machinery, so windowed servers use prompt-lookup
-    instead — enforced at construction)."""
+    resumes from the target's accepted pos. On a LINEAR cache the draft
+    writes while proposing — accepted positions hold its own proposals,
+    rejected ones are overwritten before any mask reaches them. On a
+    WINDOWED ring that invariant fails (rejected writes would clobber
+    live window history), so the draft uses the same verify-then-commit
+    discipline as the target: draft_windowed_propose runs the whole
+    chain against the pre-write ring plus its own fresh chunk, and
+    commit() lands only the accepted columns after the target rules."""
 
     def __init__(self, params, n_heads, n_slots, max_len, prompt_len,
-                 compute_dtype):
+                 compute_dtype, windowed: bool = False):
         self.params = params
         self.n_heads = n_heads
         self.prompt_len = prompt_len
         self.max_len = max_len
         self.compute_dtype = compute_dtype
+        self.windowed = windowed
         L, d = params["blocks"]["ln1"].shape
         hd = d // n_heads
         kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, n_heads)
@@ -649,17 +743,34 @@ class _DraftEngine:
         )
         stage_len = (-(-max_len // prompt_len) + 1) * prompt_len
         self._stage_shape = (L, 1, stage_len, kv, hd)
+        self._ring_shape = (L, 1, max_len, kv, hd)
         self._advance = jax.jit(
             lambda toks, cpos, cache: dec.verify_chunk(
                 params, toks, cpos, cache, n_heads,
                 compute_dtype=compute_dtype, return_logits=False,
             )[1]
         )
+        self._wadvance = jax.jit(
+            lambda toks, cpos, n, cache: dec.windowed_chunk(
+                params, toks, cpos, n, cache, n_heads,
+                compute_dtype=compute_dtype, return_logits=False,
+            )[1]
+        )
         self._insert = jax.jit(insert_slot)
+        self._propose_w = jax.jit(
+            lambda tok, pos, cache, k: draft_windowed_propose(
+                params, tok, pos, cache, n_heads, k,
+                compute_dtype=compute_dtype,
+            ),
+            static_argnames=("k",),
+        )
+        self._commit_w = jax.jit(commit_ring_chunk)
+        self._pending_chunk = None  # windowed: (cks, cvs) awaiting commit
 
         def step(tok, pos, active, cache):
             logits, cache, pos2 = batched_decode_step(
-                params, tok, pos, active, cache, n_heads, compute_dtype
+                params, tok, pos, active, cache, n_heads, compute_dtype,
+                windowed=windowed,
             )
             return jnp.argmax(logits, -1).astype(jnp.int32), cache, pos2
 
@@ -668,10 +779,27 @@ class _DraftEngine:
     def prefill_tokens(self, tokens: np.ndarray):
         """Draft-prefill a request's FULL context (prefix + prompt) in
         prompt_len buckets → (ks, vs) [L, 1, max_len, KV, Dh] ready for
-        insert_slot. No logits: the first pending token is the target's,
-        the draft only ever continues from certified tokens."""
+        insert_slot (a W-ring in windowed mode — same shape). No
+        logits: the first pending token is the target's, the draft only
+        ever continues from certified tokens."""
         P = self.prompt_len
         t = tokens.shape[0]
+        if self.windowed:
+            ring = (
+                jnp.zeros(self._ring_shape, self.compute_dtype),
+                jnp.zeros(self._ring_shape, self.compute_dtype),
+            )
+            cpos = 0
+            while cpos < t:
+                n = min(P, t - cpos)
+                chunk = np.zeros((1, P), np.int32)
+                chunk[0, :n] = tokens[cpos : cpos + n]
+                ring = self._wadvance(
+                    jnp.asarray(chunk), jnp.asarray(cpos, jnp.int32),
+                    jnp.asarray(n, jnp.int32), ring,
+                )
+                cpos += n
+            return ring
         stage = (
             jnp.zeros(self._stage_shape, self.compute_dtype),
             jnp.zeros(self._stage_shape, self.compute_dtype),
@@ -690,16 +818,30 @@ class _DraftEngine:
     def admit(self, slot: int, draft_kv) -> None:
         self._cache = self._insert(self._cache, *draft_kv, slot)
 
+    def commit(self, pos, m, active) -> None:
+        """Windowed only: land the accepted columns of the last
+        propose()'s chunk into the draft ring (the draft-side half of
+        the verify-then-commit discipline)."""
+        if self._pending_chunk is None:
+            return
+        cks, cvs = self._pending_chunk
+        self._pending_chunk = None
+        self._cache = self._commit_w(self._cache, cks, cvs, pos, m, active)
+
     def propose(self, tok, pos, active, k: int) -> np.ndarray:
-        """k sequential greedy draft steps from the pending tokens →
-        proposals [B, k-1] (np; the k-th emission is discarded). Each
-        step is one batched forward over all slots; the draft cache
-        advances in place — accepted positions keep these very writes,
-        rejected ones are overwritten next round. The extra step exists
-        for its WRITE, not its emission: on full acceptance the last
-        proposal's K/V must be in the cache (position pos+k-1), or the
-        next round would attend an unwritten hole there (the same
-        invariant as the single-stream _draft_k scan)."""
+        """k-1 greedy draft proposals per slot [B, k-1] (np).
+
+        Linear cache: k sequential batched steps writing in place (the
+        k-th emission is discarded — that step exists for its WRITE: on
+        full acceptance the last proposal's K/V must be in the cache at
+        pos+k-1 or the next round would attend an unwritten hole, the
+        single-stream _draft_k invariant). Windowed ring: one
+        draft_windowed_propose program against the pre-write ring; its
+        chunk K/V parks in _pending_chunk until commit()."""
+        if self.windowed:
+            props, cks, cvs = self._propose_w(tok, pos, self._cache, k=k)
+            self._pending_chunk = (cks, cvs)
+            return np.asarray(props)
         cache = self._cache
         cur, p = tok, pos
         props = []
@@ -763,18 +905,14 @@ class ContinuousBatcher:
         round (k-1 cheap batched forwards), verified by the same chunked
         target forward and accepted by the same point-mass logic — the
         serving-scale form of models/speculative.speculative_generate.
-        The draft must share the target's vocabulary; linear caches only
-        (windowed servers use prompt-lookup — see _DraftEngine)."""
+        The draft must share the target's vocabulary. Composes with
+        windowed rings: the draft proposes against its pre-write ring
+        and commits only accepted columns — the same verify-then-commit
+        discipline the target uses (see _DraftEngine)."""
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
         if cache_dtype not in ("auto", "int8"):
             raise ValueError(f"unknown cache_dtype {cache_dtype!r}")
-        if draft_params is not None and windowed:
-            raise ValueError(
-                "draft speculation needs an unwindowed cache: rejected "
-                "draft writes would clobber ring window history "
-                "(prompt-lookup speculation covers windowed servers)"
-            )
         quantized_cache = cache_dtype == "int8"
         if attn_impl == "pallas":
             from nnstreamer_tpu.ops.pallas.decode_attention import (
@@ -984,7 +1122,7 @@ class ContinuousBatcher:
         self._draft = (
             _DraftEngine(
                 draft_params, draft_n_heads or n_heads, n_slots, max_len,
-                prompt_len, compute_dtype,
+                prompt_len, compute_dtype, windowed=windowed,
             )
             if draft_params is not None else None
         )
@@ -1479,6 +1617,10 @@ class ContinuousBatcher:
                 else self._spec_round_greedy
             )
             m_dev, final_dev, cache, pos2 = round_fn(*args)
+            if self._draft is not None and self._draft.windowed:
+                # draft-side commit of the accepted columns (the ring
+                # discipline: nothing landed during propose)
+                self._draft.commit(args[1], m_dev, args[2])
             # [B] counts + [B] tokens — the only host transfers
             m_np = np.asarray(m_dev)
             final_np = np.asarray(final_dev)

@@ -531,13 +531,51 @@ class TestDraftBatcherSpeculation:
         assert (s1, g1) == (s2, g2)  # deterministic per seed
         assert g1 == _alone_97(params, p, 8)  # greedy slot exact
 
-    def test_draft_windowed_rejected(self):
+    def test_draft_windowed_matches_plain_ring(self):
+        """Draft speculation on a windowed ring (r4): the draft proposes
+        against its pre-write ring and commits only accepted columns —
+        the stream stays byte-identical to plain ring stepping through
+        many wraps."""
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
-        with pytest.raises(ValueError, match="unwindowed"):
-            ContinuousBatcher(self._params(), 4, n_slots=1, max_len=32,
-                              prompt_len=16, windowed=True,
-                              draft_params=self._draft_params())
+        params = self._params()
+        p = np.random.default_rng(31).integers(1, 97, (6,)).astype(np.int32)
+
+        def run(draft):
+            kw = (
+                dict(draft_params=self._draft_params(), draft_n_heads=2)
+                if draft else {}
+            )
+            cb = ContinuousBatcher(params, 4, n_slots=2, max_len=16,
+                                   prompt_len=16, windowed=True, **kw)
+            rid = cb.submit(p, 30)  # wraps the W=16 ring repeatedly
+            while cb.result(rid) is None:
+                cb.spec_step(k=4) if draft else cb.step()
+            return cb.result(rid), cb.stats()
+
+        plain, _ = run(False)
+        spec, st = run(True)
+        assert spec == plain
+        assert st["spec_rounds"] > 0
+
+    def test_self_draft_windowed_accepts_everything(self):
+        """Draft == target on a ring: perfect acceptance proves the
+        draft ring stays position-synced through wrapped commits."""
+        from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+        params = self._params()
+        p = np.random.default_rng(32).integers(1, 97, (4,)).astype(np.int32)
+        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=16,
+                               prompt_len=16, windowed=True,
+                               draft_params=params, draft_n_heads=4)
+        rid = cb.submit(p, 24)
+        while cb.result(rid) is None:
+            cb.spec_step(k=4)
+        st = cb.stats()
+        assert st["spec_accepted_tokens"] == st["spec_rounds"] * 3
+        from tests.test_serving import _sliding_reference
+
+        assert cb.result(rid) == _sliding_reference(params, p, 24, 16)
 
     def test_draft_spec_with_prefix(self):
         """Draft admission prefills the FULL context (prefix + prompt),
@@ -611,6 +649,33 @@ def test_spec_windowed_int8_prefix_composes():
         rid = cb.submit(tail, 20, prefix=pid)
         while cb.result(rid) is None:
             cb.spec_step(k=4, ngram=1) if spec else cb.step()
+        return cb.result(rid)
+
+    assert run(True) == run(False)
+
+
+def test_draft_windowed_int8_composes():
+    """draft proposer × windowed ring × int8 target cache: byte-equal
+    to plain int8 ring stepping (the draft's own ring stays float; only
+    the target cache is quantized)."""
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    params = tfm.init_params(
+        jax.random.PRNGKey(33), vocab=97, d_model=64, n_heads=4, n_layers=2
+    )
+    draft = tfm.init_params(
+        jax.random.PRNGKey(34), vocab=97, d_model=32, n_heads=2, n_layers=1
+    )
+    p = np.random.default_rng(35).integers(1, 97, (5,)).astype(np.int32)
+
+    def run(spec):
+        kw = dict(draft_params=draft, draft_n_heads=2) if spec else {}
+        cb = ContinuousBatcher(params, 4, n_slots=1, max_len=16,
+                               prompt_len=16, windowed=True,
+                               cache_dtype="int8", **kw)
+        rid = cb.submit(p, 20)
+        while cb.result(rid) is None:
+            cb.spec_step(k=3) if spec else cb.step()
         return cb.result(rid)
 
     assert run(True) == run(False)
