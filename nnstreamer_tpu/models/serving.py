@@ -276,6 +276,24 @@ def batched_verify_step(
     return logits, cache_out
 
 
+def _ring_live_mask(pos, W: int, row):
+    """Ring-row liveness for chunk queries on a pre-write W-ring.
+
+    pos [B] absolute fill, row [R] chunk-column indices → [B, R, W]
+    bool: ring slot s last held absolute position pos-1-d where
+    d = (wp-1-s) mod W (wp = pos % W); it is attendable by the query in
+    chunk column r (absolute position pos+r) iff written (d ≤ pos-1)
+    and inside the window (d ≤ W-2-r). ONE definition shared by the
+    target's verify and the draft's propose — the two masks must never
+    drift apart (a divergence only degrades acceptance, silently)."""
+    wp = pos % W
+    d = (wp[:, None] - 1 - jnp.arange(W, dtype=jnp.int32)[None, :]) % W
+    return (
+        d[:, None, :]
+        <= jnp.minimum(pos[:, None] - 1, W - 2 - row[None, :])[:, :, None]
+    )
+
+
 def batched_windowed_verify(
     params: Dict,
     toks,
@@ -313,13 +331,8 @@ def batched_windowed_verify(
     b, k = toks.shape
     x = tfm.embed_lookup(params["embed"], toks, compute_dtype)  # [B,k,D]
     positions = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
-    wp = pos % W  # [B]
     row = jnp.arange(k, dtype=jnp.int32)
-    d = (wp[:, None] - 1 - jnp.arange(W, dtype=jnp.int32)[None, :]) % W
-    ring_mask = (
-        d[:, None, :]
-        <= jnp.minimum(pos[:, None] - 1, W - 2 - row[None, :])[:, :, None]
-    )  # [B, k, W]
+    ring_mask = _ring_live_mask(pos, W, row)  # [B, k, W]
     chunk_mask = jnp.broadcast_to(
         row[None, None, :] <= row[None, :, None], (b, k, k)
     )
@@ -447,9 +460,6 @@ def draft_windowed_propose(
     b = tok.shape[0]
     kv = ring_k.shape[3]
     hd = ring_k.shape[4]
-    wp = pos % W
-    d_steps = (wp[:, None] - 1 - jnp.arange(W, dtype=jnp.int32)[None, :]) % W
-
     chunk_ks = jnp.zeros((L, b, k, kv, hd), compute_dtype)
     chunk_vs = jnp.zeros((L, b, k, kv, hd), compute_dtype)
     toks0 = jnp.zeros((b, k), jnp.int32).at[:, 0].set(tok)
@@ -458,11 +468,7 @@ def draft_windowed_propose(
         cur, cks, cvs, toks = carry
         x = tfm.embed_lookup(params["embed"], cur, compute_dtype)[:, None, :]
         positions = (pos + j)[:, None]
-        # ring rows live for column j: written (d ≤ pos-1) and inside
-        # the window of absolute position pos+j (d ≤ W-2-j)
-        ring_mask = (
-            d_steps <= jnp.minimum(pos - 1, W - 2 - j)[:, None]
-        )[:, None, :]  # [B, 1, W]
+        ring_mask = _ring_live_mask(pos, W, j[None])  # [B, 1, W]
         chunk_mask = (
             jnp.arange(k, dtype=jnp.int32)[None, None, :] <= j
         )  # [1, 1, k] — columns ≤ j (col j written below before attend)

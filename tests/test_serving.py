@@ -737,3 +737,28 @@ def test_concurrent_submit_spec_and_streaming_soak(params):
         assert cb.result(rid) == _alone(params, prompts[i], 6), (
             f"request {i} diverged under concurrency"
         )
+
+
+def test_mesh_with_draft_speculation_matches_unsharded(params):
+    """mesh-sharded slots × draft speculation: the spec-round program
+    GSPMD-partitions over the slot axis and the (replicated) draft's
+    batched proposals feed it — same tokens as the unsharded batcher."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    draft = tfm.init_params(
+        jax.random.PRNGKey(77), vocab=257, d_model=32, n_heads=2,
+        n_layers=1,
+    )
+    mesh = make_mesh(8, axes=("dp",))
+    prompts = [_prompt(4 + i, 60 + i) for i in range(3)]
+    outs = {}
+    for label, kw in (("plain", {}), ("mesh", dict(mesh=mesh))):
+        cb = ContinuousBatcher(params, N_HEADS, n_slots=8, max_len=48,
+                               prompt_len=16, draft_params=draft,
+                               draft_n_heads=2, **kw)
+        rids = [cb.submit(p, 6) for p in prompts]
+        while any(cb.result(r) is None for r in rids):
+            cb.spec_step(k=3)
+        outs[label] = [cb.result(r) for r in rids]
+        assert cb.stats()["spec_rounds"] > 0
+    assert outs["plain"] == outs["mesh"]
