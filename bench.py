@@ -916,7 +916,7 @@ def _record_measured(line: str) -> None:
         if data.get("platform") != "tpu":
             return
         path = os.environ.get(
-            "BENCH_MEASURED_PATH", "BENCH_MEASURED_r04.json"
+            "BENCH_MEASURED_PATH", "BENCH_MEASURED_r05.json"
         )
         here = os.path.dirname(os.path.abspath(__file__))
         with open(os.path.join(here, path), "w") as f:
@@ -927,11 +927,103 @@ def _record_measured(line: str) -> None:
         print(f"[bench] capture record failed: {exc!r}", file=sys.stderr)
 
 
+def _relay_up(timeout: float = 3.0) -> bool:
+    """One cheap TCP probe of the relay pool (no jax import — a dead
+    relay makes jax.devices() block forever in the axon client's
+    connect-retry loop)."""
+    import socket
+
+    hosts = [
+        h.strip()
+        for h in os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")
+        if h.strip()
+    ]
+    for host in hosts:
+        try:
+            socket.create_connection((host, 8082), timeout=timeout).close()
+            return True
+        except OSError:
+            pass
+    return False
+
+
+def _watch() -> None:
+    """Standing relay watcher (VERDICT r4 #1). The only live window ever
+    observed lasted ~5 minutes; a 10-minute poll cadence can straddle and
+    miss one entirely. This loop probes every <=45 s, appends every probe
+    to docs/relay_probes_r05.log, and the instant the relay answers it
+    fires the full capture ladder (all optional cells forced) which
+    self-records BENCH_MEASURED_r05.json, then commits the evidence.
+    Runs until BENCH_WATCH_DEADLINE_S expires (default 12 h)."""
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    repo = os.path.dirname(here)
+    log_path = os.path.join(
+        repo, "docs", os.environ.get("BENCH_WATCH_LOG", "relay_probes_r05.log")
+    )
+    deadline = time.time() + float(
+        os.environ.get("BENCH_WATCH_DEADLINE_S", str(12 * 3600))
+    )
+    interval = float(os.environ.get("BENCH_WATCH_INTERVAL_S", "45"))
+    captures = 0
+    max_captures = int(os.environ.get("BENCH_WATCH_MAX_CAPTURES", "2"))
+
+    def log(msg: str) -> None:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(log_path, "a") as f:
+            f.write(f"{stamp} {msg}\n")
+
+    log(f"watch-start interval={interval:.0f}s pid={os.getpid()}")
+    while time.time() < deadline:
+        up = _relay_up()
+        log("alive" if up else "dead")
+        if up and captures < max_captures:
+            captures += 1
+            log(f"capture-start attempt={captures}")
+            env = dict(
+                os.environ,
+                BENCH_FORCE_OPTIONAL="1",
+                BENCH_MEASURED_PATH="BENCH_MEASURED_r05.json",
+            )
+            try:
+                p = subprocess.run(
+                    [sys.executable, here],
+                    capture_output=True, text=True, timeout=3000, env=env,
+                )
+                tail = (p.stdout.strip().splitlines() or [""])[-1][:400]
+                log(f"capture-done rc={p.returncode} line={tail}")
+            except subprocess.TimeoutExpired:
+                log("capture-timeout after 3000s")
+            measured = os.path.join(repo, "BENCH_MEASURED_r05.json")
+            if os.path.exists(measured):
+                try:
+                    subprocess.run(
+                        ["git", "-C", repo, "add",
+                         "BENCH_MEASURED_r05.json", log_path],
+                        check=True, capture_output=True,
+                    )
+                    subprocess.run(
+                        ["git", "-C", repo, "commit", "-m",
+                         "TPU capture: BENCH_MEASURED_r05.json (relay watcher)"],
+                        check=True, capture_output=True, text=True,
+                    )
+                    log("capture-committed")
+                except subprocess.CalledProcessError as exc:
+                    log(f"capture-commit-failed {exc.stderr[-200:]}")
+            else:
+                log("capture-no-tpu-line (platform!=tpu or run failed)")
+        time.sleep(interval)
+    log("watch-deadline-reached")
+
+
 def main() -> None:
     if "--probe" in sys.argv:
         return _probe()
     if "--run" in sys.argv:
         return _run()
+    if "--watch" in sys.argv:
+        return _watch()
 
     import subprocess
 
