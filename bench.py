@@ -1001,7 +1001,7 @@ def _watch() -> None:
                     subprocess.run(
                         ["git", "-C", repo, "add",
                          "BENCH_MEASURED_r05.json", log_path],
-                        check=True, capture_output=True,
+                        check=True, capture_output=True, text=True,
                     )
                     subprocess.run(
                         ["git", "-C", repo, "commit", "-m",

@@ -239,8 +239,11 @@ class VideoFileSrc(Source):
                     f"{self.name}: cannot open video {self.location!r}"
                 )
             if self.decode_ahead > 0:
+                cap = self._cap  # bind THIS handle into the thread: if a
+                # wedged stop() later orphans it, the orphan keeps
+                # reading its own capture and never touches a fresh one
                 self._ahead = _DecodeAhead(
-                    self._read_one, depth=self.decode_ahead
+                    lambda: self._read_one(cap), depth=self.decode_ahead
                 )
                 self._ahead.start()
 
@@ -252,21 +255,27 @@ class VideoFileSrc(Source):
         if self._cap is not None:
             if joined:
                 self._cap.release()
-                self._cap = None
-            # else: the decode thread is still inside read() — leave the
-            # handle with it (release() racing a native read is a
-            # use-after-free, and the thread's rewind path still
-            # dereferences self._cap when the read returns)
+            # else: the decode thread is still inside read() on its
+            # bound handle — leave the native handle to the orphan
+            # (release() racing a native read is a use-after-free).
+            # Either way drop OUR reference so a later start() opens a
+            # fresh capture instead of sharing the wedged one (two
+            # native readers on one OpenCV handle is the same race
+            # stop() just avoided).
+            self._cap = None
 
-    def _read_one(self) -> Optional[np.ndarray]:
+    def _read_one(self, cap=None) -> Optional[np.ndarray]:
         """Decode the next frame (loop-rewinding at EOF); runs on the
-        decode-ahead thread when enabled, else the source thread."""
+        decode-ahead thread when enabled (with its bound handle), else
+        the source thread (on self._cap)."""
         cv2 = _require_cv2()
-        ret, bgr = self._cap.read()
+        if cap is None:
+            cap = self._cap
+        ret, bgr = cap.read()
         if not ret:
             if self.loop:
-                self._cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
-                ret, bgr = self._cap.read()
+                cap.set(cv2.CAP_PROP_POS_FRAMES, 0)
+                ret, bgr = cap.read()
             if not ret:
                 return None
         return _to_format(cv2, bgr, self.format)
@@ -368,8 +377,9 @@ class V4l2Src(Source):
         if self._cap is None:
             self._cap = self._open_cap()
         if self.decode_ahead > 0 and self._ahead is None:
+            cap = self._cap  # bound handle: an orphaned thread keeps it
             self._ahead = _DecodeAhead(
-                self._read_one, depth=self.decode_ahead
+                lambda: self._read_one(cap), depth=self.decode_ahead
             )
             self._ahead.start()
 
@@ -381,13 +391,17 @@ class V4l2Src(Source):
         if self._cap is not None:
             if joined:
                 self._cap.release()
-                self._cap = None
-            # else: wedged camera read in flight — leave the handle with
-            # the thread (leak, don't race)
+            # else: wedged camera read in flight — the orphan thread
+            # keeps its bound handle (leak, don't race). Drop our
+            # reference regardless so a restart opens a fresh capture
+            # rather than sharing the wedged one.
+            self._cap = None
 
-    def _read_one(self) -> Optional[np.ndarray]:
+    def _read_one(self, cap=None) -> Optional[np.ndarray]:
         cv2 = _require_cv2()
-        ret, bgr = self._cap.read()
+        if cap is None:
+            cap = self._cap
+        ret, bgr = cap.read()
         if not ret:
             return None
         return _to_format(cv2, bgr, self.format)

@@ -1541,10 +1541,18 @@ class ContinuousBatcher:
         spec_step() calls could diverge on near-tied logits (the kernel's
         accumulation order differs), but a server pumping spec_step
         exclusively (speculate=k) is self-consistent: every committed
-        token is certified by the same verify program. Falls back to a
-        plain step only when no slot has room for a chunk or no slot
-        proposed anything. Returns {rid: last emitted token}; use
-        partials() for the full per-round stream."""
+        token is certified by the same verify program — when ngram
+        lookup proposes nothing, a Pallas batcher runs a width-2
+        all-sentinel verify (never acceptable, so it emits the plain
+        step's token via the verify forward) instead of falling back to
+        the kernel-certified plain step. The one remaining plain-step
+        fallback on a Pallas batcher is a non-windowed batch whose
+        tightest slot has room for <2 columns, i.e. the final token
+        before max_len, where no verify chunk fits. XLA batchers fall
+        back to a plain step whenever no slot has room for a chunk or no
+        slot proposed anything (there the plain step and verify are the
+        same inline-attention math). Returns {rid: last emitted token};
+        use partials() for the full per-round stream."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -1594,11 +1602,28 @@ class ContinuousBatcher:
                                 toks_host[s, 1 : 1 + cand.size] = cand
                                 any_found = True
                         if not any_found:
-                            # no slot proposed anything: the verify
-                            # forward would certify exactly one token
-                            # per slot at k× the column cost — a plain
-                            # step is the same result cheaper
-                            k_round = 1
+                            if self._attn_impl == "pallas":
+                                # a Pallas batcher must NOT mix a
+                                # kernel-certified plain step into an
+                                # exclusively-speculative generation
+                                # (the kernel's accumulation order can
+                                # diverge from verify on near-tied
+                                # logits): run a width-2 all-sentinel
+                                # verify instead — sentinels can never
+                                # be accepted, so this emits exactly the
+                                # plain step's token, certified by the
+                                # same verify program as every other
+                                # round
+                                k_round = 2
+                                toks_host = toks_host[:, :2]
+                            else:
+                                # no slot proposed anything: the verify
+                                # forward would certify exactly one
+                                # token per slot at k× the column cost —
+                                # a plain step is the same result
+                                # cheaper (and on XLA batchers it is
+                                # bit-identical to verify)
+                                k_round = 1
             if k_round < 2:
                 # outside self._lock — _plain_step_locked reacquires it
                 return self._plain_step_locked(t0)
@@ -1658,7 +1683,14 @@ class ContinuousBatcher:
                 self._n_tokens += n_emitted
                 self._n_spec_rounds += 1
                 self._n_spec_accepted += accepted
-                self._n_spec_columns += int(active_np.sum()) * (k_round - 1)
+                # count only columns actually holding proposals — -1
+                # sentinel columns (ngram found-nothing fill) can never
+                # be accepted, so crediting them would bias the
+                # per-proposal acceptance rate (and llm_serve's
+                # speculate=auto EMA built on it) low
+                self._n_spec_columns += int(
+                    (toks_host[active_np, 1:] >= 0).sum()
+                )
                 self._step_time_s += _time.perf_counter() - t0
                 return emitted
 

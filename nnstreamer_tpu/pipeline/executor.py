@@ -495,13 +495,29 @@ class Executor:
             if len(ins) != 1 or not outs_:
                 continue  # odd wiring: keep the real node
             src, src_pad, _, _, in_size = ins[0]
-            size = e.queue_size if type(e) is _QueueElem else in_size
+            if type(e) is _QueueElem:
+                # a queue chain (q1 ! q2) collapses to ONE channel: honor
+                # the tighter bound of the two depths — q1's elimination
+                # attached its depth as the link's in_size override, and
+                # taking q2's unconditionally would silently widen it
+                size = (
+                    min(e.queue_size, in_size)
+                    if in_size is not None else e.queue_size
+                )
+            else:
+                size = in_size
             links = [L for L in links if L[0] is not e and L[2] is not e]
             for o in outs_:
-                links.append(
-                    [src, src_pad, o[2], o[3],
-                     size if size is not None else o[4]]
+                # the outgoing link may already carry a depth override
+                # (a DOWNSTREAM queue eliminated earlier — element order
+                # is construction order, not topological): combine, same
+                # tighter-bound rule as above
+                merged = (
+                    min(size, o[4])
+                    if size is not None and o[4] is not None
+                    else (size if size is not None else o[4])
                 )
+                links.append([src, src_pad, o[2], o[3], merged])
             eliminated.add(e)
 
         # create nodes

@@ -162,3 +162,28 @@ def test_decode_ahead_stop_mid_stream_does_not_hang(clip):
     src.stop()
     assert time.monotonic() - t0 < 5.0
     assert src._ahead is None
+
+
+def test_wedged_stop_reopens_fresh_capture(clip):
+    """After a stop() whose decode thread failed to join (wedged native
+    read), a restart must open a FRESH capture — reusing the leaked
+    handle would put two native readers on one OpenCV capture, the race
+    stop() exists to avoid (r4 advisor). The orphan keeps the handle it
+    bound at thread creation."""
+    src = VideoFileSrc(location=clip, loop=True, **{"decode-ahead": 2})
+    src.start()
+    old_cap = src._cap
+    orphan = src._ahead
+    real_stop = orphan.stop
+    orphan.stop = lambda: False  # simulate the wedged join
+    src.stop()
+    assert src._cap is None  # our reference dropped, handle to the orphan
+    src.start()
+    assert src._cap is not None and src._cap is not old_cap
+    f = src.generate()  # the fresh capture actually decodes
+    while f is None:
+        f = src.generate()
+    assert f is not EOS_FRAME
+    src.stop()
+    real_stop()  # join the "orphan" for real and release its handle
+    old_cap.release()

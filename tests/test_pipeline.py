@@ -491,6 +491,51 @@ class TestForwardingElimination:
         assert fused.in_queues[0]._max == 7
         assert p["out"].rendered == 3
 
+    def test_queue_chain_keeps_tighter_depth(self):
+        """q1 ! q2 collapses to ONE channel honoring the tighter of the
+        two depths (r4 advisor: taking q2's size unconditionally dropped
+        q1's bound and silently widened the channel)."""
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        for chain, want in (
+            ("queue max-size-buffers=3 ! queue max-size-buffers=9", 3),
+            ("queue max-size-buffers=9 ! queue max-size-buffers=3", 3),
+        ):
+            p = parse_pipeline(
+                "tensorsrc dimensions=2 num-frames=3 ! "
+                f"{chain} ! "
+                "tensor_filter framework=passthrough ! tensor_sink name=out"
+            )
+            ex = p.run(timeout=60)
+            fused = next(n for n in ex.nodes if "filter" in n.name)
+            assert fused.in_queues[0]._max == want
+            assert p["out"].rendered == 3
+
+    def test_queue_chain_depth_elimination_order_invariant(self):
+        """Element ADD order (= elimination order) must not change the
+        collapsed depth: when the downstream queue is eliminated first,
+        its bound rides the outgoing-link override and the upstream
+        queue's pass must still combine with it, not overwrite it."""
+        from nnstreamer_tpu.elements.flow import Queue
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.sources import TensorSrc
+        from nnstreamer_tpu.pipeline.graph import Pipeline
+
+        for q1_size, q2_size in ((9, 3), (3, 9)):
+            src = TensorSrc(dimensions="2", **{"num-frames": "3"})
+            q1 = Queue(**{"max-size-buffers": str(q1_size)})
+            q2 = Queue(**{"max-size-buffers": str(q2_size)})
+            sink = TensorSink(name="out")
+            p = Pipeline()
+            p.add(src, q2, q1, sink)  # downstream queue added FIRST
+            p.link(src, q1)
+            p.link(q1, q2)
+            p.link(q2, sink)
+            ex = p.run(timeout=60)
+            sink_node = next(n for n in ex.nodes if "out" in n.name)
+            assert sink_node.in_queues[0]._max == 3
+            assert sink.rendered == 3
+
     def test_queue_still_splits_fusion(self):
         """An explicit queue between traceable ops must keep forcing a
         segment split (its planning role) even though its node is gone."""

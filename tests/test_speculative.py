@@ -404,6 +404,38 @@ class TestBatcherSpeculation:
         assert st["spec_accepted_tokens"] > 0
         assert st["tokens_emitted"] > st["steps"]  # multi-token rounds
 
+    def test_pallas_no_proposal_stays_on_verify_program(self, monkeypatch):
+        """When ngram lookup proposes NOTHING, a Pallas batcher must not
+        fall back to the kernel-certified plain step (mixing accumulation
+        orders within one spec-pumped generation — r4 advisor): it runs a
+        width-2 all-sentinel verify instead, so spec_rounds advances while
+        spec_columns stays 0 (sentinels are not proposals). An XLA
+        batcher keeps the cheaper plain-step fallback (same math there),
+        and both end on the same tokens."""
+        from nnstreamer_tpu.models import serving
+
+        monkeypatch.setattr(serving, "ngram_lookup", lambda *a, **k: None)
+        params = self._params()
+        prompt = np.arange(1, 9, dtype=np.int32)
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cb = serving.ContinuousBatcher(
+                params, N_HEADS, n_slots=1, max_len=32, prompt_len=16,
+                attn_impl=impl,
+            )
+            rid = cb.submit(prompt, 10)
+            while cb.result(rid) is None:
+                cb.spec_step(k=4)
+            outs[impl] = cb.result(rid)
+            st = cb.stats()
+            if impl == "pallas":
+                assert st["spec_rounds"] > 0
+                assert st["spec_columns"] == 0
+                assert st["spec_accepted_tokens"] == 0
+            else:
+                assert st["spec_rounds"] == 0
+        assert outs["xla"] == outs["pallas"]
+
     def test_rejection_sampler_matches_target_distribution(self):
         """Unit-level distribution check of spec_accept's point-mass
         rejection sampling: over many independent slots (same logits,
