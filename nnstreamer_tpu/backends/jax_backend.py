@@ -164,6 +164,8 @@ class JaxBackend(Backend):
             self._open_script(path, options)
         elif path.endswith((".jaxexport", ".stablehlo", ".hlo")):
             self._open_exported(path)
+        elif path.endswith(".tflite"):
+            self._open_tflite(path)
         else:
             raise BackendError(f"jax: unsupported model source {path!r}")
         if self._in_spec is None:
@@ -194,6 +196,35 @@ class JaxBackend(Backend):
         fn, in_spec = module.get_model(options)
         self._fn = fn
         self._in_spec = in_spec
+
+    def _open_tflite(self, path: str) -> None:
+        """framework=jax model=<f>.tflite: decode the flatbuffer
+        (tools/tflite_parse) and trace the whole graph as ONE jnp
+        program (tools/tflite_exec) — the reference's canonical .tflite
+        fixtures run TPU-native through XLA with no interpreter in the
+        invoke loop (vs tensor_filter_tensorflow_lite.cc's per-op CPU
+        dispatch). Quantized graphs run fake-quant float (exact weight
+        dequant + per-tensor activation grids)."""
+        if not os.path.isfile(path):
+            raise BackendError(f"jax: tflite model not found: {path}")
+        from nnstreamer_tpu.tools.tflite_exec import TFLiteProgram
+
+        try:
+            prog = TFLiteProgram(path)
+            # trace NOW: tracing is lazy, so an unsupported op would
+            # otherwise escape later (at _compile/invoke) as a raw
+            # NotImplementedError instead of the backend error contract
+            jax.eval_shape(
+                prog.trace,
+                jax.ShapeDtypeStruct(prog.input_shape, prog.input_dtype),
+            )
+        except NotImplementedError as exc:
+            raise BackendError(f"jax: cannot compile {path}: {exc}") from exc
+        self._fn = lambda x: tuple(prog.trace(x))
+        self._in_spec = TensorsSpec((
+            TensorSpec(tuple(int(d) for d in prog.input_shape),
+                       DType.from_any(prog.input_dtype)),
+        ))
 
     def _open_exported(self, path: str) -> None:
         with open(path, "rb") as f:

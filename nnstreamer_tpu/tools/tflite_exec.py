@@ -1,0 +1,258 @@
+"""Compile a parsed TFLite graph to one jitted XLA program.
+
+Where the reference hands .tflite files to the TFLite C++ interpreter
+(ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc:154-218,
+op-by-op CPU dispatch), this walks the flatbuffer graph once at build
+time and emits the whole network as a single jnp trace — XLA fuses and
+tiles it for the MXU, so a reference user's .tflite runs TPU-native with
+no interpreter in the loop.
+
+Quantized graphs (uint8 TOCO models like mobilenet_v2_1.0_224_quant)
+run in *fake-quant* float: weights are exactly dequantized from their
+integer grid and every activation is round-tripped through its tensor's
+(scale, zero_point) grid — emulating the integer pipeline's value
+clamping/rounding in float, which keeps MXU-friendly dtypes while
+tracking the interpreter closely (activation ranges, e.g. the implicit
+ReLU6 encoded as a [0,6] quant range, are enforced by the round-trip).
+
+Supported ops cover the reference fixture models: CONV_2D,
+DEPTHWISE_CONV_2D, ADD/MUL/SUB, AVERAGE_POOL_2D/MAX_POOL_2D, RESHAPE,
+SOFTMAX, RESIZE_BILINEAR (align_corners), CONCATENATION,
+FULLY_CONNECTED, MEAN, PAD, LOGISTIC, DEQUANTIZE.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.tools.tflite_parse import TFLiteModel, Tensor, parse
+
+_QRANGE = {np.uint8: (0, 255), np.int8: (-128, 127), np.int16: (-32768, 32767)}
+
+
+def _act(x, name: Optional[str]):
+    if name is None:
+        return x
+    if name == "RELU":
+        return jnp.maximum(x, 0.0)
+    if name == "RELU6":
+        return jnp.clip(x, 0.0, 6.0)
+    if name == "RELU_N1_TO_1":
+        return jnp.clip(x, -1.0, 1.0)
+    if name == "TANH":
+        return jnp.tanh(x)
+    raise NotImplementedError(f"activation {name}")
+
+
+def _qdq(x, t: Tensor):
+    """Round-trip a float activation through tensor t's integer grid —
+    the float emulation of the interpreter's requantize step."""
+    if t.quant is None or not t.quant.quantized:
+        return x
+    rng = _QRANGE.get(np.dtype(t.dtype).type)
+    if rng is None:  # float / int32-accumulator tensors aren't gridded
+        return x
+    s = float(t.quant.scale[0])
+    z = float(t.quant.zero_point[0]) if t.quant.zero_point.size else 0.0
+    q = jnp.clip(jnp.round(x / s) + z, rng[0], rng[1])
+    return (q - z) * s
+
+
+def _resize_bilinear(x, oh: int, ow: int, align: bool, half_pixel: bool):
+    """TF-semantics bilinear resize (jax.image.resize has no
+    align_corners mode, which the DeepLab graph uses throughout)."""
+    ih, iw = x.shape[1], x.shape[2]
+
+    def coords(o, i):
+        if align and o > 1:
+            return jnp.linspace(0.0, i - 1.0, o)
+        if half_pixel:
+            return jnp.maximum((jnp.arange(o) + 0.5) * (i / o) - 0.5, 0.0)
+        return jnp.arange(o) * (i / o)
+
+    ys, xs = coords(oh, ih), coords(ow, iw)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, ih - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, iw - 1)
+    y1, x1 = jnp.minimum(y0 + 1, ih - 1), jnp.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    g = lambda yi, xi: x[:, yi][:, :, xi]  # noqa: E731
+    top = g(y0, x0) * (1.0 - wx) + g(y0, x1) * wx
+    bot = g(y1, x0) * (1.0 - wx) + g(y1, x1) * wx
+    return top * (1.0 - wy) + bot * wy
+
+
+class TFLiteProgram:
+    """A .tflite graph compiled to a single jitted function.
+
+    ``fn(x)``: input in the graph's declared dtype (uint8 for quantized
+    graphs — dequantization is part of the program) → list of float32
+    outputs (quantized outputs are dequantized on-device)."""
+
+    def __init__(self, model: TFLiteModel | str, fake_quant: Optional[bool]
+                 = None, compute_dtype=jnp.float32):
+        m = parse(model) if isinstance(model, str) else model
+        self.model = m
+        if fake_quant is None:
+            fake_quant = any(
+                t.quant is not None and t.quant.quantized
+                and np.dtype(t.dtype).type in _QRANGE
+                for t in m.tensors
+            )
+        self.fake_quant = fake_quant
+        self.compute_dtype = compute_dtype
+        # constants: dequantized once at build; shipped to device as the
+        # closure's captured params (jit keeps them resident)
+        self._consts: Dict[int, jnp.ndarray] = {}
+        for t in m.tensors:
+            if t.data is not None:
+                d = t.dequantized()
+                self._consts[t.index] = jnp.asarray(
+                    d if d is not None else t.data
+                )
+        i = m.tensors[m.inputs[0]]
+        self.input_shape = i.shape
+        self.input_dtype = np.dtype(i.dtype)
+        self.output_shapes = [m.tensors[o].shape for o in m.outputs]
+        # consts are CLOSED OVER, not jit args: shape-operands (resize
+        # sizes, reduce axes, pad widths) must be concrete at trace
+        # time, and XLA folds the weight constants into the executable
+        self._fn = jax.jit(lambda x: self._run(self._consts, x))
+
+    # the traced body: env maps tensor index -> live array
+    def _run(self, consts: Dict[int, jnp.ndarray], x):
+        m = self.model
+        env: Dict[int, Any] = dict(consts)
+        t_in = m.tensors[m.inputs[0]]
+        if np.issubdtype(self.input_dtype, np.integer) and \
+                t_in.quant is not None and t_in.quant.quantized:
+            s = float(t_in.quant.scale[0])
+            z = float(t_in.quant.zero_point[0])
+            x = (x.astype(self.compute_dtype) - z) * s
+        else:
+            x = x.astype(self.compute_dtype)
+        env[m.inputs[0]] = x
+
+        for op in m.operators:
+            o = op.options
+            outs = op.outputs
+            a = env[op.inputs[0]] if op.inputs and op.inputs[0] >= 0 else None
+            if op.name == "CONV_2D":
+                w = env[op.inputs[1]]  # [O, KH, KW, I] -> HWIO
+                y = jax.lax.conv_general_dilated(
+                    a, jnp.transpose(w, (1, 2, 3, 0)),
+                    window_strides=(o["stride_h"], o["stride_w"]),
+                    padding=o["padding"],
+                    rhs_dilation=(o["dilation_h"], o["dilation_w"]),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                if len(op.inputs) > 2 and op.inputs[2] >= 0:
+                    y = y + env[op.inputs[2]]
+                y = _act(y, o.get("activation"))
+            elif op.name == "DEPTHWISE_CONV_2D":
+                w = env[op.inputs[1]]  # [1, KH, KW, C*mult]
+                cin = a.shape[-1]
+                y = jax.lax.conv_general_dilated(
+                    a, jnp.transpose(w, (1, 2, 0, 3)),  # HW1(C*mult)
+                    window_strides=(o["stride_h"], o["stride_w"]),
+                    padding=o["padding"],
+                    rhs_dilation=(o["dilation_h"], o["dilation_w"]),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=cin,
+                )
+                if len(op.inputs) > 2 and op.inputs[2] >= 0:
+                    y = y + env[op.inputs[2]]
+                y = _act(y, o.get("activation"))
+            elif op.name in ("ADD", "MUL", "SUB"):
+                b = env[op.inputs[1]]
+                y = {"ADD": a + b, "MUL": a * b, "SUB": a - b}[op.name]
+                y = _act(y, o.get("activation"))
+            elif op.name in ("AVERAGE_POOL_2D", "MAX_POOL_2D"):
+                win = (1, o["filter_h"], o["filter_w"], 1)
+                strides = (1, o["stride_h"], o["stride_w"], 1)
+                if op.name == "MAX_POOL_2D":
+                    y = jax.lax.reduce_window(
+                        a, -jnp.inf, jax.lax.max, win, strides, o["padding"]
+                    )
+                else:
+                    y = jax.lax.reduce_window(
+                        a, 0.0, jax.lax.add, win, strides, o["padding"]
+                    )
+                    ones = jnp.ones(a.shape[1:3], a.dtype)[None, :, :, None]
+                    cnt = jax.lax.reduce_window(
+                        ones, 0.0, jax.lax.add, win, strides, o["padding"]
+                    )
+                    y = y / cnt
+                y = _act(y, o.get("activation"))
+            elif op.name == "RESHAPE":
+                shape = list(m.tensors[outs[0]].shape)
+                if shape:
+                    shape[0] = a.shape[0]  # batch-general
+                y = jnp.reshape(a, shape)
+            elif op.name == "SQUEEZE":
+                y = jnp.reshape(a, m.tensors[outs[0]].shape)
+            elif op.name == "SOFTMAX":
+                y = jax.nn.softmax(a * o.get("beta", 1.0), axis=-1)
+            elif op.name == "LOGISTIC":
+                y = jax.nn.sigmoid(a)
+            elif op.name == "RESIZE_BILINEAR":
+                size = np.asarray(env[op.inputs[1]])
+                y = _resize_bilinear(
+                    a, int(size[0]), int(size[1]),
+                    o.get("align_corners", False),
+                    o.get("half_pixel_centers", False),
+                )
+            elif op.name == "CONCATENATION":
+                y = jnp.concatenate(
+                    [env[i] for i in op.inputs], axis=o.get("axis", -1)
+                )
+                y = _act(y, o.get("activation"))
+            elif op.name == "FULLY_CONNECTED":
+                w = env[op.inputs[1]]  # [out, in]
+                y = a.reshape(a.shape[0], -1) @ w.T
+                if len(op.inputs) > 2 and op.inputs[2] >= 0:
+                    y = y + env[op.inputs[2]]
+                y = _act(y, o.get("activation"))
+            elif op.name == "MEAN":
+                axes = tuple(int(v) for v in np.asarray(env[op.inputs[1]]))
+                y = jnp.mean(a, axis=axes, keepdims=o.get("keep_dims", False))
+            elif op.name == "PAD":
+                pads = np.asarray(env[op.inputs[1]])
+                y = jnp.pad(a, [(int(lo), int(hi)) for lo, hi in pads])
+            elif op.name == "DEQUANTIZE":
+                y = a  # constants were dequantized at build time
+            else:
+                raise NotImplementedError(
+                    f"tflite op {op.name} (code {op.opcode})"
+                )
+            if self.fake_quant:
+                y = _qdq(y, m.tensors[outs[0]])
+            env[outs[0]] = y
+
+        outs = []
+        for oi in m.outputs:
+            y = env[oi]
+            t = m.tensors[oi]
+            if self.fake_quant and t.quant is not None and t.quant.quantized \
+                    and np.dtype(t.dtype).type in _QRANGE:
+                pass  # already on the grid, in float — leave dequantized
+            outs.append(y.astype(jnp.float32))
+        return outs
+
+    def trace(self, x):
+        """Unjitted traceable body — embed the program inside a larger
+        jit (e.g. the jax backend fuses pre/post ops around it)."""
+        return self._run(self._consts, x)
+
+    def __call__(self, x):
+        return self._fn(jnp.asarray(x))
+
+
+def compile_tflite(path: str, **kw) -> TFLiteProgram:
+    """Parse + compile a .tflite file to a TPU-ready program."""
+    return TFLiteProgram(path, **kw)
