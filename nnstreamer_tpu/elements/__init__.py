@@ -22,6 +22,7 @@ from nnstreamer_tpu.elements import routing  # noqa: F401
 from nnstreamer_tpu.elements import windowing  # noqa: F401
 from nnstreamer_tpu.elements import control  # noqa: F401
 from nnstreamer_tpu.elements import sparse_elems  # noqa: F401
+from nnstreamer_tpu.elements import stage  # noqa: F401
 from nnstreamer_tpu.elements import iio  # noqa: F401
 from nnstreamer_tpu.elements import llm_serve  # noqa: F401
 from nnstreamer_tpu.elements import media  # noqa: F401

@@ -1,0 +1,111 @@
+"""tensor_stage: dedicated-node device upload (double-buffered H2D).
+
+VERDICT r4 #3's overlap evidence: the stage thread must have ALREADY
+handed frame N+1 downstream (device_put issued) while the consumer is
+still busy with frame N — asserted on dispatch timestamps, not wall
+time, so it holds on any machine."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import HostElement
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.sources import AppSrc
+from nnstreamer_tpu.elements.stage import TensorStage
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+
+class _SlowConsumer(HostElement):
+    """Stands in for a busy filter node: holds each frame ~20 ms and
+    records (staged_at, start, end) per frame."""
+
+    def __init__(self):
+        super().__init__()
+        self.times = []
+
+    def negotiate(self, in_specs):
+        return list(in_specs)
+
+    def process(self, frame):
+        t0 = time.perf_counter()
+        time.sleep(0.02)
+        self.times.append(
+            (frame.meta.get("staged_at"), t0, time.perf_counter())
+        )
+        return frame
+
+
+def _frames(n):
+    rng = np.random.default_rng(0)
+    return [
+        Frame((rng.integers(0, 255, (1, 8, 8, 3)).astype(np.uint8),))
+        for _ in range(n)
+    ]
+
+
+class _TypeProbe(HostElement):
+    """Records the tensor types flowing past (the sink renders host
+    copies, so device placement must be observed mid-pipeline)."""
+
+    def __init__(self):
+        super().__init__()
+        self.types = []
+
+    def negotiate(self, in_specs):
+        return list(in_specs)
+
+    def process(self, frame):
+        self.types.append(type(frame.tensors[0]))
+        return frame
+
+
+def test_stage_uploads_to_device_spec_passthrough():
+    import jax
+
+    spec = TensorsSpec.from_strings("3:8:8:1", "uint8")
+    src = AppSrc(iterable=_frames(3), spec=spec)
+    st = TensorStage()
+    probe = _TypeProbe()
+    sink = TensorSink()
+    p = Pipeline().chain(src, st, probe, sink)
+    p.run(timeout=30)
+    assert sink.rendered == 3
+    assert len(probe.types) == 3
+    assert all(issubclass(t, jax.Array) for t in probe.types)
+    assert st.out_specs == st.in_specs  # placement changes, spec doesn't
+
+
+def test_stage_overlaps_upload_with_consumer():
+    """While the consumer chews frame N, the stage node must already
+    have staged frame N+1 (staged_at[N+1] < consumer end[N]) for most
+    frames — the double-buffering claim itself."""
+    n = 8
+    spec = TensorsSpec.from_strings("3:8:8:1", "uint8")
+    src = AppSrc(iterable=_frames(n), spec=spec)
+    st = TensorStage(stamp=True)
+    consumer = _SlowConsumer()
+    sink = TensorSink()
+    p = Pipeline().chain(src, st, consumer, sink)
+    p.run(timeout=60)
+    assert sink.rendered == n
+    times = consumer.times
+    assert len(times) == n and all(t[0] is not None for t in times)
+    overlapped = sum(
+        1 for i in range(n - 1)
+        if times[i + 1][0] < times[i][2]  # staged N+1 before N finished
+    )
+    # the first hop may serialize (pipeline fill); steady state must not
+    assert overlapped >= (n - 1) * 3 // 4, (overlapped, times)
+
+
+def test_stage_bad_device_index():
+    spec = TensorsSpec.from_strings("3:8:8:1", "uint8")
+    src = AppSrc(iterable=_frames(1), spec=spec)
+    st = TensorStage(device="99")
+    with pytest.raises(Exception, match="out of range"):
+        p = Pipeline().chain(src, st, TensorSink())
+        p.run(timeout=30)
