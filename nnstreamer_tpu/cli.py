@@ -126,7 +126,11 @@ def main(argv=None) -> int:
             if hasattr(e, "rendered"):
                 print(f"  {e.name}: rendered {e.rendered} frames", file=sys.stderr)
     if args.stats:
-        print(json.dumps(ex.stats(), indent=2))
+        stats = ex.stats()
+        # pipeline-wide frame accounting rides alongside the per-node
+        # rows (produced / rendered / dropped-by-reason / balance)
+        stats["__pipeline__"] = ex.totals()
+        print(json.dumps(stats, indent=2))
     return 0
 
 

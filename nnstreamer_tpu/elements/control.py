@@ -95,6 +95,7 @@ class TensorIf(HostElement):
             if a not in _ACTIONS:
                 raise ValueError(f"{self.name}: unknown action {a}")
         self._prev: Optional[Frame] = None
+        self._skipped = 0
         self._file_cache: dict = {}
 
     def _file_blob(self, path: str) -> bytes:
@@ -274,7 +275,13 @@ class TensorIf(HostElement):
         # arrived.
         if out is not None:
             self._prev = out
+        else:
+            self._skipped += 1
         return out
+
+    def drop_stats(self) -> dict:
+        """Frame-accounting surface (Executor.totals)."""
+        return {"if-skip": self._skipped}
 
 
 # ---------------------------------------------------------------------------

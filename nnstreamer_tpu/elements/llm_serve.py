@@ -246,6 +246,23 @@ class _LlmServer:
         self._sent[rid] = len(toks)
         return len(toks) > n0
 
+    def stats(self) -> Dict:
+        """Batcher counters + the adaptive-speculation control state
+        (VERDICT r4 #5: a silent proposer regression shows up here as a
+        sagging acceptance rate / k pinned at 2 — visible in --stats,
+        not only in wall time)."""
+        st = self.cb.stats()
+        if self.speculate == -1:
+            st["spec_k"] = self._spec_k
+            # the EMA is the auto controller's state — in fixed-k mode
+            # it never updates, and a frozen 0.5 would read "healthy"
+            # during the exact regression this surface exists to catch
+            # (fixed-k readers watch spec_acceptance_rate instead)
+            st["spec_acceptance_ema"] = self._acc_ema
+        elif self.speculate > 1:
+            st["spec_k"] = self.speculate
+        return st
+
     def pop(self):
         with self._lock:
             return self._out.popleft() if self._out else None
@@ -374,7 +391,7 @@ class LlmServerSrc(Source):
         if self._final_stats is not None:
             return self._final_stats
         if self._server is not None:
-            return self._server.cb.stats()
+            return self._server.stats()
         return None
 
     def output_spec(self) -> Spec:
@@ -390,7 +407,7 @@ class LlmServerSrc(Source):
         item = srv.pop()
         if item is None:
             if srv.drained:
-                self._final_stats = srv.cb.stats()
+                self._final_stats = srv.stats()
                 _drop_server(self.srv_id, srv)
                 return EOS_FRAME
             if not srv.pump():  # decode even while no prompts arrive

@@ -216,5 +216,20 @@ class TensorRate(HostElement):
             self._throttle_wait()
         return out
 
+    def drop_stats(self) -> dict:
+        """Frames removed from the stream, by reason (Executor.totals).
+        Includes frames an UPSTREAM producer skipped on this element's
+        QoS hint — they were produced (counted) but will never arrive
+        here, so without this reason the pipeline balance would report
+        a phantom leak."""
+        return {
+            "rate-drop": self.drop,
+            "rate-qos-skip": self.qos.skipped_upstream,
+        }
+
+    def create_stats(self) -> dict:
+        """Frames this element added to the stream (PTS dup)."""
+        return {"rate-dup": self.dup}
+
     def stop(self) -> None:
         self._next_ts = None

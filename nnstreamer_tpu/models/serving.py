@@ -1714,7 +1714,12 @@ class ContinuousBatcher:
                 "spec_accepted_tokens": self._n_spec_accepted,
                 # accepted/columns is the true per-proposal acceptance
                 # rate whatever the slot occupancy or k was per round
+                # (sentinel found-nothing columns count in neither)
                 "spec_columns": self._n_spec_columns,
+                "spec_acceptance_rate": (
+                    self._n_spec_accepted / self._n_spec_columns
+                    if self._n_spec_columns else 0.0
+                ),
                 "slots_occupied": occupied,
                 "slots_free": self.n_slots - occupied,
                 "results_pending_pickup": len(self._done_pool),

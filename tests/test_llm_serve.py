@@ -373,3 +373,48 @@ def test_speculate_auto_adapts_and_matches_plain():
     spec, srv = run("autoB", {"speculate": "auto"})
     assert spec == plain
     assert 2 <= srv._spec_k <= 8
+
+
+def test_speculate_auto_converges_above_floor_and_surfaces_stats():
+    """VERDICT r4 #5: on a high-acceptance workload (draft == target —
+    same zoo seed/config — proposes the target's own greedy tokens)
+    speculate=auto must CONVERGE to k > 2, and the --stats surface must
+    carry the acceptance telemetry (spec_k, spec_acceptance_ema,
+    spec_acceptance_rate) so a silent proposer regression is visible."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    draft_opts = MODEL_OPTS + "," + ",".join(
+        "draft_" + kv for kv in MODEL_OPTS.split(",")
+    )
+    src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+    sink = LlmServerSink(
+        **{"id": "autoconv", "model": "zoo:transformer_lm",
+           "custom": draft_opts, "n-slots": 1, "max-len": 96,
+           "prompt-len": 16, "max-new-tokens": 48,
+           "speculate": "auto",
+           "speculate-model": "zoo:transformer_lm"}
+    )
+    out_src = LlmServerSrc(**{"id": "autoconv"})
+    out_sink = AppSink()
+    p = Pipeline().chain(src, sink)
+    p.chain(out_src, out_sink)
+    p.start()
+    try:
+        src.push(Frame((np.asarray([3, 4, 5, 6], np.int32),),
+                       meta={"req": "conv"}))
+        src.end_of_stream()
+        f = out_sink.pop(timeout=240)
+        assert f is not None
+        srv = sink._server
+        st = srv.stats()
+    finally:
+        p.stop()
+    assert st["spec_k"] > 2, st  # converged off the floor
+    assert st["spec_acceptance_ema"] > 0.5, st
+    assert st["spec_acceptance_rate"] >= 0.9, st
+    assert srv._spec_k > 2
