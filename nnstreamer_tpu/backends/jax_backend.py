@@ -214,16 +214,16 @@ class JaxBackend(Backend):
             # trace NOW: tracing is lazy, so an unsupported op would
             # otherwise escape later (at _compile/invoke) as a raw
             # NotImplementedError instead of the backend error contract
-            jax.eval_shape(
-                prog.trace,
-                jax.ShapeDtypeStruct(prog.input_shape, prog.input_dtype),
-            )
+            jax.eval_shape(prog.trace, *(
+                jax.ShapeDtypeStruct(s, d)
+                for s, d in zip(prog.input_shapes, prog.input_dtypes)
+            ))
         except NotImplementedError as exc:
             raise BackendError(f"jax: cannot compile {path}: {exc}") from exc
-        self._fn = lambda x: tuple(prog.trace(x))
-        self._in_spec = TensorsSpec((
-            TensorSpec(tuple(int(d) for d in prog.input_shape),
-                       DType.from_any(prog.input_dtype)),
+        self._fn = lambda *ts: tuple(prog.trace(*ts))
+        self._in_spec = TensorsSpec(tuple(
+            TensorSpec(tuple(int(d) for d in s), DType.from_any(dt))
+            for s, dt in zip(prog.input_shapes, prog.input_dtypes)
         ))
 
     def _open_exported(self, path: str) -> None:

@@ -128,8 +128,12 @@ def main(argv=None) -> int:
     if args.stats:
         stats = ex.stats()
         # pipeline-wide frame accounting rides alongside the per-node
-        # rows (produced / rendered / dropped-by-reason / balance)
-        stats["__pipeline__"] = ex.totals()
+        # rows (produced / rendered / dropped-by-reason / balance);
+        # element names are user-chosen, so never clobber a node row
+        totals_key = "__pipeline__"
+        while totals_key in stats:
+            totals_key = "_" + totals_key
+        stats[totals_key] = ex.totals()
         print(json.dumps(stats, indent=2))
     return 0
 

@@ -115,28 +115,34 @@ class TFLiteProgram:
                 self._consts[t.index] = jnp.asarray(
                     d if d is not None else t.data
                 )
-        i = m.tensors[m.inputs[0]]
-        self.input_shape = i.shape
-        self.input_dtype = np.dtype(i.dtype)
+        self.input_shapes = [m.tensors[i].shape for i in m.inputs]
+        self.input_dtypes = [np.dtype(m.tensors[i].dtype) for i in m.inputs]
+        self.input_shape = self.input_shapes[0]   # single-input shorthand
+        self.input_dtype = self.input_dtypes[0]
         self.output_shapes = [m.tensors[o].shape for o in m.outputs]
         # consts are CLOSED OVER, not jit args: shape-operands (resize
         # sizes, reduce axes, pad widths) must be concrete at trace
         # time, and XLA folds the weight constants into the executable
-        self._fn = jax.jit(lambda x: self._run(self._consts, x))
+        self._fn = jax.jit(lambda *xs: self._run(self._consts, xs))
 
     # the traced body: env maps tensor index -> live array
-    def _run(self, consts: Dict[int, jnp.ndarray], x):
+    def _run(self, consts: Dict[int, jnp.ndarray], xs):
         m = self.model
+        if len(xs) != len(m.inputs):
+            raise ValueError(
+                f"graph takes {len(m.inputs)} inputs, got {len(xs)}"
+            )
         env: Dict[int, Any] = dict(consts)
-        t_in = m.tensors[m.inputs[0]]
-        if np.issubdtype(self.input_dtype, np.integer) and \
-                t_in.quant is not None and t_in.quant.quantized:
-            s = float(t_in.quant.scale[0])
-            z = float(t_in.quant.zero_point[0])
-            x = (x.astype(self.compute_dtype) - z) * s
-        else:
-            x = x.astype(self.compute_dtype)
-        env[m.inputs[0]] = x
+        for idx, x in zip(m.inputs, xs):
+            t_in = m.tensors[idx]
+            if np.issubdtype(np.dtype(t_in.dtype), np.integer) and \
+                    t_in.quant is not None and t_in.quant.quantized:
+                s = float(t_in.quant.scale[0])
+                z = float(t_in.quant.zero_point[0])
+                x = (x.astype(self.compute_dtype) - z) * s
+            else:
+                x = x.astype(self.compute_dtype)
+            env[idx] = x
 
         for op in m.operators:
             o = op.options
@@ -244,13 +250,13 @@ class TFLiteProgram:
             outs.append(y.astype(jnp.float32))
         return outs
 
-    def trace(self, x):
+    def trace(self, *xs):
         """Unjitted traceable body — embed the program inside a larger
         jit (e.g. the jax backend fuses pre/post ops around it)."""
-        return self._run(self._consts, x)
+        return self._run(self._consts, xs)
 
-    def __call__(self, x):
-        return self._fn(jnp.asarray(x))
+    def __call__(self, *xs):
+        return self._fn(*(jnp.asarray(x) for x in xs))
 
 
 def compile_tflite(path: str, **kw) -> TFLiteProgram:

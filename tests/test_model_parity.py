@@ -94,6 +94,19 @@ class TestFlatbufferParser:
         assert d.tensors[d.outputs[0]].shape == (1, 257, 257, 21)
         assert any(op.name == "RESIZE_BILINEAR" for op in d.operators)
 
+    def test_add_tflite_matches_interpreter(self):
+        """The third reference fixture (add.tflite, the single/filter
+        smoke model) runs through the XLA compiler and agrees with the
+        interpreter."""
+        from nnstreamer_tpu.tools.tflite_exec import compile_tflite
+
+        path = f"{REF}/models/add.tflite"
+        prog = compile_tflite(path)
+        x = np.asarray([3.25], np.float32).reshape(prog.input_shape)
+        ours = np.asarray(prog(x)[0])
+        it = _interpreter(path)
+        np.testing.assert_allclose(ours, _invoke(it, x), rtol=1e-6)
+
     def test_exec_rejects_unknown_op(self, tmp_path):
         from nnstreamer_tpu.tools import tflite_exec, tflite_parse
 
