@@ -265,13 +265,20 @@ def _run() -> None:
         # a pre-staged frame would be read straight back (D2H per frame
         # — worse than the unstaged path it replaces)
         stage = (
-            "" if device_src or fpt > 1
+            "" if device_src
             else "tensor_stage queue-size=128 ! "
         )
+        # per-frame ingest stages BEFORE the converter (upload raw
+        # frames); frames-per-tensor ingest batches on HOST first, so
+        # the staged upload goes AFTER the converter — one device_put
+        # per [fpt, ...] batch, overlapping the previous batch's compute
+        pre = stage if fpt == 1 else ""
+        post = stage if fpt > 1 else ""
         desc = (
             f"videotestsrc pattern=gradient device="
             f"{'true' if device_src else 'false'} "
-            f"num-frames={n_frames} width=224 height=224 ! {stage}{conv} ! "
+            f"num-frames={n_frames} width=224 height=224 ! {pre}{conv} ! "
+            f"{post}"
             f"tensor_filter framework=jax model=zoo:mobilenet_v2 "
             f'custom="batch:{fpt},compute_dtype:bfloat16" ! '
             "tensor_decoder mode=image_labeling ! "
@@ -294,22 +301,26 @@ def _run() -> None:
             return None
 
     n_pipe = 2048 if on_tpu else 40
-    pipe_window = 64 if on_tpu else 8
+    pipe_window = 256 if on_tpu else 8
     pipeline_fps = _pipeline_fps_safe(True, 1, n_pipe, pipe_window)
     _mark("pipeline measured")
 
     # p50 END-TO-END frame latency through the pipeline (BASELINE's
-    # tracked-latency config): wall-stamped frames, per-frame sink sync
-    # (sync-window=1 — the latency-honest configuration; on a tunneled
-    # device this includes the RTT every frame, by design)
-    def _pipeline_p50_ms():
+    # tracked-latency config): wall-stamped frames from a PACED source
+    # (is-live, below the sustainable rate — a free-running source
+    # floods the queues and a wall-stamped p50 then measures BACKLOG,
+    # not service time), per-frame sink sync (sync-window=1 — the
+    # latency-honest configuration; on a tunneled device this includes
+    # the RTT every frame, by design)
+    def _paced_p50_ms(extra: str, n: int, fps: int):
         from nnstreamer_tpu.pipeline.executor import SinkNode
         from nnstreamer_tpu.pipeline.parse import parse_pipeline
 
-        n = 64 if on_tpu else 8
         desc = (
             f"videotestsrc pattern=gradient device=true stamp-wall=true "
+            f"is-live=true framerate={fps}/1 "
             f"num-frames={n} width=224 height=224 ! tensor_converter ! "
+            f"{extra}"
             "tensor_filter framework=jax model=zoo:mobilenet_v2 "
             'custom="batch:1,compute_dtype:bfloat16" ! '
             "tensor_decoder mode=image_labeling ! tensor_sink sync-window=1"
@@ -322,9 +333,14 @@ def _run() -> None:
         all_lats = list(sink.latencies)
         lats = all_lats[max(2, len(all_lats) // 8):]
         if not lats:
-            return None
+            return None, ex
         lats.sort()
-        return 1000.0 * lats[len(lats) // 2]
+        return 1000.0 * lats[len(lats) // 2], ex
+
+    def _pipeline_p50_ms():
+        return _paced_p50_ms(
+            "", 48 if on_tpu else 8, 8 if on_tpu else 2
+        )[0]
 
     try:
         pipeline_p50_ms = _pipeline_p50_ms()
@@ -332,6 +348,44 @@ def _run() -> None:
         print(f"[bench] pipeline p50 failed: {exc!r}", file=sys.stderr)
         pipeline_p50_ms = None
     _mark("pipeline p50 measured")
+
+    # drop-to-deadline: a paced source ABOVE the sustainable rate with
+    # tensor_rate holding a stated budget — the held p50 of SURVIVING
+    # frames plus the drop rate is the latency-budget story
+    # (gsttensor_rate.c:27-36 dup/drop discipline; BASELINE.md "p50 e2e
+    # frame latency tracked"). The rate floor keeps offered load at 4×
+    # the rate element's ceiling, so ~75% must drop while survivors
+    # stay under budget.
+    def _pipeline_rate_budget():
+        hold = 4 if on_tpu else 1
+        offered = hold * 4
+        n = (48 if on_tpu else 12) * 4
+        p50, ex = _paced_p50_ms(
+            f"tensor_rate framerate={hold}/1 throttle=false ! ",
+            n, offered,
+        )
+        from nnstreamer_tpu.elements.windowing import TensorRate
+        from nnstreamer_tpu.pipeline.executor import SinkNode
+
+        dropped = sum(
+            nd.elem.drop + nd.elem.qos.skipped_upstream
+            for nd in ex.nodes
+            if isinstance(getattr(nd, "elem", None), TensorRate)
+        )
+        survived = sum(
+            nd.frames_rendered for nd in ex.nodes
+            if isinstance(nd, SinkNode)
+        )
+        total = dropped + survived
+        drop_pct = round(100.0 * dropped / total, 1) if total else None
+        return p50, drop_pct
+
+    pipeline_rate_p50_ms = rate_drop_pct = None
+    try:
+        pipeline_rate_p50_ms, rate_drop_pct = _pipeline_rate_budget()
+    except Exception as exc:  # noqa: BLE001
+        print(f"[bench] rate budget failed: {exc!r}", file=sys.stderr)
+    _mark("pipeline rate budget measured")
 
     # Optional sections below run inside a soft budget: the primary
     # metrics are already measured, and a slow tunnel day must not turn a
@@ -613,8 +667,13 @@ def _run() -> None:
             n = _drain(64 if on_tpu else 8)
             return n / (time.perf_counter() - t0)
 
+        # pump APIs (serving.py step_pump/spec_pump): N tokens or R
+        # whole speculative rounds per program launch, ONE device→host
+        # read per pump — the framework's serving hot path. Per-token
+        # step() pays a full sync per token (ruinous through the
+        # device tunnel: ~RTT/token).
         lm_cb_tok_s = _opt(
-            "lm-cb4", lambda: _cb_tok_s(lambda cb: cb.step())
+            "lm-cb4", lambda: _cb_tok_s(lambda cb: cb.step_pump(16))
         )
         _mark("lm-cb4 measured")
         # speculative pumps: prompt-lookup (free proposals) vs a draft
@@ -623,7 +682,9 @@ def _run() -> None:
         if not _over_budget():
             lm_cb_spec_ngram_tok_s = _opt(
                 "lm-cb4-spec-ngram",
-                lambda: _cb_tok_s(lambda cb: cb.spec_step(k=4, ngram=1)),
+                lambda: _cb_tok_s(
+                    lambda cb: cb.spec_pump(rounds=4, k=4, ngram=1)
+                ),
             )
             _mark("lm-cb4-spec-ngram measured")
         if not _over_budget():
@@ -635,7 +696,7 @@ def _run() -> None:
                     compute_dtype="bfloat16",
                 )
                 return _cb_tok_s(
-                    lambda cb: cb.spec_step(k=4),
+                    lambda cb: cb.spec_pump(rounds=4, k=4),
                     draft_params=mdraft.params, draft_n_heads=8,
                 )
 
@@ -822,6 +883,8 @@ for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
                 "vs_baseline": round(value / 1000.0, 3),
                 "pipeline_fps": _round(pipeline_fps),
                 "pipeline_p50_e2e_ms": _round(pipeline_p50_ms, 3),
+                "pipeline_rate_p50_ms": _round(pipeline_rate_p50_ms, 3),
+                "rate_drop_pct": rate_drop_pct,
                 "pipeline_h2d_fps": _round(pipeline_h2d_fps),
                 "pipeline_mb8_fps": _round(pipeline_mb8_fps),
                 "pipeline_mb32_fps": _round(pipeline_mb32_fps),

@@ -87,7 +87,7 @@ class _LlmServer:
     def __init__(self, model: str, options: Dict[str, str], n_slots: int,
                  max_len: int, prompt_len: int, default_new: int,
                  stream: bool = False, speculate: int = 0,
-                 speculate_model: str = ""):
+                 speculate_model: str = "", pump_tokens: int = 1):
         from nnstreamer_tpu.models import zoo
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
@@ -151,6 +151,13 @@ class _LlmServer:
         # (EMA) between 2 and 8 — long chunks when guesses land, minimal
         # verify width when they don't.
         self.speculate = speculate
+        # pump=N: target tokens per program launch — step_pump(N) /
+        # spec_pump(rounds=⌈N/k⌉). N=1 keeps the per-token step path
+        # (minimum admission latency); larger N amortizes the
+        # host↔device round trip N ways (ONE readback per pump), the
+        # knob that matters on a tunnel-attached chip. Admissions join
+        # at the next pump, so latency-sensitive servers keep N small.
+        self.pump_tokens = max(1, int(pump_tokens))
         self._spec_k = 4
         self._acc_ema = 0.5
         self._spec_seen = (0, 0)  # (columns, accepted) at last adapt
@@ -187,8 +194,14 @@ class _LlmServer:
     def pump(self) -> bool:
         """One decode step; harvest finished requests (and, in streaming
         mode, every new token). True if anything advanced."""
+        N = self.pump_tokens
         if self.speculate == -1:
-            emitted = self.cb.spec_step(k=self._spec_k)
+            if N > 1:
+                emitted = self.cb.spec_pump(
+                    rounds=max(1, -(-N // self._spec_k)), k=self._spec_k
+                )
+            else:
+                emitted = self.cb.spec_step(k=self._spec_k)
             st = self.cb.stats()
             # normalize by proposal COLUMNS, not rounds: a round offers
             # active_slots×(k-1) proposals, so a rounds-based rate would
@@ -204,7 +217,15 @@ class _LlmServer:
                 )
                 self._spec_seen = (cols, acc)
         elif self.speculate > 1:
-            emitted = self.cb.spec_step(k=self.speculate)
+            if N > 1:
+                emitted = self.cb.spec_pump(
+                    rounds=max(1, -(-N // self.speculate)),
+                    k=self.speculate,
+                )
+            else:
+                emitted = self.cb.spec_step(k=self.speculate)
+        elif N > 1:
+            emitted = self.cb.step_pump(N)
         else:
             emitted = self.cb.step()
         harvested = False
@@ -289,7 +310,10 @@ class LlmServerSink(Sink):
     of prompt-lookup; configure it with draft_-prefixed keys in the
     custom dict, e.g. draft_d_model/draft_n_layers/draft_n_heads —
     vocab is inherited from the target; implies speculate=4 when
-    speculate is unset)."""
+    speculate is unset), pump (=N: target tokens per program launch —
+    step_pump(N)/spec_pump over device-scanned rounds, ONE
+    device→host read per pump instead of one per token; default 1
+    keeps per-token stepping for minimum admission latency)."""
 
     FACTORY_NAME = "tensor_llm_serversink"
 
@@ -318,6 +342,7 @@ class LlmServerSink(Sink):
                 else int(self.get_property("speculate", 0))
             ),
             speculate_model=str(self.get_property("speculate-model", "")),
+            pump_tokens=int(self.get_property("pump", 1)),
         )
         self._server: Optional[_LlmServer] = None
 

@@ -37,6 +37,11 @@ class VideoTestSrc(Source):
     - ``solid``: constant fill (``foreground-color``)
     - ``random``: seeded rng (``seed``)
     - ``counter``: every pixel = frame index % 256 (golden-test friendly)
+
+    ``is-live=true`` paces generation at ``framerate`` (a camera's
+    clock discipline — GStreamer videotestsrc is-live); ``device=true``
+    births frames device-resident; ``stamp-wall=true`` records
+    generation wall-clock for sink-side e2e latency.
     """
 
     FACTORY_NAME = "videotestsrc"
@@ -65,6 +70,15 @@ class VideoTestSrc(Source):
         # so sinks can report true end-to-end frame latency (BASELINE's
         # "p50 e2e frame latency tracked per config")
         self.stamp_wall = _parse_bool(self.get_property("stamp-wall", False))
+        # is-live=true: PACE generation at `framerate` (a real camera's
+        # behavior — GStreamer's videotestsrc is-live). Free-running
+        # sources flood the queues, so a wall-stamped latency under
+        # them measures BACKLOG, not service time; the honest p50-e2e
+        # configuration is a paced source below the pipeline's
+        # sustainable rate. Role-match: gstreamer's live-source clock
+        # discipline (the reference inherits it from GStreamer).
+        self.is_live = _parse_bool(self.get_property("is-live", False))
+        self._t_live0 = None
         self._i = 0
         self._rng = np.random.default_rng(self.seed)
         self._base = None      # host pattern base (uint8, wraps mod 256)
@@ -82,6 +96,7 @@ class VideoTestSrc(Source):
 
     def start(self) -> None:
         self._i = 0
+        self._t_live0 = None
         self._rng = np.random.default_rng(self.seed)
         c = MediaSpec("video", format=self.format).channels_per_pixel
         h, w = self.height, self.width
@@ -145,6 +160,17 @@ class VideoTestSrc(Source):
         else:
             raise ValueError(f"unknown pattern {self.pattern!r}")
         pts, dur = _frame_pts(self._i, self.rate)
+        if self.is_live and self.rate:
+            # hold the configured cadence without drift: frame i is due
+            # at t0 + i/rate on the monotonic clock
+            import time
+
+            if self._t_live0 is None:
+                self._t_live0 = time.perf_counter()
+            due = self._t_live0 + self._i / float(self.rate)
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
         self._i += 1
         meta = {"media_type": "video"}
         if self.stamp_wall:

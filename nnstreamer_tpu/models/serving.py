@@ -677,6 +677,58 @@ def insert_slot(cache, ks, vs, slot):
     return put(cache_k, ks), put(cache_v, vs)
 
 
+def hist_write_row(hist, row, start, count):
+    """Scatter ``row`` [B, K] into the device token history ``hist``
+    [B, H] at per-slot ``start`` [B], keeping only the first ``count``
+    [B] columns per slot. Writes past H-1 clamp onto the last cell
+    (only reachable on windowed rings whose stream outruns the
+    history; the n-gram mining quality degrades there, never
+    correctness — proposals are verified before commit either way)."""
+    _, H = hist.shape
+    K = row.shape[1]
+    idx = jnp.clip(start[:, None] + jnp.arange(K)[None, :], 0, H - 1)
+    keep = jnp.arange(K)[None, :] < count[:, None]
+
+    def one(h, r, ix, kp):
+        return h.at[ix].set(jnp.where(kp, r, h[ix]))
+
+    return jax.vmap(one)(hist, row, idx, keep)
+
+
+def device_ngram_propose(hist, pos, k: int, g: int):
+    """Prompt-lookup proposals ON DEVICE — no host round trip.
+
+    The host n-gram path (ngram_lookup over req.tokens) costs two
+    device→host reads per round (pos, tok) plus Python mining; on a
+    tunnel-attached TPU each read pays the full RTT, so mining must
+    happen where the tokens already are. ``hist`` [B, H] int32 is the
+    per-slot token history (-1 padded), ``pos`` [B] the pending token's
+    index (invariant: hist[pos] == pending token). Finds the most
+    recent earlier occurrence of the suffix g-gram ending at ``pos``
+    and proposes the k-1 tokens that followed it; -1 sentinels where
+    the lookup finds nothing (sentinels can never be accepted —
+    spec_accept's found-nothing discipline, serving.py spec_step).
+    Role-match: the device form of the prompt-lookup proposer
+    (models/speculative.ngram_lookup, vLLM-style self-drafting)."""
+    _, H = hist.shape
+    idx = jnp.arange(H)
+
+    def one(h, p):
+        ok = jnp.ones((H,), bool)
+        for i in range(g):
+            shifted = h[jnp.maximum(idx - i, 0)]
+            tgt = h[jnp.maximum(p - i, 0)]
+            ok &= (shifted == tgt) & (idx - i >= 0) & (p - i >= 0)
+            ok &= shifted >= 0  # pad cells never participate
+        ok &= idx < p  # the suffix itself is not a match
+        j = jnp.max(jnp.where(ok, idx, -1))
+        cols = j + 1 + jnp.arange(k - 1)
+        valid = (j >= 0) & (cols <= p)  # only mined, known context
+        return jnp.where(valid, h[jnp.clip(cols, 0, H - 1)], -1)
+
+    return jax.vmap(one)(hist, pos)
+
+
 @dataclass
 class _Request:
     rid: int
@@ -689,6 +741,7 @@ class _Request:
     prompt: Optional[np.ndarray] = None  # spec_step's proposal context
     tokens: List[int] = field(default_factory=list)
     done: bool = False
+    fill0: int = 0  # cache fill at admission; pos = fill0+len(tokens)-1
 
     def finished(self) -> bool:
         """Budget exhausted, or the stop token was emitted (which stays
@@ -711,6 +764,7 @@ class _PendingInsert:
     fill: int  # cache fill level (= absolute position count)
     req: _Request
     draft_kv: Optional[Tuple[jax.Array, jax.Array]] = None
+    hist_row: Optional[np.ndarray] = None  # device n-gram context seed
 
 
 class _DraftEngine:
@@ -754,15 +808,17 @@ class _DraftEngine:
             lambda toks, cpos, cache: dec.verify_chunk(
                 params, toks, cpos, cache, n_heads,
                 compute_dtype=compute_dtype, return_logits=False,
-            )[1]
+            )[1],
+            donate_argnums=2,
         )
         self._wadvance = jax.jit(
             lambda toks, cpos, n, cache: dec.windowed_chunk(
                 params, toks, cpos, n, cache, n_heads,
                 compute_dtype=compute_dtype, return_logits=False,
-            )[1]
+            )[1],
+            donate_argnums=3,
         )
-        self._insert = jax.jit(insert_slot)
+        self._insert = jax.jit(insert_slot, donate_argnums=0)
         self._propose_w = jax.jit(
             lambda tok, pos, cache, k: draft_windowed_propose(
                 params, tok, pos, cache, n_heads, k,
@@ -770,7 +826,7 @@ class _DraftEngine:
             ),
             static_argnames=("k",),
         )
-        self._commit_w = jax.jit(commit_ring_chunk)
+        self._commit_w = jax.jit(commit_ring_chunk, donate_argnums=0)
         self._pending_chunk = None  # windowed: (cks, cvs) awaiting commit
 
         def step(tok, pos, active, cache):
@@ -780,7 +836,7 @@ class _DraftEngine:
             )
             return jnp.argmax(logits, -1).astype(jnp.int32), cache, pos2
 
-        self._step = jax.jit(step)
+        self._step = jax.jit(step, donate_argnums=3)
 
     def prefill_tokens(self, tokens: np.ndarray):
         """Draft-prefill a request's FULL context (prefix + prompt) in
@@ -972,6 +1028,11 @@ class ContinuousBatcher:
         self._topk = jnp.zeros((n_slots,), jnp.int32)
         self._topp = jnp.ones((n_slots,), jnp.float32)
         self._keys = jnp.zeros((n_slots, 2), jnp.uint32)
+        # per-slot token history ON DEVICE (-1 padded): the n-gram
+        # mining context for device-side prompt-lookup speculation and
+        # the multi-step pumps' running record — tokens never have to
+        # come back to the host just to propose continuations
+        self._hist = jnp.full((n_slots, max_len), -1, jnp.int32)
 
         if mesh is not None:
             # shard the slot axis over the mesh: the batched step runs
@@ -1000,6 +1061,7 @@ class ContinuousBatcher:
             self._topk = jax.device_put(self._topk, vec_sh)
             self._topp = jax.device_put(self._topp, vec_sh)
             self._keys = jax.device_put(self._keys, vec_sh)
+            self._hist = jax.device_put(self._hist, vec_sh)
         else:
             self._vec_sh = None
 
@@ -1019,13 +1081,15 @@ class ContinuousBatcher:
             lambda toks, cpos, cache: dec.verify_chunk(
                 params, toks, cpos, cache, n_heads,
                 compute_dtype=compute_dtype,
-            )
+            ),
+            donate_argnums=2,
         )
         self._advance_chunk = jax.jit(
             lambda toks, cpos, cache: dec.verify_chunk(
                 params, toks, cpos, cache, n_heads,
                 compute_dtype=compute_dtype, return_logits=False,
-            )[1]
+            )[1],
+            donate_argnums=2,
         )
         # windowed (ring) chunked-prefill programs: exact sliding-window
         # prefill for prompts of ANY length in the fixed W ring
@@ -1034,17 +1098,20 @@ class ContinuousBatcher:
             lambda toks, cpos, n, cache: dec.windowed_chunk(
                 params, toks, cpos, n, cache, n_heads,
                 compute_dtype=compute_dtype,
-            )[:2]
+            )[:2],
+            donate_argnums=3,
         )
         self._wadvance = jax.jit(
             lambda toks, cpos, n, cache: dec.windowed_chunk(
                 params, toks, cpos, n, cache, n_heads,
                 compute_dtype=compute_dtype, return_logits=False,
-            )[1]
+            )[1],
+            donate_argnums=3,
         )
 
         def step_impl(sampling):
-            def impl(tok, pos, active, cache, temp, topk, topp, keys):
+            def impl(tok, pos, active, cache, hist, temp, topk, topp,
+                     keys):
                 logits, cache, pos2 = batched_decode_step(
                     params, tok, pos, active, cache, n_heads,
                     compute_dtype, attn_fn=attn_fn, windowed=windowed,
@@ -1057,10 +1124,20 @@ class ContinuousBatcher:
                     new = sample_tokens(logits, temp, topk, topp, sub)
                 else:
                     new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return jnp.where(active, new, tok), cache, pos2
+                new = jnp.where(active, new, tok)
+                hist = hist_write_row(
+                    hist, new[:, None], pos2, active.astype(jnp.int32)
+                )
+                return new, cache, pos2, hist
 
             return impl
 
+        # the cache (and hist) are DONATED into every step-shaped
+        # program: the relay/tunnel runtime moves non-aliased outputs at
+        # link bandwidth (~ms per MB) while aliased ones update in
+        # place, and on any TPU donation halves the cache's HBM
+        # footprint — the carried state never has two live copies
+        _don = dict(donate_argnums=(3, 4))
         if mesh is not None and attn_impl == "pallas":
             # GSPMD cannot partition the kernel's custom call over the
             # slot-sharded cache — but the step is slot-parallel by
@@ -1071,26 +1148,123 @@ class ContinuousBatcher:
             ax = slots_axis
             vec, cac = P(ax), P(None, ax)
             specs = dict(
-                in_specs=(vec, vec, vec, cac, vec, vec, vec, vec),
-                out_specs=(vec, cac, vec),
+                in_specs=(vec, vec, vec, cac, vec, vec, vec, vec, vec),
+                out_specs=(vec, cac, vec, vec),
                 check_vma=False,
             )
             self._step_greedy = jax.jit(
-                jax.shard_map(step_impl(False), mesh=mesh, **specs)
+                jax.shard_map(step_impl(False), mesh=mesh, **specs), **_don
             )
             self._step_sampling = jax.jit(
-                jax.shard_map(step_impl(True), mesh=mesh, **specs)
+                jax.shard_map(step_impl(True), mesh=mesh, **specs), **_don
             )
         else:
-            self._step_greedy = jax.jit(step_impl(False))
-            self._step_sampling = jax.jit(step_impl(True))
+            self._step_greedy = jax.jit(step_impl(False), **_don)
+            self._step_sampling = jax.jit(step_impl(True), **_don)
+
+        # ---- multi-step pumps: N tokens per program launch ----
+        # One dispatch + ONE [B, n] readback per pump instead of a
+        # dispatch + readback per token: lax.scan carries
+        # (tok, pos, active, cache, hist, budget) on device, deactivates
+        # slots at budget/stop-token inside the scan, and emits -1 for
+        # idle lanes. On a tunnel-attached chip this amortizes the
+        # host↔device RTT over n tokens; on any chip it removes n-1
+        # dispatches. Role-match: the per-token step loop of a serving
+        # engine collapsed into the compiled program, the token-world
+        # analogue of the converter's frames-per-tensor batching.
+        def pump_impl(sampling, with_draft):
+            def impl(tok, pos, active, cache, hist, budget, stop,
+                     temp, topk, topp, keys, dcache, n_steps):
+                def body(carry, _):
+                    tok, pos, active, cache, hist, budget, dcache = carry
+                    if with_draft:
+                        # mirror advance_one: the draft ingests the
+                        # pending token's K/V in lockstep so later
+                        # spec rounds condition on a hole-free cache
+                        _, dcache, _ = batched_decode_step(
+                            draft_params, tok, pos, active, dcache,
+                            draft_n_heads or n_heads, compute_dtype,
+                            windowed=windowed,
+                        )
+                    logits, cache, pos2 = batched_decode_step(
+                        params, tok, pos, active, cache, n_heads,
+                        compute_dtype, attn_fn=attn_fn, windowed=windowed,
+                    )
+                    if sampling:
+                        sub = jax.vmap(jax.random.fold_in)(keys, pos2)
+                        new = sample_tokens(logits, temp, topk, topp, sub)
+                    else:
+                        new = jnp.argmax(logits, -1).astype(jnp.int32)
+                    new = jnp.where(active, new, tok)
+                    emit = jnp.where(active, new, -1)
+                    hist = hist_write_row(
+                        hist, new[:, None], pos2, active.astype(jnp.int32)
+                    )
+                    budget = budget - active.astype(jnp.int32)
+                    active = active & (budget > 0) & ~(
+                        (new == stop) & (stop >= 0)
+                    )
+                    return (
+                        new, pos2, active, cache, hist, budget, dcache,
+                    ), emit
+
+                carry, emits = jax.lax.scan(
+                    body, (tok, pos, active, cache, hist, budget, dcache),
+                    None, length=n_steps,
+                )
+                tok, pos, active, cache, hist, budget, dcache = carry
+                return emits.T, tok, pos, active, cache, hist, dcache
+
+            return impl
+
+        _pdon = dict(
+            donate_argnums=(3, 4, 11), static_argnames=("n_steps",)
+        )
+        _wd = draft_params is not None
+        if mesh is not None and attn_impl == "pallas":
+            # same shard_map partition as the single step: the scan is
+            # slot-parallel, each device pumps its local slots with the
+            # kernel inline
+            import functools as _ft
+
+            from jax.sharding import PartitionSpec as P
+
+            ax = slots_axis
+            vec, cac = P(ax), P(None, ax)
+            pspecs = dict(
+                in_specs=(vec, vec, vec, cac, vec, vec, vec, vec, vec,
+                          vec, vec, cac),
+                out_specs=(vec, vec, vec, vec, cac, vec, cac),
+                check_vma=False,
+            )
+
+            def _pump_sm(f):
+                def g(tok, pos, active, cache, hist, budget, stop, temp,
+                      topk, topp, keys, dcache, n_steps):
+                    return jax.shard_map(
+                        _ft.partial(f, n_steps=n_steps), mesh=mesh,
+                        **pspecs,
+                    )(tok, pos, active, cache, hist, budget, stop, temp,
+                      topk, topp, keys, dcache)
+
+                return g
+
+            self._pump_greedy = jax.jit(
+                _pump_sm(pump_impl(False, _wd)), **_pdon
+            )
+            self._pump_sampling = jax.jit(
+                _pump_sm(pump_impl(True, _wd)), **_pdon
+            )
+        else:
+            self._pump_greedy = jax.jit(pump_impl(False, _wd), **_pdon)
+            self._pump_sampling = jax.jit(pump_impl(True, _wd), **_pdon)
         # first-token pick: same device sampler over the prefill logits
         self._sample1 = jax.jit(
             lambda logits, temp, topk, topp, key: sample_tokens(
                 logits[None, :], temp, topk, topp, key[None]
             )[0]
         )
-        self._insert = jax.jit(insert_slot)
+        self._insert = jax.jit(insert_slot, donate_argnums=0)
 
         # one speculative round = verify + device-side acceptance (+ ring
         # commit of accepted columns when windowed) in ONE program; jit
@@ -1098,33 +1272,133 @@ class ContinuousBatcher:
         # and [B] final tokens cross to the host — never [B, k, V]
         # logits (sampling acceptance needs the full distributions,
         # which at a 32k+ vocab must not ship per round).
-        def spec_round_impl(spec_sampling):
-            def impl(toks, pos_, active, cache, temp, topk, topp, keys):
-                if windowed:
-                    logits, cks, cvs = batched_windowed_verify(
-                        params, toks, pos_, active, cache, n_heads,
-                        compute_dtype,
-                    )
-                else:
-                    logits, cache = batched_verify_step(
-                        params, toks, pos_, active, cache, n_heads,
-                        compute_dtype,
-                    )
-                m, final = spec_accept(
-                    logits, toks, temp, topk, topp, keys, pos_,
-                    spec_sampling,
+        def spec_round_core(toks, pos_, active, cache, hist, temp, topk,
+                            topp, keys, spec_sampling):
+            if windowed:
+                logits, cks, cvs = batched_windowed_verify(
+                    params, toks, pos_, active, cache, n_heads,
+                    compute_dtype,
                 )
-                m = jnp.where(active, m, 0)
-                if windowed:
-                    cache = commit_ring_chunk(
-                        cache, cks, cvs, pos_, m, active
-                    )
-                return m, final, cache, pos_ + m
+            else:
+                logits, cache = batched_verify_step(
+                    params, toks, pos_, active, cache, n_heads,
+                    compute_dtype,
+                )
+            m, final = spec_accept(
+                logits, toks, temp, topk, topp, keys, pos_, spec_sampling
+            )
+            m = jnp.where(active, m, 0)
+            if windowed:
+                cache = commit_ring_chunk(cache, cks, cvs, pos_, m, active)
+            # emitted row [B, k]: the m-1 accepted proposals then the
+            # correction/bonus token, -1 beyond — the device-side form
+            # of spec_step's host commit loop, recorded into hist so
+            # later rounds mine a complete context
+            kk = toks.shape[1]
+            j = jnp.arange(kk)[None, :]
+            prop_part = jnp.concatenate(
+                [toks[:, 1:], jnp.full((toks.shape[0], 1), -1, jnp.int32)],
+                axis=1,
+            )
+            emit = jnp.where(
+                j < (m - 1)[:, None], prop_part,
+                jnp.where(j == (m - 1)[:, None], final[:, None], -1),
+            )
+            emit = jnp.where(active[:, None], emit, -1)
+            hist = hist_write_row(hist, emit, pos_ + 1, m)
+            return m, final, cache, hist, pos_ + m, emit
+
+        def spec_round_impl(spec_sampling):
+            def impl(toks, pos_, active, cache, hist, temp, topk, topp,
+                     keys):
+                m, final, cache, hist, pos2, _ = spec_round_core(
+                    toks, pos_, active, cache, hist, temp, topk, topp,
+                    keys, spec_sampling,
+                )
+                return m, final, cache, hist, pos2
 
             return impl
 
-        self._spec_round_greedy = jax.jit(spec_round_impl(False))
-        self._spec_round_sampling = jax.jit(spec_round_impl(True))
+        self._spec_round_greedy = jax.jit(spec_round_impl(False), **_don)
+        self._spec_round_sampling = jax.jit(spec_round_impl(True), **_don)
+
+        # ---- speculative pump: R spec rounds per program launch ----
+        # The host spec_step pays two device reads (pos, tok) plus
+        # Python n-gram mining per round; this scans R whole
+        # propose→verify→accept→commit rounds on device (proposals from
+        # device_ngram_propose, or an in-scan draft model stepping k
+        # times like _DraftEngine.propose) and ships ONE packed int32
+        # vector back: [B·R·k emitted tokens ‖ accepted-count ‖
+        # proposal-columns]. Acceptance telemetry therefore costs no
+        # extra transfer.
+        def spec_pump_impl(spec_sampling, use_draft):
+            def impl(tok, pos, active, cache, hist, budget, stop, temp,
+                     topk, topp, keys, dcache, rounds, k, g):
+                def body(carry, _):
+                    (tok, pos, active, cache, hist, budget, dcache,
+                     acc, cols) = carry
+                    if use_draft:
+                        # k greedy draft steps: k-1 proposals + the
+                        # k-th write (full-acceptance K/V invariant,
+                        # _DraftEngine.propose)
+                        cur, p, dc = tok, pos, dcache
+                        outs = []
+                        for _ in range(k):
+                            dlg, dc, p = batched_decode_step(
+                                draft_params, cur, p, active, dc,
+                                draft_n_heads or n_heads, compute_dtype,
+                            )
+                            cur = jnp.argmax(dlg, -1).astype(jnp.int32)
+                            outs.append(cur)
+                        props = jnp.stack(outs[: k - 1], axis=1)
+                        dcache = dc
+                    else:
+                        props = device_ngram_propose(hist, pos, k, g)
+                    props = jnp.where(active[:, None], props, -1)
+                    toks = jnp.concatenate([tok[:, None], props], axis=1)
+                    m, final, cache, hist, pos2, emit = spec_round_core(
+                        toks, pos, active, cache, hist, temp, topk,
+                        topp, keys, spec_sampling,
+                    )
+                    acc = acc + jnp.sum(jnp.maximum(m - 1, 0))
+                    cols = cols + jnp.sum((props >= 0).astype(jnp.int32))
+                    budget = budget - m
+                    hit_stop = jnp.any(
+                        (emit == stop[:, None]) & (stop[:, None] >= 0),
+                        axis=1,
+                    )
+                    active = active & (budget > 0) & ~hit_stop
+                    tok = jnp.where(m > 0, final, tok)
+                    return (tok, pos2, active, cache, hist, budget,
+                            dcache, acc, cols), emit
+
+                zero = jnp.zeros((), jnp.int32)
+                (tok, pos, active, cache, hist, budget, dcache, acc,
+                 cols), emits = jax.lax.scan(
+                    body,
+                    (tok, pos, active, cache, hist, budget, dcache,
+                     zero, zero),
+                    None, length=rounds,
+                )
+                packed = jnp.concatenate([
+                    jnp.transpose(emits, (1, 0, 2)).reshape(-1),
+                    jnp.stack([acc, cols]),
+                ])
+                return packed, tok, pos, active, cache, hist, dcache
+
+            return impl
+
+        _sdon = dict(
+            donate_argnums=(3, 4, 11),
+            static_argnames=("rounds", "k", "g"),
+        )
+        _use_draft = draft_params is not None and not windowed
+        self._spec_pump_greedy = jax.jit(
+            spec_pump_impl(False, _use_draft), **_sdon
+        )
+        self._spec_pump_sampling = jax.jit(
+            spec_pump_impl(True, _use_draft), **_sdon
+        )
         self._draft = (
             _DraftEngine(
                 draft_params, draft_n_heads or n_heads, n_slots, max_len,
@@ -1136,7 +1410,8 @@ class ContinuousBatcher:
             lambda stage, ks, vs: (
                 jax.lax.dynamic_update_slice(stage[0], ks, (0, 0, 0, 0, 0)),
                 jax.lax.dynamic_update_slice(stage[1], vs, (0, 0, 0, 0, 0)),
-            )
+            ),
+            donate_argnums=0,
         )
         # registered shared prefixes:
         # id → ((ck, cv) trimmed to plen, plen, prefix tokens)
@@ -1204,6 +1479,11 @@ class ContinuousBatcher:
                 jnp.zeros(self._ring_shape, self.compute_dtype),
                 jnp.zeros(self._ring_shape, self.compute_dtype),
             )
+        else:
+            # the chunk programs DONATE their ring argument — a caller's
+            # ring (a registered prefix) must survive this staging run,
+            # so advance a fresh copy, never the stored buffers
+            ring = (ring[0] + 0, ring[1] + 0)
         t = tokens.shape[0]
         cpos = 0
         logits = None
@@ -1428,14 +1708,28 @@ class ContinuousBatcher:
                 self._slots[slot] = None
             raise
 
+        # device n-gram context seed: the full known stream (context +
+        # first pending token) as one padded row — staged into
+        # self._hist at admission with a single static-shape write.
+        # Streams longer than the history (windowed overrun) keep their
+        # head; mining quality degrades there, never correctness.
+        H = self.max_len
+        hist_row = np.full((H,), -1, np.int32)
+        ctx = req.prompt
+        if fill < H:
+            hist_row[:fill] = ctx[:fill]
+            hist_row[fill] = first
+        else:
+            hist_row[:] = ctx[:H]
         with self._lock:
+            req.fill0 = fill
             req.tokens.append(first)
             if req.finished():
                 self._finish(slot)
             else:
                 self._pending.append(
                     _PendingInsert(slot, ks, vs, first, fill, req,
-                                   draft_kv=draft_kv)
+                                   draft_kv=draft_kv, hist_row=hist_row)
                 )
         return rid
 
@@ -1457,6 +1751,10 @@ class ContinuousBatcher:
             )
             if p.draft_kv is not None and self._draft is not None:
                 self._draft.admit(p.slot, p.draft_kv)
+            if p.hist_row is not None:
+                self._hist = self._pin(
+                    self._hist.at[p.slot].set(jnp.asarray(p.hist_row))
+                )
             self._active[p.slot] = True
         self._pending.clear()
 
@@ -1474,6 +1772,244 @@ class ContinuousBatcher:
         with self._step_lock:
             return self._plain_step_locked(t0)
 
+    def _pump_host_state(self, active_np):
+        """Per-slot budget remaining + stop ids for a device pump
+        (host-known state shipped down once per pump; [B] int32 each)."""
+        remaining = np.zeros((self.n_slots,), np.int32)
+        stop = np.full((self.n_slots,), -1, np.int32)
+        for s, req in enumerate(self._slots):
+            if req is None or not active_np[s]:
+                continue
+            remaining[s] = req.budget - len(req.tokens)
+            if req.stop_token is not None:
+                stop[s] = req.stop_token
+        return remaining, stop
+
+    def step_pump(self, n: int = 8) -> Dict[int, List[int]]:
+        """Advance every active slot by up to ``n`` tokens in ONE
+        compiled program (lax.scan over the batched step) with ONE
+        [B, n] device→host read at the end — the serving hot loop
+        shaped for the chip, not the host: per-token pumping pays a
+        full host↔device round trip per token (ruinous through a
+        tunnel-attached device, wasteful anywhere), while a pump
+        amortizes it n ways. Slots hit their budget or stop token ON
+        DEVICE and idle out (-1 lanes); admissions join at the next
+        pump, so admission latency is bounded by one pump — pump small
+        when latency-sensitive, large for throughput. Returns
+        {rid: [tokens emitted this pump]}. Role-match: the reference's
+        single-invoke-per-buffer filter loop
+        (gst/nnstreamer/tensor_filter/tensor_filter.c) batched along
+        the token axis instead."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with self._step_lock:
+            with self._lock:
+                self._apply_pending_locked()
+                if not self._active.any():
+                    return {}
+                active_np = self._active.copy()
+                sampling = any(
+                    req is not None and active_np[s] and req.temperature > 0
+                    for s, req in enumerate(self._slots)
+                )
+                remaining, stop = self._pump_host_state(active_np)
+                args = (
+                    self._tok, self._pos, jnp.asarray(active_np),
+                    self._cache, self._hist, jnp.asarray(remaining),
+                    jnp.asarray(stop), self._temp, self._topk,
+                    self._topp, self._keys,
+                    self._draft._cache if self._draft is not None
+                    else None,
+                )
+            fn = self._pump_sampling if sampling else self._pump_greedy
+            emits, tok, pos, _act, cache, hist, dcache = fn(
+                *args, n_steps=int(n)
+            )
+            emits_np = np.asarray(emits)  # ONE [B, n] transfer
+            with self._lock:
+                self._cache = cache
+                self._hist = self._pin(hist)
+                self._tok = self._pin(tok)
+                self._pos = self._pin(pos)
+                if self._draft is not None:
+                    self._draft._cache = dcache
+                out: Dict[int, List[int]] = {}
+                n_em = 0
+                for s, req in enumerate(self._slots):
+                    if req is None or not active_np[s]:
+                        continue
+                    got: List[int] = []
+                    for t in emits_np[s]:
+                        if t < 0:
+                            break
+                        req.tokens.append(int(t))
+                        got.append(int(t))
+                        n_em += 1
+                        if req.finished():
+                            break
+                    if got:
+                        out[req.rid] = got
+                    if req.finished():
+                        self._finish(s)
+                self._n_steps += int(n)
+                self._n_tokens += n_em
+                self._step_time_s += _time.perf_counter() - t0
+                return out
+
+    def spec_pump(
+        self, rounds: int = 8, k: int = 4, ngram: int = 2
+    ) -> Dict[int, List[int]]:
+        """``rounds`` whole speculative rounds per program launch —
+        propose → verify → accept → commit scanned ON DEVICE, proposals
+        from device_ngram_propose (or an in-scan draft model), one
+        packed int32 read back per pump (emitted tokens + acceptance
+        telemetry). The host spec_step pays two device reads plus
+        Python mining per round; this pays one read per ``rounds``.
+
+        Non-windowed batchers clamp ``rounds`` so the worst-case
+        verify writes stay inside max_len (host-side arithmetic — no
+        device read: pos = fill0 + len(tokens) - 1); when not even one
+        round fits, falls back to spec_step's shrinking k_round. A
+        windowed DRAFT batcher also falls back per round: its
+        verify-then-commit ring discipline needs each round's
+        acceptance before the next propose touches the ring. The
+        clamped round count is quantized DOWN to a power of two:
+        ``rounds`` is a static scan length, so every distinct value is
+        its own XLA program — quantization bounds the program variants
+        to log2(rounds) instead of one per tail length."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        k = max(2, int(k))
+        if self._draft is not None and self.windowed:
+            return self._spec_fallback_rounds(int(rounds), k, ngram)
+        with self._step_lock:
+            with self._lock:
+                self._apply_pending_locked()
+                if not self._active.any():
+                    return {}
+                active_np = self._active.copy()
+                sampling = any(
+                    req is not None and active_np[s] and req.temperature > 0
+                    for s, req in enumerate(self._slots)
+                )
+                r = int(rounds)
+                if not self.windowed:
+                    pos_max = max(
+                        req.fill0 + len(req.tokens) - 1
+                        for s, req in enumerate(self._slots)
+                        if req is not None and active_np[s]
+                    )
+                    r = min(r, (self.max_len - pos_max) // k)
+                remaining, stop = self._pump_host_state(active_np)
+                r = min(r, int(remaining.max()))  # budget caps rounds
+                if r >= 1:
+                    while r & (r - 1):  # power-of-two floor (see above)
+                        r &= r - 1
+                    args = (
+                        self._tok, self._pos, jnp.asarray(active_np),
+                        self._cache, self._hist, jnp.asarray(remaining),
+                        jnp.asarray(stop), self._temp, self._topk,
+                        self._topp, self._keys,
+                        self._draft._cache if self._draft is not None
+                        else None,
+                    )
+                    fn = (
+                        self._spec_pump_sampling if sampling
+                        else self._spec_pump_greedy
+                    )
+            if r >= 1:
+                packed, tok, pos, _act, cache, hist, dcache = fn(
+                    *args, rounds=r, k=k, g=int(ngram)
+                )
+                packed_np = np.asarray(packed)  # ONE transfer
+                acc, cols = int(packed_np[-2]), int(packed_np[-1])
+                emits_np = packed_np[:-2].reshape(self.n_slots, r, k)
+                with self._lock:
+                    return self._spec_pump_commit_locked(
+                        t0, active_np, r, acc, cols, emits_np, tok, pos,
+                        cache, hist, dcache,
+                    )
+        # r < 1: no verify room at any width ≥ 2 — the shrinking-k host
+        # round handles the tail tokens (takes _step_lock itself)
+        return self._spec_fallback_rounds(1, k, ngram)
+
+    def _spec_fallback_rounds(
+        self, rounds: int, k: int, ngram: int
+    ) -> Dict[int, List[int]]:
+        """Drive ``rounds`` host spec_step rounds while preserving
+        spec_pump's return contract ({rid: ALL tokens emitted}) —
+        spec_step itself reports only the last token per request, so
+        the full emission is reconstructed from req.tokens growth."""
+        before: Dict[int, int] = {}
+        with self._lock:
+            for req in self._slots:
+                if req is not None:
+                    before[req.rid] = len(req.tokens)
+        # requests admitted mid-fallback start at 1: token 0 is the
+        # prefill's, emitted at submit, not by these rounds
+        default_start = 1
+        out: Dict[int, List[int]] = {}
+        for _ in range(int(rounds)):
+            em = self.spec_step(k=k, ngram=ngram)
+            if not em:
+                break
+            for rid in em:
+                out.setdefault(rid, [])
+        with self._lock:
+            live = {
+                req.rid: req for req in self._slots if req is not None
+            }
+            for rid in out:
+                req = live.get(rid) or self._done_pool.get(rid)
+                if req is not None:
+                    start = before.get(rid, default_start)
+                    out[rid] = list(req.tokens[start:])
+        return {rid: toks for rid, toks in out.items() if toks}
+
+    def _spec_pump_commit_locked(
+        self, t0, active_np, r, acc, cols, emits_np, tok, pos, cache,
+        hist, dcache,
+    ) -> Dict[int, List[int]]:
+        """spec_pump bookkeeping; caller holds _step_lock + _lock."""
+        import time as _time
+
+        self._cache = cache
+        self._hist = self._pin(hist)
+        self._tok = self._pin(tok)
+        self._pos = self._pin(pos)
+        if self._draft is not None:
+            self._draft._cache = dcache
+        out = {}
+        n_em = 0
+        for s, req in enumerate(self._slots):
+            if req is None or not active_np[s]:
+                continue
+            got: List[int] = []
+            for rnd in range(r):
+                for t in emits_np[s, rnd]:
+                    if t < 0:
+                        break
+                    req.tokens.append(int(t))
+                    got.append(int(t))
+                    n_em += 1
+                    if req.finished():
+                        break
+                if req.finished():
+                    break
+            if got:
+                out[req.rid] = got
+            if req.finished():
+                self._finish(s)
+        self._n_steps += r
+        self._n_tokens += n_em
+        self._n_spec_rounds += r
+        self._n_spec_accepted += acc
+        self._n_spec_columns += cols
+        self._step_time_s += _time.perf_counter() - t0
+        return out
+
     def _plain_step_locked(self, t0) -> Dict[int, int]:
         """step() body; caller holds _step_lock."""
         import time as _time
@@ -1489,8 +2025,8 @@ class ContinuousBatcher:
             )
             args = (
                 self._tok, self._pos, jnp.asarray(active_np),
-                self._cache, self._temp, self._topk, self._topp,
-                self._keys,
+                self._cache, self._hist, self._temp, self._topk,
+                self._topp, self._keys,
             )
         if self._draft is not None:
             # keep the draft cache position-synced with the target:
@@ -1498,12 +2034,13 @@ class ContinuousBatcher:
             # target; the draft must mirror it (see advance_one)
             self._draft.advance_one(args[0], args[1], args[2])
         step_fn = self._step_sampling if sampling else self._step_greedy
-        new_tok, cache, pos = step_fn(*args)
+        new_tok, cache, pos, hist = step_fn(*args)
         toks = np.asarray(new_tok)  # [B] ids — the only host transfer
         with self._lock:
             self._cache = cache
             self._pos = pos
             self._tok = new_tok
+            self._hist = hist
             emitted: Dict[int, int] = {}
             for slot, req in enumerate(self._slots):
                 if req is None or not active_np[slot]:
@@ -1640,14 +2177,14 @@ class ContinuousBatcher:
                 )
             args = (
                 jnp.asarray(toks_host), self._pos,
-                jnp.asarray(active_np), self._cache,
+                jnp.asarray(active_np), self._cache, self._hist,
                 self._temp, self._topk, self._topp, self._keys,
             )
             round_fn = (
                 self._spec_round_sampling if sampling
                 else self._spec_round_greedy
             )
-            m_dev, final_dev, cache, pos2 = round_fn(*args)
+            m_dev, final_dev, cache, hist, pos2 = round_fn(*args)
             if self._draft is not None and self._draft.windowed:
                 # draft-side commit of the accepted columns (the ring
                 # discipline: nothing landed during propose)
@@ -1657,6 +2194,7 @@ class ContinuousBatcher:
             final_np = np.asarray(final_dev)
             with self._lock:
                 self._cache = cache
+                self._hist = hist
                 self._pos = self._pin(pos2)
                 emitted: Dict[int, int] = {}
                 new_tok = tok_np.copy()

@@ -1,0 +1,303 @@
+"""Multi-step pump tests (models/serving.py step_pump / spec_pump).
+
+The pumps exist to amortize host↔device round trips: N decode steps (or
+R whole speculative rounds) per compiled program, ONE device→host read
+per pump. The load-bearing invariant is EXACT stream equality with the
+per-token paths — a pump is a batching of the step loop, never a
+different decoder. Role-match: the per-buffer invoke loop of
+gst/nnstreamer/tensor_filter/tensor_filter.c batched along the token
+axis.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+N_HEADS = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(
+        jax.random.PRNGKey(7), vocab=257, d_model=64, n_heads=N_HEADS,
+        n_layers=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return tfm.init_params(
+        jax.random.PRNGKey(11), vocab=257, d_model=32, n_heads=N_HEADS,
+        n_layers=1,
+    )
+
+
+def _prompt(n, seed):
+    return np.random.default_rng(seed).integers(1, 257, (n,)).astype(np.int32)
+
+
+def _rep_prompt(n, seed, period=6):
+    """Repetitive prompt: the n-gram miner's best case."""
+    base = np.random.default_rng(seed).integers(1, 257, (period,))
+    return np.tile(base, -(-n // period))[:n].astype(np.int32)
+
+
+def _drain_steps(cb, rids):
+    while any(cb.result(r) is None for r in rids):
+        cb.step()
+
+
+def _drain_pump(cb, rids, n):
+    while any(cb.result(r) is None for r in rids):
+        cb.step_pump(n)
+
+
+def _drain_spec_pump(cb, rids, rounds, k, ngram=2):
+    while any(cb.result(r) is None for r in rids):
+        cb.spec_pump(rounds=rounds, k=k, ngram=ngram)
+
+
+def _tokens(cb, rids):
+    return [cb.result(r) for r in rids]
+
+
+def _twin(params, **kw):
+    return ContinuousBatcher(
+        params, N_HEADS, n_slots=4, max_len=96, prompt_len=16, **kw
+    )
+
+
+@pytest.mark.parametrize("n", [1, 3, 8, 64])
+def test_step_pump_matches_per_token_steps(params, n):
+    """A pump of n is exactly n per-token steps, for any n (including
+    n past every budget — idle lanes emit -1 and are dropped)."""
+    prompts = [_prompt(5 + s, 100 + s) for s in range(4)]
+    a, b = _twin(params), _twin(params)
+    ra = [a.submit(p, 9) for p in prompts]
+    rb = [b.submit(p, 9) for p in prompts]
+    _drain_steps(a, ra)
+    _drain_pump(b, rb, n)
+    assert _tokens(a, ra) == _tokens(b, rb)
+
+
+def test_step_pump_stop_token_deactivates_on_device(params):
+    """The stop token ends a stream INSIDE the scan — tokens after it
+    in the same pump are discarded, exactly like per-token stepping."""
+    prompts = [_prompt(5, 7)]
+    a, b = _twin(params), _twin(params)
+    # pick the 3rd greedy token as the stop token so it triggers mid-pump
+    ra = [a.submit(prompts[0], 12)]
+    _drain_steps(a, ra)
+    stop = _tokens(a, ra)[0][2]
+    a2, b2 = _twin(params), _twin(params)
+    r2 = [a2.submit(prompts[0], 12, stop_token=stop)]
+    r3 = [b2.submit(prompts[0], 12, stop_token=stop)]
+    _drain_steps(a2, r2)
+    _drain_pump(b2, r3, 8)
+    assert _tokens(a2, r2) == _tokens(b2, r3)
+    assert _tokens(b2, r3)[0][-1] == stop
+
+
+def test_step_pump_staggered_admissions_join_next_pump(params):
+    """Requests submitted between pumps join at the next pump and still
+    produce their solo-greedy stream."""
+    a, b = _twin(params), _twin(params)
+    p0, p1 = _prompt(5, 1), _prompt(7, 2)
+    ra0, rb0 = a.submit(p0, 10), b.submit(p0, 10)
+    for _ in range(2):
+        a.step()
+    b.step_pump(2)
+    ra1, rb1 = a.submit(p1, 6), b.submit(p1, 6)
+    _drain_steps(a, [ra0, ra1])
+    _drain_pump(b, [rb0, rb1], 4)
+    assert _tokens(a, [ra0, ra1]) == _tokens(b, [rb0, rb1])
+
+
+def test_step_pump_sampling_stream_deterministic(params):
+    """Sampling slots: the per-(seed, position) key discipline makes a
+    pumped stream identical to the per-token stream."""
+    p = _prompt(6, 3)
+    a, b = _twin(params), _twin(params)
+    ra = a.submit(p, 8, temperature=0.8, top_k=40, seed=5)
+    rb = b.submit(p, 8, temperature=0.8, top_k=40, seed=5)
+    _drain_steps(a, [ra])
+    _drain_pump(b, [rb], 8)
+    assert a.result(ra) == b.result(rb)
+
+
+@pytest.mark.parametrize("rounds", [1, 2, 4])
+def test_spec_pump_greedy_exact(params, rounds):
+    """Greedy speculation is exact by construction: spec_pump streams
+    equal plain per-token streams whatever the round batching."""
+    prompts = [_rep_prompt(12, 50 + s) for s in range(4)]
+    a, b = _twin(params), _twin(params)
+    ra = [a.submit(p, 12) for p in prompts]
+    rb = [b.submit(p, 12) for p in prompts]
+    _drain_steps(a, ra)
+    _drain_spec_pump(b, rb, rounds, k=4)
+    assert _tokens(a, ra) == _tokens(b, rb)
+    st = b.stats()
+    assert st["spec_rounds"] >= rounds
+
+
+def test_spec_pump_acceptance_telemetry_rides_packed_readback(params):
+    """Acceptance counters update from the pump's packed vector — no
+    separate transfer — and a repetitive context actually accepts."""
+    p = _rep_prompt(24, 9, period=4)
+    b = _twin(params)
+    rb = b.submit(p, 16)
+    _drain_spec_pump(b, [rb], 4, k=4, ngram=1)
+    st = b.stats()
+    assert st["spec_columns"] > 0
+    assert st["spec_accepted_tokens"] >= 0
+    assert st["tokens_per_step"] >= 1.0  # never worse than plain steps
+
+
+def test_spec_pump_sampling_exact_vs_host_rounds(params):
+    """Sampling speculation: device-mined proposals differ from host
+    mining only in WHERE the mining ran — acceptance is the same
+    program, so a pumped sampling stream must remain a valid
+    deterministic stream (same seed ⇒ same stream on repeat runs)."""
+    p = _rep_prompt(16, 21, period=5)
+    outs = []
+    for _ in range(2):
+        b = _twin(params)
+        rb = b.submit(p, 10, temperature=0.7, seed=3)
+        _drain_spec_pump(b, [rb], 3, k=3, ngram=1)
+        outs.append(b.result(rb))
+    assert outs[0] == outs[1]
+
+
+def test_spec_pump_windowed_ring_exact(params):
+    """Windowed ring + device n-gram proposals: streams equal the
+    windowed per-token stream (verify-then-commit never clobbers the
+    ring with rejected columns)."""
+    prompts = [_rep_prompt(10, 70 + s) for s in range(3)]
+    kw = dict(windowed=True, max_len=32, prompt_len=16)
+    a = ContinuousBatcher(params, N_HEADS, n_slots=4, **kw)
+    b = ContinuousBatcher(params, N_HEADS, n_slots=4, **kw)
+    ra = [a.submit(p, 10) for p in prompts]
+    rb = [b.submit(p, 10) for p in prompts]
+    _drain_steps(a, ra)
+    _drain_spec_pump(b, rb, 3, k=3)
+    assert _tokens(a, ra) == _tokens(b, rb)
+
+
+def test_spec_pump_draft_inscan_exact(params, draft_params):
+    """Draft-model proposals mined IN-SCAN (k draft steps per round
+    inside the pump program) produce the plain greedy stream."""
+    prompts = [_prompt(8, 80 + s) for s in range(4)]
+    a = _twin(params)
+    b = _twin(params, draft_params=draft_params, draft_n_heads=N_HEADS)
+    ra = [a.submit(p, 10) for p in prompts]
+    rb = [b.submit(p, 10) for p in prompts]
+    _drain_steps(a, ra)
+    _drain_spec_pump(b, rb, 3, k=3)
+    assert _tokens(a, ra) == _tokens(b, rb)
+    assert b.stats()["spec_columns"] > 0  # a draft always proposes
+
+
+def test_step_pump_draft_cache_stays_synced(params, draft_params):
+    """step_pump on a draft batcher advances the draft cache in-scan
+    (the pump form of advance_one): a spec_pump AFTER a step_pump still
+    produces the exact stream — no holes in the draft cache."""
+    p = _prompt(6, 31)
+    a = _twin(params)
+    b = _twin(params, draft_params=draft_params, draft_n_heads=N_HEADS)
+    ra = a.submit(p, 12)
+    rb = b.submit(p, 12)
+    _drain_steps(a, [ra])
+    b.step_pump(4)  # first 4 tokens via plain pump
+    _drain_spec_pump(b, [rb], 2, k=3)  # rest speculated
+    assert a.result(ra) == b.result(rb)
+
+
+def test_pump_int8_cache_matches_per_token(params):
+    """int8 KV cache + pump: quantization happens inside the scan just
+    as inside the step — streams match the int8 per-token path."""
+    p = _prompt(6, 41)
+    a = _twin(params, cache_dtype="int8")
+    b = _twin(params, cache_dtype="int8")
+    ra = a.submit(p, 8)
+    rb = b.submit(p, 8)
+    _drain_steps(a, [ra])
+    _drain_pump(b, [rb], 8)
+    assert a.result(ra) == b.result(rb)
+
+
+def test_pump_mesh_sharded_slots_match_unsharded(params):
+    """Pumps under a slot-sharded mesh (SPMD decode) equal the
+    unsharded pumped streams."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, axes=("dp",))
+    prompts = [_prompt(5 + s, 90 + s) for s in range(8)]
+    outs = {}
+    for label, kw in (("plain", {}), ("mesh", dict(mesh=mesh))):
+        cb = ContinuousBatcher(
+            params, N_HEADS, n_slots=8, max_len=64, prompt_len=16, **kw
+        )
+        rids = [cb.submit(p, 8) for p in prompts]
+        _drain_pump(cb, rids, 8)
+        outs[label] = _tokens(cb, rids)
+    assert outs["plain"] == outs["mesh"]
+
+
+def test_pump_mesh_pallas_spec_pump_compose(params):
+    """The full stack in one server: mesh + pallas step pumps and a
+    spec pump on the same batcher keep the exact greedy stream."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, axes=("dp",))
+    prompts = [_rep_prompt(10, 60 + s) for s in range(8)]
+    a = ContinuousBatcher(
+        params, N_HEADS, n_slots=8, max_len=64, prompt_len=16
+    )
+    b = ContinuousBatcher(
+        params, N_HEADS, n_slots=8, max_len=64, prompt_len=16,
+        mesh=mesh, attn_impl="pallas",
+    )
+    ra = [a.submit(p, 8) for p in prompts]
+    rb = [b.submit(p, 8) for p in prompts]
+    _drain_steps(a, ra)
+    while any(b.result(r) is None for r in rb):
+        b.step_pump(2)
+        b.spec_pump(rounds=2, k=3)
+    assert _tokens(a, ra) == _tokens(b, rb)
+
+
+def test_spec_pump_room_clamp_falls_back_near_max_len(params):
+    """When the cache is nearly full a wide pump cannot fit: spec_pump
+    must clamp rounds / fall back to the shrinking-k host round and
+    still finish the stream exactly."""
+    p = _prompt(12, 55)
+    a = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=32,
+                          prompt_len=16)
+    b = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=32,
+                          prompt_len=16)
+    ra = a.submit(p, 20)  # 12 + 20 = max_len exactly
+    rb = b.submit(p, 20)
+    _drain_steps(a, [ra])
+    _drain_spec_pump(b, [rb], 8, k=4)
+    assert a.result(ra) == b.result(rb)
+
+
+def test_ngram_device_proposer_mines_recent_context(params):
+    """device_ngram_propose finds the most recent suffix match and
+    proposes its continuation; -1 where nothing matches."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models.serving import device_ngram_propose
+
+    hist = jnp.asarray(np.array([
+        [5, 6, 7, 5, 6, 9, 5, 6] + [-1] * 8,   # pending 6 at pos 7
+        [1, 2, 3, 4, 5, 6, 7, 8] + [-1] * 8,   # no repeat: nothing
+    ], np.int32))
+    pos = jnp.asarray(np.array([7, 7], np.int32))
+    props = np.asarray(device_ngram_propose(hist, pos, k=3, g=2))
+    # slot 0: latest earlier "5 6" ends at j=4 → proposes hist[5], hist[6]
+    assert props[0].tolist() == [9, 5]
+    assert props[1].tolist() == [-1, -1]
