@@ -202,7 +202,15 @@ class TensorConverter(TensorOp):
         return frame
 
     def _convert_video(self, frame: Frame) -> Optional[Frame]:
-        img = np.asarray(frame.tensors[0])  # HWC
+        # device-resident frames batch ON DEVICE (jnp.stack — one async
+        # dispatch), never through np.asarray: forcing a device frame to
+        # host here would cost a D2H round trip PER FRAME exactly on the
+        # chained-device-pipeline path the frames-per-tensor batching
+        # exists to accelerate (gsttensor_converter.c:701-712 adapter
+        # batching, rebuilt at the device boundary)
+        t0 = frame.tensors[0]
+        on_device = hasattr(t0, "devices")
+        img = t0 if on_device else np.asarray(t0)  # HWC
         if self.frames_per_tensor == 1:
             return frame.with_tensors((img[None, ...],))
         self._batch.append(img)
@@ -210,7 +218,12 @@ class TensorConverter(TensorOp):
             self._batch_pts = frame.pts
         if len(self._batch) < self.frames_per_tensor:
             return None
-        batch = np.stack(self._batch, axis=0)
+        if any(hasattr(t, "devices") for t in self._batch):
+            import jax.numpy as jnp
+
+            batch = jnp.stack(self._batch, axis=0)
+        else:
+            batch = np.stack(self._batch, axis=0)
         self._batch.clear()
         dur = (
             frame.duration * self.frames_per_tensor

@@ -132,6 +132,9 @@ class FakeSink(Sink):
     backpressure reflects real compute."""
 
     FACTORY_NAME = "fakesink"
+    # never reads tensor data: the executor must not prefetch host
+    # copies on its behalf (SinkNode sync-window path)
+    READS_HOST = False
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

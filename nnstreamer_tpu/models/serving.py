@@ -1772,6 +1772,37 @@ class ContinuousBatcher:
         with self._step_lock:
             return self._plain_step_locked(t0)
 
+    def _harvest_rows_locked(
+        self, active_np, rows
+    ) -> Tuple[Dict[int, List[int]], int]:
+        """Append per-slot emitted rows (−1-padded, [B, ...] iterable of
+        row iterables) into their requests until budget/stop finishes
+        them; returns ({rid: tokens}, n_emitted). One implementation of
+        the budget/stop truncation discipline for every pump commit
+        path (caller holds _lock)."""
+        out: Dict[int, List[int]] = {}
+        n_em = 0
+        for s, req in enumerate(self._slots):
+            if req is None or not active_np[s]:
+                continue
+            got: List[int] = []
+            for row in rows(s):
+                for t in row:
+                    if t < 0:
+                        break
+                    req.tokens.append(int(t))
+                    got.append(int(t))
+                    n_em += 1
+                    if req.finished():
+                        break
+                if req.finished():
+                    break
+            if got:
+                out[req.rid] = got
+            if req.finished():
+                self._finish(s)
+        return out, n_em
+
     def _pump_host_state(self, active_np):
         """Per-slot budget remaining + stop ids for a device pump
         (host-known state shipped down once per pump; [B] int32 each)."""
@@ -1834,24 +1865,9 @@ class ContinuousBatcher:
                 self._pos = self._pin(pos)
                 if self._draft is not None:
                     self._draft._cache = dcache
-                out: Dict[int, List[int]] = {}
-                n_em = 0
-                for s, req in enumerate(self._slots):
-                    if req is None or not active_np[s]:
-                        continue
-                    got: List[int] = []
-                    for t in emits_np[s]:
-                        if t < 0:
-                            break
-                        req.tokens.append(int(t))
-                        got.append(int(t))
-                        n_em += 1
-                        if req.finished():
-                            break
-                    if got:
-                        out[req.rid] = got
-                    if req.finished():
-                        self._finish(s)
+                out, n_em = self._harvest_rows_locked(
+                    active_np, lambda s: (emits_np[s],)
+                )
                 self._n_steps += int(n)
                 self._n_tokens += n_em
                 self._step_time_s += _time.perf_counter() - t0
@@ -1981,27 +1997,9 @@ class ContinuousBatcher:
         self._pos = self._pin(pos)
         if self._draft is not None:
             self._draft._cache = dcache
-        out = {}
-        n_em = 0
-        for s, req in enumerate(self._slots):
-            if req is None or not active_np[s]:
-                continue
-            got: List[int] = []
-            for rnd in range(r):
-                for t in emits_np[s, rnd]:
-                    if t < 0:
-                        break
-                    req.tokens.append(int(t))
-                    got.append(int(t))
-                    n_em += 1
-                    if req.finished():
-                        break
-                if req.finished():
-                    break
-            if got:
-                out[req.rid] = got
-            if req.finished():
-                self._finish(s)
+        out, n_em = self._harvest_rows_locked(
+            active_np, lambda s: (emits_np[s, rnd] for rnd in range(r))
+        )
         self._n_steps += r
         self._n_tokens += n_em
         self._n_spec_rounds += r

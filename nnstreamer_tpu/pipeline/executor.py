@@ -414,6 +414,41 @@ class SinkNode(Node):
                         pass
             return tuple(keys)
 
+        def _batch_fetch(frames: List) -> List:
+            """One stacked D2H transfer per tensor index for a window of
+            same-shaped device frames, instead of a per-frame fetch in
+            each render's to_host — per-transfer cost dominates small
+            results on a remote-attached device, so W frames' labels
+            must ride ONE transfer. Falls back to the per-frame path on
+            any heterogeneity (returning None so the caller restores
+            the overlapped per-frame prefetch the stacked path
+            replaces)."""
+            if len(frames) < 2:
+                return None
+            try:
+                import jax.numpy as jnp
+                import numpy as np
+
+                n_t = len(frames[0].tensors)
+                if any(len(f.tensors) != n_t for f in frames):
+                    return None
+                cols = []
+                for i in range(n_t):
+                    ts = [f.tensors[i] for f in frames]
+                    if not all(hasattr(t, "devices") for t in ts):
+                        return None
+                    if len({t.shape for t in ts}) != 1:
+                        return None
+                    cols.append(np.asarray(jnp.stack(ts)))
+                return [
+                    f.with_tensors(
+                        [cols[i][j] for i in range(n_t)]
+                    ).mark_synced()
+                    for j, f in enumerate(frames)
+                ]
+            except Exception:  # noqa: BLE001 — fetch is an optimization
+                return None
+
         def flush() -> None:
             # one fence on the newest frame per device covers the window
             # (each device executes its dispatches in order, but ordering
@@ -430,10 +465,20 @@ class SinkNode(Node):
             for f in newest_per_device.values():
                 f.block_until_ready()
             n = len(pending)
-            for f in pending:
+            ready = None
+            if getattr(self.elem, "READS_HOST", True):
+                ready = _batch_fetch(pending)
+                if ready is None:
+                    # heterogeneous window: restore the overlapped
+                    # per-frame async copies the stacked path replaces
+                    for f in pending:
+                        f.prefetch_host()
+            if ready is None:
+                ready = pending
+            for f in ready:
                 f.mark_synced()
                 self.elem.render(f)
-            self._mark_render(n, pending)
+            self._mark_render(n, ready)
             pending.clear()
 
         while True:
@@ -444,7 +489,11 @@ class SinkNode(Node):
                 break
             t0 = time.perf_counter()
             if window > 1:
-                item.prefetch_host()
+                # no per-frame prefetch: flush() batch-fetches the whole
+                # window in ONE stacked transfer (per-frame
+                # copy_to_host_async is a full round trip each on a
+                # remote-attached device — W of them per window was the
+                # cost this path exists to avoid)
                 pending.append(item)
                 if len(pending) >= window:
                     flush()

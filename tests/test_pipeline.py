@@ -70,6 +70,32 @@ class TestBasicChain:
         run_chain(src, conv, sink)
         assert sink.rendered == 1
 
+    def test_frames_per_tensor_device_frames_batch_on_device(self):
+        """Device-born frames batch via jnp.stack INSIDE the converter
+        (one async device op) — never through np.asarray, which would
+        cost a D2H round trip per frame on the chained-device path the
+        batching exists to accelerate. Values must match the host path
+        exactly."""
+        import jax
+
+        outs = {}
+        for dev in (False, True):
+            src = VideoTestSrc(
+                width=8, height=8, device=str(dev).lower(),
+                **{"num-frames": 6},
+            )
+            conv = TensorConverter(**{"frames-per-tensor": 3})
+            sink = TensorSink()
+            run_chain(src, conv, sink)
+            assert sink.rendered == 2
+            t = sink.frames[0].tensors[0]
+            if dev:
+                # the converter's OUTPUT stays device-resident; the
+                # sink's to_host materializes it (egress boundary)
+                assert sink.frames[0].tensors[0].shape == (3, 8, 8, 3)
+            outs[dev] = np.asarray(t)
+        np.testing.assert_array_equal(outs[False], outs[True])
+
 
 class TestTransform:
     def _run(self, mode, option, data, dims="4", types="float32"):
@@ -371,6 +397,27 @@ class TestDevicePlacement:
 class TestDeviceResidentPath:
     """r3: device-born sources and device-computed decodes — the
     zero-host-copy pipeline spine behind the pipeline_fps bench."""
+
+    def test_sink_window_batch_fetch_matches_per_frame(self):
+        """sync-window sinks batch-fetch the window in ONE stacked
+        transfer (executor SinkNode flush); rendered values must be
+        byte-identical to the sync-window=1 per-frame path, partial
+        final windows included."""
+        def run(window):
+            src = VideoTestSrc(
+                width=8, height=8, device=True, **{"num-frames": 5}
+            )
+            conv = TensorConverter()
+            tr = TensorTransform(mode="arithmetic", option="add:3")
+            sink = TensorSink(**{"sync-window": window})
+            p = Pipeline().chain(src, conv, tr, sink)
+            p.run(timeout=60)
+            assert sink.rendered == 5
+            return [np.asarray(f.tensors[0]) for f in sink.frames]
+
+        a, b = run(1), run(4)  # 4: one full window + partial flush
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
 
     @pytest.mark.parametrize("pattern", ["gradient", "counter", "solid"])
     def test_videotestsrc_device_matches_host(self, pattern):
