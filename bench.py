@@ -264,6 +264,12 @@ def _run() -> None:
         # frames-per-tensor batching: the converter batches on HOST, so
         # a pre-staged frame would be read straight back (D2H per frame
         # — worse than the unstaged path it replaces)
+        # the sink must flush SEVERAL windows or the steady-state
+        # definition has no steady region (first burst excluded): with
+        # fpt-batching the sink renders n_frames/fpt times, so clamp
+        # the window to a quarter of that (the CPU-scale mb cells were
+        # structurally null — one flush at EOS, zero steady frames)
+        window = max(1, min(window, n_frames // fpt // 4))
         stage = (
             "" if device_src
             else "tensor_stage queue-size=128 ! "

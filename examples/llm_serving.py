@@ -31,14 +31,15 @@ rng = np.random.default_rng(0)
 
 print("submit A (prompt 20 tokens, want 12)")
 ra = cb.submit(rng.integers(1, 1024, (20,)), 12)
+steps = 0
 for _ in range(4):
     cb.step()
+    steps += 1
 print("submit B mid-flight (prompt 7 tokens, want 8)")
 rb = cb.submit(rng.integers(1, 1024, (7,)), 8)
 print("submit C (prompt 30 tokens, want 5)")
 rc = cb.submit(rng.integers(1, 1024, (30,)), 5)
 
-steps = 0
 while any(cb.result(r) is None for r in (ra, rb, rc)):
     emitted = cb.step()
     steps += 1
@@ -47,6 +48,31 @@ while any(cb.result(r) is None for r in (ra, rb, rc)):
 for name, rid in (("A", ra), ("B", rb), ("C", rc)):
     print(f"{name}: {cb.result(rid)}")
 print(f"free slots at end: {cb.n_free}/4")
+
+# ---- the pumped form: same streams, a fraction of the host traffic ----
+# step() pays one dispatch + one [B] readback PER TOKEN; step_pump(n)
+# scans n steps in one program with ONE [B, n] readback, and
+# spec_pump(rounds, k) runs whole speculative rounds on device with
+# proposals mined there (device_ngram_propose). On a remote-attached
+# TPU each saved readback is a full round trip.
+cb2 = ContinuousBatcher(params, n_heads=8, n_slots=4, max_len=128,
+                        prompt_len=32)
+rng = np.random.default_rng(0)
+r2a = cb2.submit(rng.integers(1, 1024, (20,)), 12)
+cb2.step_pump(4)
+r2b = cb2.submit(rng.integers(1, 1024, (7,)), 8)
+r2c = cb2.submit(rng.integers(1, 1024, (30,)), 5)
+pumps = 0
+while any(cb2.result(r) is None for r in (r2a, r2b, r2c)):
+    out = cb2.step_pump(8)   # or cb2.spec_pump(rounds=2, k=4)
+    pumps += 1
+    total = sum(len(v) for v in out.values())
+    print(f"  pump {pumps}: {total} tokens in one readback")
+assert cb2.result(r2a) == cb.result(ra)  # pumped == per-token streams
+assert cb2.result(r2b) == cb.result(rb)
+assert cb2.result(r2c) == cb.result(rc)
+print(f"pumped streams identical; host reads: {steps} per-token vs "
+      f"{pumps + 1} pumped")
 
 print("\n-- prefix caching: shared system prompt, prefilled once --")
 system = rng.integers(1, 1024, (24,))

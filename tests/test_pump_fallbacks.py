@@ -1,0 +1,86 @@
+"""spec_pump fallback-path contract tests.
+
+spec_pump promises {rid: ALL tokens emitted this pump} even on the
+paths that route through host spec_step rounds (windowed draft
+batchers; no-verify-room tails) — spec_step itself reports only the
+last token per request, so the fallback reconstructs the full emission
+from req.tokens growth (serving._spec_fallback_rounds).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+N_HEADS = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(
+        jax.random.PRNGKey(7), vocab=257, d_model=64, n_heads=N_HEADS,
+        n_layers=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return tfm.init_params(
+        jax.random.PRNGKey(11), vocab=257, d_model=32, n_heads=N_HEADS,
+        n_layers=1,
+    )
+
+
+def _prompt(n, seed):
+    return np.random.default_rng(seed).integers(1, 257, (n,)).astype(np.int32)
+
+
+def test_windowed_draft_fallback_returns_full_emission(
+    params, draft_params
+):
+    """A windowed DRAFT batcher routes spec_pump through per-round host
+    spec_steps (ring verify-then-commit needs each round's acceptance);
+    the return must still carry EVERY token those rounds emitted, and
+    the stream must equal the per-token reference."""
+    kw = dict(
+        windowed=True, max_len=32, prompt_len=16,
+        draft_params=draft_params, draft_n_heads=N_HEADS,
+    )
+    a = ContinuousBatcher(params, N_HEADS, n_slots=2, **kw)
+    b = ContinuousBatcher(params, N_HEADS, n_slots=2, **kw)
+    p = _prompt(10, 3)
+    ra = a.submit(p, 9)
+    rb = b.submit(p, 9)
+    while a.result(ra) is None:
+        a.step()
+    collected = []
+    while b.result(rb) is None:
+        out = b.spec_pump(rounds=3, k=3)
+        collected.extend(out.get(rb, []))
+    # all pump-emitted tokens reported, in order, matching the stream
+    # (token 0 is the prefill's, emitted at submit, not by a pump)
+    assert collected == b.result(rb)[1:]
+    assert a.result(ra) == b.result(rb)
+
+
+def test_no_room_tail_fallback_returns_full_emission(params):
+    """A non-windowed batcher whose cache is too full for any k≥2
+    verify chunk falls back to the shrinking-k host round; the return
+    contract (all emitted tokens) must hold there too."""
+    a = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=16,
+                          prompt_len=16)
+    b = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=16,
+                          prompt_len=16)
+    p = _prompt(12, 5)
+    ra = a.submit(p, 4)  # 12 + 4 = max_len: rounds at k=4 never fit
+    rb = b.submit(p, 4)
+    while a.result(ra) is None:
+        a.step()
+    collected = []
+    while b.result(rb) is None:
+        out = b.spec_pump(rounds=4, k=4)
+        collected.extend(out.get(rb, []))
+    assert collected == b.result(rb)[1:]
+    assert a.result(ra) == b.result(rb)
