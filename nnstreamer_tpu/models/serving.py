@@ -43,7 +43,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -760,7 +760,7 @@ class _PendingInsert:
     slot: int
     ks: jax.Array
     vs: jax.Array
-    first_tok: int
+    first_tok: Any  # device int32 scalar (fetched at apply)
     fill: int  # cache fill level (= absolute position count)
     req: _Request
     draft_kv: Optional[Tuple[jax.Array, jax.Array]] = None
@@ -1685,14 +1685,16 @@ class ContinuousBatcher:
                 ks = stage[0][:, :, : self.max_len]
                 vs = stage[1][:, :, : self.max_len]
             fill = plen + t
-            first = int(
-                self._sample1(
-                    logits_row,
-                    jnp.asarray([temperature], jnp.float32),
-                    jnp.asarray([top_k], jnp.int32),
-                    jnp.asarray([top_p], jnp.float32),
-                    jax.random.fold_in(jnp.asarray(req.key), fill),
-                )
+            # the first token stays a DEVICE scalar: materializing it
+            # here would cost one device→host read per admission on the
+            # submit path; _apply_pending_locked fetches every queued
+            # admission's first token in ONE packed transfer instead
+            first_dev = self._sample1(
+                logits_row,
+                jnp.asarray([temperature], jnp.float32),
+                jnp.asarray([top_k], jnp.int32),
+                jnp.asarray([top_p], jnp.float32),
+                jax.random.fold_in(jnp.asarray(req.key), fill),
             )
             # draft-prefill the full context (req.prompt already carries
             # prefix + prompt) OUTSIDE the state lock, like the target's
@@ -1713,33 +1715,62 @@ class ContinuousBatcher:
         # self._hist at admission with a single static-shape write.
         # Streams longer than the history (windowed overrun) keep their
         # head; mining quality degrades there, never correctness.
+        if max_new_tokens == 1:
+            # a one-token request finishes ON its prefill token: fetch
+            # it now so the slot frees immediately (the deferred path
+            # would hold the slot until the next pump for no benefit —
+            # there is nothing to decode, and no hist row to stage)
+            first = int(first_dev)
+            with self._lock:
+                req.fill0 = fill
+                req.tokens.append(first)
+                self._finish(slot)
+            return rid
         H = self.max_len
         hist_row = np.full((H,), -1, np.int32)
         ctx = req.prompt
         if fill < H:
             hist_row[:fill] = ctx[:fill]
-            hist_row[fill] = first
         else:
             hist_row[:] = ctx[:H]
         with self._lock:
             req.fill0 = fill
-            req.tokens.append(first)
-            if req.finished():
-                self._finish(slot)
-            else:
-                self._pending.append(
-                    _PendingInsert(slot, ks, vs, first, fill, req,
-                                   draft_kv=draft_kv, hist_row=hist_row)
-                )
+            # token 0 (and any finished-at-first-token bookkeeping, e.g.
+            # a stop token landing on it) materializes at the next
+            # _apply_pending_locked, where every queued admission's
+            # first token rides one packed read — submit() itself never
+            # blocks on the device
+            self._pending.append(
+                _PendingInsert(slot, ks, vs, first_dev, fill, req,
+                               draft_kv=draft_kv, hist_row=hist_row)
+            )
         return rid
 
     def _apply_pending_locked(self) -> None:
-        """Splice queued admissions into the device state (_lock held)."""
-        for p in self._pending:
+        """Splice queued admissions into the device state (_lock held).
+
+        Every queued admission's first token (a device scalar from
+        submit's prefill sampler) is fetched in ONE packed transfer —
+        the admission-path analogue of the pumps' one-readback rule."""
+        if not self._pending:
+            return
+        firsts = np.asarray(jnp.stack(
+            [jnp.asarray(p.first_tok).reshape(()) for p in self._pending]
+        )).reshape(-1)
+        for p, first in zip(self._pending, firsts):
             if self._slots[p.slot] is not p.req:
                 continue  # request vanished (defensive; cannot happen)
+            first = int(first)
+            p.req.tokens.append(first)
+            if p.req.finished():
+                # budget 1 or an immediate stop token: the request ends
+                # on its prefill token and never occupies the batch
+                self._finish(p.slot)
+                continue
+            if p.hist_row is not None and p.fill < p.hist_row.shape[0]:
+                p.hist_row[p.fill] = first
             self._cache = self._insert(self._cache, p.ks, p.vs, p.slot)
-            self._tok = self._pin(self._tok.at[p.slot].set(p.first_tok))
+            self._tok = self._pin(self._tok.at[p.slot].set(first))
             self._pos = self._pin(self._pos.at[p.slot].set(p.fill))
             self._temp = self._pin(
                 self._temp.at[p.slot].set(p.req.temperature)
@@ -1962,9 +1993,11 @@ class ContinuousBatcher:
         with self._lock:
             for req in self._slots:
                 if req is not None:
-                    before[req.rid] = len(req.tokens)
-        # requests admitted mid-fallback start at 1: token 0 is the
-        # prefill's, emitted at submit, not by these rounds
+                    # floor 1: token 0 (the prefill's) is appended by
+                    # _apply_pending_locked — possibly DURING these
+                    # rounds for a deferred admission — and is never
+                    # pump output on the device paths either
+                    before[req.rid] = max(1, len(req.tokens))
         default_start = 1
         out: Dict[int, List[int]] = {}
         for _ in range(int(rounds)):

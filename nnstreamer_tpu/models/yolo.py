@@ -1,0 +1,149 @@
+"""YOLOv5-style single-stage detector — the ``yolov5`` decoder's native
+zoo model.
+
+The reference ships a yolov5 bounding-box decoder mode
+(ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c:143-159 mode
+table; tests/test_models/models/yolov5s-int8.tflite fixtures) whose
+input is the flattened [N, 5+C] prediction tensor a YOLOv5 head emits.
+This is a from-scratch jnp implementation of that model family shaped
+for the TPU, producing exactly the tensor the decoder (and
+ops/detection.yolov5_postprocess) consumes — so the zoo has a native
+model for every bounding-box decoder mode it claims.
+
+Architecture (CSP-flavored, compact): a strided conv stem, then three
+stages of stride-2 conv + a residual bottleneck pair at strides 8/16/32,
+and a per-level 1×1 detection head with A=3 anchors per cell. Decode is
+the YOLOv5 v4+ formula, in-graph:
+
+    xy = (2σ(t_xy) − 0.5 + grid) · stride / size     (normalized [0,1])
+    wh = (2σ(t_wh))² · anchor / size
+    obj, cls = σ(t)
+
+All levels concatenate to one [B, Σ(HᵢWᵢA), 5+C] tensor — fixed shape,
+fully fused by XLA (grids and anchors are constants baked into the
+program; no per-level host loop). The decoder's ``yolov5`` mode then
+thresholds + NMSes it, on device in the fused pipeline form.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models import mobilenet_v2, nn
+
+STRIDES = (8, 16, 32)
+# anchor (w, h) pixel pairs per level — the familiar v5 P3/P4/P5 priors
+ANCHORS = (
+    ((10, 13), (16, 30), (33, 23)),
+    ((30, 61), (62, 45), (59, 119)),
+    ((116, 90), (156, 198), (373, 326)),
+)
+A = 3  # anchors per cell
+
+
+def _conv_bn(key, cin, cout, k=3):
+    return {"w": nn.init_conv(key, k, k, cin, cout), "bn": nn.init_bn(cout)}
+
+
+def _apply_conv_bn(x, p, stride=1):
+    return nn.relu6(
+        nn.batch_norm(nn.conv2d(x, p["w"], stride=stride), p["bn"])
+    )
+
+
+def _bottleneck(key, c):
+    k1, k2 = jax.random.split(key)
+    return {"c1": _conv_bn(k1, c, c // 2, k=1), "c2": _conv_bn(k2, c // 2, c)}
+
+
+def _apply_bottleneck(x, p):
+    return x + _apply_conv_bn(_apply_conv_bn(x, p["c1"]), p["c2"])
+
+
+def init_params(key, num_classes: int = 80, width: int = 32) -> Dict:
+    """width = channels at stride 4; doubles per stage (stride-32 stage
+    at 8×width keeps every matmul MXU-aligned for width ≥ 16)."""
+    keys = jax.random.split(key, 12)
+    c1, c2, c3, c4 = width, width * 2, width * 4, width * 8
+    out_ch = A * (5 + num_classes)
+    return {
+        "stem": _conv_bn(keys[0], 3, c1),          # stride 4 (two s2 convs
+        "stem2": _conv_bn(keys[1], c1, c1),        # folded: s2 then s2)
+        "s8": _conv_bn(keys[2], c1, c2),
+        "b8": _bottleneck(keys[3], c2),
+        "s16": _conv_bn(keys[4], c2, c3),
+        "b16": _bottleneck(keys[5], c3),
+        "s32": _conv_bn(keys[6], c3, c4),
+        "b32": _bottleneck(keys[7], c4),
+        "head8": {"w": nn.init_conv(keys[8], 1, 1, c2, out_ch),
+                  "b": jnp.zeros((out_ch,), jnp.float32)},
+        "head16": {"w": nn.init_conv(keys[9], 1, 1, c3, out_ch),
+                   "b": jnp.zeros((out_ch,), jnp.float32)},
+        "head32": {"w": nn.init_conv(keys[10], 1, 1, c4, out_ch),
+                   "b": jnp.zeros((out_ch,), jnp.float32)},
+    }
+
+
+def n_rows(size: int) -> int:
+    """Total prediction rows for a square ``size`` input."""
+    return sum((size // s) ** 2 * A for s in STRIDES)
+
+
+def apply(params: Dict, x, num_classes: int = 80,
+          compute_dtype=jnp.float32):
+    """[B, S, S, 3] uint8/float → [B, n_rows(S), 5+C] decoded
+    predictions (normalized coords, sigmoided scores) — the decoder's
+    ``yolov5`` scaled-input layout. ``num_classes`` must agree with the
+    head params (guards a mismatched params overlay)."""
+    out_ch = params["head8"]["b"].shape[0]
+    if out_ch != A * (5 + num_classes):
+        raise ValueError(
+            f"params head emits {out_ch} channels, expected "
+            f"{A * (5 + num_classes)} for num_classes={num_classes}"
+        )
+    if x.dtype == jnp.uint8:
+        x = mobilenet_v2.normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    size = x.shape[1]
+    y = _apply_conv_bn(x, params["stem"], stride=2)
+    y = _apply_conv_bn(y, params["stem2"], stride=2)      # stride 4
+    feats = []
+    y = _apply_bottleneck(_apply_conv_bn(y, params["s8"], stride=2),
+                          params["b8"])
+    feats.append(y)                                       # stride 8
+    y = _apply_bottleneck(_apply_conv_bn(y, params["s16"], stride=2),
+                          params["b16"])
+    feats.append(y)                                       # stride 16
+    y = _apply_bottleneck(_apply_conv_bn(y, params["s32"], stride=2),
+                          params["b32"])
+    feats.append(y)                                       # stride 32
+
+    rows: List[jax.Array] = []
+    for feat, head_name, stride, anchors in zip(
+        feats, ("head8", "head16", "head32"), STRIDES, ANCHORS
+    ):
+        h = params[head_name]
+        t = nn.conv2d(feat, h["w"]) + h["b"]
+        b, gh, gw, _ = t.shape
+        t = t.reshape(b, gh, gw, A, -1).astype(jnp.float32)
+        s = jax.nn.sigmoid(t)
+        # grid constants fold into the compiled program
+        gy, gx = jnp.meshgrid(
+            jnp.arange(gh, dtype=jnp.float32),
+            jnp.arange(gw, dtype=jnp.float32),
+            indexing="ij",
+        )
+        grid = jnp.stack([gx, gy], axis=-1)[:, :, None, :]  # [gh,gw,1,2]
+        anc = jnp.asarray(np.asarray(anchors, np.float32))  # [A,2] px
+        xy = (2.0 * s[..., 0:2] - 0.5 + grid) * (stride / size)
+        wh = jnp.square(2.0 * s[..., 2:4]) * (anc / size)
+        row = jnp.concatenate([xy, wh, s[..., 4:]], axis=-1)
+        rows.append(row.reshape(b, gh * gw * A, -1))
+    return jnp.concatenate(rows, axis=1)

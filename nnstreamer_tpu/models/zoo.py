@@ -191,6 +191,43 @@ def _ssd_mobilenet_v2_pp(**options) -> ZooModel:
     return ZooModel("ssd_mobilenet_v2_pp", fn, spec, params, apply_fn)
 
 
+@model_factory("yolov5")
+def _yolov5(**options) -> ZooModel:
+    """YOLOv5-style detector (models/yolo.py): [B,S,S,3] → decoded
+    [B, rows, 5+C] predictions for decoder mode=yolov5 — the native
+    model behind the reference's yolov5 decoder fixtures
+    (tensordec-boundingbox.c yolov5 mode; yolov5s tflite fixtures).
+    Options: size (default 320), num_classes (80), width (32), batch,
+    seed, compute_dtype."""
+    from nnstreamer_tpu.models import yolo
+
+    seed = int(options.get("seed", 0))
+    batch = int(options.get("batch", 1))
+    size = int(options.get("size", 320))
+    num_classes = int(options.get("num_classes", 80))
+    width = int(options.get("width", 32))
+    dtype = _compute_dtype(options)
+    if size % 32:
+        raise ValueError(f"yolov5 size must be a multiple of 32, got {size}")
+    params = _load_params_overlay(
+        yolo.init_params(
+            jax.random.PRNGKey(seed), num_classes=num_classes, width=width
+        ),
+        options,
+    )
+
+    def apply_fn(p, image):
+        return yolo.apply(
+            p, image, num_classes=num_classes, compute_dtype=dtype
+        )
+
+    def fn(image):
+        return apply_fn(params, image)
+
+    spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
+    return ZooModel("yolov5", fn, spec, params, apply_fn)
+
+
 @model_factory("posenet")
 def _posenet(**options) -> ZooModel:
     """PoseNet MobileNet-v1 257x257 multi-output (heatmap/offsets/

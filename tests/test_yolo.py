@@ -1,0 +1,87 @@
+"""YOLOv5-family zoo model tests (models/yolo.py).
+
+The decoder's ``yolov5`` mode existed without a native zoo model; these
+close the loop: the model's decoded prediction tensor feeds the
+bounding-box decoder (and ops/detection.yolov5_postprocess) end to end
+through the pipeline, fused.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import yolo, zoo
+
+
+def test_prediction_layout_and_ranges():
+    """[B, n_rows, 5+C]; coords/size normalized, scores sigmoided."""
+    m = zoo.get("yolov5", size="160", num_classes="7", width="16")
+    x = np.random.default_rng(0).integers(0, 255, (1, 160, 160, 3),
+                                          np.uint8)
+    out = np.asarray(jax.jit(m.fn)(jnp.asarray(x)))
+    assert out.shape == (1, yolo.n_rows(160), 12)
+    # xy in (-0.5, 1.5)·stride-ish but normalized around [0,1]; scores
+    # strictly in (0,1) from the sigmoid
+    assert np.all(out[..., 4:] > 0) and np.all(out[..., 4:] < 1)
+    assert np.all(out[..., 2:4] > 0)  # wh strictly positive
+    assert np.isfinite(out).all()
+
+
+def test_rows_cover_every_level():
+    assert yolo.n_rows(320) == (40 * 40 + 20 * 20 + 10 * 10) * 3
+
+
+def test_postprocess_consumes_model_output():
+    """ops/detection.yolov5_postprocess accepts the model's rows and
+    packs [max_out, 6] detections."""
+    from nnstreamer_tpu.ops import detection as det
+
+    m = zoo.get("yolov5", size="160", num_classes="7", width="16")
+    x = np.zeros((1, 160, 160, 3), np.uint8)
+    pred = jax.jit(m.fn)(jnp.asarray(x))[0]
+    packed = np.asarray(
+        det.yolov5_postprocess(pred, conf_threshold=0.0, max_out=8)
+    )
+    assert packed.shape == (8, 6)
+    assert np.isfinite(packed).all()
+
+
+def test_pipeline_decoder_yolov5_end_to_end():
+    """videotestsrc → converter → filter zoo:yolov5 → decoder
+    mode=yolov5 → sink: the whole detect+decode graph through the
+    pipeline surface (fused where traceable)."""
+    from nnstreamer_tpu.pipeline.parse import parse_pipeline
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    desc = (
+        "videotestsrc pattern=gradient num-frames=2 width=160 "
+        "height=160 ! tensor_converter ! "
+        "tensor_filter framework=jax model=zoo:yolov5 "
+        'custom="size:160,num_classes:7,width:16" ! '
+        "tensor_decoder mode=bounding_boxes option1=yolov5 "
+        "option4=160:160 option5=160:160 ! tensor_sink"
+    )
+    ex = parse_pipeline(desc).run(timeout=300)
+    sink = next(
+        n.elem for n in ex.nodes if isinstance(getattr(n, "elem", None),
+                                               TensorSink)
+    )
+    assert sink.rendered == 2
+    # bounding-box decoder emits an RGBA overlay of the input size
+    img = np.asarray(sink.frames[0].tensors[0])
+    assert img.shape[-1] == 4 and img.shape[-3:-1] == (160, 160)
+
+
+def test_bf16_matches_f32_topology():
+    """bfloat16 compute runs the same topology (shape/finite parity —
+    value tolerance is loose, it is a different precision)."""
+    kw = dict(size="96", num_classes="3", width="16")
+    a = zoo.get("yolov5", **kw)
+    b = zoo.get("yolov5", compute_dtype="bfloat16", **kw)
+    x = jnp.zeros((1, 96, 96, 3), jnp.uint8)
+    oa = np.asarray(jax.jit(a.fn)(x))
+    ob = np.asarray(jax.jit(b.fn)(x))
+    assert oa.shape == ob.shape
+    assert np.isfinite(ob).all()
+    np.testing.assert_allclose(oa, ob, atol=0.15)
