@@ -306,8 +306,8 @@ def _run() -> None:
             print(f"[bench] pipeline variant failed: {exc!r}", file=sys.stderr)
             return None
 
-    n_pipe = 2048 if on_tpu else 40
-    pipe_window = 256 if on_tpu else 8
+    n_pipe = 4096 if on_tpu else 40
+    pipe_window = 512 if on_tpu else 8
     pipeline_fps = _pipeline_fps_safe(True, 1, n_pipe, pipe_window)
     _mark("pipeline measured")
 

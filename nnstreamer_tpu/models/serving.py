@@ -1687,7 +1687,7 @@ class ContinuousBatcher:
             fill = plen + t
             # the first token stays a DEVICE scalar: materializing it
             # here would cost one device→host read per admission on the
-            # submit path; _apply_pending_locked fetches every queued
+            # submit path; _apply_pending fetches every queued
             # admission's first token in ONE packed transfer instead
             first_dev = self._sample1(
                 logits_row,
@@ -1696,6 +1696,16 @@ class ContinuousBatcher:
                 jnp.asarray([top_p], jnp.float32),
                 jax.random.fold_in(jnp.asarray(req.key), fill),
             )
+            if max_new_tokens == 1:
+                # a one-token request finishes ON its prefill token:
+                # fetch it now so the slot frees immediately (nothing
+                # to decode — no hist row, no draft prefill either)
+                first = int(first_dev)
+                with self._lock:
+                    req.fill0 = fill
+                    req.tokens.append(first)
+                    self._finish(slot)
+                return rid
             # draft-prefill the full context (req.prompt already carries
             # prefix + prompt) OUTSIDE the state lock, like the target's
             # prefill — admission must never serialize device steps
@@ -1715,17 +1725,6 @@ class ContinuousBatcher:
         # self._hist at admission with a single static-shape write.
         # Streams longer than the history (windowed overrun) keep their
         # head; mining quality degrades there, never correctness.
-        if max_new_tokens == 1:
-            # a one-token request finishes ON its prefill token: fetch
-            # it now so the slot frees immediately (the deferred path
-            # would hold the slot until the next pump for no benefit —
-            # there is nothing to decode, and no hist row to stage)
-            first = int(first_dev)
-            with self._lock:
-                req.fill0 = fill
-                req.tokens.append(first)
-                self._finish(slot)
-            return rid
         H = self.max_len
         hist_row = np.full((H,), -1, np.int32)
         ctx = req.prompt
@@ -1737,7 +1736,7 @@ class ContinuousBatcher:
             req.fill0 = fill
             # token 0 (and any finished-at-first-token bookkeeping, e.g.
             # a stop token landing on it) materializes at the next
-            # _apply_pending_locked, where every queued admission's
+            # _apply_pending, where every queued admission's
             # first token rides one packed read — submit() itself never
             # blocks on the device
             self._pending.append(
@@ -1746,18 +1745,29 @@ class ContinuousBatcher:
             )
         return rid
 
-    def _apply_pending_locked(self) -> None:
-        """Splice queued admissions into the device state (_lock held).
+    def _apply_pending(self) -> None:
+        """Splice queued admissions into the device state.
 
-        Every queued admission's first token (a device scalar from
-        submit's prefill sampler) is fetched in ONE packed transfer —
-        the admission-path analogue of the pumps' one-readback rule."""
-        if not self._pending:
+        Caller holds _step_lock ONLY. Every queued admission's first
+        token (a device scalar from submit's prefill sampler) is
+        fetched in ONE packed transfer — the admission-path analogue
+        of the pumps' one-readback rule — and that fetch happens
+        OUTSIDE self._lock: it may wait on an in-flight chunked
+        prefill, and readers (submit/result/partials/stats) must not
+        stall behind it."""
+        with self._lock:
+            batch = self._pending
+            self._pending = []
+        if not batch:
             return
         firsts = np.asarray(jnp.stack(
-            [jnp.asarray(p.first_tok).reshape(()) for p in self._pending]
+            [jnp.asarray(p.first_tok).reshape(()) for p in batch]
         )).reshape(-1)
-        for p, first in zip(self._pending, firsts):
+        with self._lock:
+            self._apply_batch_locked(batch, firsts)
+
+    def _apply_batch_locked(self, batch, firsts) -> None:
+        for p, first in zip(batch, firsts):
             if self._slots[p.slot] is not p.req:
                 continue  # request vanished (defensive; cannot happen)
             first = int(first)
@@ -1787,7 +1797,6 @@ class ContinuousBatcher:
                     self._hist.at[p.slot].set(jnp.asarray(p.hist_row))
                 )
             self._active[p.slot] = True
-        self._pending.clear()
 
     def step(self) -> Dict[int, int]:
         """Advance every active slot one token; returns {rid: token}.
@@ -1866,8 +1875,8 @@ class ContinuousBatcher:
 
         t0 = _time.perf_counter()
         with self._step_lock:
+            self._apply_pending()
             with self._lock:
-                self._apply_pending_locked()
                 if not self._active.any():
                     return {}
                 active_np = self._active.copy()
@@ -1932,8 +1941,8 @@ class ContinuousBatcher:
         if self._draft is not None and self.windowed:
             return self._spec_fallback_rounds(int(rounds), k, ngram)
         with self._step_lock:
+            self._apply_pending()
             with self._lock:
-                self._apply_pending_locked()
                 if not self._active.any():
                     return {}
                 active_np = self._active.copy()
@@ -1994,7 +2003,7 @@ class ContinuousBatcher:
             for req in self._slots:
                 if req is not None:
                     # floor 1: token 0 (the prefill's) is appended by
-                    # _apply_pending_locked — possibly DURING these
+                    # _apply_pending — possibly DURING these
                     # rounds for a deferred admission — and is never
                     # pump output on the device paths either
                     before[req.rid] = max(1, len(req.tokens))
@@ -2045,8 +2054,8 @@ class ContinuousBatcher:
         """step() body; caller holds _step_lock."""
         import time as _time
 
+        self._apply_pending()
         with self._lock:
-            self._apply_pending_locked()
             if not self._active.any():
                 return {}
             active_np = self._active.copy()
@@ -2125,8 +2134,8 @@ class ContinuousBatcher:
 
         t0 = _time.perf_counter()
         with self._step_lock:
+            self._apply_pending()
             with self._lock:
-                self._apply_pending_locked()
                 if not self._active.any():
                     return {}
                 active_np = self._active.copy()
@@ -2202,7 +2211,7 @@ class ContinuousBatcher:
                 # draft cache and per-slot device vectors are only
                 # touched under _step_lock (held here) — submits may
                 # queue pending inserts concurrently, but those join at
-                # the next round's _apply_pending_locked.
+                # the next round's _apply_pending.
                 toks_host[:, 1:] = self._draft.propose(
                     self._tok, self._pos, jnp.asarray(active_np), k_round
                 )

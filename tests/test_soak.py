@@ -79,3 +79,73 @@ def test_pipeline_soak_bounded_rss_and_exact_accounting():
     assert rss_growth < 64 * 1024 * 1024, (
         f"RSS grew {rss_growth / 1e6:.1f} MB over the soak"
     )
+
+@pytest.mark.soak
+def test_serving_pump_soak_bounded_rss():
+    """Serving soak on the PUMP hot path: a continuous stream of
+    requests drained via step_pump/spec_pump for NNS_SOAK_SECONDS,
+    asserting bounded RSS (leaks in the donated-buffer chains, hist
+    staging, or pending-insert queue would grow monotonically), live
+    progress every window, and exact request accounting (submitted =
+    finished + in-flight at stop)."""
+    import jax
+    import numpy as np
+
+    from nnstreamer_tpu.models import transformer as tfm
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    dur = float(os.environ.get("NNS_SOAK_SECONDS", "60"))
+    params = tfm.init_params(
+        jax.random.PRNGKey(0), vocab=211, d_model=32, n_heads=2,
+        n_layers=2,
+    )
+    cb = ContinuousBatcher(params, 2, n_slots=4, max_len=64,
+                           prompt_len=16)
+    rng = np.random.default_rng(0)
+    proc = psutil.Process()
+
+    submitted = finished = 0
+    live = {}
+    t_end = time.monotonic() + dur
+    warm_until = time.monotonic() + min(10.0, dur / 3)
+    rss0 = None
+    samples = []
+    last_sample = time.monotonic()
+    tokens_last = 0
+    spin = 0
+    while time.monotonic() < t_end:
+        while len(live) < 4:
+            rid = cb.submit(
+                rng.integers(1, 211, (int(rng.integers(3, 14)),)),
+                int(rng.integers(2, 10)),
+            )
+            if rid is None:
+                break
+            live[rid] = True
+            submitted += 1
+        spin += 1
+        if spin % 3:
+            cb.step_pump(4)
+        else:
+            cb.spec_pump(rounds=2, k=3, ngram=1)
+        for rid in [r for r in live if cb.result(r) is not None]:
+            del live[rid]
+            finished += 1
+        now = time.monotonic()
+        if rss0 is None and now >= warm_until:
+            rss0 = proc.memory_info().rss
+            tokens_last = cb.stats()["tokens_emitted"]
+        elif rss0 is not None and now - last_sample >= 5.0:
+            last_sample = now
+            samples.append(proc.memory_info().rss)
+            tok = cb.stats()["tokens_emitted"]
+            assert tok > tokens_last, "serving stalled"
+            tokens_last = tok
+
+    assert submitted == finished + len(live)
+    assert finished > 0 and cb.stats()["tokens_emitted"] > 0
+    if rss0 is not None and samples:
+        growth = max(samples) - rss0
+        assert growth < 64 * 1024 * 1024, (
+            f"RSS grew {growth / 1e6:.1f} MB over the serving soak"
+        )
