@@ -677,16 +677,18 @@ def insert_slot(cache, ks, vs, slot):
     return put(cache_k, ks), put(cache_v, vs)
 
 
-def hist_write_row(hist, row, start, count):
+def hist_write_row(hist, row, start, count, wrap: bool = False):
     """Scatter ``row`` [B, K] into the device token history ``hist``
     [B, H] at per-slot ``start`` [B], keeping only the first ``count``
-    [B] columns per slot. Writes past H-1 clamp onto the last cell
-    (only reachable on windowed rings whose stream outruns the
-    history; the n-gram mining quality degrades there, never
-    correctness — proposals are verified before commit either way)."""
+    [B] columns per slot. ``wrap=True`` treats hist as a RING over the
+    last H stream positions (token at absolute position a lives at
+    a % H) — the windowed batcher's layout, mirroring its KV ring;
+    without it, writes past H-1 clamp onto the last cell (unreachable
+    on linear batchers, whose submit validates fill+budget ≤ H)."""
     _, H = hist.shape
     K = row.shape[1]
-    idx = jnp.clip(start[:, None] + jnp.arange(K)[None, :], 0, H - 1)
+    raw = start[:, None] + jnp.arange(K)[None, :]
+    idx = raw % H if wrap else jnp.clip(raw, 0, H - 1)
     keep = jnp.arange(K)[None, :] < count[:, None]
 
     def one(h, r, ix, kp):
@@ -695,7 +697,7 @@ def hist_write_row(hist, row, start, count):
     return jax.vmap(one)(hist, row, idx, keep)
 
 
-def device_ngram_propose(hist, pos, k: int, g: int):
+def device_ngram_propose(hist, pos, k: int, g: int, wrap: bool = False):
     """Prompt-lookup proposals ON DEVICE — no host round trip.
 
     The host n-gram path (ngram_lookup over req.tokens) costs two
@@ -714,6 +716,14 @@ def device_ngram_propose(hist, pos, k: int, g: int):
     idx = jnp.arange(H)
 
     def one(h, p):
+        if wrap:
+            # unroll the ring into stream order: after a wrap the last
+            # H tokens live at (p-H+1..p) % H; ordering them makes the
+            # pending token the last element, so the same linear
+            # matcher applies (before a wrap the ring IS linear)
+            start = jnp.where(p >= H, (p + 1) % H, 0)
+            h = h[(idx + start) % H]
+            p = jnp.minimum(p, H - 1)
         ok = jnp.ones((H,), bool)
         for i in range(g):
             shifted = h[jnp.maximum(idx - i, 0)]
@@ -1126,7 +1136,8 @@ class ContinuousBatcher:
                     new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 new = jnp.where(active, new, tok)
                 hist = hist_write_row(
-                    hist, new[:, None], pos2, active.astype(jnp.int32)
+                    hist, new[:, None], pos2, active.astype(jnp.int32),
+                    wrap=windowed,
                 )
                 return new, cache, pos2, hist
 
@@ -1198,7 +1209,8 @@ class ContinuousBatcher:
                     new = jnp.where(active, new, tok)
                     emit = jnp.where(active, new, -1)
                     hist = hist_write_row(
-                        hist, new[:, None], pos2, active.astype(jnp.int32)
+                        hist, new[:, None], pos2, active.astype(jnp.int32),
+                        wrap=windowed,
                     )
                     budget = budget - active.astype(jnp.int32)
                     active = active & (budget > 0) & ~(
@@ -1305,7 +1317,7 @@ class ContinuousBatcher:
                 jnp.where(j == (m - 1)[:, None], final[:, None], -1),
             )
             emit = jnp.where(active[:, None], emit, -1)
-            hist = hist_write_row(hist, emit, pos_ + 1, m)
+            hist = hist_write_row(hist, emit, pos_ + 1, m, wrap=windowed)
             return m, final, cache, hist, pos_ + m, emit
 
         def spec_round_impl(spec_sampling):
@@ -1353,7 +1365,9 @@ class ContinuousBatcher:
                         props = jnp.stack(outs[: k - 1], axis=1)
                         dcache = dc
                     else:
-                        props = device_ngram_propose(hist, pos, k, g)
+                        props = device_ngram_propose(
+                            hist, pos, k, g, wrap=windowed
+                        )
                     props = jnp.where(active[:, None], props, -1)
                     toks = jnp.concatenate([tok[:, None], props], axis=1)
                     m, final, cache, hist, pos2, emit = spec_round_core(
@@ -1723,13 +1737,20 @@ class ContinuousBatcher:
         # device n-gram context seed: the full known stream (context +
         # first pending token) as one padded row — staged into
         # self._hist at admission with a single static-shape write.
-        # Streams longer than the history (windowed overrun) keep their
-        # head; mining quality degrades there, never correctness.
+        # Windowed overruns stage the LAST H tokens in ring layout
+        # (a % H, mirroring the KV ring) so post-wrap mining stays
+        # exact; the non-windowed else is unreachable (submit validates
+        # fill + budget ≤ max_len) and exists as a defensive fallback.
         H = self.max_len
         hist_row = np.full((H,), -1, np.int32)
         ctx = req.prompt
         if fill < H:
             hist_row[:fill] = ctx[:fill]
+        elif self.windowed:
+            # ring layout: token at absolute position a lives at a % H
+            # (mirrors the KV ring), so post-wrap mining stays exact
+            span = np.arange(fill - H, fill)
+            hist_row[span % H] = ctx[span]
         else:
             hist_row[:] = ctx[:H]
         with self._lock:
@@ -1777,8 +1798,12 @@ class ContinuousBatcher:
                 # on its prefill token and never occupies the batch
                 self._finish(p.slot)
                 continue
-            if p.hist_row is not None and p.fill < p.hist_row.shape[0]:
-                p.hist_row[p.fill] = first
+            if p.hist_row is not None:
+                Hh = p.hist_row.shape[0]
+                if p.fill < Hh:
+                    p.hist_row[p.fill] = first
+                elif self.windowed:
+                    p.hist_row[p.fill % Hh] = first
             self._cache = self._insert(self._cache, p.ks, p.vs, p.slot)
             self._tok = self._pin(self._tok.at[p.slot].set(first))
             self._pos = self._pin(self._pos.at[p.slot].set(p.fill))

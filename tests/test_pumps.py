@@ -301,3 +301,49 @@ def test_ngram_device_proposer_mines_recent_context(params):
     # slot 0: latest earlier "5 6" ends at j=4 → proposes hist[5], hist[6]
     assert props[0].tolist() == [9, 5]
     assert props[1].tolist() == [-1, -1]
+
+
+def test_spec_pump_windowed_ring_wrap_mines_exactly(params):
+    """A windowed stream that OUTRUNS the ring (prompt+budget > W):
+    hist mirrors the KV ring's a % H layout, so post-wrap device
+    n-gram mining stays exact — streams equal the per-token windowed
+    reference, and the repetitive workload still accepts proposals
+    after the wrap."""
+    kw = dict(windowed=True, max_len=16, prompt_len=16)
+    a = ContinuousBatcher(params, N_HEADS, n_slots=2, **kw)
+    b = ContinuousBatcher(params, N_HEADS, n_slots=2, **kw)
+    p = _rep_prompt(12, 77, period=3)
+    ra = a.submit(p, 24)  # 12 + 24 >> W=16: wraps mid-generation
+    rb = b.submit(p, 24)
+    _drain_steps(a, [ra])
+    _drain_spec_pump(b, [rb], 3, k=3, ngram=1)
+    assert a.result(ra) == b.result(rb)
+    st = b.stats()
+    assert st["spec_columns"] > 0
+
+
+def test_ngram_device_proposer_wrap_unrolls_ring():
+    """wrap=True: the miner unrolls the ring (token at absolute pos a
+    lives at a % H) into stream order before matching — pinned with a
+    hand-built wrapped history so a broken unroll cannot hide behind
+    verification (wrong proposals are rejected, not exposed)."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models.serving import device_ngram_propose
+
+    # stream (period 5): [1,2,3,5,6]*2 + [1]; abs positions 0..10,
+    # H=8 ⇒ ring cell a%8; pending token 1 at abs pos 10 (cell 2)
+    hist = jnp.asarray(np.array(
+        [[5, 6, 1, 5, 6, 1, 2, 3]], np.int32
+    ))
+    pos = jnp.asarray(np.array([10], np.int32))
+    props = np.asarray(
+        device_ngram_propose(hist, pos, k=3, g=2, wrap=True)
+    )
+    # last H tokens in order: [5,6,1,2,3,5,6,1]; suffix 2-gram (6,1)
+    # recurs ending at index 2 → proposals are the following [2, 3]
+    assert props[0].tolist() == [2, 3]
+    # without wrap the same ring bytes mine garbage — the unroll is
+    # what makes post-wrap mining exact
+    raw = np.asarray(device_ngram_propose(hist, pos, k=3, g=2))
+    assert raw[0].tolist() != [2, 3]
