@@ -1,0 +1,84 @@
+"""Raw-waveform audio classifier (keyword spotting) — the audio model
+family for the zoo.
+
+The reference streams audio through the same element chain as video
+(audiotestsrc → tensor_converter audio path,
+gst/nnstreamer/elements/gsttensor_converter.c media-type dispatch) and
+runs whatever model the filter loads; this gives the zoo a native audio
+model so that chain is exercised end to end with real inference, the
+way mobilenet_v2 does for video.
+
+Architecture: an M5-style deep conv net over the raw waveform (Dai et
+al., "Very Deep CNNs for Raw Waveforms" — public): a long-kernel
+strided stem (k=80, s=16 ≈ a learned filterbank) then three conv+pool
+stages and a global-average head. TPU-first shape choices: the 1-D
+convolutions run as NHWC 2-D convs with H=1 (MXU-friendly lowering),
+channels are multiples of 8, pooling is a reshape-mean (no windowed
+reduce), and int16 PCM normalizes to float inside the program so the
+pipeline feeds device-resident S16LE chunks straight in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import nn
+
+STEM_K = 80
+STEM_S = 16
+
+
+def _conv1d(x, w, stride: int = 1):
+    """[B, T, C] × [K, C, Cout] via a H=1 2-D conv."""
+    return nn.conv2d(
+        x[:, None, :, :], w[None, :, :, :], stride=stride
+    )[:, 0]
+
+
+def init_params(key, num_classes: int = 12, width: int = 32) -> Dict:
+    k = jax.random.split(key, 5)
+    c1, c2, c3 = width, width * 2, width * 4
+    return {
+        "stem": {"w": nn.init_conv(k[0], 1, STEM_K, 1, c1)[0],
+                 "bn": nn.init_bn(c1)},
+        "c2": {"w": nn.init_conv(k[1], 1, 3, c1, c2)[0],
+               "bn": nn.init_bn(c2)},
+        "c3": {"w": nn.init_conv(k[2], 1, 3, c2, c3)[0],
+               "bn": nn.init_bn(c3)},
+        "c4": {"w": nn.init_conv(k[3], 1, 3, c3, c3)[0],
+               "bn": nn.init_bn(c3)},
+        "head": nn.init_dense(k[4], c3, num_classes),
+    }
+
+
+def _block(x, p, stride=1, pool=4):
+    y = nn.relu6(nn.batch_norm(_conv1d(x, p["w"], stride), p["bn"]))
+    b, t, c = y.shape
+    if t < pool:  # short clips: the global head pools what remains
+        return y
+    t4 = (t // pool) * pool
+    return jnp.mean(y[:, :t4].reshape(b, t4 // pool, pool, c), axis=2)
+
+
+def apply(params: Dict, x, compute_dtype=jnp.float32):
+    """[B, T, C] (or the converter's unbatched [T, C]) int16 PCM or
+    float → [B, num_classes] f32 logits. Multi-channel input is
+    mono-mixed up front (mean over C)."""
+    if x.ndim == 2:
+        x = x[None]  # converter audio tensors are [samples, channels]
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        x = x.astype(compute_dtype) * (1.0 / 32768.0)
+    else:
+        x = x.astype(compute_dtype)
+    x = jnp.mean(x, axis=-1, keepdims=True)  # mono mix
+    if compute_dtype != jnp.float32:
+        params = nn.cast_params(params, compute_dtype)
+    y = _block(x, params["stem"], stride=STEM_S)
+    y = _block(y, params["c2"])
+    y = _block(y, params["c3"])
+    y = _block(y, params["c4"])
+    pooled = jnp.mean(y, axis=1)
+    return nn.dense(pooled, params["head"]).astype(jnp.float32)

@@ -228,6 +228,45 @@ def _yolov5(**options) -> ZooModel:
     return ZooModel("yolov5", fn, spec, params, apply_fn)
 
 
+@model_factory("kws")
+def _kws(**options) -> ZooModel:
+    """Keyword-spotting raw-waveform classifier (models/audio.py, an
+    M5-style conv net) — the zoo's audio model family, exercising the
+    converter's audio path (gsttensor_converter.c media dispatch) with
+    real inference. Input [samples, channels] S16LE (the converter's
+    audio tensor) or batched [B, samples, C]. Options: samples (1024),
+    channels (1), num_classes (12), width (32), batch, seed,
+    compute_dtype."""
+    from nnstreamer_tpu.models import audio
+
+    seed = int(options.get("seed", 0))
+    batch = int(options.get("batch", 1))
+    samples = int(options.get("samples", 1024))
+    channels = int(options.get("channels", 1))
+    num_classes = int(options.get("num_classes", 12))
+    width = int(options.get("width", 32))
+    dtype = _compute_dtype(options)
+    params = _load_params_overlay(
+        audio.init_params(
+            jax.random.PRNGKey(seed), num_classes=num_classes, width=width
+        ),
+        options,
+    )
+
+    def apply_fn(p, pcm):
+        return audio.apply(p, pcm, compute_dtype=dtype)
+
+    def fn(pcm):
+        return apply_fn(params, pcm)
+
+    shape = (
+        (samples, channels) if batch == 1
+        else (batch, samples, channels)
+    )
+    spec = TensorsSpec.of(TensorSpec(shape, DType.INT16, name="pcm"))
+    return ZooModel("kws", fn, spec, params, apply_fn)
+
+
 @model_factory("posenet")
 def _posenet(**options) -> ZooModel:
     """PoseNet MobileNet-v1 257x257 multi-output (heatmap/offsets/

@@ -319,7 +319,9 @@ def test_spec_pump_windowed_ring_wrap_mines_exactly(params):
     _drain_spec_pump(b, [rb], 3, k=3, ngram=1)
     assert a.result(ra) == b.result(rb)
     st = b.stats()
-    assert st["spec_columns"] > 0
+    # ACCEPTED > 0 pins the exact mining — garbage proposals from a
+    # broken unroll would be offered (columns > 0) yet all rejected
+    assert st["spec_accepted_tokens"] > 0
 
 
 def test_ngram_device_proposer_wrap_unrolls_ring():
