@@ -32,23 +32,21 @@ STEM_S = 16
 
 
 def _conv1d(x, w, stride: int = 1):
-    """[B, T, C] × [K, C, Cout] via a H=1 2-D conv."""
-    return nn.conv2d(
-        x[:, None, :, :], w[None, :, :, :], stride=stride
-    )[:, 0]
+    """[B, T, C] × [1, K, C, Cout] (standard HWIO, H=1) 2-D conv."""
+    return nn.conv2d(x[:, None, :, :], w, stride=stride)[:, 0]
 
 
 def init_params(key, num_classes: int = 12, width: int = 32) -> Dict:
     k = jax.random.split(key, 5)
     c1, c2, c3 = width, width * 2, width * 4
     return {
-        "stem": {"w": nn.init_conv(k[0], 1, STEM_K, 1, c1)[0],
+        "stem": {"w": nn.init_conv(k[0], 1, STEM_K, 1, c1),
                  "bn": nn.init_bn(c1)},
-        "c2": {"w": nn.init_conv(k[1], 1, 3, c1, c2)[0],
+        "c2": {"w": nn.init_conv(k[1], 1, 3, c1, c2),
                "bn": nn.init_bn(c2)},
-        "c3": {"w": nn.init_conv(k[2], 1, 3, c2, c3)[0],
+        "c3": {"w": nn.init_conv(k[2], 1, 3, c2, c3),
                "bn": nn.init_bn(c3)},
-        "c4": {"w": nn.init_conv(k[3], 1, 3, c3, c3)[0],
+        "c4": {"w": nn.init_conv(k[3], 1, 3, c3, c3),
                "bn": nn.init_bn(c3)},
         "head": nn.init_dense(k[4], c3, num_classes),
     }

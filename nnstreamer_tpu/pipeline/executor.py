@@ -439,6 +439,12 @@ class SinkNode(Node):
                         return None
                     if len({t.shape for t in ts}) != 1:
                         return None
+                    # a window spanning devices (per-stage placement
+                    # pipelines) must not be stacked — the eager stack
+                    # would silently migrate buffers; per-frame fetch
+                    # keeps placement untouched
+                    if len({d for t in ts for d in t.devices()}) > 1:
+                        return None
                     cols.append(np.asarray(jnp.stack(ts)))
                 return [
                     f.with_tensors(
