@@ -238,3 +238,113 @@ def ngram_speculative_generate(
         lambda cur, context: _ngram_propose(context, k - 1),
         caller="ngram_speculative_generate",
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_heads", "max_new_tokens", "k", "g",
+                     "compute_dtype"),
+)
+def ngram_generate_scanned(
+    target_params: Dict,
+    prompt,
+    n_heads: int,
+    max_new_tokens: int,
+    k: int = 4,
+    g: int = 2,
+    compute_dtype=jnp.float32,
+):
+    """The WHOLE n-gram speculative generation as ONE compiled program.
+
+    ngram_speculative_generate pays a host round trip per round (fetch
+    predictions, mine proposals in Python, ship the next chunk) — the
+    per-token poison the serving pumps eliminate, here for the
+    single-stream ``decode:ngram`` zoo mode. This version runs the
+    propose → verify → accept loop in a device while_loop: proposals
+    are mined on device from a token-history array
+    (serving.device_ngram_propose, B=1), the verify chunk is the same
+    target forward, acceptance is the same greedy prefix rule — the
+    emitted stream stays byte-identical to decode.generate — and only
+    the finished [1, max_new_tokens] token tensor ever crosses to the
+    host. Returns (tokens [1, n_new], accepted_proposals [] int32).
+    Role-match: tensor_filter's one-invoke-per-buffer contract
+    (tensor_filter.c) kept even for a speculative generation loop."""
+    from nnstreamer_tpu.models.serving import (
+        device_ngram_propose, spec_accept,
+    )
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError("ngram_generate_scanned serves one stream (B=1)")
+    if k < 2:
+        raise ValueError("k must be ≥ 2 (one proposal + one correction)")
+    n_new = max_new_tokens
+    max_len = t + n_new + k  # chunk-overshoot slack (shared invariant)
+    H = t + n_new + 1
+
+    logits, cache, pos = dec.prefill(
+        target_params, prompt, n_heads, max_len,
+        compute_dtype=compute_dtype,
+    )
+    cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # [1]
+    hist = jnp.full((1, H), -1, jnp.int32)
+    hist = jax.lax.dynamic_update_slice(hist, prompt, (0, 0))
+    hist = hist.at[0, t].set(cur[0])
+    out = jnp.zeros((n_new,), jnp.int32)
+
+    def cond(carry):
+        return carry[0] < n_new
+
+    def body(carry):
+        n_out, cur, pos, cache, hist, out, acc_total = carry
+        # the pending token is target-certified: emit it first (the
+        # host loop's `out.append(cur)` ordering)
+        out = out.at[jnp.minimum(n_out, n_new - 1)].set(cur[0])
+        n_out = n_out + 1
+        props = device_ngram_propose(
+            hist, jnp.full((1,), pos, jnp.int32), k, g
+        )  # [1, k-1]; pos = the pending token's absolute index
+        chunk = jnp.concatenate([cur[:, None], props], axis=1)  # [1,k]
+        vlogits, cache, _ = dec.verify_chunk(
+            target_params, chunk, pos, cache, n_heads,
+            compute_dtype=compute_dtype,
+        )
+        # the ONE acceptance rule (serving.spec_accept greedy branch):
+        # sentinel discipline and prefix semantics stay shared with the
+        # batcher path instead of a second hand-rolled copy
+        m, final = spec_accept(
+            vlogits, chunk, jnp.zeros((1,), jnp.float32),
+            jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.float32),
+            jnp.zeros((1, 2), jnp.uint32),
+            jnp.full((1,), pos, jnp.int32), False,
+        )
+        n_acc = m[0] - 1
+        # masked append of the accepted prefix: dead lanes route to an
+        # out-of-bounds index and DROP — clipping them instead would
+        # collide with the last live slot, and scatter order between
+        # duplicate indices is unspecified (a stale dup can win)
+        idx = n_out + jnp.arange(k - 1)
+        keep = (jnp.arange(k - 1) < n_acc) & (idx < n_new)
+        out = out.at[jnp.where(keep, idx, n_new)].set(
+            props[0], mode="drop"
+        )
+        # hist records the accepted prefix + the next pending token
+        hcols = pos + 1 + jnp.arange(k)
+        nxt = final[0]
+        hrow = jnp.concatenate([props[0], jnp.zeros((1,), jnp.int32)])
+        hrow = jnp.where(jnp.arange(k) == n_acc, nxt, hrow)
+        hkeep = (jnp.arange(k) <= n_acc) & (hcols < H)
+        hist = hist.at[0, jnp.where(hkeep, hcols, H)].set(
+            hrow, mode="drop"
+        )
+        cur = final
+        pos = pos + n_acc + 1
+        n_out = n_out + n_acc
+        return (n_out, cur, pos, cache, hist, out, acc_total + n_acc)
+
+    n0 = jnp.zeros((), jnp.int32)
+    (_, _, _, _, _, out, acc_total) = jax.lax.while_loop(
+        cond, body, (n0, cur, pos, cache, hist, out, n0)
+    )
+    return out[None, :], acc_total

@@ -436,16 +436,22 @@ def _transformer_lm(**options) -> ZooModel:
                 )
                 return toks
         elif strategy == "ngram":
+            # the WHOLE speculative generation is one compiled program
+            # (device while_loop: on-device n-gram mining + chunk
+            # verify; speculative.ngram_generate_scanned) — the
+            # host-looped ngram_speculative_generate pays a round trip
+            # per round, the per-token poison the serving pumps remove
             from nnstreamer_tpu.models.speculative import (
-                ngram_speculative_generate,
+                ngram_generate_scanned,
             )
 
             spec_k = int(options.get("spec_k", 4))
+            spec_g = int(options.get("spec_ngram", 2))
 
             def fn(tokens):
-                toks, _ = ngram_speculative_generate(
+                toks, _ = ngram_generate_scanned(
                     params, tokens, n_heads, gen_tokens, k=spec_k,
-                    compute_dtype=dtype,
+                    g=spec_g, compute_dtype=dtype,
                 )
                 return toks
         elif strategy == "greedy":
