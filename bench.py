@@ -1052,21 +1052,11 @@ def _record_measured(line: str) -> None:
 def _relay_up(timeout: float = 3.0) -> bool:
     """One cheap TCP probe of the relay pool (no jax import — a dead
     relay makes jax.devices() block forever in the axon client's
-    connect-retry loop)."""
-    import socket
+    connect-retry loop). Shared implementation:
+    platform_pin.probe_relay."""
+    from nnstreamer_tpu.platform_pin import probe_relay
 
-    hosts = [
-        h.strip()
-        for h in os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")
-        if h.strip()
-    ]
-    for host in hosts:
-        try:
-            socket.create_connection((host, 8082), timeout=timeout).close()
-            return True
-        except OSError:
-            pass
-    return False
+    return probe_relay(timeout=timeout)
 
 
 def _watch() -> None:

@@ -302,6 +302,17 @@ def ngram_generate_scanned(
         # host loop's `out.append(cur)` ordering)
         out = out.at[jnp.minimum(n_out, n_new - 1)].set(cur[0])
         n_out = n_out + 1
+        # budget already spent: skip the speculation entirely (the
+        # host loop breaks here too) — running it would pay one dead
+        # verify forward and inflate acc_total with acceptances that
+        # emit nothing
+        return jax.lax.cond(
+            n_out < n_new, _spec_round, lambda c: c,
+            (n_out, cur, pos, cache, hist, out, acc_total),
+        )
+
+    def _spec_round(carry):
+        n_out, cur, pos, cache, hist, out, acc_total = carry
         props = device_ngram_propose(
             hist, jnp.full((1,), pos, jnp.int32), k, g
         )  # [1, k-1]; pos = the pending token's absolute index

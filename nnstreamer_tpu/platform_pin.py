@@ -19,20 +19,32 @@ import os
 import sys
 
 
+def probe_relay(hosts=None, timeout: float = 2.0) -> bool:
+    """ONE shared TCP probe of the accelerator relay pool (no jax
+    import — a dead relay makes jax.devices() block forever in the
+    axon client's connect-retry loop). ``hosts`` defaults to
+    PALLAS_AXON_POOL_IPS, falling back to the local tunnel address.
+    Callers own the policy of what an unreachable relay means."""
+    import socket
+
+    if hosts is None:
+        ips = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        hosts = [h.strip() for h in ips.split(",") if h.strip()]
+    for host in hosts:
+        try:
+            socket.create_connection((host, 8082), timeout=timeout).close()
+            return True
+        except OSError:
+            pass
+    return False
+
+
 def _relay_reachable() -> bool:
     """True unless a remote-accelerator relay is configured AND down."""
     ips = os.environ.get("PALLAS_AXON_POOL_IPS", "")
     if not ips:
         return True  # topology unknown: don't second-guess
-    import socket
-
-    for host in (h.strip() for h in ips.split(",") if h.strip()):
-        try:
-            socket.create_connection((host, 8082), timeout=2).close()
-            return True
-        except OSError:
-            pass
-    return False
+    return probe_relay([h.strip() for h in ips.split(",") if h.strip()])
 
 
 def honor_jax_platforms_env() -> None:
