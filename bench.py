@@ -653,6 +653,35 @@ def _run() -> None:
         else _opt("lm-int8w", lambda: _lm_tok_s(quantize="int8w"))
     )
     _mark("lm-int8w measured")
+    # scanned n-gram speculation (decode:ngram): the WHOLE speculative
+    # generation as one compiled program (device while_loop, on-device
+    # mining — speculative.ngram_generate_scanned). A repetitive prompt
+    # is the miner's best case, so this cell bounds the machinery's
+    # speedup over the greedy scan above.
+    rep_toks = jnp.asarray(
+        np.tile(rng.integers(1, 32000, (8,)), 16)[None, :], jnp.int32
+    )
+
+    def _lm_ngram_tok_s():
+        mlm = zoo.get(
+            "transformer_lm", generate="64", decode="ngram",
+            spec_ngram="1", **lm_kw,
+        )
+        lm_fn = jax.jit(mlm.fn)
+        jax.block_until_ready(lm_fn(rep_toks))
+        iters_lm = 8 if on_tpu else 1
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters_lm):
+            out = lm_fn(rep_toks)
+        jax.block_until_ready(out)
+        return iters_lm * 64 / (time.perf_counter() - t0)
+
+    lm_ngram_tok_s = (
+        None if _over_budget()
+        else _opt("lm-ngram", _lm_ngram_tok_s)
+    )
+    _mark("lm-ngram measured")
     # continuous batching (models/serving.py): 4 slots decoding together —
     # one batched step program amortizes the per-token dispatch + weight
     # reads over every active stream
@@ -923,6 +952,7 @@ for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
                 "composite_fused_fps": _round(fused_fps),
                 "lm_decode_tok_s": _round(lm_tok_s),
                 "lm_decode_int8w_tok_s": _round(lm_int8w_tok_s),
+                "lm_decode_ngram_tok_s": _round(lm_ngram_tok_s),
                 "lm_cb4_tok_s": _round(lm_cb_tok_s),
                 "lm_cb4_spec_ngram_tok_s": _round(lm_cb_spec_ngram_tok_s),
                 "lm_cb4_spec_draft_tok_s": _round(lm_cb_spec_draft_tok_s),

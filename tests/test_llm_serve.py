@@ -418,3 +418,47 @@ def test_speculate_auto_converges_above_floor_and_surfaces_stats():
     assert st["spec_acceptance_ema"] > 0.5, st
     assert st["spec_acceptance_rate"] >= 0.9, st
     assert srv._spec_k > 2
+
+
+def test_speculate_auto_with_pump_matches_plain():
+    """speculate=auto + pump=N: adaptive-k speculation rides the
+    scanned spec_pump (rounds=⌈N/k⌉, one readback per pump) and the
+    stream still equals plain serving; the acceptance EMA keeps
+    adapting from the pump's packed telemetry."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    prompt = np.asarray([3, 4, 3, 4, 3, 4, 3], np.int32)
+
+    def run(srv_id, extra):
+        src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+        sink = LlmServerSink(
+            **{"id": srv_id, "model": "zoo:transformer_lm",
+               "custom": MODEL_OPTS, "n-slots": 1, "max-len": 64,
+               "prompt-len": 16, "max-new-tokens": 10, **extra}
+        )
+        out_src = LlmServerSrc(**{"id": srv_id})
+        out_sink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.chain(out_src, out_sink)
+        p.start()
+        try:
+            src.push(Frame((prompt,), meta={"req": "x"}))
+            src.end_of_stream()
+            f = out_sink.pop(timeout=180)
+            assert f is not None, "server emitted EOS before the reply"
+            srv = sink._server
+            return [int(t) for t in np.asarray(f.tensors[0])[0]], srv
+        finally:
+            p.stop()
+
+    plain, _ = run("autopA", {})
+    spec, srv = run("autopB", {"speculate": "auto", "pump": "8"})
+    assert spec == plain
+    assert 2 <= srv._spec_k <= 8
+    st = srv.stats()
+    assert st["spec_rounds"] > 0 and st["spec_columns"] > 0
