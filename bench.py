@@ -632,15 +632,16 @@ def _run() -> None:
     )
     toks = jnp.asarray(rng.integers(0, 32000, (1, 128), np.int64), jnp.int32)
 
-    def _lm_tok_s(**extra):
+    def _lm_tok_s(tokens=None, **extra):
+        inp = toks if tokens is None else tokens
         mlm = zoo.get("transformer_lm", generate="64", **lm_kw, **extra)
         lm_fn = jax.jit(mlm.fn)
-        jax.block_until_ready(lm_fn(toks))  # compile prefill + decode scan
+        jax.block_until_ready(lm_fn(inp))  # compile prefill + decode scan
         iters_lm = 8 if on_tpu else 1
         t0 = time.perf_counter()
         out = None
         for _ in range(iters_lm):
-            out = lm_fn(toks)
+            out = lm_fn(inp)
         jax.block_until_ready(out)
         return iters_lm * 64 / (time.perf_counter() - t0)
 
@@ -662,24 +663,14 @@ def _run() -> None:
         np.tile(rng.integers(1, 32000, (8,)), 16)[None, :], jnp.int32
     )
 
-    def _lm_ngram_tok_s():
-        mlm = zoo.get(
-            "transformer_lm", generate="64", decode="ngram",
-            spec_ngram="1", **lm_kw,
-        )
-        lm_fn = jax.jit(mlm.fn)
-        jax.block_until_ready(lm_fn(rep_toks))
-        iters_lm = 8 if on_tpu else 1
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(iters_lm):
-            out = lm_fn(rep_toks)
-        jax.block_until_ready(out)
-        return iters_lm * 64 / (time.perf_counter() - t0)
-
     lm_ngram_tok_s = (
         None if _over_budget()
-        else _opt("lm-ngram", _lm_ngram_tok_s)
+        else _opt(
+            "lm-ngram",
+            lambda: _lm_tok_s(
+                tokens=rep_toks, decode="ngram", spec_ngram="1"
+            ),
+        )
     )
     _mark("lm-ngram measured")
     # continuous batching (models/serving.py): 4 slots decoding together —

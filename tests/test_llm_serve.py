@@ -459,6 +459,9 @@ def test_speculate_auto_with_pump_matches_plain():
     plain, _ = run("autopA", {})
     spec, srv = run("autopB", {"speculate": "auto", "pump": "8"})
     assert spec == plain
-    assert 2 <= srv._spec_k <= 8
     st = srv.stats()
     assert st["spec_rounds"] > 0 and st["spec_columns"] > 0
+    # the controller actually consumed the pump's packed telemetry:
+    # the EMA moved off its 0.5 prior (initial-k [2,8] band checks are
+    # tautological — every update clamps into it)
+    assert srv._acc_ema != 0.5

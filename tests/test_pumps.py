@@ -349,3 +349,55 @@ def test_ngram_device_proposer_wrap_unrolls_ring():
     # what makes post-wrap mining exact
     raw = np.asarray(device_ngram_propose(hist, pos, k=3, g=2))
     assert raw[0].tolist() != [2, 3]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_pump_schedule_invariance(params, seed):
+    """Greedy streams are SCHEDULE-INVARIANT: whatever interleaving of
+    step / step_pump(n) / spec_pump(rounds, k) drains the batch —
+    with staggered random submissions between operations — every
+    request's tokens equal the plain per-token reference. The fuzz net
+    over the whole pump surface."""
+    rng = np.random.default_rng(seed)
+    a = _twin(params)   # reference: plain steps only
+    b = _twin(params)   # fuzzed: random pump schedule
+    prompts = [
+        _rep_prompt(int(rng.integers(4, 14)), 200 + seed * 10 + i,
+                    period=int(rng.integers(2, 6)))
+        for i in range(6)
+    ]
+    budgets = [int(rng.integers(2, 12)) for _ in prompts]
+    ra, rb = [], []
+    queue = list(zip(prompts, budgets))
+
+    def submit_some(cb, rids, k):
+        for _ in range(k):
+            if len(rids) < len(prompts):
+                p, n = queue[len(rids)]
+                rid = cb.submit(p, n)
+                if rid is None:
+                    break
+                rids.append(rid)
+
+    submit_some(a, ra, 2)
+    submit_some(b, rb, 2)
+    while len(ra) < len(prompts) or any(
+        a.result(r) is None for r in ra
+    ):
+        a.step()
+        submit_some(a, ra, 1)
+    ops = ("step", "pump", "spec")
+    while len(rb) < len(prompts) or any(
+        b.result(r) is None for r in rb
+    ):
+        op = ops[int(rng.integers(0, 3))]
+        if op == "step":
+            b.step()
+        elif op == "pump":
+            b.step_pump(int(rng.integers(1, 7)))
+        else:
+            b.spec_pump(rounds=int(rng.integers(1, 4)),
+                        k=int(rng.integers(2, 5)),
+                        ngram=int(rng.integers(1, 3)))
+        submit_some(b, rb, int(rng.integers(0, 3)))
+    assert _tokens(a, ra) == _tokens(b, rb)
