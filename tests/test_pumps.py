@@ -401,3 +401,60 @@ def test_random_pump_schedule_invariance(params, seed):
                         ngram=int(rng.integers(1, 3)))
         submit_some(b, rb, int(rng.integers(0, 3)))
     assert _tokens(a, ra) == _tokens(b, rb)
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12])
+def test_random_config_matrix_pump_equivalence(params, draft_params,
+                                               seed):
+    """Config-matrix fuzz: a random serving configuration (windowed ×
+    int8 cache × pallas attention × draft model × per-request
+    sampling) drained by pumps equals the SAME configuration drained
+    per-token. Complements the explicit matrix tests with random
+    combinations."""
+    rng = np.random.default_rng(seed)
+    kw = {}
+    if rng.integers(0, 2):
+        kw.update(windowed=True, max_len=32, prompt_len=16)
+    else:
+        kw.update(max_len=96, prompt_len=16)
+    if rng.integers(0, 2):
+        kw["cache_dtype"] = "int8"
+    if rng.integers(0, 2):
+        kw["attn_impl"] = "pallas"
+    if rng.integers(0, 2):
+        kw.update(draft_params=draft_params, draft_n_heads=N_HEADS)
+
+    def mk():
+        return ContinuousBatcher(params, N_HEADS, n_slots=2, **kw)
+
+    a, b = mk(), mk()
+    subs = []
+    for i in range(3):
+        p = _rep_prompt(int(rng.integers(4, 12)), 300 + seed * 7 + i,
+                        period=int(rng.integers(2, 5)))
+        s_kw = {}
+        if rng.integers(0, 2):
+            s_kw = dict(temperature=0.7, top_k=30, seed=int(i))
+        subs.append((p, int(rng.integers(2, 9)), s_kw))
+    # spec rounds on SAMPLING slots are distribution-exact, not
+    # byte-identical (spec_accept keys per (seed, pos, draw)) — the
+    # byte-equality fuzz may only use spec_pump on greedy workloads
+    any_sampling = any(s for _, _, s in subs)
+    ra = [a.submit(p, n, **s) for p, n, s in subs[:2]]
+    rb = [b.submit(p, n, **s) for p, n, s in subs[:2]]
+    while any(a.result(r) is None for r in ra):
+        a.step()
+    while any(b.result(r) is None for r in rb):
+        if any_sampling or rng.integers(0, 2):
+            b.step_pump(int(rng.integers(1, 6)))
+        else:
+            b.spec_pump(rounds=2, k=3, ngram=1)
+    # late third submission joins a half-drained batch on both sides
+    p, n, s_kw = subs[2]
+    ra.append(a.submit(p, n, **s_kw))
+    rb.append(b.submit(p, n, **s_kw))
+    while any(a.result(r) is None for r in ra):
+        a.step()
+    while any(b.result(r) is None for r in rb):
+        b.step_pump(3)
+    assert _tokens(a, ra) == _tokens(b, rb)
