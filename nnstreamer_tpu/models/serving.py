@@ -41,7 +41,8 @@ Design notes:
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
+import time as _time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -752,6 +753,11 @@ class _Request:
     tokens: List[int] = field(default_factory=list)
     done: bool = False
     fill0: int = 0  # cache fill at admission; pos = fill0+len(tokens)-1
+    # latency stamps (perf_counter): submit → first token → done; the
+    # serving analogue of the pipeline's wall-stamped p50-e2e cell
+    t_submit: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
 
     def finished(self) -> bool:
         """Budget exhausted, or the stop token was emitted (which stays
@@ -1439,6 +1445,12 @@ class ContinuousBatcher:
         self._n_spec_accepted = 0
         self._n_spec_columns = 0  # proposal columns offered (normalizer)
         self._step_time_s = 0.0
+        # bounded per-request latency windows (newest 1024): TTFT and
+        # full request wall time — stats() reports their p50s
+        self._lat_ttft: deque = deque(maxlen=1024)
+        self._lat_req: deque = deque(maxlen=1024)
+        self._lat_version = 0       # bumped per finished request
+        self._lat_cache = (-1, 0.0, 0.0)  # (version, p50_ttft_ms, p50_req_s)
 
     def _empty_stage(self):
         return (
@@ -1656,6 +1668,7 @@ class ContinuousBatcher:
             req = _Request(
                 rid, max_new_tokens, temperature=temperature, top_k=top_k,
                 top_p=top_p, stop_token=stop_token,
+                t_submit=_time.perf_counter(),
                 key=np.asarray(
                     jax.random.PRNGKey(rid if seed is None else seed)
                 ),
@@ -1717,6 +1730,7 @@ class ContinuousBatcher:
                 first = int(first_dev)
                 with self._lock:
                     req.fill0 = fill
+                    req.t_first = _time.perf_counter()
                     req.tokens.append(first)
                     self._finish(slot)
                 return rid
@@ -1788,10 +1802,12 @@ class ContinuousBatcher:
             self._apply_batch_locked(batch, firsts)
 
     def _apply_batch_locked(self, batch, firsts) -> None:
+        now = _time.perf_counter()
         for p, first in zip(batch, firsts):
             if self._slots[p.slot] is not p.req:
                 continue  # request vanished (defensive; cannot happen)
             first = int(first)
+            p.req.t_first = now
             p.req.tokens.append(first)
             if p.req.finished():
                 # budget 1 or an immediate stop token: the request ends
@@ -1831,8 +1847,6 @@ class ContinuousBatcher:
         in-flight device step); _step_lock serializes concurrent
         steppers. Slots admitted while a step is in flight join at the
         next step."""
-        import time as _time
-
         t0 = _time.perf_counter()
         with self._step_lock:
             return self._plain_step_locked(t0)
@@ -1896,8 +1910,6 @@ class ContinuousBatcher:
         single-invoke-per-buffer filter loop
         (gst/nnstreamer/tensor_filter/tensor_filter.c) batched along
         the token axis instead."""
-        import time as _time
-
         t0 = _time.perf_counter()
         with self._step_lock:
             self._apply_pending()
@@ -1959,8 +1971,6 @@ class ContinuousBatcher:
         ``rounds`` is a static scan length, so every distinct value is
         its own XLA program — quantization bounds the program variants
         to log2(rounds) instead of one per tail length."""
-        import time as _time
-
         t0 = _time.perf_counter()
         k = max(2, int(k))
         if self._draft is not None and self.windowed:
@@ -2056,8 +2066,6 @@ class ContinuousBatcher:
         hist, dcache,
     ) -> Dict[int, List[int]]:
         """spec_pump bookkeeping; caller holds _step_lock + _lock."""
-        import time as _time
-
         self._cache = cache
         self._hist = self._pin(hist)
         self._tok = self._pin(tok)
@@ -2077,8 +2085,6 @@ class ContinuousBatcher:
 
     def _plain_step_locked(self, t0) -> Dict[int, int]:
         """step() body; caller holds _step_lock."""
-        import time as _time
-
         self._apply_pending()
         with self._lock:
             if not self._active.any():
@@ -2155,8 +2161,6 @@ class ContinuousBatcher:
         slot proposed anything (there the plain step and verify are the
         same inline-attention math). Returns {rid: last emitted token};
         use partials() for the full per-round stream."""
-        import time as _time
-
         t0 = _time.perf_counter()
         with self._step_lock:
             self._apply_pending()
@@ -2323,11 +2327,29 @@ class ContinuousBatcher:
                     self._n_spec_accepted / self._n_spec_columns
                     if self._n_spec_columns else 0.0
                 ),
+                "p50_ttft_ms": self._lat_p50s_locked()[0],
+                "p50_request_s": self._lat_p50s_locked()[1],
                 "slots_occupied": occupied,
                 "slots_free": self.n_slots - occupied,
                 "results_pending_pickup": len(self._done_pool),
                 "prefixes_registered": len(self._prefixes),
             }
+
+    def _lat_p50s_locked(self):
+        """Cached latency medians (_lock held): the auto-speculation
+        controller polls stats() every pump, so the O(n log n) sorts
+        run only when a request finished since the last call."""
+        if self._lat_cache[0] != self._lat_version:
+            ttft = (
+                sorted(self._lat_ttft)[len(self._lat_ttft) // 2] * 1000.0
+                if self._lat_ttft else 0.0
+            )
+            req_s = (
+                sorted(self._lat_req)[len(self._lat_req) // 2]
+                if self._lat_req else 0.0
+            )
+            self._lat_cache = (self._lat_version, ttft, req_s)
+        return self._lat_cache[1], self._lat_cache[2]
 
     def _pin(self, x):
         """Keep per-slot vectors on their mesh sharding after eager
@@ -2337,6 +2359,12 @@ class ContinuousBatcher:
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]
         req.done = True
+        req.t_done = _time.perf_counter()
+        if req.t_first and req.t_submit:
+            self._lat_ttft.append(req.t_first - req.t_submit)
+        if req.t_submit:
+            self._lat_req.append(req.t_done - req.t_submit)
+        self._lat_version += 1
         self._active[slot] = False
         self._done_pool[req.rid] = req
         while len(self._done_pool) > self._keep_results:

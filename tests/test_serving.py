@@ -762,3 +762,18 @@ def test_mesh_with_draft_speculation_matches_unsharded(params):
         outs[label] = [cb.result(r) for r in rids]
         assert cb.stats()["spec_rounds"] > 0
     assert outs["plain"] == outs["mesh"]
+
+
+def test_latency_telemetry_surface(params):
+    """stats() reports p50 TTFT and p50 request wall time from bounded
+    per-request windows — the serving analogue of the pipeline's
+    wall-stamped p50-e2e cell (BASELINE 'p50 e2e tracked')."""
+    cb = ContinuousBatcher(params, N_HEADS, n_slots=2, max_len=48,
+                           prompt_len=16)
+    rids = [cb.submit(_prompt(5 + i, 900 + i), 4) for i in range(2)]
+    while any(cb.result(r) is None for r in rids):
+        cb.step_pump(4)
+    st = cb.stats()
+    assert st["p50_ttft_ms"] > 0.0
+    assert st["p50_request_s"] > 0.0
+    assert st["p50_request_s"] * 1000.0 >= st["p50_ttft_ms"]
