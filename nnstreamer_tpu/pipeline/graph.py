@@ -217,7 +217,17 @@ class Pipeline:
     def compile_plan(self) -> "ExecPlan":
         if not self._negotiated:
             self.negotiate()
-        # group consecutive TensorOps with 1:1 linkage into segments
+        # group consecutive TensorOps with 1:1 linkage into segments.
+        # NNS_NO_FUSE=1 keeps every element its own segment — the
+        # reference-faithful per-element execution mode (one program
+        # per element, queue hops between), useful to localize a fault
+        # to an element vs the fusion, and the oracle the fused-vs-
+        # unfused equivalence tests compare against.
+        import os
+
+        no_fuse = os.environ.get("NNS_NO_FUSE", "").lower() in (
+            "1", "true", "yes", "on",
+        )
         seg_of: Dict[Element, "FusedSegment"] = {}
         segments: List[FusedSegment] = []
         for e in self._toposort():
@@ -228,7 +238,8 @@ class Pipeline:
             ups = self.in_links(e)
             up = ups[0].src if len(ups) == 1 else None
             if (
-                up is not None
+                not no_fuse
+                and up is not None
                 and isinstance(up, TensorOp)
                 and up in seg_of
                 and len(self.out_links(up)) == 1

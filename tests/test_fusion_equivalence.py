@@ -1,0 +1,112 @@
+"""Fused-vs-unfused pipeline equivalence (NNS_NO_FUSE oracle).
+
+The planner fuses consecutive traceable elements into one XLA program
+(pipeline/graph.py compile_plan); NNS_NO_FUSE=1 keeps every element its
+own program — the reference-faithful per-element mode. The two
+executions compute the same function: integer results are byte-equal;
+float results may differ by a few ULPs (XLA contracts a*b+c into FMA
+inside one program — compiler-legal rounding, the standard XLA
+semantics), so floats compare at a tight few-ULP tolerance. Random
+chains fuzz the invariant.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+
+def _run(desc, no_fuse):
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    old = os.environ.get("NNS_NO_FUSE")
+    os.environ["NNS_NO_FUSE"] = "1" if no_fuse else "0"
+    try:
+        ex = parse_pipeline(desc).run(timeout=300)
+    finally:
+        if old is None:
+            os.environ.pop("NNS_NO_FUSE", None)
+        else:
+            os.environ["NNS_NO_FUSE"] = old
+    sink = next(
+        n.elem for n in ex.nodes
+        if isinstance(getattr(n, "elem", None), TensorSink)
+    )
+    n_segs = sum(
+        1 for n in ex.nodes if type(n).__name__ == "FusedNode"
+    )
+    return [
+        [np.asarray(t) for t in f.tensors] for f in sink.frames
+    ], n_segs
+
+
+def _assert_equal(a, b):
+    assert len(a) == len(b)
+    for fa, fb in zip(a, b):
+        assert len(fa) == len(fb)
+        for ta, tb in zip(fa, fb):
+            if np.issubdtype(ta.dtype, np.integer):
+                np.testing.assert_array_equal(ta, tb)
+            else:
+                # FMA contraction inside the fused program: a few ULPs
+                # of compiler-legal rounding, nothing more (float32
+                # eps ≈ 1.2e-7; atol covers contraction at magnitudes
+                # the uint8-derived pipelines produce)
+                np.testing.assert_allclose(ta, tb, rtol=1e-6, atol=1e-6)
+
+
+def test_no_fuse_splits_segments_and_matches():
+    """The flagship chain: fused runs as ONE program, unfused as one
+    per element — outputs identical."""
+    desc = (
+        "videotestsrc pattern=gradient device=true num-frames=3 "
+        "width=32 height=32 ! tensor_converter ! "
+        "tensor_transform mode=typecast option=float32 ! "
+        "tensor_filter framework=scaler custom=factor:0.5 ! "
+        "tensor_sink"
+    )
+    fused, n_f = _run(desc, False)
+    unfused, n_u = _run(desc, True)
+    assert n_u > n_f  # the knob actually split the segment
+    _assert_equal(fused, unfused)
+
+
+@pytest.mark.parametrize("seed", list(range(4)))
+def test_random_chain_fusion_equivalence(seed):
+    """Random transform chains: whatever the element sequence, fusion
+    is a schedule — fused and per-element outputs are byte-equal."""
+    rng = np.random.default_rng(seed)
+    stages = []
+    for _ in range(int(rng.integers(1, 5))):
+        kind = int(rng.integers(0, 4))
+        if kind == 0:
+            c = round(float(rng.uniform(0.5, 3.0)), 2)
+            stages.append(
+                f"tensor_transform mode=arithmetic option=add:{c}"
+            )
+        elif kind == 1:
+            c = round(float(rng.uniform(0.25, 2.0)), 2)
+            stages.append(
+                f"tensor_transform mode=arithmetic option=mul:{c}"
+            )
+        elif kind == 2:
+            stages.append("tensor_transform mode=typecast option=float32")
+        else:
+            lo, hi = sorted(
+                round(float(x), 1) for x in rng.uniform(0, 200, 2)
+            )
+            stages.append(
+                f"tensor_transform mode=clamp option={lo}:{hi}"
+            )
+    mid = " ! ".join(stages)
+    desc = (
+        f"videotestsrc pattern=gradient device="
+        f"{'true' if rng.integers(0, 2) else 'false'} num-frames=2 "
+        f"width=16 height=16 ! tensor_converter ! {mid} ! "
+        "tensor_filter framework=scaler custom=factor:0.5 ! tensor_sink"
+    )
+    fused, _ = _run(desc, False)
+    unfused, _ = _run(desc, True)
+    _assert_equal(fused, unfused)
