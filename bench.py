@@ -393,6 +393,40 @@ def _run() -> None:
         print(f"[bench] rate budget failed: {exc!r}", file=sys.stderr)
     _mark("pipeline rate budget measured")
 
+    # EARLY partial capture: the headline + primary cells land ~10 min
+    # into a TPU window while the full optional ladder needs ~30+; a
+    # window (or the round) ending mid-run must not lose the headline.
+    # The end-of-run record replaces this (partial records never win
+    # best-by-value against a full one, and a full one always replaces
+    # a partial).
+    if on_tpu:
+        try:
+            headline = pipeline_fps if pipeline_fps is not None else fps
+            _record_measured(json.dumps({
+                "metric": (
+                    "mobilenet_v2_224_pipeline_fps_per_chip"
+                    if pipeline_fps is not None
+                    else "mobilenet_v2_224_bs1_fps_per_chip"
+                ),
+                "value": _round(headline),
+                "unit": "fps",
+                "vs_baseline": _round(headline / 1000.0, 3),
+                "partial": True,
+                "pipeline_fps": _round(pipeline_fps),
+                "pipeline_p50_e2e_ms": _round(pipeline_p50_ms, 3),
+                "pipeline_rate_p50_ms": _round(pipeline_rate_p50_ms, 3),
+                "rate_drop_pct": rate_drop_pct,
+                "raw_invoke_bs1_fps": _round(fps),
+                "p50_sync_latency_ms": round(p50, 3),
+                "h2d_streaming_fps": round(h2d_fps, 1),
+                "microbatch8_fps": round(mb_fps, 1),
+                "platform": dev.platform,
+                "device": str(dev.device_kind),
+            }))
+        except Exception as exc:  # noqa: BLE001 — strictly additive
+            print(f"[bench] partial capture failed: {exc!r}",
+                  file=sys.stderr)
+
     # Optional sections below run inside a soft budget: the primary
     # metrics are already measured, and a slow tunnel day must not turn a
     # recorded number into an rc:1 (the round-1 failure mode).
@@ -1036,13 +1070,25 @@ def _record_measured(line: str) -> None:
             "BENCH_MEASURED_PATH", "BENCH_MEASURED_r05.json"
         )
         here = os.path.dirname(os.path.abspath(__file__))
-        # every TPU capture is appended here verbatim (evidence is never
-        # lost to the best-by-value policy below)
-        with open(
-            os.path.join(here, "docs", "bench_captures_r05.jsonl"), "a"
-        ) as f:
-            f.write(json.dumps({"t": time.time(), **data}) + "\n")
         full = os.path.join(here, path)
+        # every TPU capture is appended next to the measured file
+        # verbatim (evidence is never lost to the best-by-value policy
+        # below; an overridden BENCH_MEASURED_PATH keeps its archive
+        # beside it — test isolation)
+        arch_dir = (
+            os.path.join(here, "docs") if path == os.path.basename(path)
+            else os.path.dirname(full)
+        )
+        try:
+            os.makedirs(arch_dir, exist_ok=True)
+            with open(
+                os.path.join(arch_dir, "bench_captures_r05.jsonl"), "a"
+            ) as f:
+                f.write(json.dumps({"t": time.time(), **data}) + "\n")
+        except Exception as exc:  # noqa: BLE001 — the archive is a
+            # bonus; the measured file below must still be written
+            print(f"[bench] capture archive failed: {exc!r}",
+                  file=sys.stderr)
         # keep the BEST capture by headline value: relay throughput
         # varies ~20× between windows (docs/BENCH_NOTES.md cost model),
         # and a capture taken in a degraded window must not clobber
@@ -1051,8 +1097,21 @@ def _record_measured(line: str) -> None:
             try:
                 with open(full) as f:
                     prev = json.load(f)
-                if float(prev.get("value") or 0) > float(
-                    data.get("value") or 0
+                # a full record always replaces a partial; a partial
+                # never replaces a full; otherwise best headline wins
+                prev_partial = bool(prev.get("partial"))
+                new_partial = bool(data.get("partial"))
+                if new_partial and not prev_partial:
+                    print(
+                        f"[bench] TPU capture kept: existing {path} is a "
+                        "full record",
+                        file=sys.stderr,
+                    )
+                    return
+                if (
+                    prev_partial == new_partial
+                    and float(prev.get("value") or 0)
+                    > float(data.get("value") or 0)
                 ):
                     print(
                         f"[bench] TPU capture kept: existing {path} has a "
