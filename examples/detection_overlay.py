@@ -1,5 +1,13 @@
 """SSD detection with on-device NMS decoded to an RGBA overlay
-(the reference's nnstreamer_decoder_boundingbox example pipeline)."""
+(the reference's nnstreamer_decoder_boundingbox example pipeline).
+
+Launch-string equivalent (pre-flight it with ``nns-launch --check``):
+
+    videotestsrc width=300 height=300 num-frames=4 ! tensor_converter !
+        tensor_filter framework=jax model=zoo:ssd_mobilenet_v2_pp custom=threshold:0.0001 !
+        tensor_decoder mode=bounding_boxes option1=mobilenet-ssd-postprocess option4=300:300 !
+        tensor_sink
+"""
 
 import os
 import sys

@@ -1,5 +1,12 @@
 """The v0 end-to-end slice (SURVEY.md §7 build order 2): deterministic
-frames → fused normalize+MobileNet-v2 → argmax class indices."""
+frames → fused normalize+MobileNet-v2 → argmax class indices.
+
+Launch-string equivalent (pre-flight it with ``nns-launch --check``):
+
+    videotestsrc width=224 height=224 num-frames=8 ! tensor_converter !
+        tensor_filter framework=jax model=zoo:mobilenet_v2 !
+        tensor_decoder mode=image_labeling ! tensor_sink
+"""
 
 import os
 import sys

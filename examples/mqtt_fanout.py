@@ -1,4 +1,10 @@
-"""One publisher, two subscribers via the vendored MQTT broker."""
+"""One publisher, two subscribers via the vendored MQTT broker.
+
+Launch-string equivalents (pre-flight with ``nns-launch --check``):
+
+    videotestsrc num-frames=4 ! tensor_converter ! mqttsink pub-topic=demo/video
+    mqttsrc sub-topic=demo/video ! tensor_sink
+"""
 
 import os
 import sys

@@ -1,5 +1,13 @@
 """Pipeline split across two processes: a TPU-side server pipeline serves a
-client pipeline over the native TCP transport (reference edge-ai offload)."""
+client pipeline over the native TCP transport (reference edge-ai offload).
+
+Launch-string equivalents (pre-flight with ``nns-launch --check``):
+
+    tensor_query_serversrc port=5001 !
+        tensor_filter framework=jax model=zoo:add custom=dims:4,const:10 input=4 inputtype=float32 !
+        tensor_query_serversink
+    tensorsrc dimensions=4 num-frames=8 ! tensor_query_client dest-port=5001 ! tensor_sink
+"""
 
 import os
 import sys

@@ -1,0 +1,27 @@
+"""Static pipeline analysis (nns-lint): pre-flight validation of launch
+strings and constructed Pipelines without ever starting them.
+
+Public surface:
+
+    from nnstreamer_tpu.analysis import lint
+    result = lint("videotestsrc ! tensor_converter ! tensor_sink")
+    for d in result.diagnostics:
+        print(d)            # NNS-E003 error [tensor_filter0]: ...
+    sys.exit(result.exit_code)   # 0 clean / 1 warnings / 2 errors
+
+See docs/linting.md for the diagnostic-code catalog.
+"""
+
+from nnstreamer_tpu.analysis.diagnostics import (  # noqa: F401
+    CATALOG,
+    Diagnostic,
+    LintReport,
+    Severity,
+)
+from nnstreamer_tpu.analysis.lint import (  # noqa: F401
+    LintResult,
+    annotated_dot,
+    check_properties,
+    coerce_property,
+    lint,
+)

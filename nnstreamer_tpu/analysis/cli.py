@@ -1,0 +1,77 @@
+"""nns-lint: the standalone static-analyzer CLI.
+
+    nns-lint "videotestsrc ! tensor_converter ! tensor_sink"
+    nns-lint --dot "..." > graph.dot     # diagnostics painted on nodes
+    nns-lint --json "..."                # machine-readable findings
+    nns-lint --self-check                # PROPERTIES schemas cover code?
+
+Exit codes: 0 clean, 1 warnings only, 2 errors (and 1 on --self-check
+failure). The pipeline is parsed and analyzed but NEVER started.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from nnstreamer_tpu.platform_pin import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nns-lint", description=__doc__)
+    ap.add_argument("description", nargs="?", help="pipeline description")
+    ap.add_argument(
+        "--dot", action="store_true",
+        help="print graphviz with diagnostics annotated on the nodes",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON findings")
+    ap.add_argument(
+        "--self-check", action="store_true",
+        help="verify every builtin element's PROPERTIES schema covers the "
+        "properties its code reads",
+    )
+    ap.add_argument("--quiet", "-q", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        from nnstreamer_tpu.analysis.selfcheck import main as selfcheck_main
+
+        return selfcheck_main()
+    if not args.description:
+        ap.error("pipeline description required (or --self-check)")
+
+    from nnstreamer_tpu.analysis import annotated_dot, lint
+
+    result = lint(args.description)
+    if args.dot:
+        print(annotated_dot(result))
+        return result.exit_code
+    if args.json:
+        print(json.dumps(
+            {
+                "exit_code": result.exit_code,
+                "diagnostics": [
+                    {
+                        "code": d.code,
+                        "severity": d.severity.value,
+                        "slug": d.slug,
+                        "element": d.element,
+                        "message": d.message,
+                        "hint": d.hint,
+                    }
+                    for d in result.diagnostics
+                ],
+            },
+            indent=2,
+        ))
+        return result.exit_code
+    if not args.quiet or result.diagnostics:
+        print(result.render())
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+"""Structured lint diagnostics (the reference's gst-validate report model:
+one issue-type registry, many reports per run, never fail-fast).
+
+Every problem `nns-lint` can find has a stable code in the ``NNS-Exxx``
+(error) / ``NNS-Wxxx`` (warning) namespace so scripts and CI can match on
+codes instead of message text. The catalog below is the single source of
+truth; docs/linting.md renders from the same table.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value
+
+
+# code → (severity, slug, one-line description)
+CATALOG: Dict[str, Tuple[Severity, str, str]] = {
+    "NNS-E001": (
+        Severity.ERROR, "unlinked-sink-pad",
+        "an element's required sink pad has nothing linked to it",
+    ),
+    "NNS-E002": (
+        Severity.ERROR, "cycle",
+        "the pipeline graph contains a cycle (use tensor_repo for loops)",
+    ),
+    "NNS-E003": (
+        Severity.ERROR, "caps-mismatch",
+        "spec negotiation would fail on this element at build time",
+    ),
+    "NNS-E004": (
+        Severity.ERROR, "unknown-element",
+        "no element factory registered under this name",
+    ),
+    "NNS-E005": (
+        Severity.ERROR, "bad-property-value",
+        "a property value cannot be coerced to its declared type",
+    ),
+    "NNS-E006": (
+        Severity.ERROR, "unknown-framework",
+        "tensor_filter framework= names no registered backend",
+    ),
+    "NNS-E007": (
+        Severity.ERROR, "unknown-decoder",
+        "tensor_decoder mode= names no registered decoder subplugin",
+    ),
+    "NNS-E008": (
+        Severity.ERROR, "unknown-converter",
+        "tensor_converter mode= names no registered converter subplugin",
+    ),
+    "NNS-E009": (
+        Severity.ERROR, "parse-error",
+        "the launch string does not parse (bad token, dangling '!', ...)",
+    ),
+    "NNS-E010": (
+        Severity.ERROR, "restricted-element",
+        "the element exists but is blocked by [common] restricted_elements",
+    ),
+    "NNS-E011": (
+        Severity.ERROR, "construction-failed",
+        "the element constructor raised (missing required property, "
+        "unopenable resource, ...)",
+    ),
+    "NNS-W101": (
+        Severity.WARNING, "unknown-property",
+        "property is not in the element's schema (typo?)",
+    ),
+    "NNS-W102": (
+        Severity.WARNING, "missing-model-file",
+        "tensor_filter model path does not exist on disk",
+    ),
+    "NNS-W103": (
+        Severity.WARNING, "unqueued-tee-branch",
+        "mux fan-in branches share a tee ancestor without an intervening "
+        "queue (classic deadlock topology)",
+    ),
+    "NNS-W104": (
+        Severity.WARNING, "unreachable-element",
+        "element is not reachable from any source; it will never see data",
+    ),
+    "NNS-W105": (
+        Severity.WARNING, "unlinked-src-pad",
+        "an element's src pad has nothing linked; its output is dropped",
+    ),
+    "NNS-W106": (
+        Severity.WARNING, "suspicious-property-value",
+        "the value parses at runtime but probably not as intended "
+        "(e.g. an unrecognized boolean string silently becomes false)",
+    ),
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: stable code + severity + offending element + advice."""
+
+    code: str
+    severity: Severity
+    element: Optional[str]  # element (instance) name, None = whole pipeline
+    message: str
+    hint: str = ""
+
+    @property
+    def slug(self) -> str:
+        return CATALOG[self.code][1] if self.code in CATALOG else ""
+
+    def __str__(self) -> str:
+        where = f" [{self.element}]" if self.element else ""
+        hint = f" (hint: {self.hint})" if self.hint else ""
+        return (
+            f"{self.code} {self.severity.value}{where}: {self.message}{hint}"
+        )
+
+
+def make(code: str, element: Optional[str], message: str, hint: str = "") -> Diagnostic:
+    """Build a Diagnostic with the catalog's severity for `code`."""
+    sev, _, _ = CATALOG[code]
+    return Diagnostic(code, sev, element, message, hint)
+
+
+@dataclass
+class LintReport:
+    """All diagnostics from one lint run, never fail-fast."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(self, code: str, element: Optional[str], message: str,
+            hint: str = "") -> None:
+        self.diagnostics.append(make(code, element, message, hint))
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.WARNING]
+
+    @property
+    def codes(self) -> List[str]:
+        return sorted({d.code for d in self.diagnostics})
+
+    @property
+    def exit_code(self) -> int:
+        """nns-lint / nns-launch --check contract: 0 clean, 1 warnings
+        only, 2 any error."""
+        if self.errors:
+            return 2
+        if self.warnings:
+            return 1
+        return 0
+
+    def by_element(self) -> Dict[Optional[str], List[Diagnostic]]:
+        out: Dict[Optional[str], List[Diagnostic]] = {}
+        for d in self.diagnostics:
+            out.setdefault(d.element, []).append(d)
+        return out
+
+    def render(self) -> str:
+        if not self.diagnostics:
+            return "pipeline is clean"
+        lines = [str(d) for d in self.diagnostics]
+        lines.append(
+            f"{len(self.errors)} error(s), {len(self.warnings)} warning(s)"
+        )
+        return "\n".join(lines)

@@ -1,0 +1,671 @@
+"""nns-lint: static pipeline analysis — report EVERY problem, start nothing.
+
+The reference front-loads failure detection with gst-validate, confchk and
+the launch parser's semantic checks because launch-string pipelines fail
+late and cryptically at runtime. This module gives the reproduction the
+same pre-flight: take a launch string (or a constructed Pipeline) and,
+WITHOUT starting it, run four passes that each append structured
+:class:`~nnstreamer_tpu.analysis.diagnostics.Diagnostic` findings:
+
+1. graph structure — unlinked pads, cycles (with the member list),
+   unreachable elements, mux fan-in branches sharing a tee ancestor with
+   no intervening queue (the classic deadlock topology);
+2. dry-run spec flow — each element's own ``negotiate()`` runs on a CLONE
+   in topological order, so every caps mismatch in the graph is reported,
+   not just the first, and the user's pipeline object is never mutated;
+3. property validation — launch-string properties are checked against the
+   elements' ``PROPERTIES`` schemas (unknown names, un-coercible values);
+4. resource checks — tensor_filter model paths that don't exist,
+   ``framework=`` naming an unregistered backend, decoder/converter modes
+   missing from the registry.
+
+Pipelines are never executed: no ``start()``, no executor, no sockets.
+"""
+
+from __future__ import annotations
+
+import copy
+import difflib
+import os
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.analysis.diagnostics import Diagnostic, LintReport
+from nnstreamer_tpu.elements.base import (
+    Element,
+    PropSpec,
+    PROPS_ANY,
+    Routing,
+    Sink,
+    Source,
+)
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.pipeline.parse import (
+    ParseError,
+    _make_caps_element,
+    _parse_caps,
+    scan_description,
+)
+
+
+class _Placeholder(Element):
+    """Stand-in for an element that could not be resolved/constructed, so
+    the rest of the graph still wires up and gets checked."""
+
+    FACTORY_NAME = "~unresolved"
+    N_SINKS = 1
+    N_SRCS = 1
+
+    def negotiate(self, in_specs):
+        return [None]
+
+
+@dataclass
+class LintResult:
+    """LintReport + the (possibly partially constructed) pipeline and the
+    dry-run negotiated specs (element name → out specs) for annotation."""
+
+    report: LintReport
+    pipeline: Optional[Pipeline]
+    negotiated_specs: Dict[str, List[Any]] = None  # type: ignore[assignment]
+
+    @property
+    def diagnostics(self) -> List[Diagnostic]:
+        return self.report.diagnostics
+
+    @property
+    def exit_code(self) -> int:
+        return self.report.exit_code
+
+    @property
+    def codes(self) -> List[str]:
+        return self.report.codes
+
+    def render(self) -> str:
+        return self.report.render()
+
+
+# -- property validation ----------------------------------------------------
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def coerce_property(ps: PropSpec, value: Any) -> Any:
+    """Coerce a raw (usually string) property value per its schema; raise
+    ValueError when the value cannot possibly be what the element needs."""
+    if ps.type == "str":
+        return str(value)
+    s = str(value).strip()
+    if ps.type == "int":
+        return int(s)
+    if ps.type == "float":
+        return float(s)
+    if ps.type == "fraction":
+        return Fraction(s)
+    if ps.type == "bool":
+        if s.lower() in _TRUE:
+            return True
+        if s.lower() in _FALSE:
+            return False
+        raise ValueError(f"not a boolean: {value!r}")
+    if ps.type == "enum":
+        if s.lower() in tuple(c.lower() for c in ps.choices):
+            return s
+        raise ValueError(
+            f"{value!r} not one of {', '.join(ps.choices)}"
+        )
+    return value  # unknown schema type: accept
+
+
+def check_properties(
+    cls: type, props: Dict[str, Any], elem_label: str, report: LintReport
+) -> None:
+    """Schema-validate one element's property dict (NNS-W101 / NNS-E005)."""
+    schema = cls.property_schema()
+    open_schema = PROPS_ANY in schema
+    for key, value in props.items():
+        norm = key.replace("_", "-")
+        ps = schema.get(norm)
+        if ps is None:
+            if open_schema:
+                continue
+            known = sorted(k for k in schema if k != PROPS_ANY)
+            close = difflib.get_close_matches(norm, known, n=1)
+            hint = f"did you mean {close[0]!r}?" if close else (
+                f"known properties: {', '.join(known)}"
+            )
+            report.add(
+                "NNS-W101", elem_label,
+                f"unknown property {key!r} for {cls.FACTORY_NAME}", hint,
+            )
+            continue
+        try:
+            coerce_property(ps, value)
+        except (ValueError, ZeroDivisionError) as exc:
+            hint = (
+                f"default is {ps.default!r}" if ps.default is not None else ""
+            )
+            if ps.type == "bool":
+                # runtime _parse_bool never raises — any unrecognized
+                # string silently becomes False, so this is a suspicion,
+                # not a predicted failure
+                report.add(
+                    "NNS-W106", elem_label,
+                    f"property {key}={value!r} is not a recognized boolean "
+                    "and will silently read as false",
+                    hint,
+                )
+            else:
+                report.add(
+                    "NNS-E005", elem_label,
+                    f"property {key}={value!r} is not a valid {ps.type}: "
+                    f"{exc}",
+                    hint,
+                )
+
+
+# -- fault-tolerant launch-string build -------------------------------------
+
+def _build_tolerant(
+    description: str, report: LintReport, placeholders: Set[str]
+) -> Optional[Pipeline]:
+    """parse.parse_pipeline's two passes, but every failure becomes a
+    diagnostic and a placeholder so later passes still see the graph."""
+    try:
+        items = scan_description(description)
+    except ParseError as exc:
+        report.add("NNS-E009", None, str(exc))
+        return None
+    # constructing lint elements must not shift the gst-style default
+    # numbering (tensor_sink0, ...) of pipelines parsed afterwards — the
+    # whole point of lint is to run BEFORE the real parse
+    counters_snapshot = dict(Element._instance_counters)
+    try:
+        return _build_items(items, report, placeholders)
+    finally:
+        Element._instance_counters.clear()
+        Element._instance_counters.update(counters_snapshot)
+
+
+def _build_items(
+    items: List[Any],
+    report: LintReport,
+    placeholders: Set[str],
+) -> Optional[Pipeline]:
+    pipeline = Pipeline()
+    instances: List[Optional[Element]] = []
+    n_anon = 0
+
+    def placeholder(label: Optional[str], factory: str = "unresolved") -> Element:
+        nonlocal n_anon
+        # '~' cannot appear in parsed names, so this never collides
+        p = _Placeholder(name=label or f"{factory}~{n_anon}")
+        n_anon += 1
+        placeholders.add(p.name)
+        return p
+
+    for item in items:
+        if item[0] == "element":
+            _, factory, props = item
+            cls: Optional[type] = None
+            lookup_err: Optional[Tuple[str, str, str]] = None
+            try:
+                cls = registry.get(registry.KIND_ELEMENT, factory)
+            except KeyError:
+                # builtin_only: a restricted name must never trigger
+                # plugin-file execution just to classify the diagnostic
+                if registry.is_restricted(
+                    registry.KIND_ELEMENT, factory
+                ) and registry.exists(
+                    registry.KIND_ELEMENT, factory, builtin_only=True
+                ):
+                    lookup_err = (
+                        "NNS-E010",
+                        f"element {factory!r} is restricted by configuration",
+                        "[common] restricted_elements blocks it",
+                    )
+                else:
+                    known = registry.available(registry.KIND_ELEMENT)
+                    close = difflib.get_close_matches(factory, known, n=1)
+                    lookup_err = (
+                        "NNS-E004",
+                        f"no element factory named {factory!r}",
+                        f"did you mean {close[0]!r}?" if close else "",
+                    )
+            # construct FIRST so diagnostics anchor to the node's actual
+            # (possibly auto-generated) name and dot annotation matches
+            elem: Optional[Element] = None
+            ctor_exc: Optional[Exception] = None
+            ctor = dict(props)
+            elem_name = ctor.pop("name", None)
+            if cls is not None:
+                try:
+                    elem = cls(name=elem_name, **ctor)
+                except Exception as exc:  # ctor rejected the properties
+                    ctor_exc = exc
+            if elem is None:
+                elem = placeholder(elem_name, factory)
+            label = elem.name
+            if lookup_err is not None:
+                report.add(lookup_err[0], label, lookup_err[1], lookup_err[2])
+            if cls is not None:
+                n_before = len(report.diagnostics)
+                check_properties(cls, props, label, report)
+                schema_flagged = any(
+                    d.code == "NNS-E005"
+                    for d in report.diagnostics[n_before:]
+                )
+                if ctor_exc is not None and not schema_flagged:
+                    # a ctor failure the schema didn't predict: missing
+                    # required property, unopenable resource, ... — its
+                    # own code, NOT bad-property-value (scripts match on
+                    # codes)
+                    report.add(
+                        "NNS-E011", label,
+                        f"{factory} could not be constructed: {ctor_exc}",
+                    )
+            try:
+                pipeline.add(elem)
+            except ValueError as exc:  # duplicate name
+                report.add("NNS-E009", elem.name, str(exc))
+                elem = placeholder(None)
+                pipeline.add(elem)
+            instances.append(elem)
+        elif item[0] == "caps":
+            try:
+                media, fields = _parse_caps(item[1])
+                elem = _make_caps_element(media, fields)
+            except (ParseError, ValueError) as exc:
+                report.add("NNS-E009", None, f"bad caps {item[1]!r}: {exc}")
+                elem = placeholder(None)
+            pipeline.add(elem)
+            instances.append(elem)
+        else:
+            instances.append(None)
+
+    # pass 2: wire links, tolerating per-link failures
+    prev: Optional[Element] = None
+    prev_src_pad: Optional[int] = None
+    expect_link = False
+    for item, inst in zip(items, instances):
+        if item[0] == "bang":
+            if prev is None:
+                report.add("NNS-E009", None, "'!' with nothing to link from")
+            elif expect_link:
+                report.add("NNS-E009", None, "duplicate '!'")
+            else:
+                expect_link = True
+        elif item[0] == "ref":
+            _, name, kind, pad = item
+            try:
+                target = pipeline[name]
+            except KeyError:
+                report.add(
+                    "NNS-E009", None,
+                    f"reference to unknown element {name!r}",
+                )
+                prev, prev_src_pad, expect_link = None, None, False
+                continue
+            if expect_link:
+                dst_pad = pad if kind in (None, "sink") else None
+                try:
+                    pipeline.link(prev, target, src_pad=prev_src_pad,
+                                  dst_pad=dst_pad)
+                except ValueError as exc:
+                    report.add("NNS-E009", target.name, str(exc))
+                prev, prev_src_pad, expect_link = None, None, False
+            else:
+                prev = target
+                prev_src_pad = pad if kind in (None, "src") else None
+        else:
+            if expect_link:
+                try:
+                    pipeline.link(prev, inst, src_pad=prev_src_pad)
+                except ValueError as exc:
+                    report.add("NNS-E009", inst.name, str(exc))
+                expect_link = False
+            prev, prev_src_pad = inst, None
+    if expect_link:
+        report.add("NNS-E009", None, "pipeline ends with '!'")
+    return pipeline
+
+
+# -- pass 1: graph structure -------------------------------------------------
+
+def _structure_pass(
+    pipeline: Pipeline, report: LintReport, placeholders: Set[str]
+) -> List[Element]:
+    """NNS-E001/W105 unlinked pads, NNS-E002 cycles, NNS-W104 reachability.
+    Returns the cycle members (non-empty means the spec pass must skip)."""
+    for e in pipeline.elements:
+        if e.name in placeholders:
+            continue
+        ins = len(pipeline.in_links(e))
+        outs = len(pipeline.out_links(e))
+        if e.N_SINKS is not None and ins < e.N_SINKS:
+            report.add(
+                "NNS-E001", e.name,
+                f"{ins}/{e.N_SINKS} sink pads linked",
+                "link an upstream element into it",
+            )
+        elif e.N_SINKS is None and ins == 0 and not isinstance(e, Source):
+            report.add(
+                "NNS-E001", e.name,
+                f"{e.FACTORY_NAME} has no inputs linked",
+                "fan-in elements need at least one linked sink pad",
+            )
+        if e.N_SRCS is not None and e.N_SRCS > 0 and outs < e.N_SRCS:
+            report.add(
+                "NNS-W105", e.name,
+                f"{outs}/{e.N_SRCS} src pads linked; unlinked output is "
+                "dropped",
+                "terminate it into a sink (or fakesink)",
+            )
+        # explicit pad indices beyond the allocated pad count (e.g.
+        # 'mux.sink_5' with one branch linked): pad numbering must be
+        # dense, or negotiation indexes out of range at runtime
+        n_sinks = pipeline.n_sinks(e)
+        for l in pipeline.in_links(e):
+            if l.dst_pad >= n_sinks:
+                report.add(
+                    "NNS-E001", e.name,
+                    f"sink pad {l.dst_pad} linked but only pads "
+                    f"0..{n_sinks - 1} exist; lower-numbered pads are "
+                    "unlinked",
+                    "pad numbering must be dense from 0",
+                )
+        n_srcs = pipeline.n_srcs(e)
+        for l in pipeline.out_links(e):
+            if l.src_pad >= n_srcs:
+                report.add(
+                    "NNS-W105", e.name,
+                    f"src pad {l.src_pad} linked but only pads "
+                    f"0..{n_srcs - 1} exist; lower-numbered pads are "
+                    "unlinked",
+                    "pad numbering must be dense from 0",
+                )
+    _, leftover = pipeline.toposort_partial()
+    if leftover:
+        names = sorted(e.name for e in leftover)
+        report.add(
+            "NNS-E002", None,
+            f"pipeline has a cycle through {names}",
+            "use tensor_reposink/tensor_reposrc for feedback loops",
+        )
+    # placeholders with no inputs may well BE sources (unknown name in
+    # the source position): treat them as reachability seeds and never
+    # claim "no source" on their account
+    seeds = [
+        e for e in pipeline.elements
+        if isinstance(e, Source)
+        or (e.name in placeholders and not pipeline.in_links(e))
+    ]
+    if not seeds:
+        if pipeline.elements:
+            report.add(
+                "NNS-W104", None,
+                "pipeline has no source element; nothing will flow",
+            )
+    else:
+        reached: Set[Element] = set()
+        stack = list(seeds)
+        while stack:
+            e = stack.pop()
+            if e in reached:
+                continue
+            reached.add(e)
+            stack.extend(l.dst for l in pipeline.out_links(e))
+        in_cycle = set(leftover)
+        for e in pipeline.elements:
+            if e not in reached and e not in in_cycle \
+                    and e.name not in placeholders:
+                report.add(
+                    "NNS-W104", e.name,
+                    f"{e.FACTORY_NAME} is not reachable from any source",
+                )
+    return leftover
+
+
+def _queue_free_reach(pipeline: Pipeline, start: Element, goal: Element) -> bool:
+    """True if `goal` is reachable from `start` without crossing a queue."""
+    from nnstreamer_tpu.elements.flow import Queue
+
+    if isinstance(goal, Queue):
+        return False
+    seen: Set[Element] = set()
+    stack = [start]
+    while stack:
+        e = stack.pop()
+        if e in seen:
+            continue
+        seen.add(e)
+        if e is goal:
+            return True
+        if isinstance(e, Queue) and e is not start:
+            continue  # a queue on the path buffers it: stop this walk
+        stack.extend(l.dst for l in pipeline.out_links(e))
+    return False
+
+
+def _tee_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W103: fan-in element whose branches share a tee ancestor with at
+    least one branch carrying no queue between the tee and the fan-in —
+    the tee blocks on the unqueued branch while the fan-in waits for the
+    other, the textbook launch-string deadlock."""
+    from nnstreamer_tpu.elements.flow import Tee
+
+    for m in pipeline.elements:
+        ins = pipeline.in_links(m)
+        if len(ins) < 2:
+            continue
+        branch_anc: List[Set[Element]] = []
+        for l in ins:
+            anc: Set[Element] = set()
+            stack = [l.src]
+            while stack:
+                e = stack.pop()
+                if e in anc:
+                    continue
+                anc.add(e)
+                stack.extend(ll.src for ll in pipeline.in_links(e))
+            branch_anc.append(anc)
+        flagged: Set[Element] = set()
+        for i in range(len(ins)):
+            for j in range(i + 1, len(ins)):
+                shared = [
+                    t for t in branch_anc[i] & branch_anc[j]
+                    if isinstance(t, Tee) and t not in flagged
+                ]
+                for tee in shared:
+                    bad = [
+                        ins[k].dst_pad for k in (i, j)
+                        if _queue_free_reach(pipeline, tee, ins[k].src)
+                        or ins[k].src is tee
+                    ]
+                    if bad:
+                        flagged.add(tee)
+                        pads = ", ".join(f"sink_{p}" for p in bad)
+                        report.add(
+                            "NNS-W103", m.name,
+                            f"branches from tee {tee.name!r} reach "
+                            f"{m.name} ({pads}) without an intervening "
+                            "queue",
+                            "insert 'queue' after each tee branch",
+                        )
+
+
+# -- pass 4: resources -------------------------------------------------------
+
+def _resource_pass(
+    pipeline: Pipeline, report: LintReport
+) -> Set[str]:
+    """NNS-E006/E007/E008/W102. Returns names whose negotiate() would fail
+    for an already-reported reason (the spec pass skips them)."""
+    from nnstreamer_tpu.elements.converter import TensorConverter
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    skip: Set[str] = set()
+    for e in pipeline.elements:
+        if isinstance(e, TensorFilter):
+            fw = e.fprops.framework
+            if not registry.exists(registry.KIND_FILTER, fw):
+                known = registry.available(registry.KIND_FILTER)
+                report.add(
+                    "NNS-E006", e.name,
+                    f"framework={fw!r} names no registered backend",
+                    f"available: {', '.join(known)}",
+                )
+                skip.add(e.name)
+            for model in e.fprops.model:
+                if model.startswith("zoo:"):
+                    continue  # resolved from the in-package model zoo
+                if not os.path.exists(model):
+                    report.add(
+                        "NNS-W102", e.name,
+                        f"model file {model!r} does not exist",
+                        "the path is resolved at open time, relative to "
+                        "the working directory",
+                    )
+                    skip.add(e.name)
+        elif isinstance(e, TensorDecoder):
+            if e.mode and e.mode != "custom-code" \
+                    and not registry.exists(registry.KIND_DECODER, e.mode):
+                known = registry.available(registry.KIND_DECODER)
+                report.add(
+                    "NNS-E007", e.name,
+                    f"mode={e.mode!r} names no registered decoder",
+                    f"available: {', '.join(known)}",
+                )
+                skip.add(e.name)
+        elif isinstance(e, TensorConverter):
+            mode = e.mode
+            if mode and not str(mode).startswith("custom-") \
+                    and not registry.exists(registry.KIND_CONVERTER, str(mode)):
+                known = registry.available(registry.KIND_CONVERTER)
+                report.add(
+                    "NNS-E008", e.name,
+                    f"mode={mode!r} names no registered converter",
+                    f"available: {', '.join(known)}",
+                )
+                skip.add(e.name)
+    return skip
+
+
+# -- pass 2: dry-run spec flow -----------------------------------------------
+
+def _spec_pass(
+    pipeline: Pipeline,
+    report: LintReport,
+    placeholders: Set[str],
+    skip: Set[str],
+) -> Dict[str, List[Any]]:
+    """Run every element's negotiate() on a shallow CLONE in topological
+    order, collecting ALL NegotiationErrors. Returns name → out_specs of
+    the clones (for dot annotation). The user's pipeline is untouched and
+    nothing is started."""
+    order, _ = pipeline.toposort_partial()
+    clones: Dict[Element, Element] = {}
+    for e in order:
+        c = copy.copy(e)
+        c.in_specs = []
+        c.out_specs = []
+        clones[e] = c
+    specs_out: Dict[str, List[Any]] = {}
+    try:
+        for e in order:
+            clone = clones[e]
+            n_sinks = pipeline.n_sinks(e)
+            n_srcs = pipeline.n_srcs(e)
+            in_specs: List[Any] = [None] * n_sinks
+            for l in pipeline.in_links(e):
+                if not (0 <= l.dst_pad < n_sinks):
+                    continue  # sparse pad numbering: NNS-E001 already filed
+                up = clones.get(l.src)
+                if up is not None and l.src_pad < len(up.out_specs):
+                    in_specs[l.dst_pad] = up.out_specs[l.src_pad]
+            unknown_inputs = n_sinks > 0 and any(s is None for s in in_specs)
+            not_linked = len(pipeline.in_links(e)) < n_sinks
+            if (
+                e.name in placeholders
+                or e.name in skip
+                or unknown_inputs
+                or not_linked
+                or type(e).LINT_SKIP_NEGOTIATE
+            ):
+                clone.out_specs = [None] * n_srcs
+                continue
+            if isinstance(e, Routing):
+                clone.set_pad_counts(n_sinks, n_srcs)
+            try:
+                clone.fix_negotiation(in_specs)
+                if len(clone.out_specs) != n_srcs:
+                    raise ValueError(
+                        f"negotiated {len(clone.out_specs)} specs for "
+                        f"{n_srcs} src pads"
+                    )
+            except Exception as exc:
+                report.add(
+                    "NNS-E003", e.name,
+                    f"negotiation would fail: {exc}",
+                    "check upstream dimensions/types against what this "
+                    "element accepts",
+                )
+                clone.out_specs = [None] * n_srcs
+                continue
+            specs_out[e.name] = list(clone.out_specs)
+    finally:
+        for e, clone in clones.items():
+            # The only resource negotiate() opens is a tensor_filter
+            # backend. Release it IF the clone opened its own; never call
+            # a generic clone.stop() — shallow copies share the original's
+            # live files/sockets, and stopping them would close resources
+            # of a started user pipeline.
+            opened = getattr(clone, "backend", None)
+            if opened is not None and opened is not getattr(e, "backend", None):
+                try:
+                    clone.stop()
+                except Exception:
+                    pass
+    return specs_out
+
+
+# -- entry point -------------------------------------------------------------
+
+def lint(target: Union[str, Pipeline]) -> LintResult:
+    """Statically analyze a launch string or a constructed Pipeline.
+
+    Returns a :class:`LintResult`; ``result.exit_code`` follows the
+    0/1/2 = clean/warnings/errors contract. The pipeline is never started.
+    """
+    report = LintReport()
+    placeholders: Set[str] = set()
+    if isinstance(target, str):
+        pipeline = _build_tolerant(target, report, placeholders)
+        if pipeline is None:
+            return LintResult(report, None, {})
+    else:
+        pipeline = target
+        for e in pipeline.elements:
+            check_properties(type(e), e.props, e.name, report)
+    skip = _resource_pass(pipeline, report)
+    cyclic = _structure_pass(pipeline, report, placeholders)
+    _tee_pass(pipeline, report)
+    specs: Dict[str, List[Any]] = {}
+    if not cyclic:
+        specs = _spec_pass(pipeline, report, placeholders, skip)
+    return LintResult(report, pipeline, specs)
+
+
+def annotated_dot(result: LintResult) -> str:
+    """Graphviz dump with diagnostics painted onto the offending nodes and
+    the dry-run negotiated specs on the clean ones."""
+    if result.pipeline is None:
+        return 'digraph "unparseable" {}'
+    return result.pipeline.dump_dot(
+        diagnostics=result.diagnostics,
+        specs=result.negotiated_specs,
+    )

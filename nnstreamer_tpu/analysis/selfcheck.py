@@ -1,0 +1,77 @@
+"""nns-lint --self-check: the PROPERTIES schemas must cover the code.
+
+Every registered builtin element reads its configuration through
+``get_property("...")`` / ``props.pop("...")``; this check scans each
+element class's source for those literals and fails if any read property
+is missing from the class's merged ``PROPERTIES`` schema. The style gate
+(tools/check_style.py, tests/test_style.py) runs it, so a new element (or
+a new property on an old one) cannot land without schema coverage — the
+same role as the reference's gst-inspect property introspection staying
+in sync with the GObject param specs by construction.
+"""
+
+from __future__ import annotations
+
+import inspect
+import re
+from typing import Dict, List, Set
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.elements.base import PROPS_ANY
+
+_PROP_READ = re.compile(r"""(?:get_property|props\.pop)\(\s*["']([^"']+)["']""")
+
+# Properties consumed positionally/indirectly that the scan cannot see but
+# the schema intentionally documents anyway — nothing to do for these.
+
+
+def scan_class_properties(cls: type) -> Set[str]:
+    """Property names the class source reads (dash-normalized). Walks the
+    MRO so inherited reads (base Element, Sink) are attributed too."""
+    names: Set[str] = set()
+    for klass in cls.__mro__:
+        if klass is object:
+            continue
+        try:
+            src = inspect.getsource(klass)
+        except (OSError, TypeError):  # pragma: no cover - builtins only
+            continue
+        for m in _PROP_READ.finditer(src):
+            names.add(m.group(1).replace("_", "-"))
+    return names
+
+
+def self_check() -> List[str]:
+    """Return a list of problems (empty = all schemas cover their code)."""
+    problems: List[str] = []
+    seen: Dict[type, str] = {}
+    for name in registry.available(registry.KIND_ELEMENT):
+        try:
+            cls = registry.get(registry.KIND_ELEMENT, name)
+        except KeyError:  # restricted by runtime config
+            continue
+        if cls in seen:  # aliases (videotestsrc/testsrc) check once
+            continue
+        seen[cls] = name
+        schema = cls.property_schema()
+        if PROPS_ANY in schema:
+            continue
+        for prop in sorted(scan_class_properties(cls)):
+            if prop not in schema:
+                problems.append(
+                    f"{name} ({cls.__module__}.{cls.__name__}): property "
+                    f"{prop!r} is read by the code but missing from "
+                    "PROPERTIES"
+                )
+    return problems
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin wrapper
+    problems = self_check()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} schema gap(s)")
+        return 1
+    print("all element PROPERTIES schemas cover their code")
+    return 0

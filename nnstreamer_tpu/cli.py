@@ -54,6 +54,11 @@ def main(argv=None) -> int:
     ap.add_argument("description", nargs="?", help="pipeline description")
     ap.add_argument("--inspect", nargs="?", const="", default=None, metavar="ELEMENT")
     ap.add_argument("--dot", action="store_true", help="print graphviz, don't run")
+    ap.add_argument(
+        "--check", action="store_true",
+        help="statically lint the pipeline without starting it; "
+        "exit 0 clean / 1 warnings / 2 errors (see docs/linting.md)",
+    )
     ap.add_argument("--timeout", type=float, default=None, help="run timeout (s)")
     ap.add_argument("--stats", action="store_true", help="print per-node stats JSON")
     ap.add_argument(
@@ -76,6 +81,16 @@ def main(argv=None) -> int:
         return _inspect(args.inspect or None)
     if not args.description:
         ap.error("pipeline description required")
+
+    if args.check:
+        from nnstreamer_tpu.analysis import annotated_dot, lint
+
+        result = lint(args.description)
+        if args.dot:
+            print(annotated_dot(result))
+        elif not args.quiet or result.diagnostics:
+            print(result.render())
+        return result.exit_code
 
     from nnstreamer_tpu.elements.base import ElementError, NegotiationError
     from nnstreamer_tpu.pipeline.parse import ParseError, parse_pipeline

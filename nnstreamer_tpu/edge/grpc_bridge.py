@@ -28,12 +28,13 @@ from typing import List, Optional
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.converters.protobuf import frame_to_message, message_to_tensors
 from nnstreamer_tpu.elements.base import (
+    _parse_bool,
     ElementError,
     NegotiationError,
+    PropSpec,
     Sink,
     Source,
     Spec,
-    _parse_bool,
 )
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
 from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
@@ -173,6 +174,14 @@ class GrpcTensorSrc(Source):
 
     FACTORY_NAME = "tensor_src_grpc"
 
+    PROPERTIES = {
+        "server": PropSpec("bool", True),
+        "host": PropSpec("str", "127.0.0.1"),
+        "port": PropSpec("int", 0, desc="0 = ephemeral in server mode"),
+        "idl": PropSpec("enum", "protobuf", ("protobuf", "flatbuf")),
+        "connection-timeout": PropSpec("float", 10.0),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.is_server = _parse_bool(self.get_property("server", True))
@@ -289,6 +298,14 @@ class GrpcTensorSink(Sink):
     """
 
     FACTORY_NAME = "tensor_sink_grpc"
+
+    PROPERTIES = {
+        "server": PropSpec("bool", True),
+        "host": PropSpec("str", "127.0.0.1"),
+        "port": PropSpec("int", 0, desc="0 = ephemeral in server mode"),
+        "idl": PropSpec("enum", "protobuf", ("protobuf", "flatbuf")),
+        "connection-timeout": PropSpec("float", 10.0),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

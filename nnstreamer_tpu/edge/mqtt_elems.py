@@ -29,6 +29,7 @@ from nnstreamer_tpu.edge.serialize import decode_message, encode_message
 from nnstreamer_tpu.elements.base import (
     _parse_bool,
     ElementError,
+    PropSpec,
     Sink,
     Source,
     Spec,
@@ -68,6 +69,15 @@ class MqttSink(Sink):
     ntp-servers (comma list), client-id."""
 
     FACTORY_NAME = "mqttsink"
+
+    PROPERTIES = {
+        "host": PropSpec("str", "127.0.0.1", desc="broker host"),
+        "port": PropSpec("int", 1883, desc="broker port"),
+        "pub-topic": PropSpec("str", "", desc="required"),
+        "ntp-sync": PropSpec("bool", False),
+        "ntp-servers": PropSpec("str", "pool.ntp.org", desc="comma list"),
+        "client-id": PropSpec("str", ""),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -123,6 +133,15 @@ class MqttSrc(Source):
     ntp-sync, ntp-servers, client-id."""
 
     FACTORY_NAME = "mqttsrc"
+
+    PROPERTIES = {
+        "host": PropSpec("str", "127.0.0.1", desc="broker host"),
+        "port": PropSpec("int", 1883, desc="broker port"),
+        "sub-topic": PropSpec("str", "", desc="required; wildcards ok"),
+        "ntp-sync": PropSpec("bool", False),
+        "ntp-servers": PropSpec("str", "pool.ntp.org", desc="comma list"),
+        "client-id": PropSpec("str", ""),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

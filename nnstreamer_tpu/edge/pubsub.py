@@ -21,6 +21,7 @@ from nnstreamer_tpu.elements.base import (
     _parse_bool,
     ElementError,
     NegotiationError,
+    PropSpec,
     Sink,
     Source,
     Spec,
@@ -43,6 +44,16 @@ class EdgeSink(Sink):
     """
 
     FACTORY_NAME = "edgesink"
+
+    PROPERTIES = {
+        "host": PropSpec("str", "127.0.0.1"),
+        "port": PropSpec("int", 3000, desc="0 = ephemeral"),
+        "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "SHM")),
+        "topic": PropSpec("str", "nns-edge"),
+        "wait-connection": PropSpec("bool", False),
+        "connection-timeout": PropSpec("float", 10.0),
+        "shm-capacity": PropSpec("int", None, desc="SHM ring capacity"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -160,6 +171,13 @@ class EdgeSrc(Source):
     """
 
     FACTORY_NAME = "edgesrc"
+
+    PROPERTIES = {
+        "dest-host": PropSpec("str", "127.0.0.1"),
+        "dest-port": PropSpec("int", 3000),
+        "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "SHM")),
+        "topic": PropSpec("str", "nns-edge"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

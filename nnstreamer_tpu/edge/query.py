@@ -31,6 +31,7 @@ from nnstreamer_tpu.elements.base import (
     ElementError,
     HostElement,
     NegotiationError,
+    PropSpec,
     Sink,
     Source,
     Spec,
@@ -118,6 +119,14 @@ class TensorQueryClient(HostElement):
 
     FACTORY_NAME = "tensor_query_client"
 
+    PROPERTIES = {
+        "dest-host": PropSpec("str", "127.0.0.1"),
+        "dest-port": PropSpec("int", 0, desc="required"),
+        "timeout": PropSpec("float", 10.0, desc="per-request (s)"),
+        "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "HYBRID")),
+        "topic": PropSpec("str", "nns-query"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.host = str(self.get_property("dest-host", "127.0.0.1"))
@@ -190,6 +199,16 @@ class TensorQueryServerSrc(Source):
 
     FACTORY_NAME = "tensor_query_serversrc"
 
+    PROPERTIES = {
+        "host": PropSpec("str", "127.0.0.1"),
+        "port": PropSpec("int", 0, desc="0 = ephemeral"),
+        "id": PropSpec("str", "0", desc="pairing key with serversink"),
+        "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "HYBRID")),
+        "topic": PropSpec("str", "nns-query"),
+        "data-host": PropSpec("str", "127.0.0.1", desc="HYBRID data plane"),
+        "data-port": PropSpec("int", 0, desc="HYBRID data plane"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.host = str(self.get_property("host", "127.0.0.1"))
@@ -243,6 +262,10 @@ class TensorQueryServerSink(Sink):
     """
 
     FACTORY_NAME = "tensor_query_serversink"
+
+    PROPERTIES = {
+        "id": PropSpec("str", "0", desc="pairing key with serversrc"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -68,6 +68,28 @@ class ElementError(RuntimeError):
     pass
 
 
+@dataclass(frozen=True)
+class PropSpec:
+    """Declared schema of one element property — the GObject GParamSpec
+    analogue (the reference installs param specs per element so
+    gst-inspect and gst-validate can check properties before running;
+    here nns-lint consumes the same table).
+
+    type: "str" | "int" | "float" | "bool" | "fraction" | "enum".
+    choices: allowed values when type == "enum" (case-insensitive).
+    """
+
+    type: str = "str"
+    default: Any = None
+    choices: Tuple[str, ...] = ()
+    desc: str = ""
+
+
+# Wildcard key: an element whose PROPERTIES contains PROPS_ANY accepts
+# arbitrary extra properties (capsfilter carries raw caps fields).
+PROPS_ANY = "*"
+
+
 class Element:
     """Base element. Subclasses set N_SINKS/N_SRCS (None = request pads,
     decided at link time) and implement negotiate()."""
@@ -76,7 +98,39 @@ class Element:
     N_SINKS: Optional[int] = 1
     N_SRCS: Optional[int] = 1
 
+    # Set True on elements whose negotiate() allocates shared/global
+    # state (e.g. the LLM continuous-batcher registers a server in a
+    # module table): the static analyzer (nns-lint) must not dry-run
+    # their negotiation on clones.
+    LINT_SKIP_NEGOTIATE = False
+
+    # Per-class property schema (merged over the MRO by property_schema()).
+    # Subclasses add their own entries; nns-lint validates launch-string
+    # properties against the merged table and the style gate's self-check
+    # requires every constructor-read property to appear here.
+    PROPERTIES: Dict[str, PropSpec] = {
+        "name": PropSpec("str", None, desc="element instance name"),
+        "queue-size": PropSpec(
+            "int", 64, desc="input queue depth for this element's pads"
+        ),
+        "silent": PropSpec("bool", True, desc="suppress per-frame logging"),
+    }
+
     _instance_counters: Dict[str, int] = {}
+
+    @classmethod
+    def property_schema(cls) -> Dict[str, "PropSpec"]:
+        """Merged property schema over the class MRO (subclass wins)."""
+        schema: Dict[str, PropSpec] = {}
+        for klass in reversed(cls.__mro__):
+            own = klass.__dict__.get("PROPERTIES")
+            if own:
+                schema.update(own)
+        return schema
+
+    @classmethod
+    def accepts_any_property(cls) -> bool:
+        return PROPS_ANY in cls.property_schema()
 
     def __init__(self, name: Optional[str] = None, **props: Any) -> None:
         if name is None:
@@ -237,6 +291,12 @@ class Sink(Element):
 
     N_SINKS = 1
     N_SRCS = 0
+
+    PROPERTIES = {
+        "sync-window": PropSpec(
+            "int", 1, desc="frames the sink may trail the device stream"
+        ),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -24,6 +24,7 @@ from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
     HostElement,
     NegotiationError,
+    PropSpec,
     Routing,
     Sink,
     Source,
@@ -77,6 +78,20 @@ class TensorIf(HostElement):
     """
 
     FACTORY_NAME = "tensor_if"
+
+    PROPERTIES = {
+        "compared-value": PropSpec(
+            "enum", "A_VALUE",
+            ("A_VALUE", "TENSOR_AVERAGE_VALUE", "CUSTOM"),
+        ),
+        "compared-value-option": PropSpec("str", "0,0"),
+        "operator": PropSpec("str", "GT", desc="EQ/NE/GT/GE/LT/LE/..."),
+        "supplied-value": PropSpec("str", "0", desc="'V' or 'V1:V2' range"),
+        "then": PropSpec("str", "PASSTHROUGH"),
+        "then-option": PropSpec("str", ""),
+        "else": PropSpec("str", "SKIP"),
+        "else-option": PropSpec("str", ""),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -316,6 +331,13 @@ class TensorCrop(Routing):
     N_SINKS = 2
     N_SRCS = 1
 
+    PROPERTIES = {
+        "out-size": PropSpec(
+            "str", "", desc="'W:H' enables device-resident crop batch"
+        ),
+        "max-crops": PropSpec("int", 16),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._raw: deque = deque()
@@ -464,6 +486,10 @@ class TensorRepoSink(Sink):
 
     FACTORY_NAME = "tensor_reposink"
 
+    PROPERTIES = {
+        "slot-index": PropSpec("int", 0),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.slot_index = int(self.get_property("slot-index", 0))
@@ -482,6 +508,12 @@ class TensorRepoSrc(Source):
     buffer). Props: slot-index, dimensions, types."""
 
     FACTORY_NAME = "tensor_reposrc"
+
+    PROPERTIES = {
+        "slot-index": PropSpec("int", 0),
+        "dimensions": PropSpec("str", "1"),
+        "types": PropSpec("str", "float32"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

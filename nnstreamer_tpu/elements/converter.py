@@ -26,6 +26,7 @@ from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
     MediaSpec,
     NegotiationError,
+    PropSpec,
     Spec,
     TensorOp,
 )
@@ -60,6 +61,18 @@ class TensorConverter(TensorOp):
     subplugins, flexible→static) run as a host node instead."""
 
     FACTORY_NAME = "tensor_converter"
+
+    PROPERTIES = {
+        "frames-per-tensor": PropSpec("int", 1, desc="batch N frames"),
+        "mode": PropSpec(
+            "str", None,
+            desc="converter subplugin, custom-code:<name>, or "
+            "custom-script:<path.py>",
+        ),
+        "input-dim": PropSpec("str", None, desc="octet framing dims"),
+        "input-type": PropSpec("str", "uint8"),
+        "script": PropSpec("str", None, desc="python3 subplugin script path"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -18,7 +18,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
+from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Spec, TensorOp
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
 
@@ -48,6 +48,14 @@ class TensorDecoder(TensorOp):
     (host rasterization, label lookup, byte codecs) runs as a host node."""
 
     FACTORY_NAME = "tensor_decoder"
+
+    PROPERTIES = dict(
+        {"mode": PropSpec("str", None, desc="decoder subplugin name")},
+        **{
+            f"option{i}": PropSpec("str", "", desc="mode-specific option")
+            for i in range(1, 10)
+        },
+    )
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -29,7 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.backends.base import Backend, FilterProps, InvokeStats
-from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
+from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Spec, TensorOp
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
 
@@ -121,6 +121,26 @@ def _parse_combination(s: str, prefix_ok=("i", "o")) -> Optional[List[Tuple[str,
 @registry.element("tensor_filter")
 class TensorFilter(TensorOp):
     FACTORY_NAME = "tensor_filter"
+
+    PROPERTIES = {
+        "framework": PropSpec("str", "auto", desc="backend subplugin name"),
+        "model": PropSpec("str", "", desc="model path(s), comma-separated"),
+        "input": PropSpec("str", None, desc="input spec override (dims)"),
+        "inputtype": PropSpec("str", "float32"),
+        "inputname": PropSpec("str", ""),
+        "output": PropSpec("str", None, desc="output spec override (dims)"),
+        "outputtype": PropSpec("str", "float32"),
+        "outputname": PropSpec("str", ""),
+        "custom": PropSpec("str", "", desc="backend options 'k:v,k2:v2'"),
+        "accelerator": PropSpec("str", ""),
+        "invoke-dynamic": PropSpec("bool", False),
+        "is-updatable": PropSpec("bool", False, desc="allow reload_model()"),
+        "shared-tensor-filter-key": PropSpec(
+            "str", "", desc="filters with one key share one opened backend"
+        ),
+        "input-combination": PropSpec("str", ""),
+        "output-combination": PropSpec("str", ""),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

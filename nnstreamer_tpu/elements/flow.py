@@ -11,6 +11,8 @@ from typing import List, Tuple
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
     NegotiationError,
+    PROPS_ANY,
+    PropSpec,
     Routing,
     Spec,
     TensorOp,
@@ -46,6 +48,12 @@ class Queue(TensorOp):
 
     FACTORY_NAME = "queue"
 
+    PROPERTIES = {
+        "max-size-buffers": PropSpec(
+            "int", 64, desc="depth of the downstream element's input queue"
+        ),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         # matches the executor's default channel depth (elements/base.py):
@@ -73,6 +81,20 @@ class CapsFilter(TensorOp):
     to zero cost on tensor links, host passthrough on media links."""
 
     FACTORY_NAME = "capsfilter"
+
+    # caps tokens carry arbitrary media fields (media/width/height/...):
+    # the schema is open-ended, so PROPS_ANY opts out of unknown-property
+    # linting for this element only
+    PROPERTIES = {
+        "dimensions": PropSpec("str", None),
+        "types": PropSpec("str", "float32"),
+        "format": PropSpec("str", None),
+        "framerate": PropSpec("fraction", None),
+        "media": PropSpec("str", None),
+        "width": PropSpec("int", None),
+        "height": PropSpec("int", None),
+        PROPS_ANY: PropSpec("str", None, desc="raw caps fields pass through"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -38,7 +38,13 @@ from fractions import Fraction
 from typing import Dict, List, Optional
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import ElementError, NegotiationError, Source, Spec
+from nnstreamer_tpu.elements.base import (
+    ElementError,
+    NegotiationError,
+    PropSpec,
+    Source,
+    Spec,
+)
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
 from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
 
@@ -113,6 +119,18 @@ class TensorSrcIIO(Source):
     base-dir (sysfs root) / dev-dir (node dir) for tests/containers."""
 
     FACTORY_NAME = "tensor_src_iio"
+
+    PROPERTIES = {
+        "device": PropSpec("str", None, desc="iio device name"),
+        "device-number": PropSpec("int", None),
+        "frequency": PropSpec("float", 10.0, desc="sampling rate (Hz)"),
+        "num-frames": PropSpec("int", -1, desc="-1 = endless"),
+        "mode": PropSpec("enum", "oneshot", ("oneshot", "buffer")),
+        "buffer-length": PropSpec("int", 16),
+        "channels": PropSpec("str", "", desc="comma list; empty = all"),
+        "base-dir": PropSpec("str", None, desc="sysfs root override"),
+        "dev-dir": PropSpec("str", None, desc="device node dir override"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

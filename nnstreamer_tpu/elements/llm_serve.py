@@ -42,6 +42,7 @@ from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
     ElementError,
     NegotiationError,
+    PropSpec,
     Sink,
     Source,
     Spec,
@@ -317,6 +318,25 @@ class LlmServerSink(Sink):
 
     FACTORY_NAME = "tensor_llm_serversink"
 
+    # negotiate() builds the shared _LlmServer (full model load) and
+    # registers it in the module-global _table — nns-lint must not do
+    # that during a dry run
+    LINT_SKIP_NEGOTIATE = True
+
+    PROPERTIES = {
+        "id": PropSpec("str", "0", desc="pairing key with the serversrc"),
+        "model": PropSpec("str", "zoo:transformer_lm"),
+        "custom": PropSpec("str", "", desc="model options 'k:v,k2:v2'"),
+        "n-slots": PropSpec("int", 4),
+        "max-len": PropSpec("int", 256),
+        "prompt-len": PropSpec("int", 64),
+        "max-new-tokens": PropSpec("int", 16),
+        "stream": PropSpec("bool", False),
+        "speculate": PropSpec("str", "0", desc="k, or 'auto'"),
+        "speculate-model": PropSpec("str", "", desc="zoo:<draft model>"),
+        "pump": PropSpec("int", 1, desc="target tokens per launch"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.srv_id = str(self.get_property("id", "0"))
@@ -372,6 +392,11 @@ class LlmServerSrc(Source):
     the submitting frame's meta preserved (client_id routing)."""
 
     FACTORY_NAME = "tensor_llm_serversrc"
+
+    PROPERTIES = {
+        "id": PropSpec("str", "0", desc="pairing key with the serversink"),
+        "stream": PropSpec("bool", False),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -35,6 +35,7 @@ from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
     ElementError,
     MediaSpec,
+    PropSpec,
     Source,
     Spec,
     _parse_bool,
@@ -161,6 +162,15 @@ class VideoFileSrc(Source):
     thread), overlapping decode with downstream upload/inference."""
 
     FACTORY_NAME = "videofilesrc"
+
+    PROPERTIES = {
+        "location": PropSpec("str", "", desc="video/image file path"),
+        "format": PropSpec("enum", "RGB", ("RGB", "BGR", "RGBA", "GRAY8")),
+        "loop": PropSpec("bool", False),
+        "num-frames": PropSpec("int", -1, desc="-1 = whole file"),
+        "decode-ahead": PropSpec("int", 8, desc="0 = synchronous decode"),
+        "framerate": PropSpec("fraction", None, desc="override file rate"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -309,6 +319,16 @@ class V4l2Src(Source):
     the staleness window; 0 = synchronous capture)."""
 
     FACTORY_NAME = "v4l2src"
+
+    PROPERTIES = {
+        "device": PropSpec("str", 0, desc="V4L2 node or camera index"),
+        "format": PropSpec("enum", "RGB", ("RGB", "BGR", "RGBA", "GRAY8")),
+        "num-frames": PropSpec("int", -1),
+        "width": PropSpec("int", 0, desc="0 = camera default"),
+        "height": PropSpec("int", 0, desc="0 = camera default"),
+        "decode-ahead": PropSpec("int", 4, desc="0 = synchronous capture"),
+        "framerate": PropSpec("fraction", None),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -24,7 +24,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import NegotiationError, Routing, Spec
+from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Routing, Spec
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import (
     NNS_TENSOR_SIZE_LIMIT,
@@ -202,6 +202,15 @@ class TensorMux(Routing):
     N_SINKS = None
     N_SRCS = 1
 
+    PROPERTIES = {
+        "sync-mode": PropSpec(
+            "enum", "slowest", ("nosync", "slowest", "basepad", "refresh")
+        ),
+        "sync-option": PropSpec(
+            "str", "", desc="basepad: 'PAD' or 'PAD:DURATION' slack"
+        ),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.sync_mode = str(self.get_property("sync-mode", "slowest"))
@@ -249,6 +258,17 @@ class TensorMerge(Routing):
     FACTORY_NAME = "tensor_merge"
     N_SINKS = None
     N_SRCS = 1
+
+    PROPERTIES = {
+        "mode": PropSpec("enum", "linear", ("linear",)),
+        "option": PropSpec("int", 0, desc="reference dim index to merge on"),
+        "sync-mode": PropSpec(
+            "enum", "slowest", ("nosync", "slowest", "basepad", "refresh")
+        ),
+        "sync-option": PropSpec(
+            "str", "", desc="basepad: 'PAD' or 'PAD:DURATION' slack"
+        ),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -316,6 +336,12 @@ class TensorDemux(Routing):
     N_SINKS = 1
     N_SRCS = None
 
+    PROPERTIES = {
+        "tensorpick": PropSpec(
+            "str", "", desc="select/reorder: '0,2' or grouped '0:1,2'"
+        ),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         pick = str(self.get_property("tensorpick", ""))
@@ -361,6 +387,12 @@ class TensorSplit(Routing):
     FACTORY_NAME = "tensor_split"
     N_SINKS = 1
     N_SRCS = None
+
+    PROPERTIES = {
+        "tensorseg": PropSpec(
+            "str", None, desc="per-output dims along the split axis"
+        ),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

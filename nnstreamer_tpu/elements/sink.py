@@ -14,7 +14,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import Sink, Spec
+from nnstreamer_tpu.elements.base import PropSpec, Sink, Spec
 from nnstreamer_tpu.tensors.frame import Frame
 
 
@@ -29,6 +29,14 @@ class TensorSink(Sink):
     """
 
     FACTORY_NAME = "tensor_sink"
+
+    PROPERTIES = {
+        "max-stored": PropSpec("int", 0, desc="retained frames; 0 = all"),
+        "signal-rate": PropSpec(
+            "float", 0, desc="max new-data callbacks/sec; 0 = every frame"
+        ),
+        "sync": PropSpec("bool", False, desc="unused placeholder"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -68,6 +76,10 @@ class AppSink(Sink):
 
     FACTORY_NAME = "appsink"
 
+    PROPERTIES = {
+        "max-buffers": PropSpec("int", 0, desc="pop queue bound; 0 = unbounded"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._queue: queue_mod.Queue = queue_mod.Queue(
@@ -93,6 +105,12 @@ class FileSink(Sink):
     (multifilesink parity, what SSAT golden tests compare)."""
 
     FACTORY_NAME = "filesink"
+
+    PROPERTIES = {
+        "location": PropSpec(
+            "str", "", desc="output path; %d = one file per frame"
+        ),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -135,6 +153,12 @@ class FakeSink(Sink):
     # never reads tensor data: the executor must not prefetch host
     # copies on its behalf (SinkNode sync-window path)
     READS_HOST = False
+
+    PROPERTIES = {
+        "sync-device": PropSpec(
+            "bool", True, desc="block until the device future completes"
+        ),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

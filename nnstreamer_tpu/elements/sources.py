@@ -14,7 +14,7 @@ from typing import Iterable, Iterator, List, Optional
 import numpy as np
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import MediaSpec, Source, Spec
+from nnstreamer_tpu.elements.base import MediaSpec, PropSpec, Source, Spec
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame, SECOND
 from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
 
@@ -46,6 +46,26 @@ class VideoTestSrc(Source):
 
     FACTORY_NAME = "videotestsrc"
 
+    PROPERTIES = {
+        "width": PropSpec("int", 320),
+        "height": PropSpec("int", 240),
+        "format": PropSpec(
+            "enum", "RGB", ("RGB", "BGR", "RGBA", "BGRx", "GRAY8")
+        ),
+        "num-frames": PropSpec("int", 10, desc="-1 = endless"),
+        "num-buffers": PropSpec("int", 10, desc="alias of num-frames"),
+        "pattern": PropSpec(
+            "enum", "gradient",
+            ("smpte", "gradient", "solid", "random", "counter"),
+        ),
+        "framerate": PropSpec("fraction", "30/1"),
+        "seed": PropSpec("int", 0, desc="rng seed for pattern=random"),
+        "foreground-color": PropSpec("int", 128, desc="pattern=solid fill"),
+        "device": PropSpec("bool", False, desc="frames born device-resident"),
+        "stamp-wall": PropSpec("bool", False, desc="record generation wall-clock"),
+        "is-live": PropSpec("bool", False, desc="pace generation at framerate"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.width = int(self.get_property("width", 320))
@@ -54,7 +74,7 @@ class VideoTestSrc(Source):
         self.num_frames = int(
             self.get_property("num-frames", self.get_property("num-buffers", 10))
         )
-        self.pattern = str(self.get_property("pattern", "gradient"))
+        self.pattern = str(self.get_property("pattern", "gradient")).lower()
         self.rate = Fraction(str(self.get_property("framerate", "30/1")))
         self.seed = int(self.get_property("seed", 0))
         # device=true: frames are born device-resident (pattern math runs
@@ -187,6 +207,14 @@ class AudioTestSrc(Source):
 
     FACTORY_NAME = "audiotestsrc"
 
+    PROPERTIES = {
+        "rate": PropSpec("int", 16000, desc="sample rate (Hz)"),
+        "channels": PropSpec("int", 1),
+        "samples-per-buffer": PropSpec("int", 1024),
+        "num-buffers": PropSpec("int", 10),
+        "freq": PropSpec("float", 440.0, desc="sine frequency (Hz)"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.sample_rate = int(self.get_property("rate", 16000))
@@ -230,6 +258,11 @@ class AppSrc(Source):
     """
 
     FACTORY_NAME = "appsrc"
+
+    PROPERTIES = {
+        "dimensions": PropSpec("str", None, desc="output spec dims"),
+        "types": PropSpec("str", "float32"),
+    }
 
     def __init__(self, name=None, iterable: Optional[Iterable] = None,
                  spec: Optional[Spec] = None, **props):
@@ -281,6 +314,11 @@ class FileSrc(Source):
 
     FACTORY_NAME = "filesrc"
 
+    PROPERTIES = {
+        "location": PropSpec("str", "", desc="file path to read"),
+        "blocksize": PropSpec("int", 0, desc="0 = whole file in one buffer"),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.location = str(self.get_property("location", ""))
@@ -327,6 +365,17 @@ class TensorSrc(Source):
 
     FACTORY_NAME = "tensorsrc"
 
+    PROPERTIES = {
+        "dimensions": PropSpec("str", "1"),
+        "types": PropSpec("str", "float32"),
+        "pattern": PropSpec(
+            "enum", "counter", ("zeros", "ones", "counter", "random")
+        ),
+        "num-frames": PropSpec("int", 10),
+        "framerate": PropSpec("fraction", None),
+        "seed": PropSpec("int", 0),
+    }
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.spec = TensorsSpec.from_strings(
@@ -335,7 +384,7 @@ class TensorSrc(Source):
             rate=self.get_property("framerate"),
         )
         self.num_frames = int(self.get_property("num-frames", 10))
-        self.pattern = str(self.get_property("pattern", "counter"))
+        self.pattern = str(self.get_property("pattern", "counter")).lower()
         self.seed = int(self.get_property("seed", 0))
         self._i = 0
         self._rng = np.random.default_rng(self.seed)

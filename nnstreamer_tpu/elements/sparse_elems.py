@@ -14,7 +14,7 @@ from typing import List
 import numpy as np
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import HostElement, NegotiationError, Spec
+from nnstreamer_tpu.elements.base import HostElement, NegotiationError, PropSpec, Spec
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.sparse import sparse_decode, sparse_encode
 from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
@@ -42,6 +42,11 @@ class TensorSparseEnc(HostElement):
 @registry.element("tensor_sparse_dec")
 class TensorSparseDec(HostElement):
     FACTORY_NAME = "tensor_sparse_dec"
+
+    PROPERTIES = {
+        "dimensions": PropSpec("str", None, desc="declared dense out dims"),
+        "types": PropSpec("str", "float32"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -26,13 +26,18 @@ import time
 from typing import List
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import HostElement, Spec, _parse_bool
+from nnstreamer_tpu.elements.base import HostElement, PropSpec, Spec, _parse_bool
 from nnstreamer_tpu.tensors.frame import Frame
 
 
 @registry.element("tensor_stage")
 class TensorStage(HostElement):
     """Uploads each frame's tensors to the device, spec-passthrough."""
+
+    PROPERTIES = {
+        "stamp": PropSpec("bool", False, desc="record staged_at meta"),
+        "device": PropSpec("int", None, desc="jax.devices() index"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

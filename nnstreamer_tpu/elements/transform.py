@@ -28,7 +28,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax.numpy as jnp
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import NegotiationError, Spec, TensorOp
+from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Spec, TensorOp
 from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
 
 _ARITH_OP = re.compile(
@@ -49,6 +49,15 @@ def _ref_axis(canonical_rank: int, ref_dim: int) -> int:
 @registry.element("tensor_transform")
 class TensorTransform(TensorOp):
     FACTORY_NAME = "tensor_transform"
+
+    PROPERTIES = {
+        "mode": PropSpec(
+            "enum", None,
+            ("typecast", "arithmetic", "transpose", "dimchg", "clamp",
+             "stand"),
+        ),
+        "option": PropSpec("str", "", desc="per-mode option string"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -18,7 +18,7 @@ import time
 from typing import List, Optional, Union
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import HostElement, NegotiationError, Spec
+from nnstreamer_tpu.elements.base import HostElement, NegotiationError, PropSpec, Spec
 from nnstreamer_tpu.tensors.frame import Frame, SECOND
 from nnstreamer_tpu.tensors.spec import TensorSpec, TensorsSpec
 from fractions import Fraction
@@ -38,6 +38,16 @@ class TensorAggregator(HostElement):
     """
 
     FACTORY_NAME = "tensor_aggregator"
+
+    PROPERTIES = {
+        "frames-in": PropSpec("int", 1),
+        "frames-out": PropSpec("int", 1),
+        "frames-flush": PropSpec("int", 0, desc="0 = frames-out (tumbling)"),
+        "frames-dim": PropSpec(
+            "int", None, desc="innermost-first dim index to concat along"
+        ),
+        "concat": PropSpec("bool", True),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -156,6 +166,12 @@ class TensorRate(HostElement):
     """
 
     FACTORY_NAME = "tensor_rate"
+
+    PROPERTIES = {
+        "framerate": PropSpec("fraction", None, desc="target rate"),
+        "throttle": PropSpec("bool", False),
+        "qos": PropSpec("bool", True),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

@@ -133,7 +133,11 @@ class Pipeline:
         return e.N_SINKS if e.N_SINKS is not None else len(self.in_links(e))
 
     # -- negotiation -------------------------------------------------------
-    def _toposort(self) -> List[Element]:
+    def toposort_partial(self) -> Tuple[List[Element], List[Element]]:
+        """Kahn's algorithm; returns (topological order, leftover). A
+        non-empty leftover means those elements sit in (or behind) a
+        cycle. The static analyzer consumes the partial form; negotiate()
+        treats leftover as fatal via _toposort()."""
         indeg = {e: len(self.in_links(e)) for e in self.elements}
         ready = [e for e in self.elements if indeg[e] == 0]
         order: List[Element] = []
@@ -144,8 +148,14 @@ class Pipeline:
                 indeg[l.dst] -= 1
                 if indeg[l.dst] == 0:
                     ready.append(l.dst)
-        if len(order) != len(self.elements):
-            cyclic = [e.name for e in self.elements if e not in order]
+        ordered = set(order)
+        leftover = [e for e in self.elements if e not in ordered]
+        return order, leftover
+
+    def _toposort(self) -> List[Element]:
+        order, leftover = self.toposort_partial()
+        if leftover:
+            cyclic = [e.name for e in leftover]
             raise NegotiationError(
                 f"pipeline has a cycle through {cyclic}; use tensor_repo "
                 "(reposink/reposrc) for feedback loops"
@@ -297,15 +307,46 @@ class Pipeline:
         if self._executor is not None:
             self._executor.stop()
 
-    def dump_dot(self) -> str:
-        """Graphviz dump (reference GST_DEBUG_DUMP_DOT_DIR parity)."""
+    def dump_dot(self, diagnostics=None, specs=None) -> str:
+        """Graphviz dump (reference GST_DEBUG_DUMP_DOT_DIR parity).
+
+        `diagnostics`: optional iterable of nns-lint Diagnostics; offending
+        nodes are painted (red = error, orange = warning) with their codes
+        appended to the label, and pipeline-level findings become the
+        graph label. `specs`: optional {element name: out_specs} override
+        for the spec line (nns-lint's dry-run results — this pipeline's
+        own elements stay un-negotiated)."""
+        by_elem: Dict[str, List] = {}
+        graph_level: List[str] = []
+        for d in diagnostics or ():
+            if d.element is None:
+                graph_level.append(d.code)
+            else:
+                by_elem.setdefault(d.element, []).append(d)
         lines = [f'digraph "{self.name}" {{', "  rankdir=LR;"]
+        if graph_level:
+            lines.append(f'  label="{" ".join(sorted(set(graph_level)))}";')
         for e in self.elements:
             spec = ""
-            if e.out_specs:
-                s = e.out_specs[0]
+            out = (specs or {}).get(e.name) or e.out_specs
+            if out:
+                s = out[0]
                 spec = f"\\n{s}" if s is not None else ""
-            lines.append(f'  "{e.name}" [label="{e.FACTORY_NAME}\\n{e.name}{spec}", shape=box];')
+            style = ""
+            diags = by_elem.get(e.name)
+            if diags:
+                codes = " ".join(sorted({d.code for d in diags}))
+                spec += f"\\n{codes}"
+                worst = (
+                    "red"
+                    if any(d.severity.value == "error" for d in diags)
+                    else "orange"
+                )
+                style = f', style=filled, fillcolor="{worst}"'
+            lines.append(
+                f'  "{e.name}" [label="{e.FACTORY_NAME}\\n{e.name}{spec}"'
+                f", shape=box{style}];"
+            )
         for l in self.links:
             lines.append(f'  "{l.src.name}" -> "{l.dst.name}" [label="{l.src_pad}→{l.dst_pad}"];')
         lines.append("}")

@@ -53,7 +53,9 @@ def _parse_caps(token: str) -> Tuple[str, Dict[str, str]]:
         if "=" not in p:
             raise ParseError(f"bad caps field {p!r} in {token!r}")
         k, v = p.split("=", 1)
-        v = re.sub(r"^\((string|int|fraction)\)", "", v.strip())
+        # strip any '(type)' annotation — (string), (int), (fraction),
+        # (boolean), (uint), ... must never leak into the field value
+        v = re.sub(r"^\([A-Za-z]\w*\)", "", v.strip())
         fields[k.strip()] = v
     return media, fields
 
@@ -161,11 +163,18 @@ def _scan(tokens: List[str]):
     return items
 
 
-def parse_pipeline(description: str) -> Pipeline:
+def scan_description(description: str):
+    """Tokenize + scan a launch string into structural items without
+    instantiating anything — the shared front end of parse_pipeline and
+    the static analyzer (nnstreamer_tpu.analysis). Raises ParseError."""
     tokens = _tokenize(description)
     if not tokens:
         raise ParseError("empty pipeline description")
-    items = _scan(tokens)
+    return _scan(tokens)
+
+
+def parse_pipeline(description: str) -> Pipeline:
+    items = scan_description(description)
     # pass 1: instantiate all elements so forward references ('! mux.sink_0'
     # before 'tensor_mux name=mux' appears, gst-launch-legal) resolve
     b = _Builder()
@@ -176,7 +185,22 @@ def parse_pipeline(description: str) -> Pipeline:
             cls = registry.get(registry.KIND_ELEMENT, factory)
             props = dict(props)
             elem_name = props.pop("name", None)
-            elem = cls(name=elem_name, **props)
+            try:
+                elem = cls(name=elem_name, **props)
+            except TypeError as exc:
+                # a bare TypeError from cls(**props) is useless to the
+                # user — name the element and the offending property
+                m = re.search(r"unexpected keyword argument '([^']+)'",
+                              str(exc))
+                what = (
+                    f"unknown property {m.group(1)!r}" if m
+                    else f"bad properties {sorted(props)}"
+                )
+                raise ParseError(
+                    f"element {factory!r}"
+                    f"{f' (name={elem_name})' if elem_name else ''}: "
+                    f"{what}: {exc}"
+                ) from exc
             b.pipeline.add(elem)
             instances.append(elem)
         elif item[0] == "caps":

@@ -110,21 +110,8 @@ def _load_from_search_paths(kind: str, name: str) -> bool:
     return False
 
 
-def get(kind: str, name: str) -> Any:
-    """get_subplugin analogue with lazy loading; raises KeyError on miss."""
-    name = name.lower()
-    if kind == KIND_ELEMENT:
-        # product element restriction (reference meson_options.txt:40-41
-        # element-restriction whitelist): [common] restricted_elements =
-        # comma list; empty = everything allowed
-        from nnstreamer_tpu.config import conf
-
-        allowed = conf().get_list("common", "restricted_elements")
-        if allowed and name not in [a.lower() for a in allowed]:
-            raise KeyError(
-                f"element {name!r} is restricted by configuration "
-                "([common] restricted_elements)"
-            )
+def _resolve(kind: str, name: str) -> Any:
+    """Lazy-loading lookup, ignoring the element restriction whitelist."""
     with _lock:
         if name not in _registry[kind]:
             _load_builtins(kind)
@@ -136,6 +123,67 @@ def get(kind: str, name: str) -> Any:
                 f"no {kind} subplugin named {name!r}; known: {sorted(_registry[kind])}"
             )
         return _registry[kind][name]
+
+
+def exists(kind: str, name: str, *, builtin_only: bool = False) -> bool:
+    """True if the subplugin resolves (restriction whitelist NOT applied) —
+    the static analyzer's resource checks use this.
+
+    builtin_only=True probes builtins/already-registered names WITHOUT
+    entry-point or search-path plugin loading — the only safe probe for a
+    name the restriction whitelist blocks (loading would execute code
+    the whitelist exists to keep out)."""
+    name = name.lower()
+    if builtin_only:
+        with _lock:
+            _load_builtins(kind)
+            return name in _registry[kind]
+    try:
+        _resolve(kind, name)
+        return True
+    except KeyError:
+        return False
+
+
+def is_restricted(kind: str, name: str) -> bool:
+    """True if [common] restricted_elements is active and blocks `name`
+    (regardless of whether the element exists)."""
+    if kind != KIND_ELEMENT:
+        return False
+    allowed = conf().get_list("common", "restricted_elements")
+    return bool(allowed) and name.lower() not in [a.lower() for a in allowed]
+
+
+def get(kind: str, name: str) -> Any:
+    """get_subplugin analogue with lazy loading; raises KeyError on miss."""
+    name = name.lower()
+    if kind == KIND_ELEMENT:
+        # product element restriction (reference meson_options.txt:40-41
+        # element-restriction whitelist): [common] restricted_elements =
+        # comma list; empty = everything allowed
+        from nnstreamer_tpu.config import conf
+
+        allowed = conf().get_list("common", "restricted_elements")
+        if allowed and name not in [a.lower() for a in allowed]:
+            # distinguish "blocked" from "no such element" so the user
+            # knows whether fixing the config would help — but probe ONLY
+            # builtins/already-registered names: a restricted name must
+            # never trigger entry-point or search-path plugin EXECUTION
+            with _lock:
+                _load_builtins(kind)
+                known = name in _registry[kind]
+            if not known:
+                raise KeyError(
+                    f"no element subplugin named {name!r} (note: "
+                    f"[common] restricted_elements is active; allowed: "
+                    f"{sorted(a.lower() for a in allowed)})"
+                )
+            raise KeyError(
+                f"element {name!r} exists but is restricted by "
+                f"configuration ([common] restricted_elements allows: "
+                f"{sorted(a.lower() for a in allowed)})"
+            )
+    return _resolve(kind, name)
 
 
 def available(kind: str) -> List[str]:

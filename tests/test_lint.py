@@ -1,0 +1,511 @@
+"""nns-lint static analyzer: the bad-pipeline table (every diagnostic
+code), multi-error collection, the never-executes guarantee, exit codes,
+and the docs/examples lint-clean sweep."""
+
+import ast
+import os
+import re
+
+import pytest
+
+from nnstreamer_tpu.analysis import Severity, lint
+from nnstreamer_tpu.pipeline.parse import ParseError, parse_pipeline, scan_description
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLEAN = (
+    "tensorsrc dimensions=4 num-frames=2 ! "
+    "tensor_transform mode=typecast option=float32 ! tensor_sink"
+)
+
+# (description, expected diagnostic codes — subset of what's reported)
+BAD_PIPELINES = [
+    ("tensorsrc ! frobnicator ! tensor_sink", {"NNS-E004"}),
+    (
+        "tensorsrc dimensions=4 ! "
+        "other/tensors,dimensions=(string)8,types=(string)float32 ! "
+        "tensor_sink",
+        {"NNS-E003"},
+    ),
+    (
+        "tensor_transform mode=typecast option=float32 ! tensor_sink",
+        {"NNS-E001"},
+    ),
+    (
+        # b feeds back into a: cycle
+        "tensor_transform name=a mode=typecast option=float32 ! "
+        "tensor_transform name=b mode=typecast option=float32 ! a.",
+        {"NNS-E002"},
+    ),
+    ("tensorsrc frobnicate=1 ! tensor_sink", {"NNS-W101"}),
+    (
+        "videotestsrc width=banana ! tensor_converter ! tensor_sink",
+        {"NNS-E005"},
+    ),
+    (
+        "tensorsrc ! tensor_filter framework=jax model=/no/such/model.pt ! "
+        "tensor_sink",
+        {"NNS-W102"},
+    ),
+    (
+        "tensorsrc ! tensor_filter framework=nosuchfw model=/no/x.foo ! "
+        "tensor_sink",
+        {"NNS-E006"},
+    ),
+    ("tensorsrc ! tensor_decoder mode=nosuchmode ! tensor_sink", {"NNS-E007"}),
+    (
+        "videotestsrc ! tensor_converter mode=nosuchsub ! tensor_sink",
+        {"NNS-E008"},
+    ),
+    ("tensorsrc !", {"NNS-E009"}),
+    (
+        # two tee branches into a mux with no queues: deadlock topology
+        "videotestsrc num-frames=2 ! tee name=t "
+        "t. ! tensor_converter ! mux.sink_0 "
+        "t. ! tensor_converter ! mux.sink_1 "
+        "tensor_mux name=mux ! tensor_sink",
+        {"NNS-W103"},
+    ),
+    (
+        # second chain is an island: unreachable + unlinked input
+        "tensorsrc dimensions=4 ! tensor_sink "
+        "tensor_transform name=x mode=typecast option=float32 ! "
+        "tensor_sink name=s2",
+        {"NNS-W104", "NNS-E001"},
+    ),
+    (
+        # a source whose output goes nowhere
+        "tensorsrc name=a dimensions=4 "
+        "tensorsrc name=b dimensions=4 ! tensor_sink",
+        {"NNS-W105"},
+    ),
+]
+
+
+class TestBadPipelineTable:
+    @pytest.mark.parametrize(
+        "description,expected",
+        BAD_PIPELINES,
+        ids=[", ".join(sorted(e)) for _, e in BAD_PIPELINES],
+    )
+    def test_expected_codes_reported(self, description, expected):
+        result = lint(description)
+        assert expected <= set(result.codes), (
+            f"wanted {sorted(expected)} in {result.codes}:\n{result.render()}"
+        )
+        assert result.exit_code != 0
+
+    def test_at_least_eight_distinct_codes_covered(self):
+        seen = set()
+        for _, expected in BAD_PIPELINES:
+            seen |= expected
+        assert len(seen) >= 8, sorted(seen)
+
+    def test_clean_pipeline_is_clean(self):
+        result = lint(CLEAN)
+        assert result.codes == []
+        assert result.exit_code == 0
+
+    def test_queued_tee_branches_are_clean(self):
+        result = lint(
+            "videotestsrc num-frames=2 ! tee name=t "
+            "t. ! queue ! tensor_converter ! mux.sink_0 "
+            "t. ! queue ! tensor_converter ! mux.sink_1 "
+            "tensor_mux name=mux ! tensor_sink"
+        )
+        assert "NNS-W103" not in result.codes, result.render()
+
+    def test_restricted_element_distinguished(self, monkeypatch):
+        import nnstreamer_tpu.config as config_mod
+
+        monkeypatch.setenv(
+            "NNS_TPU_COMMON_RESTRICTED_ELEMENTS", "tensorsrc,tensor_sink"
+        )
+        config_mod.reload_conf()
+        try:
+            result = lint(
+                "tensorsrc dimensions=4 ! tensor_transform mode=typecast "
+                "option=float32 ! tensor_sink"
+            )
+            assert "NNS-E010" in result.codes, result.render()
+            # a NONEXISTENT element still reports unknown, not restricted
+            result = lint("tensorsrc dimensions=4 ! frobnicator ! tensor_sink")
+            assert "NNS-E004" in result.codes
+            assert "NNS-E010" not in [
+                d.code for d in result.diagnostics
+                if d.element == "frobnicator"
+            ]
+        finally:
+            monkeypatch.delenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS")
+            config_mod.reload_conf()
+
+
+class TestCollection:
+    def test_multiple_errors_one_run(self):
+        result = lint(
+            "tensorsrc bogus=1 ! frobnicator ! "
+            "tensor_decoder mode=nope ! tensor_sink"
+        )
+        assert {"NNS-W101", "NNS-E004", "NNS-E007"} <= set(result.codes)
+        assert len(result.diagnostics) >= 3
+
+    def test_every_caps_mismatch_reported_not_first_only(self):
+        # two INDEPENDENT mismatches (parallel chains): both must surface
+        result = lint(
+            "tensorsrc name=s1 dimensions=4 ! "
+            "other/tensors,dimensions=(string)8 ! tensor_sink name=k1 "
+            "tensorsrc name=s2 dimensions=2 ! "
+            "other/tensors,dimensions=(string)9 ! tensor_sink name=k2"
+        )
+        mismatches = [d for d in result.diagnostics if d.code == "NNS-E003"]
+        assert len(mismatches) >= 2, result.render()
+
+    def test_diagnostics_are_structured(self):
+        result = lint("tensorsrc ! tensor_decoder mode=nope ! tensor_sink")
+        (d,) = [x for x in result.diagnostics if x.code == "NNS-E007"]
+        assert d.severity is Severity.ERROR
+        assert d.element and d.element.startswith("tensor_decoder")
+        assert "nope" in d.message
+        assert d.hint  # actionable advice present
+        assert d.slug == "unknown-decoder"
+
+
+class TestReviewRegressions:
+    def test_out_of_range_pad_ref_is_diagnosed_not_crash(self):
+        result = lint(
+            "videotestsrc num-frames=2 ! tensor_converter ! m.sink_5 "
+            "tensor_mux name=m ! tensor_sink"
+        )
+        assert "NNS-E001" in result.codes, result.render()
+        assert any(
+            d.element == "m" and "sink pad 5" in d.message
+            for d in result.diagnostics
+        ), result.render()
+
+    def test_lint_does_not_close_started_pipeline_resources(self, tmp_path):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline as pp
+
+        out = tmp_path / "out.bin"
+        p = pp(
+            "tensorsrc dimensions=4 num-frames=2 ! "
+            f"filesink name=fs location={out}"
+        )
+        p.negotiate()
+        p["fs"].start()  # opens the file like the executor would
+        try:
+            assert lint(p).exit_code == 0
+            assert not p["fs"]._file.closed, (
+                "lint closed a started sink's file handle"
+            )
+        finally:
+            p["fs"].stop()
+
+    def test_dot_paints_unnamed_element_diagnostics(self):
+        from nnstreamer_tpu.analysis import annotated_dot
+
+        result = lint(
+            "videotestsrc numframes=2 ! tensor_converter ! tensor_sink"
+        )
+        assert "NNS-W101" in result.codes
+        dot = annotated_dot(result)
+        assert "NNS-W101" in dot and "fillcolor" in dot, dot
+
+    def test_unknown_element_diagnostic_matches_its_node(self):
+        from nnstreamer_tpu.analysis import annotated_dot
+
+        result = lint("tensorsrc dimensions=4 ! frobnicator ! tensor_sink")
+        dot = annotated_dot(result)
+        assert "NNS-E004" in dot, dot
+
+    def test_uppercase_enum_value_lints_clean_and_runs(self):
+        desc = (
+            "videotestsrc pattern=RANDOM num-frames=1 ! tensor_converter ! "
+            "tensor_sink name=out"
+        )
+        assert lint(desc).exit_code == 0
+        p = parse_pipeline(desc)
+        p.run(timeout=60)
+        assert p["out"].rendered == 1
+
+    def test_unrecognized_bool_is_warning_not_error(self):
+        # runtime _parse_bool silently reads 'maybe' as false, so --check
+        # must not hard-fail a pipeline that actually runs
+        result = lint(
+            "tensorsrc dimensions=4 silent=maybe num-frames=1 ! tensor_sink"
+        )
+        assert "NNS-W106" in result.codes, result.render()
+        assert result.exit_code == 1
+
+    def test_ctor_resource_failure_is_not_bad_property_value(self):
+        result = lint(
+            "videofilesrc location=/no/such/clip.mp4 ! tensor_converter ! "
+            "tensor_sink"
+        )
+        assert "NNS-E011" in result.codes, result.render()
+        assert "NNS-E005" not in result.codes, result.render()
+
+    def test_restricted_probe_does_not_execute_plugin_files(
+        self, monkeypatch, tmp_path
+    ):
+        # a restricted (non-whitelisted) name must never trigger plugin
+        # file execution — neither registry.get phrasing its error nor
+        # the linter classifying restricted-vs-unknown
+        import nnstreamer_tpu.config as config_mod
+        from nnstreamer_tpu import registry
+
+        trap = tmp_path / "nns_element_evilplugin.py"
+        trap.write_text("raise SystemExit('plugin executed during probe')\n")
+        monkeypatch.setenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS", "tensorsrc")
+        monkeypatch.setenv("NNS_TPU_ELEMENT_PLUGIN_PATHS", str(tmp_path))
+        config_mod.reload_conf()
+        try:
+            with pytest.raises(KeyError, match="no element subplugin"):
+                registry.get(registry.KIND_ELEMENT, "evilplugin")
+            result = lint("evilplugin ! tensor_sink")  # must not SystemExit
+            assert "NNS-E004" in result.codes, result.render()
+        finally:
+            monkeypatch.delenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS")
+            monkeypatch.delenv("NNS_TPU_ELEMENT_PLUGIN_PATHS")
+            config_mod.reload_conf()
+
+    def test_llm_serversink_negotiate_not_dry_run(self):
+        # LlmServerSink.negotiate() loads a model and registers a server
+        # in the module-global table — lint must skip it entirely
+        from nnstreamer_tpu.elements import llm_serve
+
+        before = dict(llm_serve._table)
+        result = lint(
+            "appsrc dimensions=4 ! tensor_llm_serversink id=lint-probe"
+        )
+        assert "lint-probe" not in llm_serve._table
+        assert dict(llm_serve._table) == before
+        assert result.exit_code == 0, result.render()
+
+    def test_unknown_source_position_does_not_claim_no_source(self):
+        result = lint("frobnicator ! tensor_sink")
+        assert "NNS-E004" in result.codes
+        assert not any(
+            "no source element" in d.message for d in result.diagnostics
+        ), result.render()
+
+    def test_dot_carries_dry_run_specs(self):
+        from nnstreamer_tpu.analysis import annotated_dot
+
+        result = lint(CLEAN)
+        assert "Tensor[" in annotated_dot(result)
+
+    def test_lint_does_not_shift_default_element_numbering(self):
+        from nnstreamer_tpu.elements.base import Element
+
+        before = dict(Element._instance_counters)
+        lint(CLEAN)
+        assert dict(Element._instance_counters) == before
+        # the advertised pre-flight workflow: lint, then parse and
+        # address elements by their gst-style default names
+        p = parse_pipeline("tensorsrc dimensions=4 num-frames=1 ! tensor_sink")
+        names = {e.name for e in p.elements}
+        lint("tensorsrc dimensions=4 num-frames=1 ! tensor_sink")
+        p2 = parse_pipeline("tensorsrc dimensions=4 num-frames=1 ! tensor_sink")
+        n0 = sorted(int(n.replace("tensorsrc", ""))
+                    for n in names if n.startswith("tensorsrc"))
+        n2 = sorted(int(e.name.replace("tensorsrc", ""))
+                    for e in p2.elements if e.name.startswith("tensorsrc"))
+        assert n2[0] == n0[0] + 1  # one parse apart, lint in between free
+
+
+class TestNeverExecutes:
+    def test_lint_never_starts_elements(self, monkeypatch):
+        from nnstreamer_tpu.elements.base import Element
+        from nnstreamer_tpu.pipeline.graph import Pipeline
+
+        def boom(self, *a, **k):
+            raise AssertionError("lint must not start anything")
+
+        monkeypatch.setattr(Element, "start", boom)
+        monkeypatch.setattr(Pipeline, "start", boom)
+        result = lint(CLEAN)
+        assert result.exit_code == 0
+
+    def test_lint_pipeline_object_does_not_mutate_it(self):
+        p = parse_pipeline(CLEAN)
+        result = lint(p)
+        assert result.exit_code == 0
+        assert all(not e.out_specs for e in p.elements)
+        assert not p._negotiated
+
+    def test_linted_pipeline_still_runs(self):
+        p = parse_pipeline(CLEAN + " name=out")
+        assert lint(p).exit_code == 0
+        p.run(timeout=60)
+        assert p["out"].rendered == 2
+
+
+class TestCliAndDot:
+    def test_launch_check_exit_codes(self, capsys):
+        from nnstreamer_tpu.cli import main
+
+        assert main(["--check", CLEAN]) == 0
+        assert main(["--check", "tensorsrc frobnicate=1 ! tensor_sink"]) == 1
+        rc = main(["--check", "tensorsrc ! tensor_decoder mode=nope ! tensor_sink"])
+        assert rc == 2
+        out = capsys.readouterr().out
+        assert "NNS-E007" in out  # codes are printed
+
+    def test_nns_lint_cli(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        assert main([CLEAN]) == 0
+        assert main(["tensorsrc ! frobnicator ! tensor_sink"]) == 2
+        assert "NNS-E004" in capsys.readouterr().out
+
+    def test_nns_lint_json(self, capsys):
+        import json
+
+        from nnstreamer_tpu.analysis.cli import main
+
+        assert main(["--json", "tensorsrc ! frobnicator ! tensor_sink"]) == 2
+        data = json.loads(capsys.readouterr().out)
+        assert data["exit_code"] == 2
+        assert any(d["code"] == "NNS-E004" for d in data["diagnostics"])
+
+    def test_self_check_passes(self):
+        from nnstreamer_tpu.analysis.selfcheck import self_check
+
+        assert self_check() == []
+
+    def test_dot_annotation(self):
+        from nnstreamer_tpu.analysis import annotated_dot
+
+        result = lint(
+            "tensorsrc dimensions=4 ! "
+            "other/tensors,dimensions=(string)8 ! tensor_sink"
+        )
+        dot = annotated_dot(result)
+        assert "NNS-E003" in dot
+        assert "fillcolor" in dot
+        # clean pipeline: plain dot, no paint
+        clean = annotated_dot(lint(CLEAN))
+        assert "fillcolor" not in clean
+
+
+class TestSatelliteFixes:
+    def test_caps_annotation_stripping_beyond_string_int_fraction(self):
+        from nnstreamer_tpu.pipeline.parse import _parse_caps
+
+        media, fields = _parse_caps(
+            "other/tensors,num_tensors=(uint)4,fixed=(boolean)true,"
+            "dimensions=(string)4,framerate=(fraction)30/1"
+        )
+        assert fields["num_tensors"] == "4"
+        assert fields["fixed"] == "true"
+        assert fields["dimensions"] == "4"
+        assert fields["framerate"] == "30/1"
+
+    def test_restricted_error_says_whether_element_exists(self, monkeypatch):
+        import nnstreamer_tpu.config as config_mod
+        from nnstreamer_tpu import registry
+
+        monkeypatch.setenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS", "tensorsrc")
+        config_mod.reload_conf()
+        try:
+            with pytest.raises(KeyError, match="exists but is restricted"):
+                registry.get(registry.KIND_ELEMENT, "tensor_converter")
+            with pytest.raises(KeyError, match="no element subplugin"):
+                registry.get(registry.KIND_ELEMENT, "frobnicator")
+        finally:
+            monkeypatch.delenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS")
+            config_mod.reload_conf()
+
+    def test_unknown_ctor_keyword_raises_parse_error(self):
+        # an element with a strict constructor (no **props catch-all, the
+        # plugin-element case) must surface as ParseError naming element
+        # and property, not a bare TypeError from cls(**props)
+        from nnstreamer_tpu import registry
+        from nnstreamer_tpu.elements.base import Source
+
+        class StrictSrc(Source):
+            FACTORY_NAME = "strictsrc"
+
+            def __init__(self, name=None, width=1):
+                super().__init__(name)
+                self.width = int(width)
+
+        registry.register(registry.KIND_ELEMENT, "strictsrc", StrictSrc)
+        try:
+            with pytest.raises(ParseError, match=r"strictsrc.*bogus"):
+                parse_pipeline("strictsrc bogus=2 ! tensor_sink")
+        finally:
+            registry.unregister(registry.KIND_ELEMENT, "strictsrc")
+
+
+# -- the docs/examples sweep -------------------------------------------------
+
+def _is_pipelineish(text):
+    if " ! " not in text:
+        return False
+    try:
+        items = scan_description(text)
+    except (ParseError, ValueError):
+        return False
+    n_elems = sum(1 for it in items if it[0] in ("element", "caps"))
+    n_bangs = sum(1 for it in items if it[0] == "bang")
+    return n_elems >= 2 and n_bangs >= 1
+
+
+def _candidate_pipelines_from_text(text):
+    """Yield parseable pipeline strings: double-quoted launch strings
+    (doc code blocks) plus paragraph-joined docstring blocks."""
+    seen = set()
+    flat = " ".join(
+        line.strip().rstrip("\\").strip() for line in text.splitlines()
+    )
+    for m in re.finditer(r'"([^"]+ ! [^"]+)"', flat):
+        cand = m.group(1).strip()
+        if cand not in seen and _is_pipelineish(cand):
+            seen.add(cand)
+            yield cand
+    for para in re.split(r"\n\s*\n", text):
+        joined = " ".join(
+            line.strip().rstrip("\\").strip()
+            for line in para.strip().splitlines()
+        )
+        joined = joined.strip().strip('"').replace('\\"', '"')
+        if joined not in seen and _is_pipelineish(joined):
+            seen.add(joined)
+            yield joined
+
+
+def _embedded_pipeline_strings():
+    found = []
+    ex_dir = os.path.join(REPO, "examples")
+    for fn in sorted(os.listdir(ex_dir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(ex_dir, fn)) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for cand in _candidate_pipelines_from_text(node.value):
+                    found.append((fn, cand))
+    for doc in ("elements.md", "linting.md"):
+        with open(os.path.join(REPO, "docs", doc)) as f:
+            for cand in _candidate_pipelines_from_text(f.read()):
+                found.append((doc, cand))
+    return found
+
+
+class TestDocumentedPipelinesLintClean:
+    def test_sweep_finds_pipelines(self):
+        found = _embedded_pipeline_strings()
+        assert len(found) >= 5, found  # examples + docs must carry strings
+
+    @pytest.mark.parametrize(
+        "source,description",
+        _embedded_pipeline_strings(),
+        ids=[f"{s}:{d[:40]}" for s, d in _embedded_pipeline_strings()],
+    )
+    def test_documented_pipeline_lints_clean(self, source, description):
+        result = lint(description)
+        assert result.exit_code == 0, (
+            f"{source}: {description!r}\n{result.render()}"
+        )

@@ -1,5 +1,7 @@
 """Style gate (the reference's gst-indent/pre-commit role, SURVEY.md §2.5):
-the in-tree checker must pass over the whole tree."""
+the in-tree checker must pass over the whole tree, and every registered
+builtin element's PROPERTIES schema must cover the properties its code
+reads (nns-lint --self-check)."""
 
 import os
 import subprocess
@@ -10,7 +12,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_tree_is_style_clean():
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "check_style.py"), REPO],
+        [sys.executable, os.path.join(REPO, "tools", "check_style.py"),
+         "--no-self-check", REPO],
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, f"style problems:\n{proc.stdout}"
+
+
+def test_element_property_schemas_cover_code():
+    """nns-lint --self-check: an element property readable by code but
+    absent from PROPERTIES would be invisible to the linter — fail the
+    gate (in-process; tools/check_style.py runs the same check)."""
+    from nnstreamer_tpu.analysis.selfcheck import self_check
+
+    problems = self_check()
+    assert not problems, "\n".join(problems)

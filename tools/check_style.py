@@ -7,6 +7,10 @@ Rules for tracked .py files (and the C++ under native/):
 - no tabs, no trailing whitespace, LF line endings, final newline
 - max line length 100 (the repo style; docstring URLs exempt)
 - no merge-conflict markers
+- `nns-lint --self-check` passes: every registered builtin element's
+  PROPERTIES schema covers the properties its code reads (whole-tree
+  runs only — explicit path args stay stdlib-fast; --no-self-check
+  forces it off entirely)
 
 Usage: python tools/check_style.py [paths...]   (default: repo tree)
 Exit 0 clean, 1 with findings listed one per line.
@@ -64,13 +68,35 @@ def iter_files(roots):
                     yield os.path.join(dirpath, fn)
 
 
+def run_self_check() -> list:
+    """Run nns-lint --self-check in-process: schema gaps are style
+    problems (an element property without a PROPERTIES entry is invisible
+    to gst-inspect-style tooling and to the static analyzer)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.selfcheck import self_check
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"nns-lint --self-check could not run: {exc}"]
+    return [f"self-check: {p}" for p in self_check()]
+
+
 def main(argv=None) -> int:
-    args = (argv if argv is not None else sys.argv[1:]) or [
+    args = list(argv if argv is not None else sys.argv[1:])
+    no_self_check = "--no-self-check" in args
+    args = [a for a in args if a != "--no-self-check"]
+    # explicit path args = quick per-file run: stay stdlib-only; the
+    # package-importing self-check rides the whole-tree (gate) run
+    whole_tree = not args
+    args = args or [
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ]
     problems = []
     for path in iter_files(args):
         problems.extend(check_file(path))
+    if whole_tree and not no_self_check:
+        problems.extend(run_self_check())
     for p in problems:
         print(p)
     if problems:
