@@ -1213,6 +1213,75 @@ def _watch() -> None:
     log("watch-deadline-reached")
 
 
+def _pipeline_batched(smoke: bool) -> None:
+    """``--pipeline batched``: micro-batched vs per-frame pipeline FPS
+    (pipeline/batching.py), ONE JSON line. ``--smoke`` pins CPU and
+    shrinks the MobileNet-style config so it runs inside tier-1: small
+    spatial size (per-frame dispatch + executor overhead dominates, which
+    is exactly what micro-batching amortizes — the CPU-visible share of
+    the TPU story) and a small frame count."""
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    size = 224 if on_tpu else 32
+    width = 1.0 if on_tpu else 0.25
+    n_frames = 4096 if on_tpu else 256
+    max_batch = 8
+
+    from nnstreamer_tpu.pipeline.executor import FusedNode
+    from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+    def run_once(batching: bool):
+        batch_props = (
+            f"batching=true max-batch={max_batch} batch-timeout-ms=2"
+            if batching else "batching=false"
+        )
+        desc = (
+            f"videotestsrc pattern=gradient device=true "
+            f"num-frames={n_frames} width={size} height={size} ! "
+            "tensor_converter queue-size=128 ! "
+            "tensor_filter framework=jax model=zoo:mobilenet_v2 "
+            f'custom="size:{size},width:{width}" {batch_props} ! '
+            "tensor_decoder mode=image_labeling ! "
+            "tensor_sink sync-window=8 queue-size=128"
+        )
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=900)
+        fps = _steady_fps(ex)
+        seg = next(
+            (n.seg for n in ex.nodes if isinstance(n, FusedNode)), None
+        )
+        return fps, seg
+
+    unbatched_fps, _ = run_once(False)
+    _mark("pipeline unbatched measured")
+    batched_fps, seg = run_once(True)
+    _mark("pipeline batched measured")
+    speedup = (
+        round(batched_fps / unbatched_fps, 3)
+        if batched_fps and unbatched_fps else None
+    )
+    rec = {
+        "metric": "mobilenet_style_pipeline_batched_vs_unbatched_fps",
+        "unit": "fps",
+        "batched_fps": _round(batched_fps),
+        "unbatched_fps": _round(unbatched_fps),
+        "speedup": speedup,
+        "max_batch": max_batch,
+        "size": size,
+        "n_frames": n_frames,
+        "platform": dev.platform,
+        "device": str(dev.device_kind),
+    }
+    if seg is not None:
+        rec.update(seg.batch_stats.snapshot())
+        rec["segment_traces"] = seg.n_traces
+    print(json.dumps(rec))
+
+
 def main() -> None:
     if "--probe" in sys.argv:
         return _probe()
@@ -1220,6 +1289,12 @@ def main() -> None:
         return _run()
     if "--watch" in sys.argv:
         return _watch()
+    if "--pipeline" in sys.argv:
+        mode = sys.argv[sys.argv.index("--pipeline") + 1 :][:1]
+        if mode != ["batched"]:
+            print(f"unknown --pipeline mode {mode}", file=sys.stderr)
+            return 2
+        return _pipeline_batched("--smoke" in sys.argv)
 
     import subprocess
 

@@ -75,6 +75,12 @@ class Backend(ABC):
     name: str = "base"
     #: whether outputs may change shape per-invoke (flexible output)
     invoke_dynamic: bool = False
+    #: whether invoke_batched() may be fed a micro-batch of frames in one
+    #: call (pipeline/batching.py). Host-library backends whose invoke is
+    #: strictly per-frame (tflite set_tensor/invoke/get_tensor) leave this
+    #: False and keep per-frame invokes; backends that can amortize a
+    #: window (stacking, engine-side batching) opt in.
+    batchable: bool = False
 
     def __init__(self) -> None:
         self.props: Optional[FilterProps] = None
@@ -124,6 +130,16 @@ class Backend(ABC):
         """Pure jax function equivalent to invoke(), or None if this backend
         is host-bound (fusion barrier)."""
         return None
+
+    def invoke_batched(
+        self, batch: Sequence[Tuple[Any, ...]]
+    ) -> List[Tuple[Any, ...]]:
+        """Run inference on a micro-batch of frames' tensors in ONE call
+        (only used when ``batchable``). The default chains invoke() —
+        still worthwhile (one lock acquisition / one timed section per
+        window); genuinely batchable engines override with a stacked
+        implementation."""
+        return [tuple(self.invoke(ts)) for ts in batch]
 
     # -- instrumented invoke (reference latency/throughput props,
     #    tensor_filter.c:334-433) ----------------------------------------

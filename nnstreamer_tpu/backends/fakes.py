@@ -125,6 +125,47 @@ class AverageBackend(Backend):
         return fn
 
 
+@registry.filter_backend("hostscaler")
+class HostScalerBackend(Backend):
+    """Host-bound scaler (numpy, no traceable fn — a fusion barrier) that
+    declares the ``batchable`` capability: invoke_batched stacks the
+    window and multiplies once. The test stand-in for an engine with a
+    real batched entry point (vs tflite's strictly per-frame invoke)."""
+
+    name = "hostscaler"
+    batchable = True
+
+    def open(self, props: FilterProps) -> None:
+        self.props = props
+        self._factor = float(props.custom_dict().get("factor", "2.0"))
+        self._spec = props.input_spec
+        self.batched_calls = 0  # tests assert the batched entry was used
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._spec is None:
+            raise BackendError("hostscaler: input spec unknown until set")
+        return self._spec, self._spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._spec = in_spec
+        return in_spec
+
+    def invoke(self, tensors):
+        return tuple(
+            (np.asarray(t) * self._factor).astype(np.asarray(t).dtype)
+            for t in tensors
+        )
+
+    def invoke_batched(self, batch):
+        self.batched_calls += 1
+        n_t = len(batch[0])
+        cols = []
+        for i in range(n_t):
+            stacked = np.stack([np.asarray(ts[i]) for ts in batch])
+            cols.append((stacked * self._factor).astype(stacked.dtype))
+        return [tuple(col[j] for col in cols) for j in range(len(batch))]
+
+
 @registry.filter_backend("framecounter")
 class FrameCounterBackend(Backend):
     """Emits a running uint32 frame count (custom_example_framecounter) —

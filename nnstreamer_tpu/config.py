@@ -57,6 +57,17 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "default_port": "3000",  # reference edge_common.h:36-37
         "timeout_sec": "10",  # reference tensor_query_common.h:28
     },
+    "executor": {
+        # micro-batching defaults for fused segments / batchable filters
+        # (pipeline/batching.py); per-element properties on tensor_filter
+        # (batching=, max-batch=, ...) override. Env:
+        # NNS_TPU_EXECUTOR_BATCHING etc.
+        "batching": "false",
+        "max_batch": "8",
+        "batch_timeout_ms": "1.0",
+        # comma list of padded batch sizes; empty = 1,2,4,...,max_batch
+        "batch_buckets": "",
+    },
 }
 
 _ENV_PREFIX = "NNS_TPU_"

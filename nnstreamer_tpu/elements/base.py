@@ -216,6 +216,20 @@ class TensorOp(Element):
     N_SINKS = 1
     N_SRCS = 1
 
+    # Micro-batching (pipeline/batching.py): stats are assigned at plan
+    # time — for fused segments shared per segment — and read by the
+    # filter's read-only avg-batch-size/pad-waste-pct/batch-wait-ms
+    # props; batch_config is the plan-time resolved BatchConfig for
+    # host-path (non-traceable) ops.
+    batch_stats: Optional[Any] = None
+    batch_config: Optional[Any] = None
+
+    # Bumped whenever the op's make_fn() result changes without a shape
+    # change (model hot swap via reload_model): part of FusedSegment's
+    # compiled-program cache key, so a same-shape reload cannot keep
+    # serving the stale program.
+    fn_version: int = 0
+
     def make_fn(self) -> Callable[[Tuple[Any, ...]], Tuple[Any, ...]]:
         """Return the pure fn (tensors) -> tensors for the negotiated specs.
         Called after negotiation; must be traceable by jax when
@@ -226,6 +240,23 @@ class TensorOp(Element):
         """False → run as a host node (fusion barrier) instead of fusing
         (e.g. tensor_filter with a host-library backend)."""
         return True
+
+    def is_batch_capable(self) -> bool:
+        """True → the host path may collect a micro-batch and call
+        host_process_batch (tensor_filter with a ``batchable`` backend).
+        Traceable ops batch through the fused segment instead."""
+        return False
+
+    def host_process_batch(self, frames: List[Frame]) -> List[Frame]:
+        """Host-path batched execution (only called when
+        is_batch_capable()); default chains per-frame host_process."""
+        out: List[Frame] = []
+        for frame in frames:
+            got = self.host_process(frame)
+            if got is None:
+                continue
+            out.extend(got if isinstance(got, list) else [got])
+        return out
 
     def host_process(self, frame: Frame) -> Union[Frame, List[Frame], None]:
         """Host-path execution for non-traceable TensorOps. May return

@@ -140,6 +140,23 @@ class TensorFilter(TensorOp):
         ),
         "input-combination": PropSpec("str", ""),
         "output-combination": PropSpec("str", ""),
+        # micro-batching (pipeline/batching.py): per-element overrides of
+        # the executor-level [executor] defaults. Unset = inherit.
+        "batching": PropSpec(
+            "bool", None,
+            desc="micro-batch queued frames into one device invoke",
+        ),
+        "max-batch": PropSpec(
+            "int", None, desc="micro-batch frame cap (default 8)"
+        ),
+        "batch-timeout-ms": PropSpec(
+            "float", None,
+            desc="straggler wait when trickle-fed (default 1.0; 0 = never wait)",
+        ),
+        "batch-buckets": PropSpec(
+            "str", None,
+            desc="comma list of padded batch sizes (default 1,2,4,...,max-batch)",
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -225,6 +242,9 @@ class TensorFilter(TensorOp):
         """Hot swap (reference is-updatable + RELOAD_MODEL event)."""
         self._ensure_open().reload(tuple(m for m in model.split(",") if m))
         self._traceable = None
+        # invalidate fused-segment cache entries that embed the old fn
+        # (shapes unchanged ⇒ same signature key, so the version must tick)
+        self.fn_version += 1
 
     # -- negotiation -------------------------------------------------------
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
@@ -348,6 +368,60 @@ class TensorFilter(TensorOp):
         self._elem_stats.record(dt)
         return frame.with_tensors(out)
 
+    # -- host micro-batching (pipeline/batching.py) ------------------------
+    def is_batch_capable(self) -> bool:
+        """Host path may micro-batch only when the backend declared the
+        capability; flexible per-frame shapes can't share one invoke."""
+        if getattr(self, "_flexible_input", False):
+            return False
+        return bool(getattr(self._ensure_open(), "batchable", False))
+
+    def host_process_batch(self, frames: List[Frame]) -> List[Frame]:
+        """One invoke_batched() call for the window: combinations applied
+        per frame, ONE timed section (and one shared-lock acquisition)
+        amortized over the whole batch."""
+        sig0 = tuple((t.shape, t.dtype) for t in frames[0].tensors)
+        if any(
+            tuple((t.shape, t.dtype) for t in f.tensors) != sig0
+            for f in frames[1:]
+        ):
+            # heterogeneous window (flexible-ish source): frames can't
+            # share one stacked invoke — per-frame fallback, same
+            # semantics (parity with FusedSegment.process_batch)
+            return [self.host_process(f) for f in frames]
+        b = self._ensure_open()
+        in_comb, out_comb = self.in_combination, self.out_combination
+        model_ins = [
+            f.tensors if in_comb is None
+            else tuple(f.tensors[i] for _, i in in_comb)
+            for f in frames
+        ]
+        lock = getattr(b, "shared_invoke_lock", None)
+        t0 = time.perf_counter_ns()
+        if lock is not None:
+            with lock:
+                model_outs = b.invoke_batched(model_ins)
+        else:
+            model_outs = b.invoke_batched(model_ins)
+        dt = time.perf_counter_ns() - t0
+        # per-frame share so latency_us stays per-invoke comparable
+        per = dt // max(1, len(frames))
+        for _ in frames:
+            self._elem_stats.record(per)
+            b.stats.record(per)
+        outs: List[Frame] = []
+        for f, model_out in zip(frames, model_outs):
+            model_out = tuple(model_out)
+            if out_comb is None:
+                tensors = model_out
+            else:
+                tensors = tuple(
+                    f.tensors[i] if kind == "i" else model_out[i]
+                    for kind, i in out_comb
+                )
+            outs.append(f.with_tensors(tensors))
+        return outs
+
     # -- stats (reference read-only latency/throughput props) -------------
     @property
     def invoke_stats(self) -> InvokeStats:
@@ -362,3 +436,21 @@ class TensorFilter(TensorOp):
     @property
     def throughput_fps(self) -> float:
         return self._elem_stats.throughput_fps
+
+    # micro-batching observability (read-only, like latency/throughput):
+    # stats live on the fused segment (or this element on the host path)
+    # via the shared BatchStats assigned at plan time.
+    @property
+    def avg_batch_size(self) -> float:
+        s = self.batch_stats
+        return s.avg_batch_size if s is not None else 0.0
+
+    @property
+    def pad_waste_pct(self) -> float:
+        s = self.batch_stats
+        return s.pad_waste_pct if s is not None else 0.0
+
+    @property
+    def batch_wait_ms(self) -> float:
+        s = self.batch_stats
+        return s.batch_wait_ms if s is not None else 0.0

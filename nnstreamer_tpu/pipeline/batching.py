@@ -1,0 +1,351 @@
+"""Adaptive micro-batching for fused segments (and batch-capable filters).
+
+The executor pipelines frames across stages, but a per-frame jitted call
+leaves most of a TPU's matmul units idle — device utilization scales with
+the leading axis, not with dispatch count. The StreamTensor/Hermes lesson
+(PAPERS.md): streaming dataflow frameworks win by aggregating stream
+elements into device-sized work units while *bounding* the latency cost.
+This module is that aggregation layer:
+
+- :class:`BatchConfig` — resolved knobs for one execution node. Stream
+  properties (``batching=true``, ``max-batch``, ``batch-timeout-ms``,
+  ``batch-buckets`` on ``tensor_filter``) override the executor-level
+  defaults from the ``[executor]`` config section (env:
+  ``NNS_TPU_EXECUTOR_BATCHING`` etc.).
+- :class:`BatchCollector` — drains up to ``max-batch`` queued frames from
+  a node's input channel. Adaptive discipline: when the queue is deep the
+  collector takes what is there and returns immediately (queue depth is
+  free batch — NO added latency under load); only when trickle-fed (the
+  blocking pop yielded a single frame and the queue is empty) does it
+  wait up to ``batch-timeout-ms`` for stragglers.
+- :class:`BatchStats` — per-segment observability: average batch size,
+  padding waste, and collector wait time, surfaced as read-only
+  ``tensor_filter`` properties next to ``latency``/``throughput`` and in
+  ``Executor.stats()``.
+
+Bucketing: batch sizes are rounded UP to a fixed bucket ladder
+(default 1,2,4,...,max-batch) and padded with replicas of the last frame,
+so each fused segment retraces at most O(log max-batch) times instead of
+once per observed batch size. The pad rows are computed and discarded —
+``pad-waste-pct`` reports the cost.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.elements.base import _parse_bool
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.tensors.frame import EOS_FRAME
+
+_log = get_logger("batching")
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to ``max_batch``, always ending exactly at
+    ``max_batch`` (so max-batch=6 buckets as 1,2,4,6 — the cap the user
+    asked for is always a real bucket, never overshot)."""
+    max_batch = max(1, int(max_batch))
+    out: List[int] = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class BatchConfig:
+    """Resolved micro-batching knobs for one execution node."""
+
+    enabled: bool = False
+    max_batch: int = 8
+    timeout_ms: float = 1.0
+    buckets: Tuple[int, ...] = ()
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n is already clamped to max_batch)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1] if self.buckets else n
+
+    @property
+    def active(self) -> bool:
+        return self.enabled and self.max_batch > 1
+
+
+def _executor_defaults() -> dict:
+    """Executor-level batching defaults ([executor] config section; env
+    ``NNS_TPU_EXECUTOR_*`` outranks ini, the standard config layering).
+    Malformed config values fall back to the built-in default with a
+    warning — a typo'd ini line must not fail EVERY pipeline compile
+    (element properties, by contrast, raise with context: the user set
+    them on purpose, right here)."""
+    from nnstreamer_tpu.config import conf
+
+    c = conf()
+
+    def _num(key: str, cast, fallback):
+        raw = c.get("executor", key, str(fallback))
+        try:
+            return cast(raw)
+        except ValueError:
+            _log.warning(
+                "[executor] %s=%r is not a valid %s; using %s",
+                key, raw, cast.__name__, fallback,
+            )
+            return fallback
+
+    timeout_ms = _num("batch_timeout_ms", float, 1.0)
+    max_batch = _num("max_batch", int, 8)
+    buckets_raw = c.get("executor", "batch_buckets", "").strip()
+    try:
+        buckets = [
+            int(p) for p in buckets_raw.split(",") if p.strip()
+        ]
+    except ValueError:
+        _log.warning(
+            "[executor] batch_buckets=%r is not a comma list of ints; "
+            "using the default ladder", buckets_raw,
+        )
+        buckets = []
+    return {
+        "batching": c.get_bool("executor", "batching", False),
+        "max-batch": max_batch,
+        "batch-timeout-ms": timeout_ms,
+        "batch-buckets": buckets,
+    }
+
+
+def _parse_buckets(
+    vals: Optional[List[int]], max_batch: int
+) -> Tuple[int, ...]:
+    if not vals:
+        return default_buckets(max_batch)
+    kept = sorted({v for v in vals if 1 <= v <= max_batch})
+    dropped = sorted(set(vals) - set(kept))
+    if dropped:
+        # an explicitly configured ladder must not be rewritten silently
+        _log.warning(
+            "batch-buckets entries %s outside [1, max-batch=%d] ignored",
+            dropped, max_batch,
+        )
+    added = []
+    if not kept or kept[-1] != max_batch:
+        # a ladder not reaching max-batch would leave full windows
+        # without a bucket to dispatch as
+        kept.append(max_batch)
+        added.append(max_batch)
+    if kept[0] != 1:
+        # a bucket ladder without 1 would pad EVERY lone frame up to the
+        # smallest bucket — trickle traffic must stay pad-free
+        kept.insert(0, 1)
+        added.append(1)
+    if added:
+        _log.warning(
+            "batch-buckets: adding required bucket(s) %s (ladder must "
+            "span [1, max-batch=%d]); effective ladder %s",
+            sorted(added), max_batch, tuple(kept),
+        )
+    return tuple(kept)
+
+
+def resolve_batch_config(elements: Sequence[Any]) -> BatchConfig:
+    """Merge element-level batching properties over the executor default.
+
+    Scans the elements in chain order; for each knob the first element
+    that sets it explicitly wins. Only tensor_filter DECLARES the
+    batching PropSpecs (lint-clean launch strings); the scan reads any
+    op's properties so programmatic set_property overrides still work."""
+    defaults = _executor_defaults()
+    enabled: Optional[bool] = None
+    max_batch: Optional[int] = None
+    timeout_ms: Optional[float] = None
+    buckets: Optional[List[int]] = None
+
+    def _coerce(elem, prop: str, fn, raw):
+        try:
+            return fn(raw)
+        except (TypeError, ValueError) as exc:
+            # name the element and property (PR-1 diagnostics discipline:
+            # a bare int() traceback from a node thread localizes nothing)
+            raise ValueError(
+                f"{getattr(elem, 'name', elem)}: bad {prop}={raw!r}: {exc}"
+            ) from exc
+
+    def _int_list(raw) -> List[int]:
+        return [int(p) for p in str(raw).split(",") if str(p).strip()]
+
+    for e in elements:
+        get = getattr(e, "get_property", None)
+        if get is None:
+            continue
+        if enabled is None and get("batching") is not None:
+            enabled = _parse_bool(get("batching"))
+        if max_batch is None and get("max-batch") is not None:
+            max_batch = _coerce(e, "max-batch", int, get("max-batch"))
+        if timeout_ms is None and get("batch-timeout-ms") is not None:
+            timeout_ms = _coerce(
+                e, "batch-timeout-ms", float, get("batch-timeout-ms")
+            )
+        if buckets is None and get("batch-buckets") is not None:
+            buckets = _coerce(
+                e, "batch-buckets", _int_list, get("batch-buckets")
+            )
+    if enabled is None:
+        enabled = defaults["batching"]
+    if max_batch is None:
+        max_batch = defaults["max-batch"]
+    if timeout_ms is None:
+        timeout_ms = defaults["batch-timeout-ms"]
+    if buckets is None:
+        buckets = defaults["batch-buckets"]
+    max_batch = max(1, int(max_batch))
+    return BatchConfig(
+        enabled=bool(enabled),
+        max_batch=max_batch,
+        timeout_ms=max(0.0, float(timeout_ms)),
+        buckets=_parse_buckets(buckets, max_batch),
+    )
+
+
+class BatchStats:
+    """Single-writer (the node thread) batching counters; readers see a
+    consistent-enough snapshot (GIL-atomic attribute reads)."""
+
+    __slots__ = ("batches", "frames", "padded_rows", "bucket_rows",
+                 "wait_ns")
+
+    def __init__(self) -> None:
+        self.batches = 0
+        self.frames = 0
+        self.padded_rows = 0   # pad rows computed and thrown away
+        self.bucket_rows = 0   # total rows dispatched (incl. padding)
+        self.wait_ns = 0       # collector straggler-wait time
+
+    def record(self, n: int, bucket: int, wait_s: float) -> None:
+        self.batches += 1
+        self.frames += n
+        self.bucket_rows += bucket
+        self.padded_rows += bucket - n
+        self.wait_ns += int(wait_s * 1e9)
+
+    @property
+    def avg_batch_size(self) -> float:
+        return self.frames / self.batches if self.batches else 0.0
+
+    @property
+    def pad_waste_pct(self) -> float:
+        """Percent of dispatched device rows that were padding."""
+        if not self.bucket_rows:
+            return 0.0
+        return 100.0 * self.padded_rows / self.bucket_rows
+
+    @property
+    def batch_wait_ms(self) -> float:
+        """Average straggler wait per batch, ms (latency the batching
+        layer itself added; 0 under load — drain-what's-there)."""
+        if not self.batches:
+            return 0.0
+        return self.wait_ns / self.batches / 1e6
+
+    def snapshot(self) -> dict:
+        return {
+            "avg_batch_size": round(self.avg_batch_size, 3),
+            "pad_waste_pct": round(self.pad_waste_pct, 2),
+            "batch_wait_ms": round(self.batch_wait_ms, 4),
+        }
+
+
+class BatchCollector:
+    """Drains up to ``max_batch`` frames per call from a bounded channel.
+
+    ``collect()`` returns ``(frames, eos, wait_s)``:
+    - blocks for the first frame (honoring the node's stop event);
+    - drains whatever else is queued, without blocking, up to the cap —
+      under load this is the whole batch and costs zero added latency;
+    - only when trickle-fed (exactly one frame and an empty queue) waits
+      up to ``timeout_ms`` for stragglers, then goes with what arrived;
+    - an EOS sentinel mid-drain ends collection: the partial batch is
+      returned first with ``eos=True`` so in-flight frames flush before
+      EOS propagates (EOS ordering parity with the per-frame path).
+
+    ``drop`` is the per-frame upstream-QoS predicate (frames a
+    downstream rate limiter will certainly discard are skipped before
+    they can occupy batch slots).
+    """
+
+    def __init__(
+        self,
+        chan,
+        stop_event: threading.Event,
+        config: BatchConfig,
+        drop: Optional[Callable[[Any], bool]] = None,
+    ) -> None:
+        self.chan = chan
+        self.stop_event = stop_event
+        self.config = config
+        self.drop = drop
+        self._pending_eos = False
+
+    def collect(self) -> Tuple[List[Any], bool, float]:
+        if self._pending_eos:
+            self._pending_eos = False
+            return [], True, 0.0
+        cfg = self.config
+        batch: List[Any] = []
+        # first frame: plain blocking pop (frame path latency untouched)
+        while True:
+            item = self.chan.get(self.stop_event)
+            if item is EOS_FRAME:
+                return [], True, 0.0
+            if self.drop is not None and self.drop(item):
+                continue
+            batch.append(item)
+            break
+        # drain-what's-there: everything already queued rides this batch
+        eos = self._drain_queued(batch, cfg.max_batch)
+        wait_s = 0.0
+        if (
+            not eos
+            and len(batch) == 1
+            and cfg.timeout_ms > 0.0
+            and cfg.max_batch > 1
+        ):
+            # trickle-fed: bounded wait for stragglers. One wake is
+            # enough — whatever arrived by then is the batch (waiting
+            # again after each arrival would turn the bound into a
+            # rolling window and stretch worst-case latency).
+            t0 = time.perf_counter()
+            deadline = time.monotonic() + cfg.timeout_ms / 1000.0
+            item = self.chan.get_until(deadline, self.stop_event)
+            if item is not None:
+                if item is EOS_FRAME:
+                    eos = True
+                elif self.drop is not None and self.drop(item):
+                    pass
+                else:
+                    batch.append(item)
+                if not eos:
+                    eos = self._drain_queued(batch, cfg.max_batch)
+            wait_s = time.perf_counter() - t0
+        if eos and batch:
+            # deliver the flushed batch now; report EOS on the next call
+            self._pending_eos = True
+            return batch, False, wait_s
+        return batch, eos, wait_s
+
+    def _drain_queued(self, batch: List[Any], cap: int) -> bool:
+        items = self.chan.drain(cap - len(batch))
+        for item in items:
+            if item is EOS_FRAME:
+                return True
+            if self.drop is not None and self.drop(item):
+                continue
+            batch.append(item)
+        return False

@@ -142,6 +142,49 @@ class _Chan:
         self._wake_put(d)
         return item
 
+    def get_until(self, deadline: float, stop_event):
+        """Blocking pop bounded by a ``time.monotonic()`` deadline;
+        returns None once the deadline passes with nothing queued (frames
+        are never None — see module invariant). The batch collector's
+        straggler wait."""
+        d = self._d
+        while True:
+            if d:
+                item = d.popleft()
+                self._wake_put(d)
+                return item
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            if stop_event.is_set():
+                raise _Stop()
+            self._data.clear()
+            self._get_waiting = True
+            # same Dekker pairing as get(): advertise, then recheck
+            if d:
+                self._get_waiting = False
+                continue
+            self._data.wait(min(0.05, remaining))
+            self._get_waiting = False
+
+    def drain(self, limit: int) -> list:
+        """Pop up to ``limit`` queued items without blocking.
+
+        Unlike get/get_nowait this ALWAYS wakes a parked producer when
+        space was freed, low-water mark or not: a batch consumer goes
+        compute for a whole batch after draining, so the "it will pop
+        again in a moment and hit low-water" assumption behind the
+        burst-amortized wake does not hold — without the wake a full
+        channel plus a partial drain leaves the producer sleeping out
+        its entire 50 ms beat while space sits free."""
+        d = self._d
+        out = []
+        while len(out) < limit and d:
+            out.append(d.popleft())
+        if out and self._put_waiting and len(d) < self._max:
+            self._space.set()
+        return out
+
 
 class Node:
     def __init__(self, ex: "Executor", name: str) -> None:
@@ -222,6 +265,41 @@ class Node:
                 {"frame": self.frames_processed},
             )
 
+    def make_batch_collector(self, cfg, elem):
+        """BatchCollector on input pad 0 with the upstream-QoS drop
+        predicate for `elem` (one definition of skipped-upstream
+        accounting for both batched service loops)."""
+        from nnstreamer_tpu.pipeline.batching import BatchCollector
+
+        drop = None
+        if elem.qos_sources:
+            def drop(frame, _elem=elem):
+                if _elem.qos_would_drop(frame):
+                    for q in _elem.qos_sources:
+                        q.skipped_upstream += 1
+                    return True
+                return False
+
+        return BatchCollector(
+            self.in_queues[0], self.ex.stop_event, cfg, drop=drop
+        )
+
+    def stat_batch(self, t0: float, n: int, bucket: int, wait_s: float) -> None:
+        """Per-BATCH accounting: frames_processed counts frames, the EMA
+        tracks per-batch wall time, and with a tracer attached one
+        batch-assembly span records size/bucket/wait/pad-waste."""
+        self.frames_processed += n
+        now = time.perf_counter()
+        dt = (now - t0) * 1000.0
+        a = 0.2
+        self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+        tracer = trace.get()
+        if tracer is not None:
+            tracer.batch(
+                self.name, t0, now - t0, batch=n, bucket=bucket,
+                wait_s=wait_s, frame=self.frames_processed,
+            )
+
 
 class SourceNode(Node):
     def __init__(self, ex, elem: Source) -> None:
@@ -248,6 +326,10 @@ class FusedNode(Node):
 
     def run(self) -> None:
         self.seg.build()  # compile before first frame (PAUSED-state parity)
+        cfg = self.seg.batch_config
+        if cfg is not None and cfg.active:
+            self._run_batched(cfg)
+            return
         first = self.seg.first
         while True:
             item = self.pop(0)
@@ -265,6 +347,28 @@ class FusedNode(Node):
             self.push_out(0, out)
         self.broadcast_eos()
 
+    def _run_batched(self, cfg) -> None:
+        """Micro-batched service loop: drain up to max-batch frames, ONE
+        batched device invoke, split results back in order."""
+        collector = self.make_batch_collector(cfg, self.seg.first)
+        while True:
+            frames, eos, wait_s = collector.collect()
+            if frames:
+                t0 = time.perf_counter()
+                if len(frames) == 1:
+                    # lone frame: the per-frame program, no stack/split
+                    outs = [self.seg.process(frames[0])]
+                    bucket = 1
+                else:
+                    outs, bucket = self.seg.process_batch(frames, cfg)
+                self.seg.batch_stats.record(len(frames), bucket, wait_s)
+                self.stat_batch(t0, len(frames), bucket, wait_s)
+                for f in outs:
+                    self.push_out(0, f)
+            if eos:
+                break
+        self.broadcast_eos()
+
 
 class TensorOpHostNode(Node):
     """Host-path adapter for non-traceable TensorOps (e.g. tensor_filter
@@ -275,6 +379,16 @@ class TensorOpHostNode(Node):
         self.elem = elem
 
     def run(self) -> None:
+        # resolved at plan time (graph.py compile_plan); fall back for
+        # hand-built ExecPlans that bypassed it
+        cfg = getattr(self.elem, "batch_config", None)
+        if cfg is None:
+            from nnstreamer_tpu.pipeline.batching import resolve_batch_config
+
+            cfg = resolve_batch_config([self.elem])
+        if cfg.active and self.elem.is_batch_capable():
+            self._run_batched(cfg)
+            return
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
@@ -292,6 +406,36 @@ class TensorOpHostNode(Node):
                 continue
             for f in out if isinstance(out, list) else [out]:
                 self.push_out(0, f)
+        self.broadcast_eos()
+
+    def _run_batched(self, cfg) -> None:
+        """Host micro-batching for backends that declared the
+        ``batchable`` capability (backends/base.py) — host backends that
+        did not (tflite's set/invoke/get is strictly per-frame) keep the
+        per-frame loop above."""
+        from nnstreamer_tpu.pipeline.batching import BatchStats
+
+        elem = self.elem
+        if getattr(elem, "batch_stats", None) is None:
+            # host elements sit outside fused segments, so plan time did
+            # not hand them a shared stats object
+            elem.batch_stats = BatchStats()
+        collector = self.make_batch_collector(cfg, elem)
+        stats = elem.batch_stats
+        while True:
+            frames, eos, wait_s = collector.collect()
+            if frames:
+                t0 = time.perf_counter()
+                outs = elem.host_process_batch(frames)
+                # host path never pads: bucket == batch size
+                stats.record(len(frames), len(frames), wait_s)
+                self.stat_batch(t0, len(frames), len(frames), wait_s)
+                for f in outs:
+                    self.push_out(0, f)
+            if eos:
+                for f in elem.flush():
+                    self.push_out(0, f)
+                break
         self.broadcast_eos()
 
 
@@ -681,6 +825,13 @@ class Executor:
                 got = sstats()
                 if got:
                     s.update({f"serving_{k}": v for k, v in got.items()})
+            # micro-batching observability (fused segments and batchable
+            # host filters): avg batch size, pad waste, straggler wait
+            bstats = getattr(
+                getattr(n, "seg", None), "batch_stats", None
+            ) or getattr(elem, "batch_stats", None)
+            if bstats is not None and bstats.batches:
+                s.update(bstats.snapshot())
             out[n.name] = s
         return out
 

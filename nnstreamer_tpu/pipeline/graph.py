@@ -240,10 +240,22 @@ class Pipeline:
         )
         seg_of: Dict[Element, "FusedSegment"] = {}
         segments: List[FusedSegment] = []
+        from nnstreamer_tpu.pipeline.batching import (
+            BatchStats,
+            resolve_batch_config,
+        )
+
         for e in self._toposort():
             # non-traceable TensorOps (host-bound backends) execute as host
             # nodes; they are fusion barriers like HostElement
             if not isinstance(e, TensorOp) or not e.is_traceable():
+                if isinstance(e, TensorOp):
+                    # host-path batching config resolves at PLAN time like
+                    # the segments below, so a bad batching property fails
+                    # compile_plan() instead of poisoning a running node
+                    e.batch_config = resolve_batch_config([e])
+                    if e.batch_stats is None:
+                        e.batch_stats = BatchStats()
                 continue
             ups = self.in_links(e)
             up = ups[0].src if len(ups) == 1 else None
@@ -261,6 +273,14 @@ class Pipeline:
                 seg = FusedSegment(ops=[e])
                 segments.append(seg)
                 seg_of[e] = seg
+        # resolve micro-batching per segment (element properties over the
+        # executor-level [executor] config default) and share the stats
+        # object with the ops so tensor_filter's read-only avg-batch-size/
+        # pad-waste-pct/batch-wait-ms properties report their segment
+        for seg in segments:
+            seg.batch_config = resolve_batch_config(seg.ops)
+            for op in seg.ops:
+                op.batch_stats = seg.batch_stats
         return ExecPlan(self, segments, seg_of)
 
     # -- run ---------------------------------------------------------------
@@ -354,11 +374,32 @@ class Pipeline:
 
 
 class FusedSegment:
-    """A maximal linear chain of TensorOps compiled into ONE jitted fn."""
+    """A maximal linear chain of TensorOps compiled into ONE jitted fn.
+
+    Compiled programs are cached by (arity, shapes, dtypes, batch
+    bucket, op fn versions), NOT by "compiled once": a spec
+    renegotiation (different shapes/dtypes arriving after a rebuild), a
+    different micro-batch bucket, or a same-shape model hot swap
+    (reload_model ticks the op's fn_version) gets its own entry with
+    freshly collected op fns — a stale program can never be silently
+    reused. ``n_traces`` counts cache
+    fills (each entry traces exactly once: shapes are fixed per key), so
+    tests can assert the bucket ladder bounds retracing at
+    O(log max-batch).
+    """
 
     def __init__(self, ops: List[TensorOp]) -> None:
         self.ops = ops
-        self._jitted: Optional[Callable] = None
+        # (sig, bucket, fn versions) -> jitted fn; bucket 0 = per-frame
+        self._cache: Dict[tuple, Callable] = {}
+        self._last: Optional[tuple] = None  # (full_key, fn) fast path
+        self.n_traces = 0
+        # micro-batching (pipeline/batching.py): resolved at plan time;
+        # stats shared with the ops so tensor_filter can surface them
+        self.batch_config = None
+        from nnstreamer_tpu.pipeline.batching import BatchStats
+
+        self.batch_stats = BatchStats()
 
     @property
     def first(self) -> TensorOp:
@@ -372,9 +413,16 @@ class FusedSegment:
     def name(self) -> str:
         return "+".join(o.name for o in self.ops)
 
-    def build(self) -> Callable:
-        if self._jitted is not None:
-            return self._jitted
+    @staticmethod
+    def _sig_of(tensors) -> tuple:
+        # raw (shape, dtype) pairs: np.dtype is hashable and equality-
+        # stable, so no string normalization — this runs per frame on
+        # the fused hot path
+        return tuple((tuple(t.shape), t.dtype) for t in tensors)
+
+    def _compose(self) -> Callable:
+        """Collect the ops' CURRENT fns (re-run per cache fill so a
+        renegotiated/reloaded op contributes its fresh fn)."""
         fns = [op.make_fn() for op in self.ops]
 
         def composed(*tensors):
@@ -383,15 +431,104 @@ class FusedSegment:
                 t = tuple(f(t))
             return t
 
-        self._jitted = jax.jit(composed)
-        return self._jitted
+        return composed
+
+    def _jitted_for(self, sig: tuple, bucket: int = 0) -> Callable:
+        # fn_version ticks on model hot swap (reload_model): same shapes,
+        # different weights — the old program must not be served
+        versions = tuple(op.fn_version for op in self.ops)
+        key = (sig, bucket, versions)
+        last = self._last
+        if last is not None and last[0] == key:
+            return last[1]
+        fn = self._cache.get(key)
+        if fn is None:
+            composed = self._compose()
+            fn = jax.jit(jax.vmap(composed) if bucket else composed)
+            self._cache[key] = fn
+            self.n_traces += 1
+        self._last = (key, fn)
+        return fn
+
+    def _negotiated_sig(self) -> Optional[tuple]:
+        spec = self.first.in_specs[0] if self.first.in_specs else None
+        if not isinstance(spec, TensorsSpec) or not spec.is_static:
+            return None
+        return tuple(
+            (tuple(t.shape), t.dtype.np_dtype) for t in spec
+        )
+
+    def build(self) -> Optional[Callable]:
+        """Instantiate the per-frame program for the negotiated spec
+        (PAUSED-state parity); per-signature entries fill lazily. With
+        batching active, also warm the max-batch bucket — the
+        steady-state program under load — by invoking it on zeros, so
+        the first full batch doesn't stall the stream on an XLA compile
+        (smaller buckets stay lazy: they only appear at trickle/EOS
+        boundaries where a one-off compile stall is tolerable)."""
+        sig = self._negotiated_sig()
+        if sig is None:
+            return None
+        fn = self._jitted_for(sig)
+        cfg = self.batch_config
+        if cfg is not None and cfg.active:
+            try:
+                import numpy as _np
+
+                bucket = cfg.buckets[-1]
+                zeros = [
+                    _np.zeros((bucket,) + shape, dtype)
+                    for shape, dtype in sig
+                ]
+                jax.block_until_ready(
+                    self._jitted_for(sig, bucket)(*zeros)
+                )
+            except Exception as exc:  # warmup is an optimization
+                _log.warning("%s: batched warmup failed: %s", self.name, exc)
+        return fn
 
     def process(self, frame: Frame) -> Frame:
-        out = self.build()(*frame.tensors)
+        out = self._jitted_for(self._sig_of(frame.tensors))(*frame.tensors)
         f = frame.with_tensors(out)
         for op in self.ops:
             f = op.transform_meta(f)
         return f
+
+    def process_batch(self, frames, cfg) -> Tuple[List[Frame], int]:
+        """ONE batched device invoke for a window of same-spec frames.
+
+        Stacks each tensor index on a NEW leading axis, pads up to the
+        next bucket with replicas of the last frame (rows computed and
+        discarded — the price of a bounded trace count), runs the
+        vmapped program, and splits results back per frame in order
+        with per-frame metadata/timestamps applied exactly as the
+        per-frame path would."""
+        import jax.numpy as jnp
+
+        n = len(frames)
+        sig = self._sig_of(frames[0].tensors)
+        if any(self._sig_of(f.tensors) != sig for f in frames[1:]):
+            # heterogeneous window (flexible stream / renegotiation
+            # boundary): frames can't share one stacked invoke — fall
+            # back to per-frame programs, semantics identical
+            return [self.process(f) for f in frames], n
+        bucket = cfg.bucket_for(n)
+        fn = self._jitted_for(sig, bucket)
+        pad = bucket - n
+        cols = []
+        for i in range(len(frames[0].tensors)):
+            rows = [f.tensors[i] for f in frames]
+            if pad:
+                rows.extend([frames[-1].tensors[i]] * pad)
+            cols.append(jnp.stack(rows))
+        outs = fn(*cols)
+        result: List[Frame] = []
+        for j, frame in enumerate(frames):
+            f = frame.with_tensors([o[j] for o in outs])
+            for op in self.ops:
+                f = op.transform_meta(f)
+            result.append(f)
+        return result, bucket
 
 
 @dataclass

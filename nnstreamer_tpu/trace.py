@@ -71,6 +71,27 @@ class Tracer:
         finally:
             self.complete(name, cat, t0, time.perf_counter() - t0, args or None)
 
+    def batch(
+        self, name: str, t_start: float, dur_s: float, *, batch: int,
+        bucket: int, wait_s: float, **extra
+    ) -> None:
+        """Batch-assembly span (micro-batching, pipeline/batching.py):
+        one "X" event per batched invoke carrying the batch size, the
+        padded bucket it dispatched as, the pad waste that padding cost,
+        and how long the collector waited for stragglers — the three
+        numbers that explain where batched throughput (or latency) went."""
+        waste = 100.0 * (bucket - batch) / bucket if bucket else 0.0
+        self.complete(
+            name, "batch", t_start, dur_s,
+            {
+                "batch": batch,
+                "bucket": bucket,
+                "wait_ms": round(wait_s * 1000.0, 3),
+                "pad_waste_pct": round(waste, 2),
+                **extra,
+            },
+        )
+
     def instant(self, name: str, cat: str = "event", **args) -> None:
         with self._lock:
             self._events.append(

@@ -175,3 +175,76 @@ def test_spec_context_includes_prefix_tokens():
     assert list(req.prompt[: len(pfx_toks)]) == list(pfx_toks)
     while cb.result(rid) is None:
         cb.spec_step(k=3)
+
+
+def test_chan_2deep_lockstep_stays_under_one_beat():
+    """Regression (_Chan wake discipline): a 2-deep channel in strict
+    producer/consumer lockstep must never eat a 50 ms wait beat — the
+    consumer draining to the low-water mark between the producer's
+    checks has to wake it (the Dekker advertise-then-recheck pairing).
+    32 items through a full channel finish in well under one beat."""
+    import threading
+    import time
+
+    from nnstreamer_tpu.pipeline.executor import _Chan
+
+    stop = threading.Event()
+    ch = _Chan(2)
+    n = 32
+    got = []
+
+    def consume():
+        while len(got) < n:
+            got.append(ch.get(stop))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t0 = time.perf_counter()
+    t.start()
+    for i in range(n):
+        ch.put(i, stop)
+    t.join(timeout=5)
+    elapsed = time.perf_counter() - t0
+    assert got == list(range(n))
+    # a genuinely missed wake costs a 50 ms beat per parked put (~1.5 s
+    # for 32 items through a 2-deep channel); the bound discriminates
+    # that while absorbing loaded-runner scheduling noise
+    assert elapsed < 0.5, f"missed wake: {elapsed*1000:.1f} ms for {n} items"
+
+
+def test_chan_drain_wakes_parked_producer():
+    """Regression (batch-collector interaction): drain() stops above the
+    low-water mark and the consumer then computes for a whole batch — a
+    parked producer must still be woken the moment space frees, not
+    sleep out its 50 ms beat."""
+    import threading
+    import time
+
+    from nnstreamer_tpu.pipeline.executor import _Chan
+
+    stop = threading.Event()
+
+    def attempt() -> float:
+        ch = _Chan(8)
+        for i in range(8):
+            ch.put(i, stop)  # fill: next put parks
+        put_done = threading.Event()
+
+        def producer():
+            ch.put(8, stop)
+            put_done.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.01)  # let the producer park
+        t0 = time.perf_counter()
+        items = ch.drain(2)  # 8→6: above low-water (4), space freed
+        assert items == [0, 1]
+        assert put_done.wait(timeout=1.0)
+        woke_ms = (time.perf_counter() - t0) * 1000
+        t.join(timeout=1)
+        return woke_ms
+
+    # min-of-3: a missed wake is deterministic (every attempt sleeps the
+    # full 50 ms beat), while scheduler noise on a loaded runner is not
+    best = min(attempt() for _ in range(3))
+    assert best < 40, f"producer slept a full beat: {best:.1f} ms"

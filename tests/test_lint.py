@@ -487,7 +487,7 @@ def _embedded_pipeline_strings():
             if isinstance(node, ast.Constant) and isinstance(node.value, str):
                 for cand in _candidate_pipelines_from_text(node.value):
                     found.append((fn, cand))
-    for doc in ("elements.md", "linting.md"):
+    for doc in ("elements.md", "linting.md", "batching.md"):
         with open(os.path.join(REPO, "docs", doc)) as f:
             for cand in _candidate_pipelines_from_text(f.read()):
                 found.append((doc, cand))
