@@ -1092,7 +1092,15 @@ def _record_measured(line: str) -> None:
         # keep the BEST capture by headline value: relay throughput
         # varies ~20× between windows (docs/BENCH_NOTES.md cost model),
         # and a capture taken in a degraded window must not clobber
-        # evidence from a healthy one
+        # evidence from a healthy one. A regression must stay VISIBLE in
+        # the primary artifact though, so the kept record always carries
+        # a `last_run` summary of the newest capture plus a count of
+        # lower captures discarded since the best one landed.
+        last_run = {
+            "t": time.time(),
+            "value": data.get("value"),
+            "partial": bool(data.get("partial")),
+        }
         if os.path.exists(full):
             try:
                 with open(full) as f:
@@ -1101,26 +1109,34 @@ def _record_measured(line: str) -> None:
                 # never replaces a full; otherwise best headline wins
                 prev_partial = bool(prev.get("partial"))
                 new_partial = bool(data.get("partial"))
-                if new_partial and not prev_partial:
-                    print(
-                        f"[bench] TPU capture kept: existing {path} is a "
-                        "full record",
-                        file=sys.stderr,
-                    )
-                    return
-                if (
+                lower_value = (
                     prev_partial == new_partial
                     and float(prev.get("value") or 0)
                     > float(data.get("value") or 0)
-                ):
+                )
+                keep_prev = (new_partial and not prev_partial) or lower_value
+                if keep_prev:
+                    prev["last_run"] = last_run
+                    if lower_value:
+                        # counts only genuinely-lower same-kind captures —
+                        # a partial discarded against a full record is
+                        # not a regression signal
+                        prev["discarded_lower_captures"] = (
+                            int(prev.get("discarded_lower_captures") or 0)
+                            + 1
+                        )
+                    with open(full, "w") as f:
+                        json.dump(prev, f, indent=1)
+                        f.write("\n")
                     print(
                         f"[bench] TPU capture kept: existing {path} has a "
-                        "better headline value",
+                        "better/fuller record (last_run updated)",
                         file=sys.stderr,
                     )
                     return
             except Exception:  # noqa: BLE001 — unreadable prior: replace
                 pass
+        data["last_run"] = last_run
         with open(full, "w") as f:
             json.dump(data, f, indent=1)
             f.write("\n")

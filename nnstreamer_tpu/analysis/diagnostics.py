@@ -95,6 +95,11 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "the value parses at runtime but probably not as intended "
         "(e.g. an unrecognized boolean string silently becomes false)",
     ),
+    "NNS-W107": (
+        Severity.WARNING, "unrouted-error-pad",
+        "on-error=route but the dead-letter error pad is unlinked; "
+        "failed frames are silently dropped",
+    ),
 }
 
 

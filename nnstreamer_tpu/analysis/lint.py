@@ -357,11 +357,35 @@ def _structure_pass(
                 f"{e.FACTORY_NAME} has no inputs linked",
                 "fan-in elements need at least one linked sink pad",
             )
-        if e.N_SRCS is not None and e.N_SRCS > 0 and outs < e.N_SRCS:
+        err_pad = getattr(e, "error_pad", None)
+        out_pads = {l.src_pad for l in pipeline.out_links(e)}
+        if err_pad is not None:
+            # the dead-letter pad gets its own diagnostic (NNS-W107), and
+            # is excluded from the generic unlinked-src count below: an
+            # unlinked error pad is a ROUTING mistake (silent drop), not
+            # a dangling data output. Only on-error=route REQUIRES the
+            # pad; a retry element's pad is optional exhaustion overflow
+            if getattr(e, "error_pad_required", False) \
+                    and err_pad not in out_pads:
+                report.add(
+                    "NNS-W107", e.name,
+                    "on-error=route but the error pad "
+                    f"(src_{err_pad}) is unlinked; dead-lettered frames "
+                    "are silently dropped",
+                    f"link '{e.name}.src_{err_pad}' to a sink "
+                    "(the dead-letter queue)",
+                )
+            n_data_srcs = e.N_SRCS - 1
+            data_outs = len(out_pads - {err_pad})
+        else:
+            n_data_srcs = e.N_SRCS
+            data_outs = outs
+        if n_data_srcs is not None and n_data_srcs > 0 \
+                and data_outs < n_data_srcs:
             report.add(
                 "NNS-W105", e.name,
-                f"{outs}/{e.N_SRCS} src pads linked; unlinked output is "
-                "dropped",
+                f"{data_outs}/{n_data_srcs} src pads linked; unlinked "
+                "output is dropped",
                 "terminate it into a sink (or fakesink)",
             )
         # explicit pad indices beyond the allocated pad count (e.g.

@@ -166,6 +166,102 @@ class HostScalerBackend(Backend):
         return [tuple(col[j] for col in cols) for j in range(len(batch))]
 
 
+@registry.filter_backend("faulty")
+class FaultyBackend(Backend):
+    """Chaos-injection passthrough (docs/fault-tolerance.md): a
+    deterministic stand-in for a flaky inference engine, used to drive
+    the executor's error policies end-to-end. Host-bound (no traceable
+    fn) so failures raise per frame. Options via ``custom=``:
+
+    - ``fail_rate:0.2`` — probability an invoke raises (seeded RNG).
+    - ``fail_every_n:5`` — every Nth invoke raises (deterministic; a
+      retried frame re-rolls on the next invoke count).
+    - ``fail_first_n:3`` — the first N invokes raise, then healthy
+      (circuit-breaker recovery scenarios).
+    - ``latency_spike_ms:50`` + ``spike_every_n:10`` — periodic stalls.
+    - ``raise_type:backend|value|runtime`` — exception class raised.
+    - ``strict_shapes:true`` — invokes validate tensors against the
+      opened spec, so tensor_chaos-corrupted frames raise here.
+    - ``batchable:true`` — declare the micro-batch capability; the
+      default invoke_batched chains invoke(), so one poisoned frame
+      fails the whole window (the batch-split path under test).
+    - ``seed:7`` — RNG seed (default 0).
+    """
+
+    name = "faulty"
+
+    _RAISES = {
+        "backend": BackendError,
+        "value": ValueError,
+        "runtime": RuntimeError,
+    }
+
+    def open(self, props: FilterProps) -> None:
+        import random
+
+        # runtime import: backends load before the elements package
+        from nnstreamer_tpu.elements.base import _parse_bool
+
+        self.props = props
+        opts = props.custom_dict()
+        self._spec = props.input_spec
+        self._fail_rate = float(opts.get("fail_rate", "0"))
+        self._fail_every_n = int(opts.get("fail_every_n", "0"))
+        self._fail_first_n = int(opts.get("fail_first_n", "0"))
+        self._spike_ms = float(opts.get("latency_spike_ms", "0"))
+        self._spike_every_n = int(opts.get("spike_every_n", "0"))
+        self._strict = _parse_bool(opts.get("strict_shapes", "false"))
+        self.batchable = _parse_bool(opts.get("batchable", "false"))
+        self._exc = self._RAISES.get(
+            opts.get("raise_type", "backend").lower(), BackendError
+        )
+        self._rng = random.Random(int(opts.get("seed", "0")))
+        self.invokes = 0
+        self.failures = 0
+        self.batched_calls = 0
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._spec is None:
+            raise BackendError("faulty: input spec unknown until set")
+        return self._spec, self._spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._spec = in_spec
+        return in_spec
+
+    def _maybe_fail(self) -> None:
+        n = self.invokes
+        fail = (
+            (self._fail_first_n and n <= self._fail_first_n)
+            or (self._fail_every_n and n % self._fail_every_n == 0)
+            or (self._fail_rate and self._rng.random() < self._fail_rate)
+        )
+        if fail:
+            self.failures += 1
+            raise self._exc(f"faulty: injected failure on invoke {n}")
+
+    def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        import time as _t
+
+        self.invokes += 1
+        if self._spike_every_n and self.invokes % self._spike_every_n == 0:
+            _t.sleep(self._spike_ms / 1000.0)
+        if self._strict and self._spec is not None:
+            for t, ts in zip(tensors, self._spec):
+                if tuple(np.asarray(t).shape) != tuple(ts.shape):
+                    self.failures += 1
+                    raise self._exc(
+                        f"faulty: corrupted frame — tensor shape "
+                        f"{np.asarray(t).shape} != spec {ts.shape}"
+                    )
+        self._maybe_fail()
+        return tensors
+
+    def invoke_batched(self, batch):
+        self.batched_calls += 1
+        return super().invoke_batched(batch)
+
+
 @registry.filter_backend("framecounter")
 class FrameCounterBackend(Backend):
     """Emits a running uint32 frame count (custom_example_framecounter) —

@@ -67,6 +67,16 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "batch_timeout_ms": "1.0",
         # comma list of padded batch sizes; empty = 1,2,4,...,max_batch
         "batch_buckets": "",
+        # fault tolerance defaults (pipeline/faults.py); per-element
+        # on-error/retry-max/retry-backoff-ms properties override. Env:
+        # NNS_TPU_EXECUTOR_ON_ERROR etc.
+        "on_error": "stop",
+        "retry_max": "3",
+        "retry_backoff_ms": "10.0",
+        "retry_backoff_cap_ms": "1000.0",
+        # stall watchdog: >0 arms the executor monitor thread that turns
+        # a no-progress-with-queued-data hang into PipelineStallError
+        "watchdog_timeout_ms": "0",
     },
 }
 

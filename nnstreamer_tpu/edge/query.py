@@ -21,7 +21,9 @@ its meson option.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from typing import Dict, List, Optional
 
 from nnstreamer_tpu import registry
@@ -115,7 +117,17 @@ class TensorQueryClient(HostElement):
     raw TCP bulk — reference tensor_query_common.c:35-42), topic
     (default nns-query). Requests are strictly synchronous request/reply
     per frame (the reference's max-request pipelining knob does not
-    apply)."""
+    apply).
+
+    Reconnect-with-backoff (docs/fault-tolerance.md): ``retry-max`` > 0
+    makes CONNECT/SEND failures (unreachable server at start, a dead
+    connection discovered while sending) reconnect with the fault
+    layer's jittered exponential backoff (``retry-backoff-ms`` base) and
+    resend the frame, instead of failing fast. Once a request went out,
+    failures keep failing fast — a timeout or a connection lost while
+    awaiting the reply may mean the server already processed the
+    request, and a resend could double-process it (the dropped
+    connection still reconnects for the next frame)."""
 
     FACTORY_NAME = "tensor_query_client"
 
@@ -125,6 +137,12 @@ class TensorQueryClient(HostElement):
         "timeout": PropSpec("float", 10.0, desc="per-request (s)"),
         "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "HYBRID")),
         "topic": PropSpec("str", "nns-query"),
+        "retry-max": PropSpec(
+            "int", 0, desc="reconnect attempts on transport failure"
+        ),
+        "retry-backoff-ms": PropSpec(
+            "float", 50.0, desc="reconnect backoff base (jittered, doubling)"
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -134,6 +152,15 @@ class TensorQueryClient(HostElement):
         self.timeout = float(self.get_property("timeout", DEFAULT_TIMEOUT))
         self.connect_type = "TCP"
         self.topic = str(self.get_property("topic", "nns-query"))
+        self.retry_max = max(0, int(self.get_property("retry-max", 0)))
+        from nnstreamer_tpu.pipeline.faults import FaultPolicy
+
+        self._retry_policy = FaultPolicy(
+            on_error="retry",
+            retry_max=self.retry_max,
+            backoff_ms=float(self.get_property("retry-backoff-ms", 50.0)),
+        )
+        self._rng = random.Random(0xED6E)  # deterministic jitter stream
         self._transport = None
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
@@ -145,42 +172,92 @@ class TensorQueryClient(HostElement):
         # tensor_query/README.md)
         return [TensorsSpec(format=TensorFormat.FLEXIBLE)]
 
-    def start(self) -> None:
+    def _connect_once(self) -> None:
+        # resolve (and validate) connect-type here, not only in start():
+        # standalone callers may hit process() without start(), and the
+        # property must be honored on that path too
         self.connect_type = _check_connect_type(self)
         self._transport = _make_client_transport(self.connect_type, self.topic)
         try:
             self._transport.connect(self.host, self.port)
-        except (TransportError, OSError) as exc:
-            raise ElementError(
-                f"{self.name}: cannot reach query server "
-                f"{self.host}:{self.port}: {exc}"
-            ) from exc
+        except (TransportError, OSError):
+            self._drop_connection()
+            raise
 
-    def stop(self) -> None:
+    def _drop_connection(self) -> None:
         if self._transport is not None:
             self._transport.close()
             self._transport = None
 
+    def start(self) -> None:
+        from nnstreamer_tpu.pipeline.faults import backoff_s
+
+        attempt = 0
+        while True:
+            try:
+                self._connect_once()
+                return
+            except (TransportError, OSError) as exc:
+                if attempt >= self.retry_max:
+                    raise ElementError(
+                        f"{self.name}: cannot reach query server "
+                        f"{self.host}:{self.port}"
+                        + (f" after {attempt + 1} attempts" if attempt else "")
+                        + f": {exc}"
+                    ) from exc
+                time.sleep(backoff_s(attempt, self._retry_policy, self._rng))
+                attempt += 1
+
+    def stop(self) -> None:
+        self._drop_connection()
+
     def process(self, frame: Frame) -> Optional[Frame]:
-        if self._transport is None:  # reconnect after a timeout-dropped conn
-            self.start()
-        self._transport.send(0, encode_message(frame))
-        got = self._transport.recv(timeout=self.timeout)
-        if got is None:
-            # In a pipeline this error poisons the stream, matching the
-            # reference's GST_FLOW_ERROR on query timeout. For standalone
-            # (direct process()) callers who catch and continue, drop the
-            # connection first so a reply arriving *after* the timeout
-            # can't be returned for the NEXT frame (off-by-one desync);
-            # the next call reconnects.
-            self._transport.close()
-            self._transport = None
-            raise ElementError(
-                f"{self.name}: query timeout after {self.timeout}s"
-            )
-        _, payload = got
-        if not payload:
-            raise ElementError(f"{self.name}: server closed the connection")
+        from nnstreamer_tpu.pipeline.faults import backoff_s
+
+        data = encode_message(frame)
+        attempt = 0
+        while True:
+            sent = False
+            try:
+                if self._transport is None:
+                    # reconnect after a timeout-dropped/failed connection
+                    self._connect_once()
+                self._transport.send(0, data)
+                sent = True
+                got = self._transport.recv(timeout=self.timeout)
+                if got is None:
+                    # In a pipeline this error poisons the stream, matching
+                    # the reference's GST_FLOW_ERROR on query timeout. For
+                    # standalone (direct process()) callers who catch and
+                    # continue, drop the connection first so a reply
+                    # arriving *after* the timeout can't be returned for
+                    # the NEXT frame (off-by-one desync); the next call
+                    # reconnects. Timeouts do NOT ride the reconnect-retry
+                    # loop: the server may have received the request, and
+                    # a resend could double-process it.
+                    self._drop_connection()
+                    raise ElementError(
+                        f"{self.name}: query timeout after {self.timeout}s"
+                    )
+                _, payload = got
+                if not payload:
+                    raise TransportError("server closed the connection")
+                break
+            except (TransportError, OSError) as exc:
+                self._drop_connection()
+                # the retry loop covers CONNECT/SEND failures only: once
+                # the request went out, a lost connection is the timeout
+                # case in different clothes — the server may have
+                # processed it, and a resend could double-process (the
+                # reconnected transport still serves the NEXT frame)
+                if sent or attempt >= self.retry_max:
+                    raise ElementError(
+                        f"{self.name}: query transport failed"
+                        + (f" after {attempt + 1} attempts" if attempt else "")
+                        + f": {exc}"
+                    ) from exc
+                time.sleep(backoff_s(attempt, self._retry_policy, self._rng))
+                attempt += 1
         reply = decode_message(payload)
         if isinstance(reply, EOS):
             return None

@@ -24,6 +24,7 @@ from nnstreamer_tpu.elements import control  # noqa: F401
 from nnstreamer_tpu.elements import sparse_elems  # noqa: F401
 from nnstreamer_tpu.elements import stage  # noqa: F401
 from nnstreamer_tpu.elements import iio  # noqa: F401
+from nnstreamer_tpu.elements import chaos  # noqa: F401
 from nnstreamer_tpu.elements import llm_serve  # noqa: F401
 from nnstreamer_tpu.elements import media  # noqa: F401
 # distributed elements (conditional registration in the reference's

@@ -31,7 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
-from nnstreamer_tpu.tensors.spec import TensorsSpec
+from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
 
 _log = get_logger("elements")
 
@@ -90,6 +90,66 @@ class PropSpec:
 PROPS_ANY = "*"
 
 
+# -- fault-tolerance property surface (pipeline/faults.py) ------------------
+# Declared here (not in pipeline.faults) so element modules can spread the
+# schema without importing the pipeline package at class-definition time.
+
+ON_ERROR_CHOICES = ("stop", "drop", "retry", "route")
+
+#: PropSpec table spread into the PROPERTIES of every element that
+#: supports per-frame error policies; pipeline/faults.py resolves the
+#: values (element property over [executor] config default).
+FAULT_PROPS: Dict[str, PropSpec] = {
+    "on-error": PropSpec(
+        "enum", None, ON_ERROR_CHOICES,
+        desc="per-frame error policy (default stop; see "
+        "docs/fault-tolerance.md)",
+    ),
+    "retry-max": PropSpec(
+        "int", None, desc="retry attempts before degrading (default 3)"
+    ),
+    "retry-backoff-ms": PropSpec(
+        "float", None,
+        desc="base retry backoff, doubled per attempt, jittered "
+        "(default 10.0)",
+    ),
+}
+
+
+def install_error_pad(elem: "Element") -> None:
+    """Expose the dead-letter error pad on ``elem`` when its ``on-error``
+    property says ``route`` — or ``retry``, whose exhausted frames
+    degrade to the error pad when one is linked (unlinked is fine for
+    retry: exhaustion then drops; only ``route`` with an unlinked pad is
+    a wiring mistake, nns-lint NNS-W107). Called from the __init__ of
+    every element class that DECLARES the fault PropSpecs (after the
+    base __init__ has consumed the property dict). The pad appears at
+    index N_SRCS (src_1 for 1-src elements); negotiation appends a
+    flexible spec for it (fix_negotiation) and the compiler keeps the
+    element out of fused segments so per-frame routing is possible."""
+    raw = elem.get_property("on-error")
+    if raw is None:
+        return
+    mode = str(raw).strip().lower()
+    if mode not in ON_ERROR_CHOICES:
+        raise ValueError(
+            f"{elem.name}: on-error={raw!r} not one of "
+            f"{'/'.join(ON_ERROR_CHOICES)}"
+        )
+    if mode not in ("route", "retry"):
+        return
+    if type(elem).N_SRCS != 1:
+        raise ValueError(
+            f"{elem.name}: on-error={mode} needs exactly one src pad "
+            f"(got N_SRCS={type(elem).N_SRCS})"
+        )
+    # instance attribute shadows the class attribute: only THIS element
+    # grows the extra pad
+    elem.N_SRCS = 2
+    elem.error_pad = 1
+    elem.error_pad_required = mode == "route"
+
+
 class Element:
     """Base element. Subclasses set N_SINKS/N_SRCS (None = request pads,
     decided at link time) and implement negotiate()."""
@@ -103,6 +163,16 @@ class Element:
     # module table): the static analyzer (nns-lint) must not dry-run
     # their negotiation on clones.
     LINT_SKIP_NEGOTIATE = False
+
+    # Dead-letter error pad index (pipeline/faults.py): None = no error
+    # pad; elements whose ``on-error=route|retry`` property exposed one
+    # carry the extra src pad index here (install_error_pad sets it, the
+    # executor routes error frames to it). error_pad_required is True
+    # only for ``route``, where leaving the pad unlinked is a silent-drop
+    # wiring mistake (nns-lint NNS-W107); a retry element's pad is an
+    # optional overflow for exhausted frames.
+    error_pad: Optional[int] = None
+    error_pad_required: bool = False
 
     # Per-class property schema (merged over the MRO by property_schema()).
     # Subclasses add their own entries; nns-lint validates launch-string
@@ -174,7 +244,13 @@ class Element:
 
     def fix_negotiation(self, in_specs: List[Spec]) -> List[Spec]:
         self.in_specs = list(in_specs)
-        self.out_specs = self.negotiate(list(in_specs))
+        outs = list(self.negotiate(list(in_specs)))
+        if self.error_pad is not None and len(outs) == self.error_pad:
+            # the dead-letter pad (on-error=route): error frames carry the
+            # element's ORIGINAL input tensors + error meta, so the pad's
+            # spec is flexible — any sink accepts it
+            outs.append(TensorsSpec(format=TensorFormat.FLEXIBLE))
+        self.out_specs = outs
         return self.out_specs
 
     # -- QoS ----------------------------------------------------------------
@@ -223,6 +299,10 @@ class TensorOp(Element):
     # host-path (non-traceable) ops.
     batch_stats: Optional[Any] = None
     batch_config: Optional[Any] = None
+
+    # Plan-time resolved FaultPolicy (pipeline/faults.py) for host-path
+    # ops; fused segments carry their own on FusedSegment.
+    fault_policy: Optional[Any] = None
 
     # Bumped whenever the op's make_fn() result changes without a shape
     # change (model hot swap via reload_model): part of FusedSegment's

@@ -24,11 +24,13 @@ import numpy as np
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.elements.base import (
+    FAULT_PROPS,
     MediaSpec,
     NegotiationError,
     PropSpec,
     Spec,
     TensorOp,
+    install_error_pad,
 )
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import DType, TensorFormat, TensorSpec, TensorsSpec
@@ -72,6 +74,8 @@ class TensorConverter(TensorOp):
         "input-dim": PropSpec("str", None, desc="octet framing dims"),
         "input-type": PropSpec("str", "uint8"),
         "script": PropSpec("str", None, desc="python3 subplugin script path"),
+        # per-frame error policy (pipeline/faults.py)
+        **FAULT_PROPS,
     }
 
     def __init__(self, name=None, **props):
@@ -85,6 +89,7 @@ class TensorConverter(TensorOp):
         self._subplugin = None
         self._custom_fn = None
         self._traceable_fn = None
+        install_error_pad(self)
 
     # -- negotiation -------------------------------------------------------
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:

@@ -18,7 +18,14 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Spec, TensorOp
+from nnstreamer_tpu.elements.base import (
+    FAULT_PROPS,
+    NegotiationError,
+    PropSpec,
+    Spec,
+    TensorOp,
+    install_error_pad,
+)
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
 
@@ -50,7 +57,9 @@ class TensorDecoder(TensorOp):
     FACTORY_NAME = "tensor_decoder"
 
     PROPERTIES = dict(
-        {"mode": PropSpec("str", None, desc="decoder subplugin name")},
+        {"mode": PropSpec("str", None, desc="decoder subplugin name"),
+         # per-frame error policy (pipeline/faults.py)
+         **FAULT_PROPS},
         **{
             f"option{i}": PropSpec("str", "", desc="mode-specific option")
             for i in range(1, 10)
@@ -68,6 +77,7 @@ class TensorDecoder(TensorOp):
         self._sub = None
         self._custom_fn = None
         self._traceable_fn = None
+        install_error_pad(self)
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs

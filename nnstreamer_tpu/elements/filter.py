@@ -23,15 +23,26 @@ all). Read-only: latency, throughput.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.backends.base import Backend, FilterProps, InvokeStats
-from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Spec, TensorOp
+from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps, InvokeStats
+from nnstreamer_tpu.elements.base import (
+    FAULT_PROPS,
+    NegotiationError,
+    PropSpec,
+    Spec,
+    TensorOp,
+    install_error_pad,
+)
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.tensors.frame import Frame
 from nnstreamer_tpu.tensors.spec import TensorsSpec
+
+_log = get_logger("filter")
 
 # shared-model table (reference shared_tensor_filter_key,
 # tensor_filter_common.c shared-model support): filters with the same key
@@ -157,6 +168,24 @@ class TensorFilter(TensorOp):
             "str", None,
             desc="comma list of padded batch sizes (default 1,2,4,...,max-batch)",
         ),
+        # per-frame error policy (pipeline/faults.py)
+        **FAULT_PROPS,
+        # graceful degradation: after fallback-after CONSECUTIVE backend
+        # failures the filter hot-swaps to the fallback backend (circuit
+        # breaker) instead of dying, probing the primary every
+        # fallback-probe-every frames for recovery
+        "fallback-framework": PropSpec(
+            "str", "", desc="degraded-mode backend (circuit breaker)"
+        ),
+        "fallback-model": PropSpec(
+            "str", "", desc="degraded-mode model path(s)"
+        ),
+        "fallback-after": PropSpec(
+            "int", 3, desc="consecutive failures that open the circuit"
+        ),
+        "fallback-probe-every": PropSpec(
+            "int", 64, desc="frames between primary recovery probes"
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -205,6 +234,28 @@ class TensorFilter(TensorOp):
         )
         self.backend: Optional[Backend] = None
         self._traceable: Optional[Callable] = None
+        install_error_pad(self)
+        # circuit-breaker fallback (docs/fault-tolerance.md): a configured
+        # fallback forces the host path (is_traceable False) so the swap
+        # can happen per frame — a fused program can't change backends
+        self.fallback_framework = str(
+            self.get_property("fallback-framework", "") or ""
+        )
+        self.fallback_model = str(self.get_property("fallback-model", "") or "")
+        self._fallback_conf = bool(self.fallback_framework or self.fallback_model)
+        self.fallback_after = max(1, int(self.get_property("fallback-after", 3)))
+        self.fallback_probe_every = max(
+            1, int(self.get_property("fallback-probe-every", 64))
+        )
+        self._fb_backend: Optional[Backend] = None
+        self._fb_open_error: Optional[Exception] = None
+        self._consec_failures = 0
+        self._circuit_open = False
+        self._since_probe = 0
+        self._cb = {
+            "primary_failures": 0, "circuit_opens": 0,
+            "circuit_closes": 0, "fallback_invokes": 0,
+        }
         # Per-ELEMENT invoke stats, like the reference's (latency/
         # throughput live in the element private data, tensor_filter.c:
         # 334-433) — backends keep their own cumulative stats (the
@@ -237,6 +288,9 @@ class TensorFilter(TensorOp):
                 self.backend.close()
             self.backend = None
             self._traceable = None
+        if self._fb_backend is not None:
+            self._fb_backend.close()
+            self._fb_backend = None
 
     def reload_model(self, model: str) -> None:
         """Hot swap (reference is-updatable + RELOAD_MODEL event)."""
@@ -255,6 +309,7 @@ class TensorFilter(TensorOp):
             )
         b = self._ensure_open()
         model_in = self._select_model_inputs_spec(spec)
+        self._negotiated_model_in = model_in  # fallback opens to this spec
         if not model_in.is_static:
             # flexible input stream (e.g. from a query serversrc or edge
             # src): the model's own spec governs; per-frame tensors are
@@ -321,6 +376,10 @@ class TensorFilter(TensorOp):
         if getattr(self, "_flexible_input", False):
             # per-frame shapes: can't be part of a statically-jitted segment
             return False
+        if self._fallback_conf:
+            # circuit-breaker hot swap needs per-frame invokes: the filter
+            # is a deliberate fusion barrier in degradable mode
+            return False
         b = self._ensure_open()
         return b.traceable_fn() is not None
 
@@ -351,6 +410,53 @@ class TensorFilter(TensorOp):
         return self._apply_combinations(traced)
 
     def host_process(self, frame: Frame) -> Frame:
+        if not self._fallback_conf:
+            return self._invoke_primary(frame)
+        # circuit breaker (docs/fault-tolerance.md): consecutive primary
+        # failures open the circuit and the fallback backend serves;
+        # periodic probes close it again once the primary recovers
+        if self._circuit_open:
+            self._since_probe += 1
+            if self._since_probe >= self.fallback_probe_every:
+                self._since_probe = 0
+                try:
+                    out = self._invoke_primary(frame)
+                except Exception as exc:  # noqa: BLE001 — probe failed
+                    self._cb["primary_failures"] += 1
+                    _log.debug("%s: recovery probe failed: %s", self.name, exc)
+                else:
+                    self._circuit_open = False
+                    self._consec_failures = 0
+                    self._cb["circuit_closes"] += 1
+                    _log.warning(
+                        "%s: primary backend recovered; circuit closed",
+                        self.name,
+                    )
+                    return out
+            return self._invoke_fallback(frame)
+        try:
+            out = self._invoke_primary(frame)
+        except Exception:
+            self._consec_failures += 1
+            self._cb["primary_failures"] += 1
+            if self._consec_failures >= self.fallback_after:
+                self._circuit_open = True
+                self._since_probe = 0
+                self._cb["circuit_opens"] += 1
+                _log.warning(
+                    "%s: %d consecutive backend failures; circuit OPEN — "
+                    "serving from fallback %s",
+                    self.name, self._consec_failures,
+                    self.fallback_framework or self.fprops.framework,
+                )
+                # this frame survives on the fallback instead of dying
+                return self._invoke_fallback(frame)
+            # below the threshold: the node's on-error policy decides
+            raise
+        self._consec_failures = 0
+        return out
+
+    def _invoke_primary(self, frame: Frame) -> Frame:
         b = self._ensure_open()
         fn = self._apply_combinations(b.invoke_timed)
         lock = getattr(b, "shared_invoke_lock", None)
@@ -368,11 +474,84 @@ class TensorFilter(TensorOp):
         self._elem_stats.record(dt)
         return frame.with_tensors(out)
 
+    # -- circuit-breaker fallback ------------------------------------------
+    def _ensure_fallback(self) -> Backend:
+        if self._fb_open_error is not None:
+            # an unopenable fallback is latched: re-loading the model per
+            # frame while the circuit is open would turn a misconfigured
+            # path into a model-load attempt per frame
+            raise BackendError(
+                f"{self.name}: fallback backend failed to open: "
+                f"{self._fb_open_error}"
+            ) from self._fb_open_error
+        if self._fb_backend is None:
+            try:
+                self._fb_backend = self._open_fallback()
+            except Exception as exc:
+                self._fb_open_error = exc
+                raise
+        return self._fb_backend
+
+    def _open_fallback(self) -> Backend:
+        fw = self.fallback_framework or self.fprops.framework
+        models = tuple(
+            m for m in self.fallback_model.split(",") if m
+        ) or self.fprops.model
+        props = dataclasses.replace(
+            self.fprops, framework=fw, model=models
+        )
+        cls = registry.get(registry.KIND_FILTER, fw)
+        b: Backend = cls()
+        b.open(props)
+        # the swap is invisible downstream only if the fallback keeps
+        # the negotiated output spec — verify once at open
+        model_in = getattr(self, "_negotiated_model_in", None)
+        if model_in is not None and model_in.is_static:
+            try:
+                cur_in, fb_out = b.get_model_info()
+            except Exception:
+                fb_out = b.set_input_info(model_in)
+            else:
+                if not cur_in.is_compatible(model_in):
+                    fb_out = b.set_input_info(model_in)
+            want = getattr(self, "_model_out_spec", None)
+            if want is not None and not fb_out.is_compatible(want):
+                b.close()
+                raise BackendError(
+                    f"{self.name}: fallback output spec {fb_out} is not "
+                    f"compatible with the negotiated {want}"
+                )
+        return b
+
+    def _invoke_fallback(self, frame: Frame) -> Frame:
+        b = self._ensure_fallback()
+        fn = self._apply_combinations(b.invoke_timed)
+        t0 = time.perf_counter_ns()
+        out = fn(frame.tensors)
+        self._elem_stats.record(time.perf_counter_ns() - t0)
+        self._cb["fallback_invokes"] += 1
+        return frame.with_tensors(out)
+
+    def circuit_stats(self) -> Dict[str, float]:
+        """Circuit-breaker observability (Executor.stats() surfaces these
+        as ``cb_*`` next to latency/throughput); {} when no fallback is
+        configured so stats stay noise-free."""
+        if not self._fallback_conf:
+            return {}
+        return {
+            **self._cb,
+            "fallback_active": 1 if self._circuit_open else 0,
+        }
+
     # -- host micro-batching (pipeline/batching.py) ------------------------
     def is_batch_capable(self) -> bool:
         """Host path may micro-batch only when the backend declared the
-        capability; flexible per-frame shapes can't share one invoke."""
+        capability; flexible per-frame shapes can't share one invoke, and
+        a degradable filter (fallback configured) stays per-frame so the
+        circuit breaker counts and swaps at frame granularity."""
         if getattr(self, "_flexible_input", False):
+            return False
+        if self._fallback_conf:
             return False
         return bool(getattr(self._ensure_open(), "batchable", False))
 

@@ -28,7 +28,14 @@ from typing import Any, Callable, List, Optional, Tuple
 import jax.numpy as jnp
 
 from nnstreamer_tpu import registry
-from nnstreamer_tpu.elements.base import NegotiationError, PropSpec, Spec, TensorOp
+from nnstreamer_tpu.elements.base import (
+    FAULT_PROPS,
+    NegotiationError,
+    PropSpec,
+    Spec,
+    TensorOp,
+    install_error_pad,
+)
 from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
 
 _ARITH_OP = re.compile(
@@ -57,6 +64,8 @@ class TensorTransform(TensorOp):
              "stand"),
         ),
         "option": PropSpec("str", "", desc="per-mode option string"),
+        # per-frame error policy (pipeline/faults.py)
+        **FAULT_PROPS,
     }
 
     def __init__(self, name=None, **props):
@@ -72,6 +81,7 @@ class TensorTransform(TensorOp):
             "stand",
         ):
             raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
+        install_error_pad(self)
 
     # -- negotiation -------------------------------------------------------
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:

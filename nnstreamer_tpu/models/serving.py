@@ -940,6 +940,14 @@ class _DraftEngine:
         _, self._cache, _ = self._step(tok, pos, active, self._cache)
 
 
+class BatcherFailedError(RuntimeError):
+    """The batcher's device state is invalid: a step/pump launch raised
+    AFTER dispatch, so the donated ``_cache``/``_hist`` (and draft cache)
+    buffers were consumed while the attributes still reference them.
+    Every later call would hit a cryptic deleted-buffer error; this typed
+    error names the original failure instead. Build a new batcher."""
+
+
 class ContinuousBatcher:
     """Continuous-batching server over a fixed slot batch (greedy by
     default; per-request temperature/top-k/top-p sampling via submit()).
@@ -947,6 +955,12 @@ class ContinuousBatcher:
     submit() may be called at any time (thread-safe); step() advances every
     active slot by one token. Finished requests free their slot for the
     next submit — the batch never drains to admit new work.
+
+    Failure semantics: the step/pump programs donate the KV cache, so a
+    raise after dispatch poisons the carried state irreversibly. The
+    batcher marks itself failed (``_mark_failed``) and every subsequent
+    step/pump/submit raises :class:`BatcherFailedError` chained to the
+    original exception — mirroring submit()'s slot-release rollback.
     """
 
     def __init__(
@@ -1012,6 +1026,9 @@ class ContinuousBatcher:
         self.compute_dtype = compute_dtype
         self._lock = threading.Lock()       # host/device state
         self._step_lock = threading.Lock()  # serializes device steps
+        # set by _mark_failed when a donated-state launch raised after
+        # dispatch; read lock-free (GIL-atomic) by _check_failed
+        self._failed: Optional[Exception] = None
         self._next_rid = 0
         self._slots: List[Optional[_Request]] = [None] * n_slots
         self._pending: List[_PendingInsert] = []
@@ -1613,6 +1630,7 @@ class ContinuousBatcher:
         with a deterministic per-request stream: every token is keyed by
         fold_in(PRNGKey(seed), fill-level), so the stream depends only on
         (seed, position) — never on batch composition."""
+        self._check_failed()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
         if max_new_tokens < 1:
@@ -1839,6 +1857,24 @@ class ContinuousBatcher:
                 )
             self._active[p.slot] = True
 
+    # -- failure containment (donated-state launches) ----------------------
+    def _mark_failed(self, exc: Exception) -> None:
+        """A step/pump program raised after dispatch: the donated cache
+        buffers are gone while the attributes still point at them. Latch
+        the failure so every later call raises a clear typed error
+        instead of a cryptic deleted-buffer one. Lock-free write
+        (GIL-atomic; callers may already hold _lock/_step_lock)."""
+        if self._failed is None:
+            self._failed = exc
+
+    def _check_failed(self) -> None:
+        if self._failed is not None:
+            raise BatcherFailedError(
+                f"batcher is failed: a prior step/pump launch raised "
+                f"{type(self._failed).__name__}: {self._failed}; the "
+                "donated device state is invalid — build a new batcher"
+            ) from self._failed
+
     def step(self) -> Dict[int, int]:
         """Advance every active slot one token; returns {rid: token}.
 
@@ -1847,6 +1883,7 @@ class ContinuousBatcher:
         in-flight device step); _step_lock serializes concurrent
         steppers. Slots admitted while a step is in flight join at the
         next step."""
+        self._check_failed()
         t0 = _time.perf_counter()
         with self._step_lock:
             return self._plain_step_locked(t0)
@@ -1910,6 +1947,7 @@ class ContinuousBatcher:
         single-invoke-per-buffer filter loop
         (gst/nnstreamer/tensor_filter/tensor_filter.c) batched along
         the token axis instead."""
+        self._check_failed()
         t0 = _time.perf_counter()
         with self._step_lock:
             self._apply_pending()
@@ -1931,10 +1969,18 @@ class ContinuousBatcher:
                     else None,
                 )
             fn = self._pump_sampling if sampling else self._pump_greedy
-            emits, tok, pos, _act, cache, hist, dcache = fn(
-                *args, n_steps=int(n)
-            )
-            emits_np = np.asarray(emits)  # ONE [B, n] transfer
+            try:
+                emits, tok, pos, _act, cache, hist, dcache = fn(
+                    *args, n_steps=int(n)
+                )
+                emits_np = np.asarray(emits)  # ONE [B, n] transfer
+            except Exception as exc:
+                # the launch donated _cache/_hist (and the draft cache):
+                # a raise here leaves them consumed — latch the failure
+                # so later calls get BatcherFailedError, not a cryptic
+                # deleted-buffer error (submit()'s rollback analogue)
+                self._mark_failed(exc)
+                raise
             with self._lock:
                 self._cache = cache
                 self._hist = self._pin(hist)
@@ -1971,6 +2017,7 @@ class ContinuousBatcher:
         ``rounds`` is a static scan length, so every distinct value is
         its own XLA program — quantization bounds the program variants
         to log2(rounds) instead of one per tail length."""
+        self._check_failed()
         t0 = _time.perf_counter()
         k = max(2, int(k))
         if self._draft is not None and self.windowed:
@@ -2011,10 +2058,14 @@ class ContinuousBatcher:
                         else self._spec_pump_greedy
                     )
             if r >= 1:
-                packed, tok, pos, _act, cache, hist, dcache = fn(
-                    *args, rounds=r, k=k, g=int(ngram)
-                )
-                packed_np = np.asarray(packed)  # ONE transfer
+                try:
+                    packed, tok, pos, _act, cache, hist, dcache = fn(
+                        *args, rounds=r, k=k, g=int(ngram)
+                    )
+                    packed_np = np.asarray(packed)  # ONE transfer
+                except Exception as exc:
+                    self._mark_failed(exc)  # donated state consumed
+                    raise
                 acc, cols = int(packed_np[-2]), int(packed_np[-1])
                 emits_np = packed_np[:-2].reshape(self.n_slots, r, k)
                 with self._lock:
@@ -2032,7 +2083,12 @@ class ContinuousBatcher:
         """Drive ``rounds`` host spec_step rounds while preserving
         spec_pump's return contract ({rid: ALL tokens emitted}) —
         spec_step itself reports only the last token per request, so
-        the full emission is reconstructed from req.tokens growth."""
+        the full emission is reconstructed from req.tokens growth.
+        Direct _Request references are captured the first time each rid
+        is seen: re-resolving rids at the end through the bounded
+        _done_pool would silently drop tokens for any request evicted by
+        keep_results churn mid-rounds, breaking the ALL-tokens
+        contract."""
         before: Dict[int, int] = {}
         with self._lock:
             for req in self._slots:
@@ -2043,22 +2099,43 @@ class ContinuousBatcher:
                     # pump output on the device paths either
                     before[req.rid] = max(1, len(req.tokens))
         default_start = 1
-        out: Dict[int, List[int]] = {}
+        refs: Dict[int, _Request] = {}
+        emitted: set = set()
         for _ in range(int(rounds)):
+            with self._lock:
+                # pre-round snapshot: anything that can emit this round
+                # is live in a slot (or pending) RIGHT NOW — grabbing the
+                # reference here beats post-round _done_pool lookups,
+                # which lose evicted requests
+                for r in self._slots:
+                    if r is not None and r.rid not in refs:
+                        refs[r.rid] = r
+                for p in self._pending:
+                    if p.req.rid not in refs:
+                        refs[p.req.rid] = p.req
             em = self.spec_step(k=k, ngram=ngram)
             if not em:
                 break
-            for rid in em:
-                out.setdefault(rid, [])
+            emitted |= set(em)
+            missing = [rid for rid in em if rid not in refs]
+            if missing:
+                # admitted DURING the round (the round's own
+                # _apply_pending, after our pre-round snapshot): resolve
+                # now, while the request is still live or freshly done
+                with self._lock:
+                    live = {
+                        r.rid: r for r in self._slots if r is not None
+                    }
+                    for rid in missing:
+                        req = live.get(rid) or self._done_pool.get(rid)
+                        if req is not None:
+                            refs[rid] = req
         with self._lock:
-            live = {
-                req.rid: req for req in self._slots if req is not None
+            out = {
+                rid: list(req.tokens[before.get(rid, default_start):])
+                for rid, req in refs.items()
+                if rid in emitted
             }
-            for rid in out:
-                req = live.get(rid) or self._done_pool.get(rid)
-                if req is not None:
-                    start = before.get(rid, default_start)
-                    out[rid] = list(req.tokens[start:])
         return {rid: toks for rid, toks in out.items() if toks}
 
     def _spec_pump_commit_locked(
@@ -2099,14 +2176,18 @@ class ContinuousBatcher:
                 self._cache, self._hist, self._temp, self._topk,
                 self._topp, self._keys,
             )
-        if self._draft is not None:
-            # keep the draft cache position-synced with the target:
-            # this plain step writes the pending token's K/V on the
-            # target; the draft must mirror it (see advance_one)
-            self._draft.advance_one(args[0], args[1], args[2])
-        step_fn = self._step_sampling if sampling else self._step_greedy
-        new_tok, cache, pos, hist = step_fn(*args)
-        toks = np.asarray(new_tok)  # [B] ids — the only host transfer
+        try:
+            if self._draft is not None:
+                # keep the draft cache position-synced with the target:
+                # this plain step writes the pending token's K/V on the
+                # target; the draft must mirror it (see advance_one)
+                self._draft.advance_one(args[0], args[1], args[2])
+            step_fn = self._step_sampling if sampling else self._step_greedy
+            new_tok, cache, pos, hist = step_fn(*args)
+            toks = np.asarray(new_tok)  # [B] ids — the only host transfer
+        except Exception as exc:
+            self._mark_failed(exc)  # donated state consumed
+            raise
         with self._lock:
             self._cache = cache
             self._pos = pos
@@ -2161,6 +2242,7 @@ class ContinuousBatcher:
         slot proposed anything (there the plain step and verify are the
         same inline-attention math). Returns {rid: last emitted token};
         use partials() for the full per-round stream."""
+        self._check_failed()
         t0 = _time.perf_counter()
         with self._step_lock:
             self._apply_pending()
@@ -2253,14 +2335,18 @@ class ContinuousBatcher:
                 self._spec_round_sampling if sampling
                 else self._spec_round_greedy
             )
-            m_dev, final_dev, cache, hist, pos2 = round_fn(*args)
-            if self._draft is not None and self._draft.windowed:
-                # draft-side commit of the accepted columns (the ring
-                # discipline: nothing landed during propose)
-                self._draft.commit(args[1], m_dev, args[2])
-            # [B] counts + [B] tokens — the only host transfers
-            m_np = np.asarray(m_dev)
-            final_np = np.asarray(final_dev)
+            try:
+                m_dev, final_dev, cache, hist, pos2 = round_fn(*args)
+                if self._draft is not None and self._draft.windowed:
+                    # draft-side commit of the accepted columns (the ring
+                    # discipline: nothing landed during propose)
+                    self._draft.commit(args[1], m_dev, args[2])
+                # [B] counts + [B] tokens — the only host transfers
+                m_np = np.asarray(m_dev)
+                final_np = np.asarray(final_dev)
+            except Exception as exc:
+                self._mark_failed(exc)  # donated state consumed
+                raise
             with self._lock:
                 self._cache = cache
                 self._hist = hist

@@ -16,7 +16,12 @@ Node kinds (from the compile plan):
 
 EOS: a sentinel flows through every queue. Multi-input nodes forward EOS
 downstream only after ALL sink pads saw it. Errors capture into
-Executor.errors and poison the pipeline (stop event) so threads unwind.
+Executor.errors and poison the pipeline (stop event) so threads unwind —
+UNLESS the failing node carries an active error policy (pipeline/faults.py
+``on-error=drop|retry|route``): then the FaultGate consumes the frame
+(drop/dead-letter/backoff-retry) and streaming continues. A stall watchdog
+([executor] watchdog_timeout_ms > 0) converts hangs — data queued, no node
+progressing — into typed PipelineStallErrors with a per-node snapshot.
 """
 
 from __future__ import annotations
@@ -36,6 +41,12 @@ from nnstreamer_tpu.elements.base import (
 )
 from nnstreamer_tpu import trace
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline.faults import (
+    FaultGate,
+    PipelineStallError,
+    resolve_fault_policy,
+    watchdog_timeout_ms,
+)
 from nnstreamer_tpu.pipeline.graph import ExecPlan, FusedSegment, Link
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
 
@@ -197,6 +208,8 @@ class Node:
         self.frames_processed = 0
         self.proc_time_ema_ms = 0.0
         self._needs_notify = False  # set for multi-pad scheduler nodes
+        self.fault_stats = None  # FaultStats when an error policy is active
+        self.fault_gate = None   # the gate itself (watchdog backoff check)
 
     def add_in_queue(self, size: int) -> int:
         self.in_queues.append(_Chan(size))
@@ -265,6 +278,37 @@ class Node:
                 {"frame": self.frames_processed},
             )
 
+    def make_fault_gate(self, policy, elem=None) -> Optional[FaultGate]:
+        """Build this node's error-policy applicator (None when the
+        policy is ``stop`` — the default path stays untouched). Called
+        from run(), AFTER the executor wired self.outs, so the route
+        closure can see whether the element's error pad has a consumer.
+
+        Only elements that DECLARE the fault surface (``on-error`` in
+        their PROPERTIES) participate: a class that never opted in must
+        not have an [executor] on_error default applied to it, nor its
+        own same-named knobs misread — tensor_query_client's
+        ``retry-max`` configures transport reconnects, not frame
+        retries."""
+        if elem is not None and "on-error" not in type(elem).property_schema():
+            return None
+        if policy is None:
+            policy = resolve_fault_policy([elem] if elem is not None else [])
+        if not policy.active:
+            return None
+        route = None
+        err_pad = getattr(elem, "error_pad", None) if elem is not None else None
+        if err_pad is not None and err_pad in self.outs:
+            def route(err_frame, _pad=err_pad):
+                self.push_out(_pad, err_frame)
+        gate = FaultGate(
+            policy, self.name, stop_event=self.ex.stop_event, route=route,
+            raise_through=(_Stop,), stop_exc=_Stop,
+        )
+        self.fault_stats = gate.stats
+        self.fault_gate = gate  # watchdog reads backoff_deadline
+        return gate
+
     def make_batch_collector(self, cfg, elem):
         """BatchCollector on input pad 0 with the upstream-QoS drop
         predicate for `elem` (one definition of skipped-upstream
@@ -326,9 +370,10 @@ class FusedNode(Node):
 
     def run(self) -> None:
         self.seg.build()  # compile before first frame (PAUSED-state parity)
+        gate = self.make_fault_gate(self.seg.fault_policy, self.seg.first)
         cfg = self.seg.batch_config
         if cfg is not None and cfg.active:
-            self._run_batched(cfg)
+            self._run_batched(cfg, gate)
             return
         first = self.seg.first
         while True:
@@ -342,25 +387,48 @@ class FusedNode(Node):
                     q.skipped_upstream += 1
                 continue
             t0 = time.perf_counter()
-            out = self.seg.process(item)
+            if gate is None:
+                out = self.seg.process(item)
+            else:
+                delivered, out = gate.process(item, self.seg.process)
+                if not delivered:
+                    continue
             self.stat(t0)
             self.push_out(0, out)
         self.broadcast_eos()
 
-    def _run_batched(self, cfg) -> None:
+    def _run_batched(self, cfg, gate=None) -> None:
         """Micro-batched service loop: drain up to max-batch frames, ONE
-        batched device invoke, split results back in order."""
+        batched device invoke, split results back in order. With an
+        error policy active, a FAILED batch is split and re-run
+        per-frame through the gate — one bad frame must not discard its
+        batchmates (the per-frame rerun classifies each: retried,
+        delivered, dropped, or routed)."""
         collector = self.make_batch_collector(cfg, self.seg.first)
         while True:
             frames, eos, wait_s = collector.collect()
             if frames:
                 t0 = time.perf_counter()
-                if len(frames) == 1:
-                    # lone frame: the per-frame program, no stack/split
-                    outs = [self.seg.process(frames[0])]
-                    bucket = 1
-                else:
-                    outs, bucket = self.seg.process_batch(frames, cfg)
+                try:
+                    if len(frames) == 1:
+                        # lone frame: the per-frame program, no stack/split
+                        outs = [self.seg.process(frames[0])]
+                        bucket = 1
+                    else:
+                        outs, bucket = self.seg.process_batch(frames, cfg)
+                except _Stop:
+                    raise
+                except Exception:
+                    if gate is None:
+                        raise
+                    outs = []
+                    # per-frame programs pad nothing: bucket == batch size
+                    # (a smaller bucket would book negative pad rows)
+                    bucket = len(frames)
+                    for f in frames:
+                        delivered, out = gate.process(f, self.seg.process)
+                        if delivered:
+                            outs.append(out)
                 self.seg.batch_stats.record(len(frames), bucket, wait_s)
                 self.stat_batch(t0, len(frames), bucket, wait_s)
                 for f in outs:
@@ -386,8 +454,11 @@ class TensorOpHostNode(Node):
             from nnstreamer_tpu.pipeline.batching import resolve_batch_config
 
             cfg = resolve_batch_config([self.elem])
+        gate = self.make_fault_gate(
+            getattr(self.elem, "fault_policy", None), self.elem
+        )
         if cfg.active and self.elem.is_batch_capable():
-            self._run_batched(cfg)
+            self._run_batched(cfg, gate)
             return
         while True:
             item = self.pop(0)
@@ -400,7 +471,12 @@ class TensorOpHostNode(Node):
                     q.skipped_upstream += 1
                 continue
             t0 = time.perf_counter()
-            out = self.elem.host_process(item)
+            if gate is None:
+                out = self.elem.host_process(item)
+            else:
+                delivered, out = gate.process(item, self.elem.host_process)
+                if not delivered:
+                    continue
             self.stat(t0)
             if out is None:  # absorbed (e.g. batching mid-window)
                 continue
@@ -408,7 +484,7 @@ class TensorOpHostNode(Node):
                 self.push_out(0, f)
         self.broadcast_eos()
 
-    def _run_batched(self, cfg) -> None:
+    def _run_batched(self, cfg, gate=None) -> None:
         """Host micro-batching for backends that declared the
         ``batchable`` capability (backends/base.py) — host backends that
         did not (tflite's set/invoke/get is strictly per-frame) keep the
@@ -426,7 +502,22 @@ class TensorOpHostNode(Node):
             frames, eos, wait_s = collector.collect()
             if frames:
                 t0 = time.perf_counter()
-                outs = elem.host_process_batch(frames)
+                try:
+                    outs = elem.host_process_batch(frames)
+                except _Stop:
+                    raise
+                except Exception:
+                    # split the failed window per-frame through the
+                    # policy (retry/drop/route each) — one bad frame
+                    # must not discard its batchmates
+                    if gate is None:
+                        raise
+                    outs = []
+                    for f in frames:
+                        delivered, out = gate.process(f, elem.host_process)
+                        if not delivered or out is None:
+                            continue
+                        outs.extend(out if isinstance(out, list) else [out])
                 # host path never pads: bucket == batch size
                 stats.record(len(frames), len(frames), wait_s)
                 self.stat_batch(t0, len(frames), len(frames), wait_s)
@@ -445,6 +536,9 @@ class HostNode(Node):
         self.elem = elem
 
     def run(self) -> None:
+        gate = self.make_fault_gate(
+            getattr(self.elem, "fault_policy", None), self.elem
+        )
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
@@ -456,7 +550,12 @@ class HostNode(Node):
                     q.skipped_upstream += 1
                 continue
             t0 = time.perf_counter()
-            out = self.elem.process(item)
+            if gate is None:
+                out = self.elem.process(item)
+            else:
+                delivered, out = gate.process(item, self.elem.process)
+                if not delivered:
+                    continue
             self.stat(t0)
             if out is None:
                 continue
@@ -666,6 +765,12 @@ class Executor:
         self._sinks_cv = threading.Condition()
         self._started = False
         self.finished = False
+        # stall watchdog ([executor] watchdog_timeout_ms; 0 = disabled):
+        # resolved at construction so tests/operators can also override
+        # the attribute on the instance before start()
+        self.watchdog_timeout_ms = watchdog_timeout_ms()
+        self._watchdog: Optional[threading.Thread] = None
+        self.stalled = False
         self._build()
 
     # -- construction ------------------------------------------------------
@@ -769,6 +874,93 @@ class Executor:
             e.start()
         for n in self.nodes:
             n.start()
+        if self.watchdog_timeout_ms and self.watchdog_timeout_ms > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="nns-watchdog", daemon=True
+            )
+            self._watchdog.start()
+
+    # -- stall watchdog ----------------------------------------------------
+    def progress_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-node progress: frames processed + per-pad queue depths
+        (the payload of PipelineStallError)."""
+        return {
+            n.name: {
+                "frames": n.frames_processed,
+                "queued": [len(q) for q in n.in_queues],
+            }
+            for n in self.nodes
+        }
+
+    def _watchdog_loop(self) -> None:
+        """Detect hangs: data queued somewhere but NO node progressing for
+        longer than watchdog-timeout-ms. An all-idle pipeline with empty
+        queues (a live source waiting for data) is NOT a stall — the
+        queued-data condition keeps the watchdog quiet there — and a
+        node parked in a retry backoff (fault_gate.backoff_deadline) is
+        recovering, not hung. On detection the hang becomes a typed
+        PipelineStallError recorded like any node error, so wait()/run()
+        report it instead of a silent timeout kill.
+
+        Granularity: the detector cannot see INSIDE one invoke — a hang
+        inside element code is precisely what it exists to catch, so a
+        legitimately slow single invoke (first-frame jit compile, a
+        mid-stream bucket retrace, a cold model load) is
+        indistinguishable from one. The timeout must therefore be set
+        ABOVE the worst-case single-invoke latency; it defaults to off
+        (0)."""
+        timeout_s = self.watchdog_timeout_ms / 1000.0
+        beat = max(0.01, min(timeout_s / 4.0, 0.25))
+
+        def _counts():
+            # retry/disposal activity counts as progress: a node working
+            # through its error policy is not hung even though
+            # frames_processed stands still
+            return tuple(
+                (
+                    n.frames_processed,
+                    (n.fault_stats.errors, n.fault_stats.retries)
+                    if n.fault_stats is not None else (0, 0),
+                )
+                for n in self.nodes
+            )
+
+        last = _counts()
+        t_last = time.monotonic()
+        while not self.stop_event.wait(beat):
+            if self._pending_sinks <= 0 or self.errors:
+                return
+            cur = _counts()
+            now = time.monotonic()
+            if cur != last:
+                last, t_last = cur, now
+                continue
+            if now - t_last <= timeout_s:
+                continue
+            if not any(len(q) for n in self.nodes for q in n.in_queues):
+                t_last = now  # idle, not stuck: nothing is waiting to move
+                continue
+            if any(
+                n.fault_gate is not None
+                and n.fault_gate.backoff_deadline >= now
+                for n in self.nodes
+            ):
+                # a node is parked in a LEGITIMATE retry backoff (the
+                # deadline is live and bounded by backoff_cap_ms) — a
+                # recovering pipeline must not be killed as stalled
+                t_last = now
+                continue
+            snapshot = self.progress_snapshot()
+            self.stalled = True
+            _log.error("stall watchdog fired: %s", snapshot)
+            tracer = trace.get()
+            if tracer is not None:
+                tracer.fault("executor", "stall", None,
+                             timeout_ms=self.watchdog_timeout_ms)
+            self.record_error(
+                PipelineStallError(self.watchdog_timeout_ms, snapshot)
+            )
+            return
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until every sink saw EOS (or error). True if completed."""
@@ -832,6 +1024,18 @@ class Executor:
             ) or getattr(elem, "batch_stats", None)
             if bstats is not None and bstats.batches:
                 s.update(bstats.snapshot())
+            # fault-tolerance counters (pipeline/faults.py): per-node
+            # errors/drops/routes/retries when an error policy is active
+            fstats = n.fault_stats
+            if fstats is not None and (fstats.errors or fstats.retries):
+                s.update(fstats.snapshot())
+            # circuit-breaker fallback (tensor_filter fallback-framework/
+            # fallback-model): primary failures, opens, fallback serves
+            cstats = getattr(elem, "circuit_stats", None)
+            if callable(cstats):
+                got = cstats()
+                if got:
+                    s.update({f"cb_{k}": v for k, v in got.items()})
             out[n.name] = s
         return out
 
@@ -862,6 +1066,17 @@ class Executor:
                 if callable(fn):
                     for reason, count in fn().items():
                         bucket[reason] = bucket.get(reason, 0) + count
+            # error-policy accounting: dropped frames leave the stream
+            # with a reason; ROUTED frames reach a dead-letter sink and
+            # count as rendered there, so they stay out of `dropped`
+            fs = n.fault_stats
+            if fs is not None:
+                for reason, count in (
+                    ("on-error-drop", fs.dropped - fs.routed_unlinked),
+                    ("on-error-route-unlinked", fs.routed_unlinked),
+                ):
+                    if count:
+                        dropped[reason] = dropped.get(reason, 0) + count
         return {
             "produced": produced,
             "rendered": rendered,

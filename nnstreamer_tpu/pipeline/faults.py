@@ -1,0 +1,336 @@
+"""Fault-tolerant execution: per-element error policies for streaming.
+
+The reference treats any element error as pipeline-fatal (GST_FLOW_ERROR
+unwinds the whole stream), and the executor inherited that: one exception
+in a node thread poisoned every queue. For a serving pipeline ("heavy
+traffic from millions of users", ROADMAP) a single malformed frame or a
+transient backend hiccup must not kill the stream. GStreamer's flow-return
+design shows per-buffer error semantics composing with streaming; this
+module is the TPU-native equivalent:
+
+- ``on-error`` (declared by tensor_filter / tensor_transform /
+  tensor_converter / tensor_decoder, and tensor_chaos):
+
+  * ``stop``  — fail fast with the original typed exception (default;
+    the reference-faithful behavior).
+  * ``drop``  — skip the offending frame, keep streaming; counted.
+  * ``retry`` — re-invoke with jittered exponential backoff
+    (``retry-max``, ``retry-backoff-ms``; capped). Exhausted retries
+    degrade to ``route`` when an error pad is linked, else ``drop`` —
+    retry is a keep-streaming policy, never a delayed crash.
+  * ``route`` — wrap the frame + exception into an ERROR FRAME emitted
+    on a dedicated error pad (``<name>.src_1``) that links to any sink:
+    the dead-letter queue. An unlinked error pad silently drops (nns-lint
+    NNS-W107 warns about that wiring).
+
+- :class:`FaultPolicy` resolution mirrors batching: element properties
+  override the ``[executor]`` config defaults (``NNS_TPU_EXECUTOR_ON_ERROR``
+  etc.), first element in chain order that sets a knob wins.
+- :class:`FaultGate` is the per-node applicator the executor wraps around
+  frame work; batched service loops split a failed batch through it
+  per-frame so one bad frame never discards its batchmates.
+- :class:`PipelineStallError` is the stall watchdog's typed conversion of
+  a hang (executor monitor thread) — a per-node progress snapshot instead
+  of a silent ``TimeoutError``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+# schema + pad installer live in elements.base (so element classes can
+# spread FAULT_PROPS without importing the pipeline package); re-exported
+# here because this module is the fault layer's front door
+from nnstreamer_tpu.elements.base import (  # noqa: F401  (re-export)
+    FAULT_PROPS,
+    ON_ERROR_CHOICES,
+    install_error_pad,
+)
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("faults")
+
+
+class PipelineStallError(RuntimeError):
+    """The stall watchdog detected queued data with no node progressing
+    for longer than ``watchdog-timeout-ms``. Carries a per-node progress
+    snapshot ({node: {frames, queued}}) so the hang localizes without a
+    debugger attached."""
+
+    def __init__(self, timeout_ms: float, snapshot: Dict[str, Dict]) -> None:
+        self.timeout_ms = timeout_ms
+        self.snapshot = snapshot
+        stalled = [
+            f"{name}(frames={s['frames']}, queued={s['queued']})"
+            for name, s in sorted(snapshot.items())
+            if any(s["queued"])
+        ] or [f"{n}(frames={s['frames']})" for n, s in sorted(snapshot.items())]
+        super().__init__(
+            f"pipeline made no progress for {timeout_ms:.0f} ms with data "
+            f"queued; suspect node(s): {', '.join(stalled)}"
+        )
+
+
+@dataclass(frozen=True)
+class FaultPolicy:
+    """Resolved error-policy knobs for one execution node."""
+
+    on_error: str = "stop"
+    retry_max: int = 3
+    backoff_ms: float = 10.0
+    backoff_cap_ms: float = 1000.0
+
+    @property
+    def active(self) -> bool:
+        return self.on_error != "stop"
+
+
+def _executor_fault_defaults() -> dict:
+    """[executor] fault-tolerance defaults (env ``NNS_TPU_EXECUTOR_*``
+    outranks ini). Malformed values fall back with a warning — a typo'd
+    ini line must not fail every pipeline compile."""
+    from nnstreamer_tpu.config import conf
+
+    c = conf()
+
+    def _num(key: str, cast, fallback):
+        raw = c.get("executor", key, str(fallback))
+        try:
+            return cast(raw)
+        except ValueError:
+            _log.warning(
+                "[executor] %s=%r is not a valid %s; using %s",
+                key, raw, cast.__name__, fallback,
+            )
+            return fallback
+
+    on_error = c.get("executor", "on_error", "stop").strip().lower()
+    if on_error not in ON_ERROR_CHOICES:
+        _log.warning(
+            "[executor] on_error=%r not one of %s; using 'stop'",
+            on_error, "/".join(ON_ERROR_CHOICES),
+        )
+        on_error = "stop"
+    return {
+        "on-error": on_error,
+        "retry-max": _num("retry_max", int, 3),
+        "retry-backoff-ms": _num("retry_backoff_ms", float, 10.0),
+        "retry-backoff-cap-ms": _num("retry_backoff_cap_ms", float, 1000.0),
+        "watchdog-timeout-ms": _num("watchdog_timeout_ms", float, 0.0),
+    }
+
+
+def watchdog_timeout_ms() -> float:
+    """Executor stall-watchdog timeout (0 = disabled, the default)."""
+    return _executor_fault_defaults()["watchdog-timeout-ms"]
+
+
+def resolve_fault_policy(elements: Sequence[Any]) -> FaultPolicy:
+    """Merge element-level fault properties over the executor default.
+
+    Chain-order scan, first element that sets a knob wins (the same
+    discipline as resolve_batch_config; for a fused segment the ops are
+    the segment members)."""
+    defaults = _executor_fault_defaults()
+    on_error: Optional[str] = None
+    retry_max: Optional[int] = None
+    backoff_ms: Optional[float] = None
+
+    def _coerce(elem, prop: str, fn, raw):
+        try:
+            return fn(raw)
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"{getattr(elem, 'name', elem)}: bad {prop}={raw!r}: {exc}"
+            ) from exc
+
+    for e in elements:
+        get = getattr(e, "get_property", None)
+        if get is None:
+            continue
+        if on_error is None and get("on-error") is not None:
+            raw = str(get("on-error")).strip().lower()
+            if raw not in ON_ERROR_CHOICES:
+                raise ValueError(
+                    f"{getattr(e, 'name', e)}: on-error={raw!r} not one of "
+                    f"{'/'.join(ON_ERROR_CHOICES)}"
+                )
+            on_error = raw
+        if retry_max is None and get("retry-max") is not None:
+            retry_max = _coerce(e, "retry-max", int, get("retry-max"))
+        if backoff_ms is None and get("retry-backoff-ms") is not None:
+            backoff_ms = _coerce(
+                e, "retry-backoff-ms", float, get("retry-backoff-ms")
+            )
+    if on_error is None:
+        on_error = defaults["on-error"]
+    if retry_max is None:
+        retry_max = defaults["retry-max"]
+    if backoff_ms is None:
+        backoff_ms = defaults["retry-backoff-ms"]
+    return FaultPolicy(
+        on_error=on_error,
+        retry_max=max(0, int(retry_max)),
+        backoff_ms=max(0.0, float(backoff_ms)),
+        backoff_cap_ms=max(0.0, float(defaults["retry-backoff-cap-ms"])),
+    )
+
+
+def backoff_s(attempt: int, policy: FaultPolicy, rng: random.Random) -> float:
+    """Jittered exponential backoff for the ``attempt``-th retry
+    (0-based): base × 2^attempt ms, capped at backoff_cap_ms, with
+    uniform jitter in [0.5, 1.0]× so synchronized failures de-correlate
+    instead of retrying in lockstep."""
+    full_ms = min(policy.backoff_ms * (2.0 ** attempt), policy.backoff_cap_ms)
+    return (0.5 + 0.5 * rng.random()) * full_ms / 1000.0
+
+
+def make_error_frame(frame, exc: Exception, element: str):
+    """Dead-letter frame: the ORIGINAL input tensors (so the consumer can
+    replay or inspect the offending payload) plus structured error meta."""
+    return frame.with_meta(
+        error=True,
+        error_element=element,
+        error_type=type(exc).__name__,
+        error_msg=str(exc),
+    )
+
+
+class FaultStats:
+    """Single-writer (node thread) fault counters; GIL-atomic reads give
+    observers a consistent-enough snapshot (same contract as BatchStats)."""
+
+    __slots__ = ("errors", "dropped", "routed", "routed_unlinked",
+                 "retries", "retry_exhausted", "backoff_total_s")
+
+    def __init__(self) -> None:
+        self.errors = 0           # raw element failures observed
+        self.dropped = 0          # frames consumed by drop (incl. degraded)
+        self.routed = 0           # error frames delivered to the error pad
+        self.routed_unlinked = 0  # route policy with no error-pad consumer
+        self.retries = 0          # re-invocations attempted
+        self.retry_exhausted = 0  # frames whose retry budget ran out
+        self.backoff_total_s = 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "errors": self.errors,
+            "error_dropped": self.dropped,
+            "error_routed": self.routed,
+            "error_retries": self.retries,
+            "error_backoff_ms": round(self.backoff_total_s * 1000.0, 3),
+        }
+
+
+class FaultGate:
+    """Applies one node's resolved :class:`FaultPolicy` around per-frame
+    work. ``process(frame, fn)`` returns ``(delivered, result)``:
+    ``delivered`` False means the policy consumed the frame (dropped or
+    routed) and streaming continues. ``stop`` raises the original typed
+    exception unchanged — the executor only builds a gate when the
+    policy is active, so the default path stays zero-overhead."""
+
+    def __init__(
+        self,
+        policy: FaultPolicy,
+        name: str,
+        stop_event=None,
+        route: Optional[Callable[[Any], None]] = None,
+        raise_through: Tuple[type, ...] = (),
+        stop_exc: Optional[type] = None,
+    ) -> None:
+        self.policy = policy
+        self.name = name
+        self.stop_event = stop_event
+        self.route = route  # callable(error_frame) when the pad is linked
+        self.raise_through = raise_through
+        self.stop_exc = stop_exc
+        self.stats = FaultStats()
+        # monotonic deadline of an in-progress backoff sleep (0.0 = not
+        # parked): the stall watchdog reads this so a node legitimately
+        # backing off is never mistaken for a hang
+        self.backoff_deadline = 0.0
+        # deterministic per-node jitter stream (content-stable seed, not
+        # hash(): PYTHONHASHSEED must not change retry timing between runs)
+        self._rng = random.Random(zlib.crc32(name.encode()))
+
+    def process(self, frame, fn: Callable[[Any], Any]) -> Tuple[bool, Any]:
+        policy = self.policy
+        attempt = 0
+        while True:
+            try:
+                return True, fn(frame)
+            except self.raise_through:
+                raise
+            except Exception as exc:  # noqa: BLE001 — the policy decides
+                self.stats.errors += 1
+                if policy.on_error == "retry" and attempt < policy.retry_max:
+                    delay = backoff_s(attempt, policy, self._rng)
+                    attempt += 1
+                    self.stats.retries += 1
+                    self.stats.backoff_total_s += delay
+                    self._trace("retry", exc, attempt=attempt,
+                                backoff_ms=round(delay * 1000.0, 3))
+                    self._sleep(delay)
+                    continue
+                return False, self._dispose(frame, exc, attempt)
+
+    def _dispose(self, frame, exc: Exception, attempts: int):
+        """The frame failed past any retry budget: drop or route it."""
+        policy = self.policy
+        mode = policy.on_error
+        if mode == "stop":
+            raise exc
+        if mode == "retry":
+            # exhausted: degrade to the dead-letter pad when wired, else
+            # drop — a retry policy never turns into a delayed crash
+            self.stats.retry_exhausted += 1
+            mode = "route" if self.route is not None else "drop"
+        if mode == "route":
+            if self.route is not None:
+                self.stats.routed += 1
+                self._trace("route", exc)
+                self.route(make_error_frame(frame, exc, self.name))
+                return None
+            self.stats.routed_unlinked += 1
+            self.stats.dropped += 1
+            self._trace("route-unlinked", exc)
+            _log.warning(
+                "%s: on-error=route but the error pad is unlinked; "
+                "dropping frame (%s: %s)", self.name, type(exc).__name__, exc,
+            )
+            return None
+        self.stats.dropped += 1
+        self._trace("drop", exc, attempts=attempts)
+        _log.debug("%s: dropped frame after %s: %s",
+                   self.name, type(exc).__name__, exc)
+        return None
+
+    def _trace(self, action: str, exc: Exception, **extra) -> None:
+        from nnstreamer_tpu import trace
+
+        tracer = trace.get()
+        if tracer is not None:
+            tracer.fault(self.name, action, exc, **extra)
+
+    def _sleep(self, delay: float) -> None:
+        """Bounded-slice backoff sleep that still honors the executor's
+        stop event — a parked retry must not stall pipeline teardown."""
+        deadline = time.monotonic() + delay
+        self.backoff_deadline = deadline  # visible to the stall watchdog
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                if self.stop_event is not None and self.stop_event.is_set():
+                    if self.stop_exc is not None:
+                        raise self.stop_exc()
+                    return
+                time.sleep(min(0.05, remaining))
+        finally:
+            self.backoff_deadline = 0.0

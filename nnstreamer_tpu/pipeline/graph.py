@@ -244,18 +244,31 @@ class Pipeline:
             BatchStats,
             resolve_batch_config,
         )
+        from nnstreamer_tpu.pipeline.faults import resolve_fault_policy
 
         for e in self._toposort():
             # non-traceable TensorOps (host-bound backends) execute as host
-            # nodes; they are fusion barriers like HostElement
-            if not isinstance(e, TensorOp) or not e.is_traceable():
+            # nodes; they are fusion barriers like HostElement. An element
+            # whose dead-letter error pad is LINKED is also a barrier:
+            # per-frame error routing needs per-frame invokes, which a
+            # fused program cannot give it (an unlinked pad — retry with
+            # no overflow sink — costs nothing and fuses normally).
+            err_routed = e.error_pad is not None and any(
+                l.src_pad == e.error_pad for l in self.out_links(e)
+            )
+            if (
+                not isinstance(e, TensorOp)
+                or err_routed
+                or not e.is_traceable()
+            ):
                 if isinstance(e, TensorOp):
-                    # host-path batching config resolves at PLAN time like
-                    # the segments below, so a bad batching property fails
+                    # host-path batching/fault config resolves at PLAN time
+                    # like the segments below, so a bad property fails
                     # compile_plan() instead of poisoning a running node
                     e.batch_config = resolve_batch_config([e])
                     if e.batch_stats is None:
                         e.batch_stats = BatchStats()
+                    e.fault_policy = resolve_fault_policy([e])
                 continue
             ups = self.in_links(e)
             up = ups[0].src if len(ups) == 1 else None
@@ -279,6 +292,7 @@ class Pipeline:
         # pad-waste-pct/batch-wait-ms properties report their segment
         for seg in segments:
             seg.batch_config = resolve_batch_config(seg.ops)
+            seg.fault_policy = resolve_fault_policy(seg.ops)
             for op in seg.ops:
                 op.batch_stats = seg.batch_stats
         return ExecPlan(self, segments, seg_of)
@@ -397,6 +411,10 @@ class FusedSegment:
         # micro-batching (pipeline/batching.py): resolved at plan time;
         # stats shared with the ops so tensor_filter can surface them
         self.batch_config = None
+        # error policy (pipeline/faults.py): resolved at plan time from
+        # the member ops' on-error/retry-* properties. Segments never
+        # carry a route policy — route ops are fusion barriers.
+        self.fault_policy = None
         from nnstreamer_tpu.pipeline.batching import BatchStats
 
         self.batch_stats = BatchStats()

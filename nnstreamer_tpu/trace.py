@@ -92,6 +92,15 @@ class Tracer:
             },
         )
 
+    def fault(self, name: str, action: str, exc=None, **extra) -> None:
+        """Fault-layer event (pipeline/faults.py): one instant marker per
+        retry/drop/route/stall so the timeline shows where the error
+        policies worked and what they cost."""
+        args = {"action": action, **extra}
+        if exc is not None:
+            args["error"] = type(exc).__name__
+        self.instant(name, cat="fault", **args)
+
     def instant(self, name: str, cat: str = "event", **args) -> None:
         with self._lock:
             self._events.append(

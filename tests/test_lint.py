@@ -79,6 +79,13 @@ BAD_PIPELINES = [
         "tensorsrc name=b dimensions=4 ! tensor_sink",
         {"NNS-W105"},
     ),
+    (
+        # on-error=route with no dead-letter consumer: silent drop
+        "tensorsrc dimensions=4 ! "
+        "tensor_transform mode=typecast option=float32 on-error=route ! "
+        "tensor_sink",
+        {"NNS-W107"},
+    ),
 ]
 
 
@@ -105,6 +112,25 @@ class TestBadPipelineTable:
         result = lint(CLEAN)
         assert result.codes == []
         assert result.exit_code == 0
+
+    def test_routed_error_pad_is_clean(self):
+        # a LINKED error pad raises no W107 and no W105 for the extra pad
+        result = lint(
+            "tensorsrc dimensions=4 ! "
+            "tensor_transform name=t mode=typecast option=float32 "
+            "on-error=route ! tensor_sink "
+            "t.src_1 ! tensor_sink name=dlq"
+        )
+        assert result.codes == [], result.render()
+
+    def test_unrouted_error_pad_reports_w107_not_w105(self):
+        result = lint(
+            "tensorsrc dimensions=4 ! "
+            "tensor_transform mode=typecast option=float32 "
+            "on-error=route ! tensor_sink"
+        )
+        assert "NNS-W107" in result.codes
+        assert "NNS-W105" not in result.codes, result.render()
 
     def test_queued_tee_branches_are_clean(self):
         result = lint(
@@ -487,7 +513,8 @@ def _embedded_pipeline_strings():
             if isinstance(node, ast.Constant) and isinstance(node.value, str):
                 for cand in _candidate_pipelines_from_text(node.value):
                     found.append((fn, cand))
-    for doc in ("elements.md", "linting.md", "batching.md"):
+    for doc in ("elements.md", "linting.md", "batching.md",
+                "fault-tolerance.md"):
         with open(os.path.join(REPO, "docs", doc)) as f:
             for cand in _candidate_pipelines_from_text(f.read()):
                 found.append((doc, cand))
