@@ -19,9 +19,11 @@ from nnstreamer_tpu.analysis.diagnostics import (  # noqa: F401
     Severity,
 )
 from nnstreamer_tpu.analysis.lint import (  # noqa: F401
+    DEADLOCK_CODES,
     LintResult,
     annotated_dot,
     check_properties,
     coerce_property,
     lint,
 )
+from nnstreamer_tpu.analysis.racecheck import run_race_lint  # noqa: F401

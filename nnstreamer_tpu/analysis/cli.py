@@ -4,9 +4,12 @@
     nns-lint --dot "..." > graph.dot     # diagnostics painted on nodes
     nns-lint --json "..."                # machine-readable findings
     nns-lint --self-check                # PROPERTIES schemas cover code?
+    nns-lint --strict "..."              # warnings fail hard (exit 2)
 
 Exit codes: 0 clean, 1 warnings only, 2 errors (and 1 on --self-check
-failure). The pipeline is parsed and analyzed but NEVER started.
+failure). The pipeline is parsed and analyzed but NEVER started. The
+sibling `nns-san` CLI covers the concurrency race lint and the runtime
+sanitizer (docs/sanitizer.md).
 """
 
 from __future__ import annotations
@@ -33,6 +36,10 @@ def main(argv=None) -> int:
         help="verify every builtin element's PROPERTIES schema covers the "
         "properties its code reads",
     )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="treat warnings as errors (warnings-only runs exit 2)",
+    )
     ap.add_argument("--quiet", "-q", action="store_true")
     args = ap.parse_args(argv)
 
@@ -46,13 +53,16 @@ def main(argv=None) -> int:
     from nnstreamer_tpu.analysis import annotated_dot, lint
 
     result = lint(args.description)
+    rc = result.exit_code
+    if args.strict and rc == 1:
+        rc = 2  # warnings fail hard under --strict
     if args.dot:
         print(annotated_dot(result))
-        return result.exit_code
+        return rc
     if args.json:
         print(json.dumps(
             {
-                "exit_code": result.exit_code,
+                "exit_code": rc,
                 "diagnostics": [
                     {
                         "code": d.code,
@@ -67,10 +77,10 @@ def main(argv=None) -> int:
             },
             indent=2,
         ))
-        return result.exit_code
+        return rc
     if not args.quiet or result.diagnostics:
         print(result.render())
-    return result.exit_code
+    return rc
 
 
 if __name__ == "__main__":

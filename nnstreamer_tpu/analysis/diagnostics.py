@@ -100,6 +100,81 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "on-error=route but the dead-letter error pad is unlinked; "
         "failed frames are silently dropped",
     ),
+    # -- nns-san graph-level deadlock/capacity pass (analysis/lint.py) ------
+    "NNS-W108": (
+        Severity.WARNING, "channel-capacity",
+        "a bounded channel is sized so it cannot do its job (non-positive "
+        "queue-size is clamped to 1; max-batch larger than the input "
+        "channel depth can never fill a batch)",
+    ),
+    "NNS-W109": (
+        Severity.WARNING, "unqueued-fanout-join",
+        "fan-in branches share a non-tee fan-out ancestor (demux/split) "
+        "with no intervening queue on some branch — the same blocking "
+        "topology as the tee case (NNS-W103)",
+    ),
+    "NNS-W110": (
+        Severity.WARNING, "rate-skewed-join",
+        "a synchronizing fan-in has a data-dependent frame dropper "
+        "(tensor_if SKIP, on-error=drop/retry) on a strict subset of its "
+        "branches; the join can starve waiting for skipped counterparts",
+    ),
+    # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
+    # code, not pipelines; `element` carries file:line
+    "NNS-R001": (
+        Severity.WARNING, "unlocked-shared-write",
+        "a shared counter (self.attr += ...) is read-modify-written from "
+        "more than one method of a thread-spawning class without the "
+        "owning lock held at every site",
+    ),
+    "NNS-R002": (
+        Severity.WARNING, "blocking-call-under-lock",
+        "an unbounded blocking call (sleep, join without timeout, bare "
+        "wait, recv/accept) runs while a threading lock is held",
+    ),
+    "NNS-R003": (
+        Severity.ERROR, "swallowed-interrupt",
+        "a bare except (or except BaseException) that does not re-raise "
+        "swallows KeyboardInterrupt/SystemExit",
+    ),
+    "NNS-R004": (
+        Severity.WARNING, "silent-except-in-loop",
+        "except Exception with a pass/continue-only body inside a loop: a "
+        "service loop that silently eats every failure forever",
+    ),
+    "NNS-R005": (
+        Severity.WARNING, "thread-without-join",
+        "a thread is created with no join-or-daemon story (neither "
+        "daemon=True nor a reachable .join())",
+    ),
+    "NNS-R006": (
+        Severity.ERROR, "dekker-ordering",
+        "a channel class violates the documented _Chan parking discipline "
+        "(advertise the waiting flag BEFORE re-checking the deque; check "
+        "the peer's flag AFTER the deque op) — a missed-wakeup bug",
+    ),
+    # -- nns-san runtime sanitizer (pipeline/sanitize.py) -------------------
+    "NNS-S001": (
+        Severity.ERROR, "spec-violation",
+        "a frame on a negotiated static link does not conform to the "
+        "pad's TensorsSpec (shape/dtype drift the jit would mask or a "
+        "downstream consumer would crash on)",
+    ),
+    "NNS-S002": (
+        Severity.ERROR, "accounting-leak",
+        "a node's frame accounting broke at EOS: offered != delivered + "
+        "dropped + routed (frames vanished or were duplicated)",
+    ),
+    "NNS-S003": (
+        Severity.WARNING, "lock-order-cycle",
+        "watched locks were acquired in cyclic order by different "
+        "threads — a latent deadlock",
+    ),
+    "NNS-S004": (
+        Severity.WARNING, "thread-leak",
+        "threads were still alive after Executor shutdown joined "
+        "everything it started (stragglers listed)",
+    ),
 }
 
 

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple, Union
 
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.analysis.diagnostics import Diagnostic, LintReport
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.elements.base import (
     Element,
     PropSpec,
@@ -48,6 +49,8 @@ from nnstreamer_tpu.pipeline.parse import (
     _parse_caps,
     scan_description,
 )
+
+_log = get_logger("lint")
 
 
 class _Placeholder(Element):
@@ -474,6 +477,61 @@ def _queue_free_reach(pipeline: Pipeline, start: Element, goal: Element) -> bool
     return False
 
 
+def _branch_ancestors(pipeline: Pipeline, ins) -> List[Set[Element]]:
+    """Per-in-link ancestor sets of a fan-in element (one upstream walk,
+    shared by the W103/W109/W110 join passes)."""
+    out: List[Set[Element]] = []
+    for l in ins:
+        anc: Set[Element] = set()
+        stack = [l.src]
+        while stack:
+            e = stack.pop()
+            if e in anc:
+                continue
+            anc.add(e)
+            stack.extend(ll.src for ll in pipeline.in_links(e))
+        out.append(anc)
+    return out
+
+
+def _unqueued_join_scan(
+    pipeline: Pipeline, report: LintReport, code: str,
+    ancestor_pred, noun, hint: str,
+) -> None:
+    """The shared blocking-join shape: a fan-in whose branch pair shares
+    an ancestor selected by `ancestor_pred`, with at least one branch
+    carrying no queue between the ancestor and the fan-in. `noun` labels
+    the ancestor in the message (e.g. 'tee')."""
+    for m in pipeline.elements:
+        ins = pipeline.in_links(m)
+        if len(ins) < 2:
+            continue
+        branch_anc = _branch_ancestors(pipeline, ins)
+        flagged: Set[Element] = set()
+        for i in range(len(ins)):
+            for j in range(i + 1, len(ins)):
+                shared = [
+                    f for f in branch_anc[i] & branch_anc[j]
+                    if ancestor_pred(f) and f not in flagged
+                ]
+                for fo in shared:
+                    bad = [
+                        ins[k].dst_pad for k in (i, j)
+                        if _queue_free_reach(pipeline, fo, ins[k].src)
+                        or ins[k].src is fo
+                    ]
+                    if bad:
+                        flagged.add(fo)
+                        pads = ", ".join(f"sink_{p}" for p in bad)
+                        report.add(
+                            code, m.name,
+                            f"branches from {noun(fo)} {fo.name!r} reach "
+                            f"{m.name} ({pads}) without an intervening "
+                            "queue",
+                            hint,
+                        )
+
+
 def _tee_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W103: fan-in element whose branches share a tee ancestor with at
     least one branch carrying no queue between the tee and the fan-in —
@@ -481,44 +539,153 @@ def _tee_pass(pipeline: Pipeline, report: LintReport) -> None:
     other, the textbook launch-string deadlock."""
     from nnstreamer_tpu.elements.flow import Tee
 
+    _unqueued_join_scan(
+        pipeline, report, "NNS-W103",
+        lambda f: isinstance(f, Tee),
+        lambda f: "tee",
+        "insert 'queue' after each tee branch",
+    )
+
+
+# -- nns-san deadlock/capacity pass (graph side of the sanitizer) -----------
+
+#: Codes the graph-level deadlock/capacity analysis can produce
+#: (`nns-san --deadlock` filters a full lint run down to these).
+DEADLOCK_CODES = frozenset(
+    {"NNS-E002", "NNS-W103", "NNS-W108", "NNS-W109", "NNS-W110"}
+)
+
+
+def _effective_input_depth(pipeline: Pipeline, e: Element) -> Optional[int]:
+    """The channel depth the EXECUTOR will give e's input: an eliminated
+    upstream queue chain overrides e's own queue-size (tighter bound
+    wins across the chain — executor._build's rewrite rule)."""
+    from nnstreamer_tpu.elements.flow import Queue
+
+    override: Optional[int] = None
+    cur: Element = e
+    while True:
+        ins = pipeline.in_links(cur)
+        if len(ins) != 1:
+            break
+        up = ins[0].src
+        # only 1-in/1-out queues are eliminated into a depth override
+        if not isinstance(up, Queue) or len(pipeline.out_links(up)) != 1:
+            break
+        override = (
+            up.queue_size if override is None
+            else min(override, up.queue_size)
+        )
+        cur = up
+    if override is not None:
+        return override
+    return getattr(e, "queue_size", None)
+
+
+def _capacity_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W108: bounded channels sized so they cannot do their job."""
+    from nnstreamer_tpu.elements.base import _parse_bool
+
+    for e in pipeline.elements:
+        qs = getattr(e, "queue_size", None)
+        if qs is not None and qs <= 0:
+            report.add(
+                "NNS-W108", e.name,
+                f"queue-size={qs} is non-positive; the executor clamps it "
+                "to 1, so every put parks the producer",
+                "size the channel for the expected burst",
+            )
+            continue
+        raw = e.get_property("batching")
+        if raw is None or not _parse_bool(raw):
+            continue
+        try:
+            mb = int(e.get_property("max-batch", 8))
+        except (TypeError, ValueError):
+            continue  # NNS-E005 already covers the bad value
+        depth = _effective_input_depth(pipeline, e)
+        if depth is not None and mb > depth:
+            report.add(
+                "NNS-W108", e.name,
+                f"max-batch={mb} exceeds the input channel depth "
+                f"({depth}); a full batch can never assemble",
+                "deepen the input channel (queue-size / the upstream "
+                "queue's max-size-buffers) above max-batch",
+            )
+
+
+def _fanout_join_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W109: the NNS-W103 blocking topology generalized to non-tee
+    fan-outs (demux/split/crop): a fan-in whose branches share a
+    multi-src-pad ancestor with no intervening queue on some branch."""
+    from nnstreamer_tpu.elements.flow import Tee
+
+    _unqueued_join_scan(
+        pipeline, report, "NNS-W109",
+        lambda f: len(pipeline.out_links(f)) >= 2
+        and not isinstance(f, Tee),  # tee: NNS-W103's case
+        lambda f: f.FACTORY_NAME,
+        "insert 'queue' after each fan-out branch",
+    )
+
+
+def _may_drop_frames(e: Element, pipeline: Pipeline) -> Optional[str]:
+    """Reason string when `e` drops frames data-dependently, else None."""
+    from nnstreamer_tpu.elements.control import TensorIf
+
+    if isinstance(e, TensorIf):
+        if "SKIP" in (e.then_action, e.else_action):
+            return "tensor_if with a SKIP action"
+        return None
+    raw = e.get_property("on-error")
+    if raw is None:
+        return None
+    mode = str(raw).strip().lower()
+    if mode == "drop":
+        return "on-error=drop"
+    if mode == "retry":
+        err_pad = getattr(e, "error_pad", None)
+        routed = err_pad is not None and any(
+            l.src_pad == err_pad for l in pipeline.out_links(e)
+        )
+        if not routed:
+            return "on-error=retry with no dead-letter pad linked"
+    return None
+
+
+def _skewed_join_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W110: a synchronizing fan-in (mux/merge, sync-mode != nosync)
+    with a data-dependent frame dropper on a strict subset of branches —
+    the join waits forever for counterparts of skipped frames."""
+    from nnstreamer_tpu.elements.routing import TensorMerge, TensorMux
+
     for m in pipeline.elements:
+        if not isinstance(m, (TensorMux, TensorMerge)):
+            continue
+        if str(m.get_property("sync-mode", "slowest")).lower() == "nosync":
+            continue
         ins = pipeline.in_links(m)
         if len(ins) < 2:
             continue
-        branch_anc: List[Set[Element]] = []
-        for l in ins:
-            anc: Set[Element] = set()
-            stack = [l.src]
-            while stack:
-                e = stack.pop()
-                if e in anc:
-                    continue
-                anc.add(e)
-                stack.extend(ll.src for ll in pipeline.in_links(e))
-            branch_anc.append(anc)
-        flagged: Set[Element] = set()
-        for i in range(len(ins)):
-            for j in range(i + 1, len(ins)):
-                shared = [
-                    t for t in branch_anc[i] & branch_anc[j]
-                    if isinstance(t, Tee) and t not in flagged
-                ]
-                for tee in shared:
-                    bad = [
-                        ins[k].dst_pad for k in (i, j)
-                        if _queue_free_reach(pipeline, tee, ins[k].src)
-                        or ins[k].src is tee
-                    ]
-                    if bad:
-                        flagged.add(tee)
-                        pads = ", ".join(f"sink_{p}" for p in bad)
-                        report.add(
-                            "NNS-W103", m.name,
-                            f"branches from tee {tee.name!r} reach "
-                            f"{m.name} ({pads}) without an intervening "
-                            "queue",
-                            "insert 'queue' after each tee branch",
-                        )
+        droppers: Dict[int, str] = {}
+        for l, anc in zip(ins, _branch_ancestors(pipeline, ins)):
+            for e in anc:
+                reason = _may_drop_frames(e, pipeline)
+                if reason is not None:
+                    droppers[l.dst_pad] = f"{e.name} ({reason})"
+                    break
+        if droppers and len(droppers) < len(ins):
+            detail = "; ".join(
+                f"sink_{pad}: {who}" for pad, who in sorted(droppers.items())
+            )
+            report.add(
+                "NNS-W110", m.name,
+                "synchronizing fan-in has data-dependent droppers on a "
+                f"subset of its branches ({detail}); pads fill at "
+                "different rates and the sync policy can starve",
+                "drop on every branch symmetrically, use sync-mode=nosync,"
+                " or dead-letter failures instead of dropping",
+            )
 
 
 # -- pass 4: resources -------------------------------------------------------
@@ -652,8 +819,9 @@ def _spec_pass(
             if opened is not None and opened is not getattr(e, "backend", None):
                 try:
                     clone.stop()
-                except Exception:
-                    pass
+                except Exception as exc:
+                    _log.debug("clone cleanup for %s failed: %s",
+                               e.name, exc)
     return specs_out
 
 
@@ -678,6 +846,9 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     skip = _resource_pass(pipeline, report)
     cyclic = _structure_pass(pipeline, report, placeholders)
     _tee_pass(pipeline, report)
+    _capacity_pass(pipeline, report)
+    _fanout_join_pass(pipeline, report)
+    _skewed_join_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)

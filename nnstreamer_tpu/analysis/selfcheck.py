@@ -66,6 +66,73 @@ def self_check() -> List[str]:
     return problems
 
 
+# -- nns-san --self-check: the diagnostic catalog must cover the code -------
+
+_CODE_REF = re.compile(r"""["'](NNS-[EWRS]\d{3})["']""")
+
+
+def _emitted_codes() -> Set[str]:
+    """Every diagnostic code referenced by an analyzer/sanitizer module
+    (the emitters; the catalog module itself doesn't count)."""
+    import importlib
+
+    out: Set[str] = set()
+    for name in (
+        # importlib (not `import a.b as m`): analysis.__init__ re-binds
+        # `lint` to the function, and the as-import would grab that
+        "nnstreamer_tpu.analysis.lint",
+        "nnstreamer_tpu.analysis.racecheck",
+        "nnstreamer_tpu.pipeline.sanitize",
+    ):
+        mod = importlib.import_module(name)
+        out |= set(_CODE_REF.findall(inspect.getsource(mod)))
+    return out
+
+
+def san_self_check() -> List[str]:
+    """Validate the diagnostic catalog against the code (the nns-san
+    mirror of the element-schema self-check): every code an analyzer can
+    emit exists in the catalog, every catalog code has an emitter, slugs
+    are unique, severities match the E/W prefix convention, and the
+    sanitizer doc covers the nns-san codes."""
+    import os
+
+    from nnstreamer_tpu.analysis.diagnostics import CATALOG, Severity
+
+    problems: List[str] = []
+    emitted = _emitted_codes()
+    for code in sorted(emitted - set(CATALOG)):
+        problems.append(f"code {code} is emitted but not in the catalog")
+    for code in sorted(set(CATALOG) - emitted):
+        problems.append(f"catalog code {code} has no emitter in the code")
+    slugs: Dict[str, str] = {}
+    for code, (sev, slug, _desc) in CATALOG.items():
+        if slug in slugs:
+            problems.append(
+                f"slug {slug!r} used by both {slugs[slug]} and {code}"
+            )
+        slugs[slug] = code
+        if code.startswith("NNS-E") and sev is not Severity.ERROR:
+            problems.append(f"{code} has an E prefix but severity {sev}")
+        if code.startswith("NNS-W") and sev is not Severity.WARNING:
+            problems.append(f"{code} has a W prefix but severity {sev}")
+    doc = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "docs", "sanitizer.md",
+    )
+    if os.path.isfile(doc):  # repo checkouts only; wheels ship no docs
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        for code in sorted(CATALOG):
+            if code.startswith(("NNS-R", "NNS-S")) and code not in text:
+                problems.append(
+                    f"{code} is not documented in docs/sanitizer.md"
+                )
+    return problems
+
+
 def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     problems = self_check()
     for p in problems:

@@ -77,6 +77,13 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         # stall watchdog: >0 arms the executor monitor thread that turns
         # a no-progress-with-queued-data hang into PipelineStallError
         "watchdog_timeout_ms": "0",
+        # nns-san runtime sanitizer (pipeline/sanitize.py): instrumented
+        # channels assert negotiated-spec conformance per frame, latch
+        # offered == delivered + dropped + routed per node at EOS, watch
+        # lock order, poison batch pad rows, and report leaked threads.
+        # The NNS_TPU_SANITIZE env var is the documented one-knob opt-in
+        # (checked before this layered key).
+        "sanitize": "false",
     },
 }
 

@@ -164,6 +164,15 @@ class Element:
     # their negotiation on clones.
     LINT_SKIP_NEGOTIATE = False
 
+    # Strict 1:1 cardinality declaration for the runtime sanitizer
+    # (pipeline/sanitize.py): True means every offered frame is either
+    # delivered, dropped (with a counted reason), or routed — never
+    # absorbed, split, or merged — so the EOS frame-accounting invariant
+    # offered == delivered + dropped + routed is enforceable per node.
+    # Fused segments are implicitly strict (TensorOps are 1→1 by
+    # contract); host-path elements opt in per class.
+    SAN_ONE_TO_ONE: bool = False
+
     # Dead-letter error pad index (pipeline/faults.py): None = no error
     # pad; elements whose ``on-error=route|retry`` property exposed one
     # carry the extra src pad index here (install_error_pad sets it, the

@@ -51,6 +51,9 @@ class TensorChaos(HostElement):
     custom=strict_shapes:true``) to drive the downstream policy."""
 
     FACTORY_NAME = "tensor_chaos"
+    # passthrough 1:1 (even corrupted frames are delivered): sanitizer
+    # frame accounting applies, which is exactly what chaos runs exercise
+    SAN_ONE_TO_ONE = True
 
     PROPERTIES = {
         "fail-rate": PropSpec(

@@ -132,6 +132,9 @@ def _parse_combination(s: str, prefix_ok=("i", "o")) -> Optional[List[Tuple[str,
 @registry.element("tensor_filter")
 class TensorFilter(TensorOp):
     FACTORY_NAME = "tensor_filter"
+    # one invoke per frame on every path (fused, host, batched-split):
+    # the sanitizer may enforce per-node frame accounting
+    SAN_ONE_TO_ONE = True
 
     PROPERTIES = {
         "framework": PropSpec("str", "auto", desc="backend subplugin name"),

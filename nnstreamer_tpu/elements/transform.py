@@ -56,6 +56,7 @@ def _ref_axis(canonical_rank: int, ref_dim: int) -> int:
 @registry.element("tensor_transform")
 class TensorTransform(TensorOp):
     FACTORY_NAME = "tensor_transform"
+    SAN_ONE_TO_ONE = True  # pure per-frame tensor fn (sanitizer accounting)
 
     PROPERTIES = {
         "mode": PropSpec(

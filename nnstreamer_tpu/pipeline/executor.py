@@ -48,7 +48,13 @@ from nnstreamer_tpu.pipeline.faults import (
     watchdog_timeout_ms,
 )
 from nnstreamer_tpu.pipeline.graph import ExecPlan, FusedSegment, Link
+from nnstreamer_tpu.pipeline.sanitize import (
+    Sanitizer,
+    san_chan_cls,
+    sanitize_enabled,
+)
 from nnstreamer_tpu.tensors.frame import EOS_FRAME, Frame
+from nnstreamer_tpu.tensors.spec import TensorsSpec
 
 _log = get_logger("executor")
 
@@ -212,11 +218,13 @@ class Node:
         self.fault_gate = None   # the gate itself (watchdog backoff check)
 
     def add_in_queue(self, size: int) -> int:
-        self.in_queues.append(_Chan(size))
+        self.in_queues.append(self.ex.make_chan(size, self, len(self.in_queues)))
         return len(self.in_queues) - 1
 
     # -- data movement ----------------------------------------------------
     def push_out(self, pad: int, item) -> None:
+        if self.ex.sanitizer is not None and item is not EOS_FRAME:
+            self.ex.sanitizer.count_push(self.name, pad)
         # an out pad may feed several consumers (eliminated tee fan-out);
         # frames are immutable, so every consumer shares the same object
         for dst, dst_pad in self.outs[pad]:
@@ -258,8 +266,15 @@ class Node:
     def run(self) -> None:
         raise NotImplementedError
 
+    def _advance(self, n: int) -> None:
+        """The ONE place frames_processed mutates — the node's own service
+        thread is the only writer (single-writer contract; observers get
+        GIL-atomic reads), and funneling the read-modify-write through a
+        single method makes that structural for the nns-san race lint."""
+        self.frames_processed += n
+
     def stat(self, t0: float) -> None:
-        self.frames_processed += 1
+        self._advance(1)
         tracer = trace.get()
         if tracer is None and (self.frames_processed & 7):
             # sampled EMA (1-in-8): the per-frame timing arithmetic is a
@@ -332,7 +347,7 @@ class Node:
         """Per-BATCH accounting: frames_processed counts frames, the EMA
         tracks per-batch wall time, and with a tracer attached one
         batch-assembly span records size/bucket/wait/pad-waste."""
-        self.frames_processed += n
+        self._advance(n)
         now = time.perf_counter()
         dt = (now - t0) * 1000.0
         a = 0.2
@@ -771,7 +786,27 @@ class Executor:
         self.watchdog_timeout_ms = watchdog_timeout_ms()
         self._watchdog: Optional[threading.Thread] = None
         self.stalled = False
+        # nns-san runtime sanitizer (NNS_TPU_SANITIZE=1 / [executor]
+        # sanitize): instrumented channels, frame-accounting latch,
+        # lock-order watch, thread-leak report. Resolved at construction
+        # (before _build, which materializes the channels).
+        self.sanitizer: Optional[Sanitizer] = None
+        self.leaked_threads: List[str] = []
+        self._threads_at_start: Optional[set] = None
+        if sanitize_enabled():
+            self.sanitizer = Sanitizer()
+            self._err_lock = self.sanitizer.lock("executor._err_lock")
+            self._sinks_cv = threading.Condition(
+                self.sanitizer.lock("executor._sinks_cv")
+            )
         self._build()
+
+    def make_chan(self, size: int, node: "Node", pad: int) -> _Chan:
+        """Channel factory: the instrumented SanChan under the sanitizer,
+        the lock-free _Chan otherwise."""
+        if self.sanitizer is not None:
+            return san_chan_cls()(size, self.sanitizer, node.name, pad)
+        return _Chan(size)
 
     # -- construction ------------------------------------------------------
     def _build(self) -> None:
@@ -841,7 +876,6 @@ class Executor:
                 node = SourceNode(self, e)
             elif isinstance(e, Sink):
                 node = SinkNode(self, e)
-                self._pending_sinks += 1
             elif isinstance(e, Routing):
                 node = RoutingNode(self, e)
             elif isinstance(e, HostElement):
@@ -850,6 +884,11 @@ class Executor:
                 raise TypeError(f"cannot execute element {e!r}")
             self._node_of[e] = node
         self.nodes = list(dict.fromkeys(self._node_of.values()))
+        # single assignment (not a per-sink += in the loop): after build,
+        # only sink_done mutates the count, and it holds _sinks_cv
+        self._pending_sinks = sum(
+            1 for n in self.nodes if isinstance(n, SinkNode)
+        )
         # wire channels: only links that cross node boundaries materialize
         for src, src_pad, dst, dst_pad, size in links:
             src_node = self._node_of[src]
@@ -862,14 +901,39 @@ class Executor:
             while len(dst_node.in_queues) <= dp:
                 dst_node.add_in_queue(dst.queue_size)
             if size is not None:  # an eliminated queue's depth override
-                dst_node.in_queues[dp] = _Chan(size)
+                dst_node.in_queues[dp] = self.make_chan(size, dst_node, dp)
+            if self.sanitizer is not None:
+                # pin the consumer pad's negotiated spec to the channel so
+                # every put is conformance-checked (STATIC specs only:
+                # flexible/media links negotiate per frame)
+                spec = (
+                    dst.in_specs[dst_pad]
+                    if dst_pad < len(dst.in_specs) else None
+                )
+                if isinstance(spec, TensorsSpec) and spec.is_static:
+                    dst_node.in_queues[dp].expected_spec = spec
             src_node.outs.setdefault(sp, []).append((dst_node, dp))
+        if self.sanitizer is not None:
+            # pre-register every (node, pad) push counter (lock-free
+            # per-frame increments, resize-safe snapshots) and resolve
+            # the pad-row poison decision ONCE for the fused segments
+            # (graph.py process_batch reads the flag, not the config)
+            for n in self.nodes:
+                for pad in n.outs:
+                    self.sanitizer.register_pad(n.name, pad)
+            for seg in self.plan.segments:
+                seg.sanitize_poison = True
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         if self._started:
             return
         self._started = True
+        if self.sanitizer is not None:
+            # baseline BEFORE element start: threads that appear during
+            # the run (element/edge service threads) and survive stop()
+            # land in the leak report
+            self._threads_at_start = set(threading.enumerate())
         for e in self.plan.pipeline.elements:
             e.start()
         for n in self.nodes:
@@ -986,13 +1050,65 @@ class Executor:
             self._sinks_cv.notify_all()
 
     def stop(self) -> None:
+        """Shut the pipeline down: join every thread the executor started
+        (service threads AND the watchdog) under one bounded budget,
+        stop the elements, then report stragglers in
+        ``self.leaked_threads`` instead of silently leaking daemons.
+        Under the sanitizer, threads that appeared during the run
+        (element/edge service threads) and outlived shutdown are
+        reported too, and the per-node frame-accounting invariant is
+        latched at clean EOS."""
+        if self.finished:
+            return
         self.stop_event.set()
-        for n in self.nodes:
-            if n.thread is not None:
-                n.thread.join(timeout=5.0)
+        threads = [n.thread for n in self.nodes if n.thread is not None]
+        if self._watchdog is not None:
+            threads.append(self._watchdog)
+        deadline = time.monotonic() + 5.0  # total, not per-thread
+        for t in threads:
+            t.join(timeout=max(0.05, deadline - time.monotonic()))
         for e in self.plan.pipeline.elements:
             e.stop()
+        leaked = [t.name for t in threads if t.is_alive()]
+        if self.sanitizer is not None and self._threads_at_start is not None:
+            ours = set(threads)
+            me = threading.current_thread()
+            leaked += [
+                t.name for t in threading.enumerate()
+                if t.is_alive() and t is not me and t not in ours
+                and t not in self._threads_at_start
+            ]
+        self.leaked_threads = leaked
+        if leaked:
+            _log.warning("threads alive after shutdown: %s", leaked)
+            if self.sanitizer is not None:
+                self.sanitizer.thread_leak(leaked)
+        if (
+            self.sanitizer is not None
+            and self._pending_sinks == 0
+            and not self.errors
+        ):
+            for n in self.nodes:
+                if self._accounting_eligible(n):
+                    self.sanitizer.check_accounting(n)
         self.finished = True
+
+    def _accounting_eligible(self, n: Node) -> bool:
+        """Nodes whose offered == delivered + dropped + routed invariant
+        is well-defined: fused segments (pure 1:1 TensorOps) and nodes
+        whose element declares SAN_ONE_TO_ONE — minus any with upstream
+        QoS wired (those skips aren't attributable per node) and any
+        whose thread never finished (counts still moving)."""
+        if isinstance(n, FusedNode):
+            elem = n.seg.first
+        else:
+            elem = getattr(n, "elem", None)
+            if elem is None \
+                    or not getattr(type(elem), "SAN_ONE_TO_ONE", False):
+                return False
+        if elem.qos_sources:
+            return False
+        return not (n.thread is not None and n.thread.is_alive())
 
     # -- introspection (per-element proctime, §5.1 parity) ----------------
     def stats(self) -> Dict[str, Dict[str, float]]:
@@ -1036,6 +1152,10 @@ class Executor:
                 got = cstats()
                 if got:
                     s.update({f"cb_{k}": v for k, v in got.items()})
+            # sanitizer counters (pipeline/sanitize.py): per-node frame
+            # accounting as the instrumented channels saw it
+            if self.sanitizer is not None:
+                s.update(self.sanitizer.node_snapshot(n))
             out[n.name] = s
         return out
 

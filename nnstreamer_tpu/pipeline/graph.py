@@ -415,6 +415,10 @@ class FusedSegment:
         # the member ops' on-error/retry-* properties. Segments never
         # carry a route policy — route ops are fusion barriers.
         self.fault_policy = None
+        # set by the executor when its sanitizer is active: pad rows in
+        # process_batch are then poison, not last-frame replicas. One
+        # flag resolved at build — the hot path never re-reads config.
+        self.sanitize_poison = False
         from nnstreamer_tpu.pipeline.batching import BatchStats
 
         self.batch_stats = BatchStats()
@@ -533,11 +537,20 @@ class FusedSegment:
         bucket = cfg.bucket_for(n)
         fn = self._jitted_for(sig, bucket)
         pad = bucket - n
+        filler = None
+        if pad and self.sanitize_poison:
+            # sanitizer on: pad rows are poison (NaN / int max) instead
+            # of last-frame replicas — a split/index bug then yields
+            # garbage instead of a plausibly-stale frame
+            from nnstreamer_tpu.pipeline.sanitize import poison_like
+
+            filler = poison_like
         cols = []
         for i in range(len(frames[0].tensors)):
             rows = [f.tensors[i] for f in frames]
             if pad:
-                rows.extend([frames[-1].tensors[i]] * pad)
+                last = frames[-1].tensors[i]
+                rows.extend([filler(last) if filler else last] * pad)
             cols.append(jnp.stack(rows))
         outs = fn(*cols)
         result: List[Frame] = []

@@ -101,6 +101,13 @@ class Tracer:
             args["error"] = type(exc).__name__
         self.instant(name, cat="fault", **args)
 
+    def san(self, name: str, code: str, **extra) -> None:
+        """Sanitizer finding (pipeline/sanitize.py): one instant marker
+        per NNS-S diagnostic so spec violations, accounting leaks, lock
+        cycles and thread leaks land on the same timeline as the frames
+        that caused them."""
+        self.instant(name, cat="san", code=code, **extra)
+
     def instant(self, name: str, cat: str = "event", **args) -> None:
         with self._lock:
             self._events.append(

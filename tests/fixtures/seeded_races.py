@@ -1,0 +1,96 @@
+"""Seeded concurrency violations for the nns-san race lint.
+
+This file is SCANNED by tests/test_sanitizer.py (never imported at
+runtime): every rule the race lint implements must fire here, so a check
+that silently stops matching fails the suite. One section per code.
+
+Expected findings:
+- NNS-R001 x2 (UnlockedCounter.count, both write sites)
+- NNS-R002 x1 (SleepyLock.slow)
+- NNS-R003 x1 (swallow_everything)
+- NNS-R004 x1 (service_loop)
+- NNS-R005 x1 (fire_and_forget)
+- NNS-R006 x3 (BrokenChan: unchecked append, park without re-check,
+  unchecked popleft)
+"""
+
+import threading
+import time
+from collections import deque
+
+
+class UnlockedCounter:
+    """NNS-R001: a thread-spawning class read-modify-writes a shared
+    counter from two methods with no lock at either site."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.worker = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self.count += 1  # writer 1: the service thread
+
+    def bump(self):
+        self.count += 1  # writer 2: whoever calls the public API
+
+
+class SleepyLock:
+    """NNS-R002: unbounded blocking call while holding a lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slow(self):
+        with self._lock:
+            time.sleep(1.0)
+
+
+def swallow_everything(fn):
+    """NNS-R003: bare except with no re-raise eats KeyboardInterrupt."""
+    try:
+        fn()
+    except:  # the violation under test
+        return None
+
+
+def service_loop(q):
+    """NNS-R004: a service loop that silently eats every failure."""
+    while True:
+        try:
+            q.step()
+        except Exception:
+            continue
+
+
+def fire_and_forget(fn):
+    """NNS-R005: thread with neither daemon=True nor a join."""
+    worker = threading.Thread(target=fn)
+    worker.start()
+    return worker
+
+
+class BrokenChan:
+    """NNS-R006: the _Chan Dekker pairing, violated on both sides."""
+
+    def __init__(self):
+        self._d = deque()
+        self._data = threading.Event()
+        self._get_waiting = False
+        self._put_waiting = False
+
+    def put(self, item):
+        # mover side: no waiting-flag check after the deque op — a
+        # parked consumer sleeps out its full beat
+        self._d.append(item)
+
+    def get(self):
+        d = self._d
+        while not d:
+            self._get_waiting = True
+            # waiter side: parks without re-checking the deque after
+            # advertising the flag — a push in between is missed
+            self._data.wait(0.05)
+            self._get_waiting = False
+        return d.popleft()

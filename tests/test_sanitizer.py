@@ -1,0 +1,425 @@
+"""nns-san: race-lint table tests over the seeded-violations fixture,
+graph deadlock/capacity diagnostics, --strict, the catalog self-check,
+and runtime-sanitizer runs that catch an injected spec violation, a
+frame-accounting leak, a lock-order cycle, and a leaked thread that a
+plain run misses.
+
+Wall-time discipline: tiny frame counts, no unbounded sleeps — this file
+sits mid-alphabet and the tier-1 suite brushes its 870s budget.
+"""
+
+import os
+import threading
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import registry
+from nnstreamer_tpu.analysis import lint
+from nnstreamer_tpu.analysis.racecheck import run_race_lint
+from nnstreamer_tpu.elements.base import HostElement
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+from nnstreamer_tpu.pipeline.sanitize import (
+    LockOrderGraph,
+    SpecViolationError,
+    poison_like,
+    sanitize_enabled,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "seeded_races.py")
+
+# the seeded fixture documents these exact counts in its docstring; a
+# check that silently stops matching fails here
+EXPECTED_SEEDED = {
+    "NNS-R001": 2, "NNS-R002": 1, "NNS-R003": 1,
+    "NNS-R004": 1, "NNS-R005": 1, "NNS-R006": 3,
+}
+
+
+# ------------------------------------------------------------------ static
+class TestRaceLint:
+    def test_seeded_fixture_yields_every_expected_code(self):
+        report = run_race_lint([FIXTURE])
+        got = Counter(d.code for d in report.diagnostics)
+        assert dict(got) == EXPECTED_SEEDED, report.render()
+        # R003/R006 are errors: the seeded file fails hard
+        assert report.exit_code == 2
+
+    def test_findings_anchor_to_file_and_line(self):
+        report = run_race_lint([FIXTURE])
+        for d in report.diagnostics:
+            path, _, line = d.element.rpartition(":")
+            assert path.endswith("seeded_races.py") and line.isdigit(), d
+
+    def test_waiver_comment_suppresses_single_line(self, tmp_path):
+        bad = (
+            "import threading\n"
+            "import time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(1.0)  # nns-san: ok - startup only\n"
+        )
+        p = tmp_path / "w.py"
+        p.write_text(bad)
+        assert run_race_lint([str(p)]).diagnostics == []
+        p.write_text(bad.replace("  # nns-san: ok - startup only", ""))
+        codes = [d.code for d in run_race_lint([str(p)]).diagnostics]
+        assert codes == ["NNS-R002"]
+
+    def test_condition_wait_is_not_flagged(self, tmp_path):
+        ok = (
+            "import threading\n"
+            "_cv = threading.Condition()\n"
+            "def f(pred):\n"
+            "    with _cv:\n"
+            "        _cv.wait()\n"
+        )
+        p = tmp_path / "c.py"
+        p.write_text(ok)
+        assert run_race_lint([str(p)]).diagnostics == []
+
+    # the package-is-clean gate lives in tests/test_style.py (the same
+    # assertion tools/check_style.py enforces on whole-tree runs)
+
+
+class TestDeadlockPass:
+    def test_w108_nonpositive_queue_size(self):
+        r = lint("tensorsrc dimensions=4 ! tensor_sink queue-size=0")
+        assert "NNS-W108" in r.codes, r.render()
+
+    def test_w108_batch_starved_channel(self):
+        r = lint(
+            "tensorsrc dimensions=4 ! tensor_transform mode=typecast "
+            "option=float32 batching=true max-batch=8 queue-size=4 ! "
+            "tensor_sink"
+        )
+        assert "NNS-W108" in r.codes, r.render()
+
+    def test_w108_models_eliminated_queue_depth(self):
+        # the executor replaces the consumer channel with an eliminated
+        # upstream queue's depth — the pass must use the EFFECTIVE depth
+        starved = lint(
+            "tensorsrc dimensions=4 ! queue max-size-buffers=4 ! "
+            "tensor_transform mode=typecast option=float32 "
+            "batching=true max-batch=8 ! tensor_sink"
+        )
+        assert "NNS-W108" in starved.codes, starved.render()
+        widened = lint(
+            "tensorsrc dimensions=4 ! queue max-size-buffers=32 ! "
+            "tensor_transform mode=typecast option=float32 "
+            "batching=true max-batch=16 queue-size=8 ! tensor_sink"
+        )
+        assert "NNS-W108" not in widened.codes, widened.render()
+
+    def test_w109_unqueued_demux_join(self):
+        desc_unqueued = (
+            "tensorsrc dimensions=4,4 num-tensors=2 ! tensor_demux name=d "
+            "d.src_0 ! mux.sink_0 d.src_1 ! mux.sink_1 "
+            "tensor_mux name=mux ! tensor_sink"
+        )
+        r = lint(desc_unqueued)
+        assert "NNS-W109" in r.codes, r.render()
+        queued = desc_unqueued.replace("! mux.sink", "! queue ! mux.sink")
+        r = lint(queued)
+        assert "NNS-W109" not in r.codes, r.render()
+
+    def test_w110_skewed_sync_join(self):
+        # tensor_if defaults to else=SKIP: one branch drops data-
+        # dependently, the other never does — the mux can starve
+        r = lint(
+            "tensorsrc dimensions=4 ! tee name=t "
+            "t. ! queue ! tensor_if supplied-value=0.5 ! mux.sink_0 "
+            "t. ! queue ! mux.sink_1 "
+            "tensor_mux name=mux ! tensor_sink"
+        )
+        assert "NNS-W110" in r.codes, r.render()
+
+    def test_w110_quiet_for_nosync_and_symmetric(self):
+        nosync = lint(
+            "tensorsrc dimensions=4 ! tee name=t "
+            "t. ! queue ! tensor_if supplied-value=0.5 ! mux.sink_0 "
+            "t. ! queue ! mux.sink_1 "
+            "tensor_mux name=mux sync-mode=nosync ! tensor_sink"
+        )
+        assert "NNS-W110" not in nosync.codes, nosync.render()
+        symmetric = lint(
+            "tensorsrc dimensions=4 ! tee name=t "
+            "t. ! queue ! tensor_if supplied-value=0.5 ! mux.sink_0 "
+            "t. ! queue ! tensor_if supplied-value=0.5 ! mux.sink_1 "
+            "tensor_mux name=mux ! tensor_sink"
+        )
+        assert "NNS-W110" not in symmetric.codes, symmetric.render()
+
+
+class TestCliAndSelfCheck:
+    def test_nns_san_race_json(self, capsys):
+        import json
+
+        from nnstreamer_tpu.analysis.san_cli import main
+
+        rc = main(["--json", "--race", FIXTURE])
+        assert rc == 2
+        data = json.loads(capsys.readouterr().out)
+        assert set(EXPECTED_SEEDED) == {
+            d["code"] for d in data["diagnostics"]
+        }
+
+    def test_nns_san_race_package_is_clean(self, capsys):
+        from nnstreamer_tpu.analysis.san_cli import main
+
+        rc = main(["--race", os.path.join(REPO, "nnstreamer_tpu")])
+        assert rc == 0, capsys.readouterr().out
+
+    def test_nns_san_deadlock_filters_to_graph_codes(self, capsys):
+        import json
+
+        from nnstreamer_tpu.analysis.lint import DEADLOCK_CODES
+        from nnstreamer_tpu.analysis.san_cli import main
+
+        # unknown property + undersized channel: --deadlock must report
+        # only the graph-shape finding
+        rc = main(["--json", "--deadlock",
+                   "tensorsrc dimensions=4 bogus=1 ! tensor_sink "
+                   "queue-size=-2"])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        codes = {d["code"] for d in data["diagnostics"]}
+        assert codes == {"NNS-W108"}
+        assert codes <= DEADLOCK_CODES
+
+    def test_nns_san_self_check_passes(self, capsys):
+        from nnstreamer_tpu.analysis.san_cli import main
+
+        assert main(["--self-check"]) == 0, capsys.readouterr().out
+
+    def test_nns_lint_strict_promotes_warnings(self):
+        from nnstreamer_tpu.analysis.cli import main
+
+        warn_only = "tensorsrc frobnicate=1 ! tensor_sink"
+        assert main([warn_only]) == 1
+        assert main(["--strict", warn_only]) == 2
+        clean = (
+            "tensorsrc dimensions=4 num-frames=2 ! tensor_transform "
+            "mode=typecast option=float32 ! tensor_sink"
+        )
+        assert main(["--strict", clean]) == 0
+
+    def test_nns_san_strict(self):
+        from nnstreamer_tpu.analysis.san_cli import main
+
+        assert main(["--strict", "--deadlock",
+                     "tensorsrc dimensions=4 ! tensor_sink "
+                     "queue-size=0"]) == 2
+
+
+# ----------------------------------------------------------------- runtime
+CHAOS_CORRUPT = (
+    "tensorsrc dimensions=4 num-frames=9 ! "
+    "tensor_chaos corrupt-every-n=3 ! tensor_sink name=out"
+)
+
+
+class TestRuntimeSanitizer:
+    def test_config_knob_and_env(self, monkeypatch):
+        assert not sanitize_enabled()
+        monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+        assert sanitize_enabled()
+        monkeypatch.setenv("NNS_TPU_SANITIZE", "0")
+        assert not sanitize_enabled()
+
+    def test_plain_run_misses_corruption_sanitized_catches(
+        self, monkeypatch
+    ):
+        # plain: the shape-truncated frames flow to the sink unnoticed
+        p = parse_pipeline(CHAOS_CORRUPT)
+        ex = p.run(timeout=60)
+        assert p["out"].rendered == 9 and not ex.errors
+        # sanitized: the stream fails AT the corruption point
+        monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+        p = parse_pipeline(CHAOS_CORRUPT)
+        with pytest.raises(SpecViolationError) as ei:
+            p.run(timeout=60)
+        assert "spec" in str(ei.value)
+        san = p._executor.sanitizer
+        assert "NNS-S001" in san.codes
+
+    def test_sanitized_chaos_drop_run_stays_balanced(self, monkeypatch):
+        # an on-error=drop chaos run accounts every frame: no findings
+        monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+        p = parse_pipeline(
+            "tensorsrc dimensions=4 num-frames=60 pattern=counter ! "
+            "tensor_filter name=f framework=faulty "
+            "custom=fail_rate:0.2,seed:7 on-error=drop ! "
+            "tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        assert ex.sanitizer.codes == [], [
+            str(d) for d in ex.sanitizer.findings()
+        ]
+        s = ex.stats()["f"]
+        assert s["san_offered"] == 60
+        assert s["san_delivered"] + s["error_dropped"] == 60
+        assert ex.leaked_threads == []
+
+    def test_accounting_leak_detected_only_when_sanitized(
+        self, monkeypatch
+    ):
+        class LeakyHost(HostElement):
+            """Declares 1:1 but silently eats every 3rd frame."""
+
+            FACTORY_NAME = "leakyhost"
+            SAN_ONE_TO_ONE = True
+
+            def __init__(self, name=None, **props):
+                super().__init__(name, **props)
+                self._n = 0
+
+            def negotiate(self, in_specs):
+                return [in_specs[0]]
+
+            def process(self, frame):
+                self._n += 1
+                return None if self._n % 3 == 0 else frame
+
+        registry.register(registry.KIND_ELEMENT, "leakyhost", LeakyHost)
+        try:
+            desc = (
+                "tensorsrc dimensions=4 num-frames=9 ! leakyhost ! "
+                "tensor_sink name=out"
+            )
+            ex = parse_pipeline(desc).run(timeout=60)  # plain: silent
+            assert not ex.errors and ex.sanitizer is None
+            monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+            p = parse_pipeline(desc)
+            ex = p.run(timeout=60)
+            assert "NNS-S002" in ex.sanitizer.codes, [
+                str(d) for d in ex.sanitizer.findings()
+            ]
+            (leak,) = [
+                d for d in ex.sanitizer.findings()
+                if d.code == "NNS-S002"
+            ]
+            assert "3 frame(s) leaked" in leak.message
+        finally:
+            registry.unregister(registry.KIND_ELEMENT, "leakyhost")
+
+    def test_thread_leak_reported_at_shutdown(self, monkeypatch):
+        stop_ev = threading.Event()
+
+        class ThreadLeaker(HostElement):
+            """start() spawns a service thread; stop() forgets it."""
+
+            FACTORY_NAME = "threadleaker"
+
+            def negotiate(self, in_specs):
+                return [in_specs[0]]
+
+            def start(self):
+                t = threading.Thread(
+                    target=self._loop, name="leaky-service", daemon=True
+                )
+                t.start()
+
+            def _loop(self):
+                while not stop_ev.wait(0.02):
+                    pass
+
+            def process(self, frame):
+                return frame
+
+        registry.register(
+            registry.KIND_ELEMENT, "threadleaker", ThreadLeaker
+        )
+        try:
+            monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+            p = parse_pipeline(
+                "tensorsrc dimensions=4 num-frames=3 ! threadleaker ! "
+                "tensor_sink name=out"
+            )
+            ex = p.run(timeout=60)
+            assert "leaky-service" in ex.leaked_threads
+            assert "NNS-S004" in ex.sanitizer.codes
+        finally:
+            stop_ev.set()
+            registry.unregister(registry.KIND_ELEMENT, "threadleaker")
+
+    def test_watchdog_thread_joined_on_stop(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_WATCHDOG_TIMEOUT_MS", "5000")
+        p = parse_pipeline(
+            "tensorsrc dimensions=4 num-frames=5 ! tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        assert ex._watchdog is not None
+        assert not ex._watchdog.is_alive()
+        assert "nns-watchdog" not in ex.leaked_threads
+
+    def test_lock_order_cycle_detected(self):
+        cycles = []
+        g = LockOrderGraph(on_cycle=cycles.append)
+        la, lb = "lock-A", "lock-B"
+
+        def order(first, second):
+            g.acquired(first)
+            g.acquired(second)
+            g.released(second)
+            g.released(first)
+
+        t1 = threading.Thread(target=order, args=(la, lb))
+        t1.start()
+        t1.join(timeout=5)
+        assert cycles == []  # one order alone is fine
+        t2 = threading.Thread(target=order, args=(lb, la))
+        t2.start()
+        t2.join(timeout=5)
+        assert len(cycles) == 1 and "lock-A" in cycles[0]
+
+    def test_executor_lock_cycle_lands_in_report(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+        p = parse_pipeline(
+            "tensorsrc dimensions=4 num-frames=2 ! tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        san = ex.sanitizer
+        a, b = san.lock("test-A"), san.lock("test-B")
+
+        def order(first, second):
+            with first:
+                with second:
+                    pass
+
+        t1 = threading.Thread(target=order, args=(a, b))
+        t1.start()
+        t1.join(timeout=5)
+        t2 = threading.Thread(target=order, args=(b, a))
+        t2.start()
+        t2.join(timeout=5)
+        assert san.codes == ["NNS-S003"], [
+            str(d) for d in san.findings()
+        ]
+
+    def test_poison_values_are_obviously_wrong(self):
+        f = poison_like(np.zeros((2, 3), np.float32))
+        assert f.shape == (2, 3) and np.isnan(f).all()
+        i = poison_like(np.zeros((4,), np.int32))
+        assert (i == np.iinfo(np.int32).max).all()
+
+    def test_batched_pad_poison_does_not_leak_into_frames(
+        self, monkeypatch
+    ):
+        # 5 frames, max-batch=4: the bucket padding (poisoned under the
+        # sanitizer) must never reach a delivered frame
+        monkeypatch.setenv("NNS_TPU_SANITIZE", "1")
+        p = parse_pipeline(
+            "tensorsrc dimensions=4 num-frames=5 pattern=counter ! "
+            "tensor_transform mode=typecast option=float32 "
+            "batching=true max-batch=4 batch-timeout-ms=2 ! "
+            "tensor_sink name=out"
+        )
+        ex = p.run(timeout=120)
+        assert ex.sanitizer.codes == []
+        vals = [np.asarray(f.tensors[0]) for f in p["out"].frames]
+        assert len(vals) == 5
+        assert all(np.isfinite(v).all() for v in vals)

@@ -27,3 +27,25 @@ def test_element_property_schemas_cover_code():
 
     problems = self_check()
     assert not problems, "\n".join(problems)
+
+
+def test_race_lint_clean_on_package():
+    """nns-san --race over nnstreamer_tpu/ must report ZERO findings:
+    regressions in the repo's concurrency idioms (unlocked shared
+    counters, silent service-loop swallows, _Chan pairing violations)
+    fail the suite from now on (tools/check_style.py runs the same
+    gate on whole-tree runs)."""
+    from nnstreamer_tpu.analysis.racecheck import run_race_lint
+
+    report = run_race_lint([os.path.join(REPO, "nnstreamer_tpu")])
+    assert not report.diagnostics, report.render()
+
+
+def test_san_diagnostic_catalog_covers_code():
+    """nns-san --self-check: every emitted code is cataloged, every
+    cataloged code has an emitter, slugs stay unique, and the sanitizer
+    doc covers the NNS-R/NNS-S codes."""
+    from nnstreamer_tpu.analysis.selfcheck import san_self_check
+
+    problems = san_self_check()
+    assert not problems, "\n".join(problems)

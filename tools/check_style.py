@@ -11,6 +11,8 @@ Rules for tracked .py files (and the C++ under native/):
   PROPERTIES schema covers the properties its code reads (whole-tree
   runs only — explicit path args stay stdlib-fast; --no-self-check
   forces it off entirely)
+- `nns-san --race nnstreamer_tpu/` is clean: the package source obeys
+  its own concurrency idioms (same whole-tree-only gating)
 
 Usage: python tools/check_style.py [paths...]   (default: repo tree)
 Exit 0 clean, 1 with findings listed one per line.
@@ -82,6 +84,21 @@ def run_self_check() -> list:
     return [f"self-check: {p}" for p in self_check()]
 
 
+def run_race_lint_gate() -> list:
+    """Run nns-san --race over the package in-process: a concurrency-
+    idiom violation (unlocked shared counter, silent service-loop
+    swallow, broken _Chan pairing, ...) is a style problem from now on."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.racecheck import run_race_lint
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"nns-san --race could not run: {exc}"]
+    report = run_race_lint([os.path.join(repo, "nnstreamer_tpu")])
+    return [f"race: {d}" for d in report.diagnostics]
+
+
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     no_self_check = "--no-self-check" in args
@@ -97,6 +114,7 @@ def main(argv=None) -> int:
         problems.extend(check_file(path))
     if whole_tree and not no_self_check:
         problems.extend(run_self_check())
+        problems.extend(run_race_lint_gate())
     for p in problems:
         print(p)
     if problems:
