@@ -335,21 +335,30 @@ def _run() -> None:
         ex = p.run(timeout=600)
         sink = next(nd for nd in ex.nodes if isinstance(nd, SinkNode))
         # drop the first renders (compile/warmup rides on them), then
-        # take the median of the steady tail
+        # take the median of the steady tail — the TAIL quantiles ride
+        # along (nns-obs discipline: means hide the p99 story)
         all_lats = list(sink.latencies)
         lats = all_lats[max(2, len(all_lats) // 8):]
         if not lats:
             return None, ex
         lats.sort()
-        return 1000.0 * lats[len(lats) // 2], ex
 
-    def _pipeline_p50_ms():
+        def _q(q: float) -> float:
+            return 1000.0 * lats[min(len(lats) - 1, int(q * len(lats)))]
+
+        return {"p50": _q(0.50), "p95": _q(0.95), "p99": _q(0.99)}, ex
+
+    def _pipeline_lat_ms():
         return _paced_p50_ms(
             "", 48 if on_tpu else 8, 8 if on_tpu else 2
         )[0]
 
+    pipeline_p95_ms = pipeline_p99_ms = None
     try:
-        pipeline_p50_ms = _pipeline_p50_ms()
+        _lat = _pipeline_lat_ms()
+        pipeline_p50_ms = _lat["p50"] if _lat else None
+        pipeline_p95_ms = _lat["p95"] if _lat else None
+        pipeline_p99_ms = _lat["p99"] if _lat else None
     except Exception as exc:  # noqa: BLE001
         print(f"[bench] pipeline p50 failed: {exc!r}", file=sys.stderr)
         pipeline_p50_ms = None
@@ -366,10 +375,11 @@ def _run() -> None:
         hold = 4 if on_tpu else 1
         offered = hold * 4
         n = (48 if on_tpu else 12) * 4
-        p50, ex = _paced_p50_ms(
+        lat, ex = _paced_p50_ms(
             f"tensor_rate framerate={hold}/1 throttle=false ! ",
             n, offered,
         )
+        p50 = lat["p50"] if lat else None
         from nnstreamer_tpu.elements.windowing import TensorRate
         from nnstreamer_tpu.pipeline.executor import SinkNode
 
@@ -414,6 +424,8 @@ def _run() -> None:
                 "partial": True,
                 "pipeline_fps": _round(pipeline_fps),
                 "pipeline_p50_e2e_ms": _round(pipeline_p50_ms, 3),
+                "pipeline_p95_e2e_ms": _round(pipeline_p95_ms, 3),
+                "pipeline_p99_e2e_ms": _round(pipeline_p99_ms, 3),
                 "pipeline_rate_p50_ms": _round(pipeline_rate_p50_ms, 3),
                 "rate_drop_pct": rate_drop_pct,
                 "raw_invoke_bs1_fps": _round(fps),
@@ -954,6 +966,8 @@ for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
                 "vs_baseline": round(value / 1000.0, 3),
                 "pipeline_fps": _round(pipeline_fps),
                 "pipeline_p50_e2e_ms": _round(pipeline_p50_ms, 3),
+                "pipeline_p95_e2e_ms": _round(pipeline_p95_ms, 3),
+                "pipeline_p99_e2e_ms": _round(pipeline_p99_ms, 3),
                 "pipeline_rate_p50_ms": _round(pipeline_rate_p50_ms, 3),
                 "rate_drop_pct": rate_drop_pct,
                 "pipeline_h2d_fps": _round(pipeline_h2d_fps),

@@ -133,6 +133,66 @@ def san_self_check() -> List[str]:
     return problems
 
 
+# -- nns-obs self-check: the metric catalog must cover the code -------------
+
+_METRIC_EMIT = re.compile(
+    r"""(?:counter|gauge|histogram)\(\s*\n?\s*["'](nns_[a-z0-9_]+)["']"""
+)
+
+
+def _repo_root() -> str:
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def obs_self_check() -> List[str]:
+    """Validate the nns-obs metric catalog against the code and the docs
+    (the metrics mirror of san_self_check): every metric name the
+    package emits through a registry call exists in METRIC_CATALOG,
+    every cataloged metric has an emitter, and docs/observability.md
+    documents every cataloged name."""
+    import os
+
+    from nnstreamer_tpu.obs.metrics import METRIC_CATALOG
+
+    problems: List[str] = []
+    pkg_root = os.path.join(_repo_root(), "nnstreamer_tpu")
+    catalog_file = os.path.join(pkg_root, "obs", "metrics.py")
+    emitted: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.samefile(path, catalog_file):
+                continue  # the catalog module itself doesn't count
+            with open(path, encoding="utf-8") as f:
+                emitted |= set(_METRIC_EMIT.findall(f.read()))
+    for name in sorted(emitted - set(METRIC_CATALOG)):
+        problems.append(
+            f"metric {name} is emitted but not in METRIC_CATALOG"
+        )
+    for name in sorted(set(METRIC_CATALOG) - emitted):
+        problems.append(
+            f"catalog metric {name} has no emitter in the package"
+        )
+    doc = os.path.join(_repo_root(), "docs", "observability.md")
+    if os.path.isfile(doc):  # repo checkouts only; wheels ship no docs
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        for name in sorted(METRIC_CATALOG):
+            if name not in text:
+                problems.append(
+                    f"metric {name} is not documented in "
+                    "docs/observability.md"
+                )
+    return problems
+
+
 def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     problems = self_check()
     for p in problems:

@@ -60,7 +60,16 @@ def main(argv=None) -> int:
         "exit 0 clean / 1 warnings / 2 errors (see docs/linting.md)",
     )
     ap.add_argument("--timeout", type=float, default=None, help="run timeout (s)")
-    ap.add_argument("--stats", action="store_true", help="print per-node stats JSON")
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="print per-node stats JSON (enables nns-obs metrics, so the "
+        "rows carry latency_p50/p95/p99_ms and queue-wait percentiles)",
+    )
+    ap.add_argument(
+        "--metrics", metavar="FILE", default=None,
+        help="write a one-shot nns-obs JSON snapshot at EOS "
+        "(docs/observability.md; nns-top renders it)",
+    )
     ap.add_argument(
         "--trace", metavar="FILE", default=None,
         help="write chrome://tracing JSON of per-element frame spans",
@@ -111,7 +120,12 @@ def main(argv=None) -> int:
     import contextlib
 
     from nnstreamer_tpu import trace as trace_mod
+    from nnstreamer_tpu.obs import metrics as obs_metrics
 
+    if args.stats or args.metrics:
+        # percentile columns need the histograms recording; executors
+        # resolve the registry at construction, which happens in run()
+        obs_metrics.enable()
     tracer = trace_mod.enable() if args.trace else None
     profile_cm = (
         trace_mod.device_profile(args.profile) if args.profile
@@ -140,6 +154,15 @@ def main(argv=None) -> int:
         for e in pipeline.elements:
             if hasattr(e, "rendered"):
                 print(f"  {e.name}: rendered {e.rendered} frames", file=sys.stderr)
+    if args.metrics:
+        from nnstreamer_tpu.obs import expo
+
+        expo.dump_json(
+            args.metrics,
+            expo.snapshot(obs_metrics.get(), ex.stats(), ex.totals()),
+        )
+        if not args.quiet:
+            print(f"Metrics snapshot written to {args.metrics}", file=sys.stderr)
     if args.stats:
         stats = ex.stats()
         # pipeline-wide frame accounting rides alongside the per-node

@@ -84,6 +84,18 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         # The NNS_TPU_SANITIZE env var is the documented one-knob opt-in
         # (checked before this layered key).
         "sanitize": "false",
+        # nns-obs live telemetry (obs/): `metrics` turns on per-element
+        # latency/queue-wait/queue-depth histograms (p50/p95/p99 in
+        # Executor.stats and nns-launch --stats); `metrics_port` > 0
+        # additionally serves /metrics (Prometheus) + /metrics.json
+        # (nns-top) from a background thread. NNS_TPU_METRICS /
+        # NNS_TPU_METRICS_PORT are the documented one-knob env opt-ins
+        # (checked before these layered keys).
+        "metrics": "false",
+        "metrics_port": "0",
+        # bind address for the exposition endpoint: loopback unless the
+        # operator explicitly widens it (the endpoint has no auth)
+        "metrics_host": "127.0.0.1",
     },
 }
 

@@ -21,12 +21,15 @@ its meson option.
 
 from __future__ import annotations
 
+import itertools
+import os
 import random
 import threading
 import time
 from typing import Dict, List, Optional
 
-from nnstreamer_tpu import registry
+from nnstreamer_tpu import registry, trace
+from nnstreamer_tpu.obs import metrics as obs_metrics
 from nnstreamer_tpu.edge.serialize import decode_message, encode_message
 from nnstreamer_tpu.edge.transport import TransportError, make_transport
 from nnstreamer_tpu.elements.base import (
@@ -162,6 +165,17 @@ class TensorQueryClient(HostElement):
         )
         self._rng = random.Random(0xED6E)  # deterministic jitter stream
         self._transport = None
+        # distributed correlation (docs/observability.md): every request
+        # carries a frame_id that survives the hop via the wire meta
+        # blob, so client and server traces merge into one timeline
+        self._fid_seq = itertools.count()
+        self._fid_prefix = f"{os.getpid():x}.{self.name}"
+        # registry resolved ONCE at start() (the executor discipline):
+        # obs_metrics.get() probes env+config on the None path, which
+        # must stay off the per-frame edge hot path. Standalone callers
+        # that skip start() simply record no metrics.
+        self._obs_reg = None
+        self._rtt_hist = None  # nns_edge_rtt_us histogram handle
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         self.connect_type = _check_connect_type(self)
@@ -192,6 +206,7 @@ class TensorQueryClient(HostElement):
     def start(self) -> None:
         from nnstreamer_tpu.pipeline.faults import backoff_s
 
+        self._obs_reg = obs_metrics.get()
         attempt = 0
         while True:
             try:
@@ -214,7 +229,12 @@ class TensorQueryClient(HostElement):
     def process(self, frame: Frame) -> Optional[Frame]:
         from nnstreamer_tpu.pipeline.faults import backoff_s
 
+        fid = frame.meta.get("frame_id")
+        if fid is None:
+            fid = f"{self._fid_prefix}.{next(self._fid_seq)}"
+            frame = frame.with_meta(frame_id=fid)
         data = encode_message(frame)
+        t_req = time.perf_counter()
         attempt = 0
         while True:
             sent = False
@@ -258,9 +278,29 @@ class TensorQueryClient(HostElement):
                     ) from exc
                 time.sleep(backoff_s(attempt, self._retry_policy, self._rng))
                 attempt += 1
+        rtt_s = time.perf_counter() - t_req
+        tracer = trace.get()
+        if tracer is not None:
+            # the client half of the cross-process pair: merge() lines
+            # this span up with the server's frame_id-tagged spans
+            tracer.complete(
+                self.name, "edge", t_req, rtt_s, {"frame_id": fid}
+            )
+        reg = self._obs_reg
+        if reg is not None:
+            if self._rtt_hist is None:
+                self._rtt_hist = reg.histogram(
+                    "nns_edge_rtt_us", element=self.name
+                )
+            self._rtt_hist.observe(rtt_s * 1e6)
+            reg.counter(
+                "nns_edge_requests_total", element=self.name
+            ).inc()
         reply = decode_message(payload)
         if isinstance(reply, EOS):
             return None
+        if reply.meta.get("frame_id") is None:
+            reply = reply.with_meta(frame_id=fid)
         return reply.with_pts(frame.pts, frame.duration)
 
 
@@ -328,6 +368,12 @@ class TensorQueryServerSrc(Source):
         frame = decode_message(payload)
         if isinstance(frame, EOS):
             return None  # one client's EOS must not stop the server
+        tracer = trace.get()
+        if tracer is not None:
+            tracer.instant(
+                self.name, cat="edge",
+                frame_id=frame.meta.get("frame_id"), client_id=cid,
+            )
         return frame.with_meta(client_id=cid)
 
 
@@ -359,5 +405,11 @@ class TensorQueryServerSink(Sink):
             raise ElementError(
                 f"{self.name}: frame lacks client_id meta (did it pass "
                 "through tensor_query_serversrc?)"
+            )
+        tracer = trace.get()
+        if tracer is not None:
+            tracer.instant(
+                self.name, cat="edge",
+                frame_id=frame.meta.get("frame_id"), client_id=cid,
             )
         transport.send(cid, encode_message(frame))

@@ -7,26 +7,57 @@ SURVEY.md §5.8).
 
 Layout (little-endian):
 
-    uint8  version (1)
+    uint8  version (2; v1 — no meta blob, flags always 0 — still decodes)
     uint8  kind    (0 = DATA, 1 = EOS)
     int64  pts     (ns; -1 = unknown)
     int64  duration(ns; -1 = unknown)
-    uint32 reserved
+    uint32 flags   (bit 0: a meta blob follows the header)
+    [uint32 meta_len + UTF-8 JSON meta blob, when flags bit 0]
     [flex tensors...]
+
+The meta blob is the distributed-correlation channel (docs/
+observability.md): JSON-scalar frame meta — notably the ``frame_id``
+tensor_query_client stamps — crosses tensor_query/edgesrc hops, so the
+client's trace span and the server-side spans for the same frame share
+an identity and ``trace.merge()`` can line them up on one timeline.
+Per-hop-local keys (``client_id``, the transport pairing tag;
+``wall_t0``, a perf_counter reading meaningless in another process)
+never ride the wire.
 """
 
 from __future__ import annotations
 
+import json
 import struct
-from typing import Optional
 
 from nnstreamer_tpu.tensors.frame import EOS, EOS_FRAME, Frame
 from nnstreamer_tpu.tensors.meta import decode_frame_tensors, encode_frame_tensors
 
 _HDR = struct.Struct("<BBqqI")
-VERSION = 1
+_META_LEN = struct.Struct("<I")
+# v2 added the flagged meta blob; v1 messages (reserved field always 0)
+# decode through the same path, and a v1 peer receiving v2 fails with a
+# clean unsupported-version error instead of mis-parsing the blob as
+# tensor data
+VERSION = 2
+_DECODABLE_VERSIONS = (1, 2)
 KIND_DATA = 0
 KIND_EOS = 1
+FLAG_META = 1
+
+# meta keys that must NOT cross a hop: local to the process that set them
+_WIRE_META_SKIP = frozenset({"client_id", "wall_t0"})
+
+
+def _wire_meta(frame) -> dict:
+    """The JSON-safe, propagatable subset of a frame's meta."""
+    out = {}
+    for k, v in frame.meta.items():
+        if k in _WIRE_META_SKIP:
+            continue
+        if v is None or isinstance(v, (str, int, float, bool)):
+            out[k] = v
+    return out
 
 
 def encode_message(frame) -> bytes:
@@ -35,8 +66,16 @@ def encode_message(frame) -> bytes:
     pts = -1 if frame.pts is None else frame.pts
     dur = -1 if frame.duration is None else frame.duration
     host = frame.to_host()
-    return _HDR.pack(VERSION, KIND_DATA, pts, dur, 0) + encode_frame_tensors(
-        host.tensors
+    meta = _wire_meta(frame)
+    flags = FLAG_META if meta else 0
+    blob = b""
+    if meta:
+        enc = json.dumps(meta, separators=(",", ":")).encode()
+        blob = _META_LEN.pack(len(enc)) + enc
+    return (
+        _HDR.pack(VERSION, KIND_DATA, pts, dur, flags)
+        + blob
+        + encode_frame_tensors(host.tensors)
     )
 
 
@@ -44,14 +83,33 @@ def decode_message(data: bytes):
     """→ Frame, or EOS_FRAME. Raises ValueError on malformed input."""
     if len(data) < _HDR.size:
         raise ValueError(f"edge message too short: {len(data)}")
-    version, kind, pts, dur, _ = _HDR.unpack_from(data)
-    if version != VERSION:
+    version, kind, pts, dur, flags = _HDR.unpack_from(data)
+    if version not in _DECODABLE_VERSIONS:
         raise ValueError(f"unsupported edge message version {version}")
     if kind == KIND_EOS:
         return EOS_FRAME
-    tensors = decode_frame_tensors(data[_HDR.size :])
+    off = _HDR.size
+    meta = {}
+    if flags & FLAG_META:
+        if len(data) < off + _META_LEN.size:
+            raise ValueError("edge message meta length truncated")
+        (meta_len,) = _META_LEN.unpack_from(data, off)
+        off += _META_LEN.size
+        if len(data) < off + meta_len:
+            raise ValueError("edge message meta blob truncated")
+        try:
+            meta = json.loads(data[off:off + meta_len])
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise ValueError(
+                f"edge message meta not valid JSON: {exc}"
+            ) from exc
+        if not isinstance(meta, dict):
+            raise ValueError("edge message meta is not an object")
+        off += meta_len
+    tensors = decode_frame_tensors(data[off:])
     return Frame(
         tensors,
         pts=None if pts < 0 else pts,
         duration=None if dur < 0 else dur,
+        meta=meta,
     )

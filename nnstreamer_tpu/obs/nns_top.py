@@ -1,0 +1,199 @@
+"""nns-top: live per-element console view of a running pipeline.
+
+The in-tree answer to watching GstShark dashboards: a top(1)-style table
+refreshed in place, one row per pipeline element —
+
+    ELEMENT        FPS  FRAMES  P50ms  P99ms  Q  BATCH  PAD%  ERR  NOTES
+
+Data sources (pick one):
+
+- ``nns-top http://host:9464`` — poll a live ``/metrics.json`` endpoint
+  (``[executor] metrics_port`` / ``NNS_TPU_METRICS_PORT``).
+- ``nns-top out.json`` — render a one-shot snapshot file
+  (``nns-launch --metrics out.json``), re-reading it each interval.
+- in-process: ``nns_top.watch(executor)`` renders the same table from a
+  live :class:`~nnstreamer_tpu.pipeline.executor.Executor` without any
+  HTTP hop (notebooks, tests).
+
+FPS is computed by differencing ``frames`` between polls when a
+previous snapshot exists (the live rate), falling back to each row's
+cumulative ``fps`` field (which includes compile/warmup).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, Optional
+
+_COLUMNS = (
+    ("ELEMENT", 22), ("FPS", 8), ("FRAMES", 9), ("P50ms", 8),
+    ("P99ms", 8), ("WAITms", 8), ("Q", 5), ("BATCH", 7), ("PAD%", 6),
+    ("ERR", 5), ("NOTES", 0),
+)
+
+
+def _num(row: dict, key: str, nd: int = 1) -> str:
+    v = row.get(key)
+    if v is None:
+        return "-"
+    return f"{v:.{nd}f}" if isinstance(v, float) else str(v)
+
+
+def _notes(row: dict) -> str:
+    """Compressed per-row flags: retry/circuit-breaker state from
+    FaultStats/cb_* counters, sanitizer findings, serving counters."""
+    notes = []
+    if row.get("error_retries"):
+        notes.append(f"retry={row['error_retries']}")
+    if row.get("error_routed"):
+        notes.append(f"routed={row['error_routed']}")
+    if row.get("cb_opens"):
+        state = "OPEN" if row.get("cb_open") else "closed"
+        notes.append(f"cb={state}({row['cb_opens']})")
+    san = {k: v for k, v in row.items() if k.startswith("san_") and v}
+    for k, v in sorted(san.items()):
+        notes.append(f"{k}={v}")
+    serving = {
+        k: v for k, v in row.items() if k.startswith("serving_") and v
+    }
+    if serving:
+        notes.append("serving")
+    return " ".join(notes)
+
+
+def render(
+    snap: dict,
+    prev: Optional[dict] = None,
+    interval_s: Optional[float] = None,
+) -> str:
+    """One table frame from a ``/metrics.json``-shaped snapshot.
+    ``prev`` + ``interval_s`` turn cumulative frame counts into live
+    rates; without them the cumulative ``fps`` field is shown."""
+    nodes: Dict[str, dict] = snap.get("nodes", {})
+    prev_nodes = (prev or {}).get("nodes", {})
+    lines = []
+    head = "".join(
+        name.ljust(w) if w else name for name, w in _COLUMNS
+    )
+    lines.append(head)
+    lines.append("-" * max(len(head), 72))
+    for name, row in nodes.items():
+        if name.startswith("_"):
+            continue  # the __pipeline__ totals row is footer material
+        fps = row.get("fps")
+        if interval_s and name in prev_nodes:
+            df = row.get("frames", 0) - prev_nodes[name].get("frames", 0)
+            fps = df / interval_s if interval_s > 0 else fps
+        depth = row.get("queue_depth")
+        cells = [
+            name[:21],
+            f"{fps:.1f}" if isinstance(fps, (int, float)) else "-",
+            str(row.get("frames", "-")),
+            _num(row, "latency_p50_ms", 2),
+            _num(row, "latency_p99_ms", 2),
+            _num(row, "queue_wait_p50_ms", 2),
+            str(sum(depth)) if depth else "-",
+            _num(row, "avg_batch_size"),
+            _num(row, "pad_waste_pct"),
+            str(row.get("errors", 0) or "-"),
+            _notes(row),
+        ]
+        lines.append("".join(
+            c.ljust(w) if w else c for c, (_, w) in zip(cells, _COLUMNS)
+        ))
+    totals = snap.get("totals") or {}
+    if totals:
+        lines.append("")
+        lines.append(
+            f"produced={totals.get('produced')} "
+            f"rendered={totals.get('rendered')} "
+            f"dropped={sum((totals.get('dropped') or {}).values())} "
+            f"balance={totals.get('balance')}"
+        )
+    proc = snap.get("process")
+    if proc:
+        lines.append(f"[{proc}]")
+    return "\n".join(lines)
+
+
+def _fetch(source: str) -> dict:
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if not url.endswith(".json"):
+            if url.endswith("/metrics"):
+                # the executor logs the /metrics (Prometheus) URL;
+                # pasting it here must land on the JSON sibling
+                url = url[: -len("/metrics")]
+            url += "/metrics.json"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        return json.load(f)
+
+
+def snapshot_executor(ex) -> dict:
+    """In-process snapshot from a live Executor (no HTTP hop)."""
+    from nnstreamer_tpu.obs import expo, metrics
+
+    return expo.snapshot(metrics.get(), ex.stats(), ex.totals())
+
+
+def watch(ex, interval_s: float = 1.0, iterations: Optional[int] = None,
+          out=None) -> None:
+    """Render an in-process executor's table every ``interval_s`` until
+    the pipeline finishes (or ``iterations`` frames of output)."""
+    out = out or sys.stdout
+    prev = None
+    n = 0
+    while iterations is None or n < iterations:
+        snap = snapshot_executor(ex)
+        out.write("\x1b[2J\x1b[H" if out.isatty() else "")
+        out.write(render(snap, prev, interval_s if prev else None) + "\n")
+        out.flush()
+        if ex.finished or (ex.stop_event.is_set() and ex.errors):
+            break
+        prev = snap
+        n += 1
+        time.sleep(interval_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nns-top", description=__doc__)
+    ap.add_argument(
+        "source",
+        help="metrics endpoint URL (http://host:port) or snapshot file",
+    )
+    ap.add_argument("--interval", "-n", type=float, default=1.0,
+                    help="refresh period, seconds (default 1)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripting)")
+    args = ap.parse_args(argv)
+
+    prev = None
+    prev_t = None
+    while True:
+        try:
+            snap = _fetch(args.source)
+        except (OSError, ValueError) as exc:
+            print(f"nns-top: {args.source}: {exc}", file=sys.stderr)
+            return 1
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else None
+        if not args.once and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(render(snap, prev, dt))
+        if args.once:
+            return 0
+        prev, prev_t = snap, now
+        try:
+            time.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

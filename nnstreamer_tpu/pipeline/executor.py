@@ -41,6 +41,7 @@ from nnstreamer_tpu.elements.base import (
 )
 from nnstreamer_tpu import trace
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.obs import metrics as obs_metrics
 from nnstreamer_tpu.pipeline.faults import (
     FaultGate,
     PipelineStallError,
@@ -203,6 +204,75 @@ class _Chan:
         return out
 
 
+class _MeteredChan(_Chan):
+    """_Chan plus queue-wait metering (nns-obs, opt-in): ``put`` stamps
+    a parallel timestamp deque, the pop paths pair stamps back off and
+    feed the ``nns_queue_wait_us`` histogram. The stamp lands BEFORE the
+    item so the stamp deque always runs ahead of the item deque — under
+    SPSC ordering the consumer can never pop an item whose stamp is
+    missing, and pairing stays exact for the whole run (a stamp-after
+    design desyncs permanently on the first put/pop race). Consequence:
+    the stamp records when the producer OFFERED the frame, so a
+    producer blocked on a full channel books that stall as queue wait —
+    the backpressure signal this histogram exists to surface. Default-
+    off pipelines never construct this class, so the lock-free fast
+    path stays untouched."""
+
+    __slots__ = ("_tq", "wait_hist")
+
+    def __init__(self, maxsize: int, wait_hist) -> None:
+        super().__init__(maxsize)
+        self._tq: deque = deque()
+        self.wait_hist = wait_hist
+
+    def put(self, item, stop_event) -> None:
+        self._tq.append(time.perf_counter())
+        try:
+            super().put(item, stop_event)
+        except BaseException:
+            # the item never entered the channel (stop/teardown): take
+            # our own stamp back so pairing stays exact. The right end
+            # is ours — the consumer only pops as many stamps as items.
+            try:
+                self._tq.pop()
+            except IndexError:
+                pass
+            raise
+
+    def _observe(self, n: int = 1) -> None:
+        tq = self._tq
+        now = time.perf_counter()
+        for _ in range(n):
+            if not tq:
+                break
+            dt = now - tq.popleft()
+            if dt >= 0.0:
+                self.wait_hist.observe(dt * 1e6)
+
+    def get(self, stop_event):
+        item = super().get(stop_event)
+        self._observe()
+        return item
+
+    def get_nowait(self):
+        item = super().get_nowait()
+        if item is not _EMPTY:
+            self._observe()
+        return item
+
+    def get_until(self, deadline: float, stop_event):
+        item = super().get_until(deadline, stop_event)
+        if item is not None:
+            self._observe()
+        return item
+
+    def drain(self, limit: int) -> list:
+        out = super().drain(limit)
+        if out:
+            self._observe(len(out))
+        return out
+
+
 class Node:
     def __init__(self, ex: "Executor", name: str) -> None:
         self.ex = ex
@@ -216,6 +286,12 @@ class Node:
         self._needs_notify = False  # set for multi-pad scheduler nodes
         self.fault_stats = None  # FaultStats when an error policy is active
         self.fault_gate = None   # the gate itself (watchdog backoff check)
+        # nns-obs handles (None/empty with metrics off — the default):
+        # wired by Executor._build when a registry is active
+        self._lat_hist = None        # per-invoke latency histogram
+        self._frames_ctr = None      # frames counter
+        self._depth_hists: List = []  # sampled queue depth per pad
+        self._batch_hist = None      # batch-size histogram (lazy)
 
     def add_in_queue(self, size: int) -> int:
         self.in_queues.append(self.ex.make_chan(size, self, len(self.in_queues)))
@@ -276,17 +352,26 @@ class Node:
     def stat(self, t0: float) -> None:
         self._advance(1)
         tracer = trace.get()
-        if tracer is None and (self.frames_processed & 7):
+        lat = self._lat_hist
+        if tracer is None and lat is None and (self.frames_processed & 7):
             # sampled EMA (1-in-8): the per-frame timing arithmetic is a
             # measurable slice of the host budget at multi-kfps rates,
             # and an EMA over every 8th frame reads the same. With a
-            # tracer attached every frame records (completeness matters
-            # more than throughput when profiling).
+            # tracer or a metrics registry attached every frame records
+            # (completeness matters more than throughput when profiling).
             return
         now = time.perf_counter()
         dt = (now - t0) * 1000.0
         a = 0.2
         self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+        if lat is not None:
+            lat.observe((now - t0) * 1e6)
+            self._frames_ctr.inc()
+            if not (self.frames_processed & 15):
+                # sampled queue-depth: every 16th frame, one len() read
+                # per pad (backpressure visibility without per-put cost)
+                for h, q in zip(self._depth_hists, self.in_queues):
+                    h.observe(len(q))
         if tracer is not None:
             tracer.complete(
                 self.name, type(self).__name__, t0, now - t0,
@@ -352,6 +437,20 @@ class Node:
         dt = (now - t0) * 1000.0
         a = 0.2
         self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+        lat = self._lat_hist
+        if lat is not None:
+            # one latency observation per INVOKE (the device dispatch is
+            # the unit the tail percentiles describe), n frames counted
+            lat.observe((now - t0) * 1e6)
+            self._frames_ctr.inc(n)
+            if self._batch_hist is None:
+                self._batch_hist = self.ex.metrics.histogram(
+                    "nns_batch_size", lo=1.0, growth=2.0 ** 0.5,
+                    nbuckets=16, element=self.name,
+                )
+            self._batch_hist.observe(n)
+            for h, q in zip(self._depth_hists, self.in_queues):
+                h.observe(len(q))
         tracer = trace.get()
         if tracer is not None:
             tracer.batch(
@@ -799,13 +898,27 @@ class Executor:
             self._sinks_cv = threading.Condition(
                 self.sanitizer.lock("executor._sinks_cv")
             )
+        # nns-obs metrics (obs/metrics.py): resolved at construction like
+        # the sanitizer (opt-in via obs.enable() / NNS_TPU_METRICS /
+        # [executor] metrics / a metrics port). None — the default —
+        # keeps the hot path at one attribute check per frame.
+        self.metrics = obs_metrics.get()
+        self._metrics_server = None
+        self._t_run0: Optional[float] = None
+        self._t_run_end: Optional[float] = None
         self._build()
 
     def make_chan(self, size: int, node: "Node", pad: int) -> _Chan:
         """Channel factory: the instrumented SanChan under the sanitizer,
-        the lock-free _Chan otherwise."""
+        the queue-wait-metered chan under the metrics registry, the
+        lock-free _Chan otherwise (sanitizer wins when both are on —
+        its conformance checks need its own channel class)."""
         if self.sanitizer is not None:
             return san_chan_cls()(size, self.sanitizer, node.name, pad)
+        if self.metrics is not None:
+            return _MeteredChan(size, self.metrics.histogram(
+                "nns_queue_wait_us", element=node.name, pad=str(pad)
+            ))
         return _Chan(size)
 
     # -- construction ------------------------------------------------------
@@ -923,12 +1036,51 @@ class Executor:
                     self.sanitizer.register_pad(n.name, pad)
             for seg in self.plan.segments:
                 seg.sanitize_poison = True
+        if self.metrics is not None:
+            # per-node observability handles, created once here so the
+            # per-frame path is attribute reads (no registry lookups)
+            for n in self.nodes:
+                n._lat_hist = self.metrics.histogram(
+                    "nns_element_latency_us", element=n.name
+                )
+                n._frames_ctr = self.metrics.counter(
+                    "nns_element_frames_total", element=n.name
+                )
+                n._depth_hists = [
+                    self.metrics.histogram(
+                        "nns_queue_depth", lo=1.0, growth=2.0,
+                        nbuckets=16, element=n.name, pad=str(i),
+                    )
+                    for i in range(len(n.in_queues))
+                ]
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         if self._started:
             return
         self._started = True
+        self._t_run0 = time.perf_counter()
+        if self.metrics is not None:
+            port = obs_metrics.resolve_port()
+            if port is not None:
+                from nnstreamer_tpu.config import conf
+                from nnstreamer_tpu.obs.expo import MetricsServer
+
+                # loopback by default: the endpoint is unauthenticated,
+                # so exposing it beyond the host is an explicit opt-in
+                # ([executor] metrics_host = 0.0.0.0)
+                host = conf().get(
+                    "executor", "metrics_host", "127.0.0.1"
+                )
+                try:
+                    self._metrics_server = MetricsServer(
+                        self.metrics, stats_fn=self.stats,
+                        totals_fn=self.totals, host=host, port=port,
+                    ).start()
+                except OSError as exc:
+                    # a scrape endpoint must never keep a pipeline from
+                    # starting (port squatted by a previous run, ...)
+                    _log.error("metrics endpoint failed to bind: %s", exc)
         if self.sanitizer is not None:
             # baseline BEFORE element start: threads that appear during
             # the run (element/edge service threads) and survive stop()
@@ -1061,6 +1213,12 @@ class Executor:
         if self.finished:
             return
         self.stop_event.set()
+        self._t_run_end = time.perf_counter()
+        if self._metrics_server is not None:
+            # closed BEFORE the leak sweep: the exposition thread is
+            # executor-started and must not read as a leaked daemon
+            self._metrics_server.close()
+            self._metrics_server = None
         threads = [n.thread for n in self.nodes if n.thread is not None]
         if self._watchdog is not None:
             threads.append(self._watchdog)
@@ -1111,13 +1269,52 @@ class Executor:
         return not (n.thread is not None and n.thread.is_alive())
 
     # -- introspection (per-element proctime, §5.1 parity) ----------------
-    def stats(self) -> Dict[str, Dict[str, float]]:
+    def stats(self) -> Dict[str, Dict[str, Any]]:
         out = {}
+        t_end = self._t_run_end or time.perf_counter()
+        elapsed = (
+            t_end - self._t_run0 if self._t_run0 is not None else 0.0
+        )
         for n in self.nodes:
-            s: Dict[str, float] = {
+            s: Dict[str, Any] = {
                 "frames": n.frames_processed,
                 "proc_ms_ema": round(n.proc_time_ema_ms, 3),
             }
+            if elapsed > 0:
+                s["fps"] = round(n.frames_processed / elapsed, 2)
+            if n.in_queues:
+                s["queue_depth"] = [len(q) for q in n.in_queues]
+            # nns-obs percentiles (docs/observability.md): per-invoke
+            # latency tails and queue-wait tails when metrics are on
+            lat = n._lat_hist
+            if lat is not None and lat.count:
+                p50, p95, p99 = lat.percentiles()
+                s["latency_p50_ms"] = round(p50 / 1000.0, 3)
+                s["latency_p95_ms"] = round(p95 / 1000.0, 3)
+                s["latency_p99_ms"] = round(p99 / 1000.0, 3)
+            whs = [
+                q.wait_hist for q in n.in_queues
+                if isinstance(q, _MeteredChan) and q.wait_hist.count
+            ]
+            wh = whs[0] if len(whs) == 1 else None
+            if len(whs) > 1:
+                # multi-pad joins: merge the pads' histograms (same
+                # ladder by construction) so a backpressured pad can't
+                # hide behind a trickle-fed one; per-pad detail stays
+                # available as the raw nns_queue_wait_us series
+                wh = obs_metrics.Histogram(
+                    whs[0].name, {}, lo=whs[0].lo, growth=whs[0].growth,
+                    nbuckets=len(whs[0].counts),
+                )
+                for h in whs:
+                    wh.merge(h)
+            if wh is not None:
+                s["queue_wait_p50_ms"] = round(
+                    wh.quantile(0.50) / 1000.0, 3
+                )
+                s["queue_wait_p99_ms"] = round(
+                    wh.quantile(0.99) / 1000.0, 3
+                )
             # filter invoke stats (reference latency/throughput read-only
             # properties, tensor_filter.c:334-433) surface per element
             elem = getattr(n, "elem", None)

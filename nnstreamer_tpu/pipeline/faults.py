@@ -250,6 +250,12 @@ class FaultGate:
         self.raise_through = raise_through
         self.stop_exc = stop_exc
         self.stats = FaultStats()
+        # nns-obs registry resolved ONCE at gate construction (the
+        # executor discipline): get() probes env+config on the None
+        # path, which must not run per dropped/retried frame
+        from nnstreamer_tpu.obs import metrics as obs_metrics
+
+        self._obs_reg = obs_metrics.get()
         # monotonic deadline of an in-progress backoff sleep (0.0 = not
         # parked): the stall watchdog reads this so a node legitimately
         # backing off is never mistaken for a hang
@@ -316,6 +322,13 @@ class FaultGate:
         tracer = trace.get()
         if tracer is not None:
             tracer.fault(self.name, action, exc, **extra)
+        reg = self._obs_reg
+        if reg is not None:
+            # cold path (one event per retry/drop/route, not per frame):
+            # the per-event counter lookup is fine here
+            reg.counter(
+                "nns_fault_events_total", element=self.name, action=action
+            ).inc()
 
     def _sleep(self, delay: float) -> None:
         """Bounded-slice backoff sleep that still honors the executor's

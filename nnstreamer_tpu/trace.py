@@ -5,17 +5,30 @@ HawkTracer (tools/tracing/README.md, tools/profiling/README.md; SURVEY.md
 §5.1), whose common output is chrome://tracing JSON. This module brings
 that capability in-tree:
 
-- ``Tracer``: lock-protected event buffer; ``span()`` context manager and
-  ``complete()`` record "X" (complete) events per element/frame,
-  ``instant()`` marks points, ``counter()`` tracks gauges (queue depths).
-  ``save()`` writes the Chrome Trace Event Format JSON that chrome://tracing
-  / Perfetto load directly (the HawkTracer workflow, no external daemon).
-- The executor records one span per frame per node when tracing is enabled
-  (pipeline/executor.py Node.stat), giving the per-element timeline
-  NNShark's per-element CPU/proctime view provides.
-- ``device_profile()``: wraps ``jax.profiler.trace`` — the XPlane/TensorBoard
-  capture for on-device (TPU) timing, the XLA-world analogue of GstShark's
-  proctime tracer.
+- ``Tracer``: lock-protected bounded event buffer; ``span()`` context
+  manager and ``complete()`` record "X" (complete) events per element/
+  frame, ``instant()`` marks points, ``counter()`` tracks gauges (queue
+  depths). ``save()`` atomically writes the Chrome Trace Event Format
+  JSON that chrome://tracing / Perfetto load directly (the HawkTracer
+  workflow, no external daemon).
+- Lanes are labeled: each OS thread gets a stable small tid (first-seen
+  order, never truncated-ident collisions) and ``to_chrome_trace()``
+  emits chrome ``thread_name``/``process_name`` metadata so Perfetto
+  shows element/service-thread names instead of bare numbers.
+- The buffer is bounded (``max_events``, drop-oldest): soak runs keep a
+  sliding window instead of growing without bound;
+  ``dropped_events`` counts what the window lost.
+- Distributed correlation: a Tracer carries a process label and a
+  wall-clock anchor, and :func:`merge` folds several processes' trace
+  docs (client + query server) into ONE timeline, shifting each by its
+  anchor so cross-host spans line up. Frame identity rides the
+  ``frame_id`` meta the edge layer propagates (edge/serialize.py).
+- The executor records one span per frame per node when tracing is
+  enabled (pipeline/executor.py Node.stat), giving the per-element
+  timeline NNShark's per-element CPU/proctime view provides.
+- ``device_profile()``: wraps ``jax.profiler.trace`` — the XPlane/
+  TensorBoard capture for on-device (TPU) timing, the XLA-world analogue
+  of GstShark's proctime tracer.
 
 Enable via ``trace.enable()`` / ``nns-launch --trace out.json``; env knob
 ``NNS_TRACE`` (path) mirrors the reference's GST_DEBUG_DUMP_DOT_DIR-style
@@ -29,22 +42,67 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, List, Optional, Sequence
 
 _lock = threading.Lock()
 _tracer: Optional["Tracer"] = None
 
+# drop-oldest window: ~100 MB of JSON at worst, hours of steady-state
+# pipeline spans — a soak run records a sliding window, not a leak
+DEFAULT_MAX_EVENTS = 500_000
+
 
 class Tracer:
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        process: Optional[str] = None,
+        max_events: int = DEFAULT_MAX_EVENTS,
+        pid: Optional[int] = None,
+    ) -> None:
         self._lock = threading.Lock()
-        self._events: List[Dict] = []
+        self._max = max(1, int(max_events))
+        self._events: deque = deque(maxlen=self._max)
+        self.dropped_events = 0
         self._t0 = time.perf_counter()
-        self._pid = os.getpid()
+        # wall-clock anchor paired with the perf_counter epoch: merge()
+        # uses the DIFFERENCE of anchors across processes, so absolute
+        # wall accuracy only needs to hold to NTP-ish precision
+        self._wall_t0 = time.time()
+        self._pid = os.getpid() if pid is None else int(pid)
+        self.process = process or f"pid{self._pid}"
+        # stable small tids: ident → 1,2,3... in first-seen order. The
+        # old `get_ident() & 0xFFFF` truncation collided unrelated
+        # threads into one Perfetto lane.
+        self._tids: Dict[int, int] = {}
+        self._tid_names: Dict[int, str] = {}
 
     # -- recording ---------------------------------------------------------
     def _ts_us(self, t: Optional[float] = None) -> float:
         return ((t if t is not None else time.perf_counter()) - self._t0) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)  # GIL-atomic fast path
+        if tid is None:
+            with self._lock:
+                tid = self._tids.get(ident)
+                if tid is None:
+                    tid = len(self._tids) + 1
+                    self._tids[ident] = tid
+                    self._tid_names[tid] = threading.current_thread().name
+        return tid
+
+    def set_process(self, name: str) -> None:
+        """Label this process's lanes (shows as the Perfetto process
+        name; merge() keys the combined timeline on it)."""
+        self.process = name
+
+    def _append(self, ev: Dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._max:
+                self.dropped_events += 1
+            self._events.append(ev)
 
     def complete(
         self, name: str, cat: str, t_start: float, dur_s: float, args: Optional[Dict] = None
@@ -56,12 +114,11 @@ class Tracer:
             "ts": self._ts_us(t_start),
             "dur": dur_s * 1e6,
             "pid": self._pid,
-            "tid": threading.get_ident() & 0xFFFF,
+            "tid": self._tid(),
         }
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "element", **args):
@@ -109,41 +166,120 @@ class Tracer:
         self.instant(name, cat="san", code=code, **extra)
 
     def instant(self, name: str, cat: str = "event", **args) -> None:
-        with self._lock:
-            self._events.append(
-                {
-                    "name": name, "cat": cat, "ph": "i", "s": "t",
-                    "ts": self._ts_us(), "pid": self._pid,
-                    "tid": threading.get_ident() & 0xFFFF,
-                    "args": args or {},
-                }
-            )
+        self._append(
+            {
+                "name": name, "cat": cat, "ph": "i", "s": "t",
+                "ts": self._ts_us(), "pid": self._pid,
+                "tid": self._tid(),
+                "args": args or {},
+            }
+        )
 
     def counter(self, name: str, **values: float) -> None:
-        with self._lock:
-            self._events.append(
-                {
-                    "name": name, "cat": "counter", "ph": "C",
-                    "ts": self._ts_us(), "pid": self._pid, "tid": 0,
-                    "args": values,
-                }
-            )
+        self._append(
+            {
+                "name": name, "cat": "counter", "ph": "C",
+                "ts": self._ts_us(), "pid": self._pid, "tid": 0,
+                "args": values,
+            }
+        )
 
     # -- output ------------------------------------------------------------
     def events(self) -> List[Dict]:
         with self._lock:
             return list(self._events)
 
+    def _metadata_events(self) -> List[Dict]:
+        """Chrome "M" metadata: process_name + one thread_name per lane,
+        synthesized at export (not stored) so the recording buffer holds
+        only real events and events() stays metadata-free."""
+        meta = [{
+            "name": "process_name", "ph": "M", "ts": 0, "pid": self._pid,
+            "tid": 0, "args": {"name": self.process},
+        }]
+        with self._lock:
+            names = dict(self._tid_names)
+        for tid, tname in sorted(names.items()):
+            meta.append({
+                "name": "thread_name", "ph": "M", "ts": 0,
+                "pid": self._pid, "tid": tid, "args": {"name": tname},
+            })
+        return meta
+
     def to_chrome_trace(self) -> Dict:
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": self._metadata_events() + self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "process": self.process,
+                "pid": self._pid,
+                "wall_t0_s": self._wall_t0,
+                "dropped_events": self.dropped_events,
+            },
+        }
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
+        """Atomic write (tmp + rename): a crash mid-dump — or a reader
+        polling the file during a soak run — never sees a torn JSON."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
 
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self.dropped_events = 0
+
+
+def merge(docs: Sequence[Dict]) -> Dict:
+    """Fold several processes' chrome-trace docs into ONE timeline.
+
+    Each doc carries its wall-clock anchor (``otherData.wall_t0_s``);
+    events shift by the anchor delta against the earliest doc, so a
+    client span and the server span it caused line up on one axis
+    (client + tensor_query server traces merge into the end-to-end
+    view examples/query_offload.py needed). Docs without an anchor
+    merge unshifted. Colliding pids (containers, pid reuse) are
+    remapped so lanes never interleave across processes.
+    """
+    anchors = [
+        (d.get("otherData") or {}).get("wall_t0_s") for d in docs
+    ]
+    known = [a for a in anchors if a is not None]
+    base = min(known) if known else 0.0
+    events: List[Dict] = []
+    processes = []
+    assigned_pids: set = set()
+    for doc, anchor in zip(docs, anchors):
+        shift_us = ((anchor - base) * 1e6) if anchor is not None else 0.0
+        other = doc.get("otherData") or {}
+        if other.get("process"):
+            processes.append(other["process"])
+        doc_pids = {
+            e.get("pid") for e in doc.get("traceEvents", [])
+            if e.get("pid") is not None
+        }
+        remap = {}
+        for pid in sorted(doc_pids, key=str):
+            new = pid
+            while new in assigned_pids:
+                new = (new if isinstance(new, int) else 0) + 100_000
+            remap[pid] = new
+            assigned_pids.add(new)
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            if ev.get("pid") in remap:
+                ev["pid"] = remap[ev["pid"]]
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("ts", 0), e.get("ph") != "M"))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged_processes": processes},
+    }
 
 
 def enable() -> Tracer:

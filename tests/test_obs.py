@@ -1,0 +1,531 @@
+"""nns-obs tests: histogram/quantile math vs numpy ground truth,
+exposition formats (Prometheus line format + JSON roundtrip), the HTTP
+endpoint during a live pipeline, frame-id propagation over a loopback
+query hop, and the merged multi-process chrome trace.
+
+Kept fast (<5 s of work beyond the shared jax import) so the tier-1
+870 s budget doesn't truncate later-alphabet test files.
+"""
+
+import json
+import re
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import trace
+from nnstreamer_tpu.obs import expo, metrics as obs_metrics
+from nnstreamer_tpu.obs import nns_top
+from nnstreamer_tpu.obs.metrics import Histogram, MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    yield
+    obs_metrics.disable()
+    trace.disable()
+
+
+# -- histogram math ----------------------------------------------------------
+
+class TestHistogram:
+    def test_quantiles_vs_numpy(self):
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(mean=5.0, sigma=1.2, size=8000)
+        h = Histogram("nns_element_latency_us", {})
+        for v in vals:
+            h.observe(float(v))
+        for q in (0.50, 0.95, 0.99):
+            est = h.quantile(q)
+            ref = float(np.quantile(vals, q))
+            assert abs(est - ref) / ref < 0.05, (q, est, ref)
+        assert h.count == len(vals)
+        assert h.mean == pytest.approx(float(vals.mean()), rel=1e-9)
+        assert h.min == pytest.approx(float(vals.min()))
+        assert h.max == pytest.approx(float(vals.max()))
+
+    def test_single_sample_reports_the_sample(self):
+        h = Histogram("nns_element_latency_us", {})
+        h.observe(123.0)
+        # clamped to observed min/max, not a bucket edge
+        assert h.quantile(0.5) == pytest.approx(123.0)
+        assert h.quantile(0.99) == pytest.approx(123.0)
+
+    def test_merge_and_json_roundtrip(self):
+        a = Histogram("nns_element_latency_us", {"element": "f"})
+        b = Histogram("nns_element_latency_us", {"element": "f"})
+        for v in (5.0, 50.0, 500.0):
+            a.observe(v)
+        for v in (10.0, 100.0):
+            b.observe(v)
+        back = Histogram.from_dict(json.loads(json.dumps(a.to_dict())))
+        assert back.count == a.count
+        assert back.quantile(0.5) == pytest.approx(a.quantile(0.5))
+        back.merge(b)
+        assert back.count == 5
+        assert back.min == 5.0 and back.max == 500.0
+
+    def test_merge_ladder_mismatch_raises(self):
+        a = Histogram("nns_element_latency_us", {})
+        b = Histogram("nns_element_latency_us", {}, growth=2.0)
+        with pytest.raises(ValueError, match="ladder"):
+            a.merge(b)
+
+    def test_registry_rejects_uncataloged_names(self):
+        reg = MetricsRegistry()
+        with pytest.raises(KeyError, match="METRIC_CATALOG"):
+            reg.counter("nns_not_a_real_metric")
+
+    def test_registry_merge_dict_sums_counters(self):
+        a = MetricsRegistry()
+        a.counter("nns_element_frames_total", element="x").inc(3)
+        a.histogram("nns_element_latency_us", element="x").observe(9.0)
+        snap = json.loads(json.dumps(a.to_dict()))
+        b = MetricsRegistry()
+        b.counter("nns_element_frames_total", element="x").inc(4)
+        b.merge_dict(snap)
+        assert b.find("nns_element_frames_total", element="x").value == 7
+        h = b.find("nns_element_latency_us", element="x")
+        assert h is not None and h.count == 1
+
+
+# -- exposition --------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\""
+    r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? \S+$"
+)
+
+
+class TestExposition:
+    def _registry(self):
+        reg = MetricsRegistry()
+        reg.counter("nns_element_frames_total", element="f").inc(12)
+        h = reg.histogram("nns_element_latency_us", element="f")
+        for v in (3.0, 30.0, 300.0, 3000.0):
+            h.observe(v)
+        return reg
+
+    def test_prometheus_line_format(self):
+        text = expo.to_prometheus(self._registry())
+        assert text.endswith("\n")
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert re.match(r"^# (HELP|TYPE) nns_[a-z0-9_]+", line)
+            else:
+                assert _PROM_LINE.match(line), line
+
+    def test_prometheus_histogram_buckets_cumulative(self):
+        text = expo.to_prometheus(self._registry())
+        counts = [
+            int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("nns_element_latency_us_bucket")
+        ]
+        assert counts == sorted(counts)  # cumulative, monotone
+        assert counts[-1] == 4  # the +Inf bucket carries the total
+        assert "nns_element_latency_us_count{element=\"f\"} 4" in text
+
+    def test_json_snapshot_roundtrips(self):
+        doc = expo.snapshot(
+            self._registry(), {"f": {"frames": 12}}, {"produced": 12},
+            process="unit",
+        )
+        back = json.loads(json.dumps(doc))
+        assert back["schema"] == "nns-obs/1"
+        assert back["process"] == "unit"
+        assert back["nodes"]["f"]["frames"] == 12
+        reg = MetricsRegistry()
+        reg.merge_dict(back)
+        assert reg.find("nns_element_frames_total", element="f").value == 12
+
+    def test_dump_json_atomic(self, tmp_path):
+        path = tmp_path / "m.json"
+        expo.dump_json(str(path), {"ok": 1})
+        expo.dump_json(str(path), {"ok": 2})  # overwrite, no .tmp left
+        assert json.loads(path.read_text()) == {"ok": 2}
+        assert list(tmp_path.iterdir()) == [path]
+
+
+# -- executor wiring ---------------------------------------------------------
+
+class TestExecutorMetrics:
+    def test_stats_gain_percentile_columns(self):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        obs_metrics.enable()
+        p = parse_pipeline(
+            "videotestsrc num-frames=40 width=8 height=8 ! "
+            "tensor_converter ! tensor_sink"
+        )
+        ex = p.run(timeout=60)
+        for name, row in ex.stats().items():
+            assert row["frames"] == 40, name
+            assert "fps" in row
+            assert row["latency_p50_ms"] <= row["latency_p95_ms"] \
+                <= row["latency_p99_ms"]
+        sink_name, sink_row = next(
+            (k, v) for k, v in ex.stats().items()
+            if k.startswith("tensor_sink")
+        )
+        assert "queue_wait_p50_ms" in sink_row
+        assert sink_row["queue_depth"] == [0]
+        # the registry saw the same elements
+        reg = obs_metrics.get()
+        h = reg.find("nns_element_latency_us", element=sink_name)
+        assert h is not None and h.count > 0
+
+    def test_disabled_pipeline_records_nothing(self):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        obs_metrics.disable()
+        p = parse_pipeline(
+            "videotestsrc num-frames=4 width=8 height=8 ! "
+            "tensor_converter ! tensor_sink"
+        )
+        ex = p.run(timeout=60)
+        assert obs_metrics._registry is None
+        sink_row = next(
+            v for k, v in ex.stats().items() if k.startswith("tensor_sink")
+        )
+        assert "latency_p50_ms" not in sink_row
+
+    def test_endpoint_serves_during_live_pipeline(self, monkeypatch):
+        from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        monkeypatch.setenv("NNS_TPU_METRICS_PORT", str(port))
+        p = parse_pipeline(
+            "videotestsrc num-frames=40 is-live=true framerate=40/1 "
+            "width=8 height=8 ! tensor_converter ! tensor_sink"
+        )
+        ex = p.start()
+        try:
+            url = f"http://127.0.0.1:{port}"
+            deadline = 50
+            while True:  # the server binds inside ex.start(); poll it up
+                try:
+                    with urllib.request.urlopen(
+                        url + "/metrics", timeout=2
+                    ) as r:
+                        prom = r.read().decode()
+                    break
+                except OSError:
+                    deadline -= 1
+                    assert deadline > 0, "endpoint never came up"
+            assert "nns_element_latency_us" in prom
+            with urllib.request.urlopen(
+                url + "/metrics.json", timeout=2
+            ) as r:
+                doc = json.loads(r.read())
+            assert any(
+                k.startswith("videotestsrc") for k in doc["nodes"]
+            )
+            assert ex.wait(30)
+        finally:
+            ex.stop()
+        # server thread shut down with the executor
+        assert not any(
+            t.name == "nns-obs-http" for t in threading.enumerate()
+        )
+        assert ex._metrics_server is None
+
+    def test_launch_stats_prints_percentiles(self, capsys):
+        from nnstreamer_tpu import cli
+
+        rc = cli.main([
+            "videotestsrc num-frames=20 width=8 height=8 ! "
+            "tensor_converter ! tensor_sink",
+            "--stats", "-q",
+        ])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out)
+        row = next(
+            v for k, v in stats.items() if k.startswith("tensor_sink")
+        )
+        assert {"latency_p50_ms", "latency_p95_ms",
+                "latency_p99_ms"} <= set(row)
+
+    def test_launch_metrics_one_shot_dump(self, tmp_path, capsys):
+        from nnstreamer_tpu import cli
+
+        out = tmp_path / "m.json"
+        rc = cli.main([
+            "videotestsrc num-frames=8 width=8 height=8 ! "
+            "tensor_converter ! tensor_sink",
+            "--metrics", str(out), "-q",
+        ])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["schema"] == "nns-obs/1"
+        sink_row = next(
+            v for k, v in doc["nodes"].items()
+            if k.startswith("tensor_sink")
+        )
+        assert sink_row["frames"] == 8
+        assert any(
+            m["name"] == "nns_element_latency_us" for m in doc["metrics"]
+        )
+        # nns-top renders the snapshot file
+        table = nns_top.render(doc)
+        assert "tensor_sink" in table and "P99ms" in table
+
+
+# -- nns-top -----------------------------------------------------------------
+
+class TestNnsTop:
+    SNAP = {
+        "process": "pid1",
+        "nodes": {
+            "filter0": {
+                "frames": 100, "fps": 50.0, "latency_p50_ms": 2.0,
+                "latency_p99_ms": 9.5, "queue_wait_p50_ms": 0.4,
+                "queue_depth": [3], "avg_batch_size": 6.2,
+                "pad_waste_pct": 9.4, "errors": 2, "error_retries": 5,
+                "cb_opens": 1, "cb_open": True, "san_spec_violations": 1,
+            },
+            "_totals_like": {"frames": 1},
+        },
+        "totals": {"produced": 100, "rendered": 98,
+                   "dropped": {"x": 2}, "balance": 0},
+    }
+
+    def test_render_columns_and_notes(self):
+        out = nns_top.render(self.SNAP)
+        assert "filter0" in out
+        assert "9.50" in out       # p99
+        assert "retry=5" in out
+        assert "cb=OPEN(1)" in out
+        assert "san_spec_violations=1" in out
+        assert "_totals_like" not in out  # underscore rows are footer
+        assert "produced=100" in out and "dropped=2" in out
+
+    def test_render_diffs_fps_between_polls(self):
+        prev = {"nodes": {"filter0": {"frames": 50}}}
+        out = nns_top.render(self.SNAP, prev, interval_s=2.0)
+        assert "25.0" in out  # (100-50)/2s beats the cumulative 50.0
+
+
+# -- distributed correlation -------------------------------------------------
+
+class TestWireMeta:
+    def test_meta_rides_the_wire(self):
+        from nnstreamer_tpu.edge.serialize import (
+            decode_message, encode_message,
+        )
+        from nnstreamer_tpu.tensors.frame import Frame
+
+        f = Frame(
+            (np.arange(4, dtype=np.float32),), pts=7,
+            meta={"frame_id": "abc.1", "client_id": 9,
+                  "wall_t0": 123.0, "score": 0.5},
+        )
+        back = decode_message(encode_message(f))
+        assert back.meta["frame_id"] == "abc.1"
+        assert back.meta["score"] == 0.5
+        # per-hop-local keys never cross
+        assert "client_id" not in back.meta
+        assert "wall_t0" not in back.meta
+        assert back.pts == 7
+        np.testing.assert_array_equal(back.tensors[0], f.tensors[0])
+
+    def test_metaless_frames_stay_lean(self):
+        from nnstreamer_tpu.edge.serialize import (
+            _HDR, decode_message, encode_message,
+        )
+        from nnstreamer_tpu.tensors.frame import Frame
+
+        f = Frame((np.zeros(2, dtype=np.float32),))
+        data = encode_message(f)
+        assert data[_HDR.size - 4] == 0  # flags clear: no blob
+        assert decode_message(data).meta == {}
+
+    def test_frame_id_propagates_over_loopback_query_hop(self):
+        from nnstreamer_tpu.edge.query import (
+            TensorQueryClient, TensorQueryServerSrc, TensorQueryServerSink,
+        )
+        from nnstreamer_tpu.pipeline.graph import Pipeline
+        from nnstreamer_tpu.tensors.frame import Frame
+
+        tracer = trace.enable()
+        tracer.clear()
+        src = TensorQueryServerSrc(port=0, id="obs-t")
+        sink = TensorQueryServerSink(id="obs-t")
+        server = Pipeline().chain(src, sink)  # echo server
+        ex = server.start()
+        try:
+            client = TensorQueryClient(
+                **{"dest-port": src.bound_port, "timeout": 10.0}
+            )
+            client.negotiate([None])
+            client.start()
+            try:
+                reply = client.process(
+                    Frame((np.ones(3, dtype=np.float32),))
+                )
+            finally:
+                client.stop()
+            fid = reply.meta.get("frame_id")
+            assert fid, "client must stamp and recover a frame_id"
+            # both halves of the hop traced the same frame identity
+            edge_evs = [
+                e for e in tracer.events() if e.get("cat") == "edge"
+            ]
+            tagged = {
+                e["name"] for e in edge_evs
+                if e.get("args", {}).get("frame_id") == fid
+            }
+            assert any("client" in n for n in tagged)
+            assert any("serversrc" in n for n in tagged)
+            assert any("serversink" in n for n in tagged)
+        finally:
+            ex.stop()
+
+
+def _trace_echo_server(port_q, stop_q, trace_path):
+    """Child-process body for the two-process merged-trace test (module
+    level so multiprocessing can target it)."""
+    from nnstreamer_tpu import trace as trace_mod
+    from nnstreamer_tpu.edge.query import (
+        TensorQueryServerSink, TensorQueryServerSrc,
+    )
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    tracer = trace_mod.enable()
+    tracer.set_process("obs-test-server")
+    src = TensorQueryServerSrc(port=0, id="obs-2p")
+    sink = TensorQueryServerSink(id="obs-2p")
+    ex = Pipeline().chain(src, sink).start()
+    port_q.put(src.bound_port)
+    stop_q.get()
+    ex.stop()
+    tracer.save(trace_path)
+
+
+@pytest.mark.slow
+def test_two_process_query_trace_merges_into_one_timeline(tmp_path):
+    """The acceptance-criteria walkthrough, for real: a client pipeline
+    and a separate server PROCESS each record a chrome trace over a
+    loopback query hop; trace.merge() folds them into one Perfetto
+    document where both processes' edge events share the frame_id."""
+    import multiprocessing as mp
+
+    from nnstreamer_tpu.edge.query import TensorQueryClient
+    from nnstreamer_tpu.tensors.frame import Frame
+
+    server_path = str(tmp_path / "server.json")
+    port_q: mp.Queue = mp.Queue()
+    stop_q: mp.Queue = mp.Queue()
+    proc = mp.Process(
+        target=_trace_echo_server,
+        args=(port_q, stop_q, server_path), daemon=True,
+    )
+    proc.start()
+    try:
+        port = port_q.get(timeout=60)
+        tracer = trace.enable()
+        tracer.clear()
+        tracer.set_process("obs-test-client")
+        client = TensorQueryClient(**{"dest-port": port})
+        client.negotiate([None])
+        client.start()
+        try:
+            reply = client.process(Frame((np.ones(2, dtype=np.float32),)))
+        finally:
+            client.stop()
+        fid = reply.meta["frame_id"]
+        client_path = str(tmp_path / "client.json")
+        tracer.save(client_path)
+        stop_q.put(None)
+        proc.join(timeout=60)
+        assert proc.exitcode == 0
+        docs = [
+            json.loads(open(client_path).read()),
+            json.loads(open(server_path).read()),
+        ]
+        merged = trace.merge(docs)
+        procs = {
+            e["args"]["name"] for e in merged["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        }
+        assert {"obs-test-client", "obs-test-server"} <= procs
+        edge_pids = {
+            e["pid"] for e in merged["traceEvents"]
+            if e.get("cat") == "edge"
+            and e.get("args", {}).get("frame_id") == fid
+        }
+        assert len(edge_pids) == 2  # BOTH processes saw this frame
+    finally:
+        if proc.is_alive():
+            proc.terminate()
+
+
+class TestTracer:
+    def test_stable_tids_and_thread_names(self):
+        t = trace.Tracer(process="unit")
+        with t.span("main-span"):
+            pass
+
+        def worker():
+            with t.span("worker-span"):
+                pass
+
+        th = threading.Thread(target=worker, name="svc-thread")
+        th.start()
+        th.join()
+        evs = t.events()
+        tids = {e["name"]: e["tid"] for e in evs}
+        assert tids["main-span"] != tids["worker-span"]
+        assert all(0 < tid < 100 for tid in tids.values())
+        doc = t.to_chrome_trace()
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        names = {e["args"]["name"] for e in meta}
+        assert "unit" in names and "svc-thread" in names
+
+    def test_bounded_buffer_drops_oldest(self):
+        t = trace.Tracer(max_events=50)
+        for i in range(130):
+            t.instant(f"e{i}")
+        evs = t.events()
+        assert len(evs) == 50
+        assert t.dropped_events == 80
+        assert evs[0]["name"] == "e80"  # oldest dropped, newest kept
+        assert t.to_chrome_trace()["otherData"]["dropped_events"] == 80
+
+    def test_save_is_atomic(self, tmp_path):
+        t = trace.Tracer()
+        t.instant("x")
+        path = tmp_path / "trace.json"
+        t.save(str(path))
+        t.instant("y")
+        t.save(str(path))  # overwrite via rename
+        doc = json.loads(path.read_text())
+        assert len([e for e in doc["traceEvents"] if e["ph"] == "i"]) == 2
+        assert list(tmp_path.iterdir()) == [path]
+
+    def test_merge_aligns_two_processes(self):
+        client = trace.Tracer(process="client", pid=111)
+        server = trace.Tracer(process="server", pid=111)  # pid collision
+        # server booted 2s after the client (wall anchors disagree)
+        server._wall_t0 = client._wall_t0 + 2.0
+        client.complete("request", "edge", client._t0, 0.001)
+        server.complete("serve", "element", server._t0, 0.001)
+        merged = trace.merge(
+            [client.to_chrome_trace(), server.to_chrome_trace()]
+        )
+        evs = {
+            e["name"]: e for e in merged["traceEvents"]
+            if e["ph"] == "X"
+        }
+        # the server span lands ~2s after the client span on ONE axis
+        delta_us = evs["serve"]["ts"] - evs["request"]["ts"]
+        assert 1.9e6 < delta_us < 2.1e6
+        assert evs["serve"]["pid"] != evs["request"]["pid"]
+        assert merged["otherData"]["merged_processes"] == [
+            "client", "server"
+        ]

@@ -41,6 +41,17 @@ def test_race_lint_clean_on_package():
     assert not report.diagnostics, report.render()
 
 
+def test_obs_metric_catalog_covers_code():
+    """nns-obs self-check: every metric the package emits is cataloged
+    in obs.metrics.METRIC_CATALOG, every cataloged metric has an
+    emitter, and docs/observability.md documents every name
+    (tools/check_style.py runs the same gate on whole-tree runs)."""
+    from nnstreamer_tpu.analysis.selfcheck import obs_self_check
+
+    problems = obs_self_check()
+    assert not problems, "\n".join(problems)
+
+
 def test_san_diagnostic_catalog_covers_code():
     """nns-san --self-check: every emitted code is cataloged, every
     cataloged code has an emitter, slugs stay unique, and the sanitizer

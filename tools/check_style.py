@@ -84,6 +84,20 @@ def run_self_check() -> list:
     return [f"self-check: {p}" for p in self_check()]
 
 
+def run_obs_self_check() -> list:
+    """Run the nns-obs metric-catalog self-check in-process: a metric
+    emitted but uncataloged (or cataloged but undocumented) is invisible
+    to dashboards and to docs/observability.md readers."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.selfcheck import obs_self_check
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"obs self-check could not run: {exc}"]
+    return [f"obs: {p}" for p in obs_self_check()]
+
+
 def run_race_lint_gate() -> list:
     """Run nns-san --race over the package in-process: a concurrency-
     idiom violation (unlocked shared counter, silent service-loop
@@ -114,6 +128,7 @@ def main(argv=None) -> int:
         problems.extend(check_file(path))
     if whole_tree and not no_self_check:
         problems.extend(run_self_check())
+        problems.extend(run_obs_self_check())
         problems.extend(run_race_lint_gate())
     for p in problems:
         print(p)
