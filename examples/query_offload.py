@@ -3,10 +3,14 @@ client pipeline over the native TCP transport (reference edge-ai offload).
 
 Launch-string equivalents (pre-flight with ``nns-launch --check``):
 
-    tensor_query_serversrc port=5001 !
+    tensor_query_serversrc port=5001 max-clients=4 max-inflight=16 !
         tensor_filter framework=jax model=zoo:add custom=dims:4,const:10 input=4 inputtype=float32 !
         tensor_query_serversink
     tensorsrc dimensions=4 num-frames=8 ! tensor_query_client dest-port=5001 ! tensor_sink
+
+The server carries admission bounds (docs/edge-serving.md) — a query
+server without any is the overload-collapse topology nns-lint flags as
+NNS-W111.
 
 Distributed tracing (docs/observability.md): run with NNS_TRACE_DIR=/tmp/t
 and both processes record chrome traces — the client stamps each request
@@ -39,7 +43,8 @@ def server(port_q, stop_q):
 
         tracer = trace_mod.enable()
         tracer.set_process("query-server")
-    src = TensorQueryServerSrc(port=0)
+    src = TensorQueryServerSrc(port=0, **{"max-clients": 4,
+                                          "max-inflight": 16})
     # serversrc emits format=flexible; declare the static input spec
     filt = TensorFilter(framework="jax", model="zoo:add", custom="dims:4,const:10",
                         input="4", inputtype="float32")

@@ -119,6 +119,12 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "(tensor_if SKIP, on-error=drop/retry) on a strict subset of its "
         "branches; the join can starve waiting for skipped counterparts",
     ),
+    "NNS-W111": (
+        Severity.WARNING, "unbounded-query-server",
+        "a tensor_query_serversrc has no admission bound (max-clients / "
+        "max-inflight / per-client-inflight / rate); overload degrades "
+        "as unbounded queueing and silent latency collapse",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -688,6 +688,39 @@ def _skewed_join_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _admission_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W111: a query server launched without any admission bound —
+    every client is accepted and every request queued forever, so
+    overload shows up as latency collapse instead of structured NACKs
+    (docs/edge-serving.md)."""
+    from nnstreamer_tpu.edge.query import TensorQueryServerSrc
+
+    bounds = ("max-clients", "max-inflight", "per-client-inflight", "rate")
+    for e in pipeline.elements:
+        if not isinstance(e, TensorQueryServerSrc):
+            continue
+        bounded = False
+        for key in bounds:
+            raw = e.get_property(key)
+            if raw is None:
+                continue
+            try:
+                if float(raw) > 0:
+                    bounded = True
+                    break
+            except (TypeError, ValueError):
+                bounded = True  # NNS-E005 already covers the bad value
+                break
+        if not bounded:
+            report.add(
+                "NNS-W111", e.name,
+                "no admission bound set; overload degrades as unbounded "
+                "queueing and silent latency collapse",
+                "set max-clients / max-inflight / per-client-inflight / "
+                "rate (docs/edge-serving.md)",
+            )
+
+
 # -- pass 4: resources -------------------------------------------------------
 
 def _resource_pass(
@@ -849,6 +882,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _capacity_pass(pipeline, report)
     _fanout_join_pass(pipeline, report)
     _skewed_join_pass(pipeline, report)
+    _admission_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)

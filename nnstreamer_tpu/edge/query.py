@@ -30,8 +30,26 @@ from typing import Dict, List, Optional
 
 from nnstreamer_tpu import registry, trace
 from nnstreamer_tpu.obs import metrics as obs_metrics
-from nnstreamer_tpu.edge.serialize import decode_message, encode_message
-from nnstreamer_tpu.edge.transport import TransportError, make_transport
+from nnstreamer_tpu.edge.admission import (
+    REASON_DEADLINE,
+    REASON_FAILED,
+    REASON_MALFORMED,
+    REASON_MAX_CLIENTS,
+    AdmissionConfig,
+    AdmissionController,
+)
+from nnstreamer_tpu.edge.serialize import (
+    Nack,
+    decode_message,
+    encode_message,
+    encode_nack,
+)
+from nnstreamer_tpu.edge.transport import (
+    ChaosCounter,
+    ChaosTransport,
+    TransportError,
+    make_transport,
+)
 from nnstreamer_tpu.elements.base import (
     ElementError,
     HostElement,
@@ -47,19 +65,31 @@ from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
 # reference QUERY_DEFAULT_TIMEOUT_SEC (tensor_query_common.h:28) is 10 s
 DEFAULT_TIMEOUT = 10.0
 
-# serversrc/serversink pairing: id → shared server transport
+# serversrc/serversink pairing: id → shared server transport (+ the
+# admission controller when one is configured, keyed separately so the
+# transport-only consumers stay untouched)
 _server_table: Dict[str, object] = {}
+_controller_table: Dict[str, AdmissionController] = {}
 _server_lock = threading.Lock()
 
 
-def _register_server(srv_id: str, transport) -> None:
+def _register_server(srv_id: str, transport, controller=None) -> None:
     with _server_lock:
         _server_table[srv_id] = transport
+        if controller is not None:
+            _controller_table[srv_id] = controller
+        else:
+            _controller_table.pop(srv_id, None)
 
 
 def _get_server(srv_id: str):
     with _server_lock:
         return _server_table.get(srv_id)
+
+
+def _get_controller(srv_id: str) -> Optional[AdmissionController]:
+    with _server_lock:
+        return _controller_table.get(srv_id)
 
 
 def _unregister_server(srv_id: str, transport=None) -> None:
@@ -68,9 +98,51 @@ def _unregister_server(srv_id: str, transport=None) -> None:
     with _server_lock:
         if transport is None or _server_table.get(srv_id) is transport:
             _server_table.pop(srv_id, None)
+            _controller_table.pop(srv_id, None)
 
 
-CONNECT_TYPES = ("TCP", "MQTT", "HYBRID")
+def nack_for_shed(srv_id: str, cid, frame_id=None) -> None:
+    """Deadline shed notification (pipeline/faults.py notify_shed): the
+    executor dropped an admitted request before it consumed device time;
+    tell the client so the request still has a terminal outcome, and
+    return the admission budget. Best-effort — a vanished client must
+    not poison the shedding node."""
+    transport = _get_server(srv_id)
+    if transport is not None and cid is not None:
+        try:
+            transport.send(
+                cid, encode_nack(REASON_DEADLINE, 0.0, frame_id=frame_id)
+            )
+        except (TransportError, OSError):
+            pass
+    ctrl = _get_controller(srv_id)
+    if ctrl is not None and cid is not None:
+        ctrl.release(cid)
+
+
+def discard_admitted(srv_id: str, cid, action: str, frame_id=None) -> None:
+    """A fault policy disposed of an admitted request (pipeline/faults.py
+    notify_discard): return its admission budget — the in-flight slot
+    must not stay pinned forever — and, unless the frame was delivered
+    to a dead-letter consumer (``action == "route"``), NACK the client
+    (``failed``, terminal) so the request does not end as a silent
+    client-side timeout."""
+    ctrl = _get_controller(srv_id)
+    if ctrl is not None and cid is not None:
+        ctrl.release(cid)
+    if action == "route":
+        return  # the dead-letter consumer owns the request's fate now
+    transport = _get_server(srv_id)
+    if transport is not None and cid is not None:
+        try:
+            transport.send(
+                cid, encode_nack(REASON_FAILED, 0.0, frame_id=frame_id)
+            )
+        except (TransportError, OSError):
+            pass
+
+
+CONNECT_TYPES = ("TCP", "MQTT", "HYBRID", "SHM")
 
 
 def _check_connect_type(elem) -> str:
@@ -95,10 +167,16 @@ def _make_client_transport(ct: str, topic: str):
         from nnstreamer_tpu.edge.query_transports import HybridClientTransport
 
         return HybridClientTransport(topic)
+    if ct == "SHM":
+        from nnstreamer_tpu.edge.query_transports import ShmClientTransport
+
+        return ShmClientTransport()
     return make_transport()
 
 
-def _make_server_transport(ct: str, topic: str, data_host: str, data_port: int):
+def _make_server_transport(ct: str, topic: str, data_host: str,
+                           data_port: int, max_conns: int = 0,
+                           retry_after_ms: float = 50.0):
     if ct == "MQTT":
         from nnstreamer_tpu.edge.query_transports import MqttQueryTransport
 
@@ -106,8 +184,19 @@ def _make_server_transport(ct: str, topic: str, data_host: str, data_port: int):
     if ct == "HYBRID":
         from nnstreamer_tpu.edge.query_transports import HybridServerTransport
 
-        return HybridServerTransport(topic, data_host, data_port)
-    return make_transport()
+        t = HybridServerTransport(topic, data_host, data_port)
+    elif ct == "SHM":
+        from nnstreamer_tpu.edge.query_transports import ShmServerTransport
+
+        return ShmServerTransport()
+    else:
+        # connection caps need the python acceptor's reject path; the
+        # native transport still gets request-level admission NACKs
+        t = make_transport(prefer_native=not max_conns)
+    if max_conns and hasattr(t, "max_conns"):
+        t.max_conns = max_conns
+        t.reject_payload = encode_nack(REASON_MAX_CLIENTS, retry_after_ms)
+    return t
 
 
 @registry.element("tensor_query_client")
@@ -130,7 +219,17 @@ class TensorQueryClient(HostElement):
     failures keep failing fast — a timeout or a connection lost while
     awaiting the reply may mean the server already processed the
     request, and a resend could double-process it (the dropped
-    connection still reconnects for the next frame)."""
+    connection still reconnects for the next frame).
+
+    Overload cooperation (docs/edge-serving.md): ``deadline-ms`` stamps
+    a per-request SLO into the wire meta (the server sheds frames that
+    can no longer meet it, and NACKs back); ``priority`` picks the
+    admission class (lower = more urgent). Admission NACKs (max-clients
+    / overload / client-backpressure / rate / malformed) mean the server
+    did NOT process the request — the client honors the NACK's
+    retry-after hint on its existing ``retry-max`` budget. The
+    ``chaos-*`` properties inject deterministic network faults
+    (docs/fault-tolerance.md) for testing those paths."""
 
     FACTORY_NAME = "tensor_query_client"
 
@@ -138,13 +237,31 @@ class TensorQueryClient(HostElement):
         "dest-host": PropSpec("str", "127.0.0.1"),
         "dest-port": PropSpec("int", 0, desc="required"),
         "timeout": PropSpec("float", 10.0, desc="per-request (s)"),
-        "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "HYBRID")),
+        "connect-type": PropSpec("enum", "TCP", CONNECT_TYPES),
         "topic": PropSpec("str", "nns-query"),
         "retry-max": PropSpec(
             "int", 0, desc="reconnect attempts on transport failure"
         ),
         "retry-backoff-ms": PropSpec(
             "float", 50.0, desc="reconnect backoff base (jittered, doubling)"
+        ),
+        "deadline-ms": PropSpec(
+            "float", 0.0,
+            desc="per-request SLO stamped into the wire meta; the server "
+            "sheds frames that can no longer meet it (0 = none)",
+        ),
+        "priority": PropSpec(
+            "int", None,
+            desc="admission priority class (lower = more urgent; "
+            "default 1 server-side)",
+        ),
+        "chaos-drop-every-n": PropSpec(
+            "int", 0,
+            desc="chaos harness: sever the connection on every Nth send",
+        ),
+        "chaos-truncate-every-n": PropSpec(
+            "int", 0,
+            desc="chaos harness: send a truncated header every Nth send",
         ),
     }
 
@@ -156,6 +273,16 @@ class TensorQueryClient(HostElement):
         self.connect_type = "TCP"
         self.topic = str(self.get_property("topic", "nns-query"))
         self.retry_max = max(0, int(self.get_property("retry-max", 0)))
+        self.deadline_ms = float(self.get_property("deadline-ms", 0.0))
+        raw_prio = self.get_property("priority")
+        self.priority = None if raw_prio is None else int(raw_prio)
+        self._chaos_drop_n = max(
+            0, int(self.get_property("chaos-drop-every-n", 0))
+        )
+        self._chaos_trunc_n = max(
+            0, int(self.get_property("chaos-truncate-every-n", 0))
+        )
+        self._chaos_counter = ChaosCounter()
         from nnstreamer_tpu.pipeline.faults import FaultPolicy
 
         self._retry_policy = FaultPolicy(
@@ -176,6 +303,7 @@ class TensorQueryClient(HostElement):
         # that skip start() simply record no metrics.
         self._obs_reg = None
         self._rtt_hist = None  # nns_edge_rtt_us histogram handle
+        self._nack_ctrs: Dict[str, object] = {}  # reason → counter
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         self.connect_type = _check_connect_type(self)
@@ -191,7 +319,16 @@ class TensorQueryClient(HostElement):
         # standalone callers may hit process() without start(), and the
         # property must be honored on that path too
         self.connect_type = _check_connect_type(self)
-        self._transport = _make_client_transport(self.connect_type, self.topic)
+        t = _make_client_transport(self.connect_type, self.topic)
+        if self._chaos_drop_n or self._chaos_trunc_n:
+            # the counter survives reconnects so the injection schedule
+            # stays deterministic across the faults it causes
+            t = ChaosTransport(
+                t, self._chaos_counter,
+                drop_every_n=self._chaos_drop_n,
+                truncate_every_n=self._chaos_trunc_n,
+            )
+        self._transport = t
         try:
             self._transport.connect(self.host, self.port)
         except (TransportError, OSError):
@@ -233,6 +370,10 @@ class TensorQueryClient(HostElement):
         if fid is None:
             fid = f"{self._fid_prefix}.{next(self._fid_seq)}"
             frame = frame.with_meta(frame_id=fid)
+        if self.deadline_ms > 0 and "deadline_ms" not in frame.meta:
+            frame = frame.with_meta(deadline_ms=self.deadline_ms)
+        if self.priority is not None and "priority" not in frame.meta:
+            frame = frame.with_meta(priority=self.priority)
         data = encode_message(frame)
         t_req = time.perf_counter()
         attempt = 0
@@ -262,6 +403,51 @@ class TensorQueryClient(HostElement):
                 _, payload = got
                 if not payload:
                     raise TransportError("server closed the connection")
+                msg = decode_message(payload)
+                if isinstance(msg, Nack):
+                    # a NACK means the server did NOT process the request,
+                    # so a resend cannot double-process: honor the
+                    # retry-after hint on the existing retry budget.
+                    # Reason "deadline" is terminal — the request WAS
+                    # admitted and then shed; the budget it consumed is
+                    # gone and the reply window with it.
+                    self._count_nack(msg.reason)
+                    if msg.reason == REASON_DEADLINE:
+                        raise ElementError(
+                            f"{self.name}: server shed the request "
+                            f"(deadline {self.deadline_ms:.0f} ms missed)"
+                        )
+                    if msg.reason == REASON_FAILED:
+                        # the server admitted AND processed the request,
+                        # and its fault policy dropped it — a resend
+                        # would re-run work that already failed
+                        raise ElementError(
+                            f"{self.name}: server failed the request "
+                            "(dropped by its error policy)"
+                        )
+                    if attempt >= self.retry_max:
+                        raise ElementError(
+                            f"{self.name}: server rejected the request "
+                            f"({msg.reason}) after {attempt + 1} attempt(s); "
+                            f"retry-after hint {msg.retry_after_ms:.0f} ms"
+                        )
+                    delay = max(
+                        msg.retry_after_ms / 1000.0,
+                        backoff_s(attempt, self._retry_policy, self._rng),
+                    )
+                    attempt += 1
+                    # reconnect for the retry: a conn-level reject (the
+                    # max-clients accept path) NACKs then CLOSES, and a
+                    # resend into that dead socket would buffer fine but
+                    # fail at recv with sent=True — terminal, wasting
+                    # the whole retry budget. The NACK guarantees the
+                    # request was not processed, so reconnect+resend is
+                    # always safe; the reconnect is wasted only on a
+                    # still-healthy connection, and the retry-after
+                    # sleep dwarfs the handshake.
+                    self._drop_connection()
+                    time.sleep(delay)
+                    continue
                 break
             except (TransportError, OSError) as exc:
                 self._drop_connection()
@@ -296,12 +482,23 @@ class TensorQueryClient(HostElement):
             reg.counter(
                 "nns_edge_requests_total", element=self.name
             ).inc()
-        reply = decode_message(payload)
+        reply = msg
         if isinstance(reply, EOS):
             return None
         if reply.meta.get("frame_id") is None:
             reply = reply.with_meta(frame_id=fid)
         return reply.with_pts(frame.pts, frame.duration)
+
+    def _count_nack(self, reason: str) -> None:
+        reg = self._obs_reg
+        if reg is None:
+            return
+        ctr = self._nack_ctrs.get(reason)
+        if ctr is None:
+            ctr = self._nack_ctrs[reason] = reg.counter(
+                "nns_edge_nacks_total", element=self.name, reason=reason
+            )
+        ctr.inc()
 
 
 @registry.element("tensor_query_serversrc")
@@ -310,8 +507,21 @@ class TensorQueryServerSrc(Source):
 
     Props: host (default 127.0.0.1), port (0 = ephemeral; read back via
     ``bound_port``), id (pairing key, default "0"),
-    connect-type=TCP|MQTT|HYBRID, topic (MQTT/HYBRID), data-host/
+    connect-type=TCP|MQTT|HYBRID|SHM, topic (MQTT/HYBRID), data-host/
     data-port (HYBRID TCP data plane, default ephemeral loopback).
+
+    Admission control (docs/edge-serving.md): ``max-clients``,
+    ``max-inflight``, ``per-client-inflight``, ``rate``/``rate-burst``
+    bound what the server accepts — excess connections and requests get
+    an explicit structured NACK (reason + ``retry-after-ms`` hint) on
+    the wire instead of queueing forever. Admitted requests are served
+    weighted-fair: strict priority classes (the client's ``priority``
+    meta, lower = more urgent), round-robin across clients within a
+    class, so one hot client cannot starve the rest. Every admitted
+    frame is stamped with its admission time so the executor's
+    deadline-aware shedder can drop SLO-missed frames before they
+    consume device time. A serversrc with NO bound set keeps the legacy
+    unbounded behavior (nns-lint NNS-W111 warns).
     """
 
     FACTORY_NAME = "tensor_query_serversrc"
@@ -320,10 +530,32 @@ class TensorQueryServerSrc(Source):
         "host": PropSpec("str", "127.0.0.1"),
         "port": PropSpec("int", 0, desc="0 = ephemeral"),
         "id": PropSpec("str", "0", desc="pairing key with serversink"),
-        "connect-type": PropSpec("enum", "TCP", ("TCP", "MQTT", "HYBRID")),
+        "connect-type": PropSpec("enum", "TCP", CONNECT_TYPES),
         "topic": PropSpec("str", "nns-query"),
         "data-host": PropSpec("str", "127.0.0.1", desc="HYBRID data plane"),
         "data-port": PropSpec("int", 0, desc="HYBRID data plane"),
+        "max-clients": PropSpec(
+            "int", 0, desc="admission: concurrent client cap (0 = none)"
+        ),
+        "max-inflight": PropSpec(
+            "int", 0,
+            desc="admission: global in-flight request cap (0 = none)",
+        ),
+        "per-client-inflight": PropSpec(
+            "int", 0,
+            desc="admission: per-client in-flight cap (0 = none)",
+        ),
+        "rate": PropSpec(
+            "float", 0.0,
+            desc="admission: global token-bucket rate, requests/s "
+            "(0 = none)",
+        ),
+        "rate-burst": PropSpec(
+            "int", 0, desc="token-bucket depth (0 = max(1, rate))"
+        ),
+        "retry-after-ms": PropSpec(
+            "float", 50.0, desc="base retry-after hint carried by NACKs"
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -339,6 +571,9 @@ class TensorQueryServerSrc(Source):
         self.connect_type = "TCP"
         self.bound_port: Optional[int] = None
         self._transport = None
+        self._adm_cfg = AdmissionConfig.from_element(self)
+        self._controller: Optional[AdmissionController] = None
+        self.malformed_total = 0  # undecodable requests NACKed
 
     def output_spec(self) -> Spec:
         self.connect_type = _check_connect_type(self)
@@ -346,11 +581,17 @@ class TensorQueryServerSrc(Source):
 
     def start(self) -> None:
         self.connect_type = _check_connect_type(self)
+        if self._adm_cfg.active:
+            self._controller = AdmissionController(
+                self._adm_cfg, name=self.name
+            )
         self._transport = _make_server_transport(
-            self.connect_type, self.topic, self.data_host, self.data_port
+            self.connect_type, self.topic, self.data_host, self.data_port,
+            max_conns=self._adm_cfg.max_clients,
+            retry_after_ms=self._adm_cfg.retry_after_ms,
         )
         self.bound_port = self._transport.listen(self.host, self.port)
-        _register_server(self.srv_id, self._transport)
+        _register_server(self.srv_id, self._transport, self._controller)
 
     def stop(self) -> None:
         _unregister_server(self.srv_id, self._transport)
@@ -358,23 +599,107 @@ class TensorQueryServerSrc(Source):
             self._transport.close()
             self._transport = None
 
-    def generate(self):
-        got = self._transport.recv(timeout=0.1)
-        if got is None:
-            return None  # re-poll; executor loops until EOS/stop
-        cid, payload = got
-        if not payload:
-            return None  # client disconnect event; keep serving others
-        frame = decode_message(payload)
-        if isinstance(frame, EOS):
-            return None  # one client's EOS must not stop the server
+    def _trace_in(self, frame, cid) -> None:
         tracer = trace.get()
         if tracer is not None:
             tracer.instant(
                 self.name, cat="edge",
                 frame_id=frame.meta.get("frame_id"), client_id=cid,
             )
-        return frame.with_meta(client_id=cid)
+
+    def _stamp(self, frame, cid):
+        """Admission meta: client_id routes the reply, admit_t anchors
+        the deadline shedder, _nns_srv lets the shedding node find this
+        server to NACK — the latter two are local-only keys that never
+        ride the wire (serialize._WIRE_META_SKIP)."""
+        return frame.with_meta(
+            client_id=cid, admit_t=time.monotonic(), _nns_srv=self.srv_id
+        )
+
+    def _send_nack(self, cid, reason: str, retry_after_ms: float,
+                   frame_id=None) -> None:
+        try:
+            self._transport.send(
+                cid, encode_nack(reason, retry_after_ms, frame_id=frame_id)
+            )
+        except (TransportError, OSError):
+            pass  # the client vanished; nothing to tell
+
+    def _handle_incoming(self, cid, payload) -> None:
+        """Admission at arrival: decode, admit or NACK, queue."""
+        ctrl = self._controller
+        if not payload:
+            ctrl.client_gone(cid)
+            return
+        try:
+            msg = decode_message(payload)
+        except ValueError:
+            self.malformed_total += 1
+            ctrl.count_reject(REASON_MALFORMED)
+            self._send_nack(cid, REASON_MALFORMED, 0.0)
+            return
+        if isinstance(msg, (EOS, Nack)):
+            return  # one client's EOS must not stop the server
+        frame = self._stamp(msg, cid)
+        decision = ctrl.offer(cid, frame)
+        if not decision.ok:
+            self._send_nack(
+                cid, decision.reason, decision.retry_after_ms,
+                frame_id=frame.meta.get("frame_id"),
+            )
+
+    def generate(self):
+        ctrl = self._controller
+        if ctrl is None:
+            # unbounded legacy path (nns-lint NNS-W111 warns): still
+            # stamps admission meta so deadline shedding works
+            got = self._transport.recv(timeout=0.1)
+            if got is None:
+                return None  # re-poll; executor loops until EOS/stop
+            cid, payload = got
+            if not payload:
+                return None  # client disconnect; keep serving others
+            try:
+                frame = decode_message(payload)
+            except ValueError:
+                # one client's garbage must not crash the server for
+                # everyone: same structured NACK as the admission path
+                self.malformed_total += 1
+                self._send_nack(cid, REASON_MALFORMED, 0.0)
+                return None
+            if isinstance(frame, EOS):
+                return None
+            if isinstance(frame, Nack):
+                return None  # NACKs are server→client only; ignore
+            self._trace_in(frame, cid)
+            return self._stamp(frame, cid)
+        # drain everything that arrived (admitting or NACKing each),
+        # then serve ONE request picked weighted-fair across clients
+        got = self._transport.recv(
+            timeout=0.0 if ctrl.has_ready() else 0.1
+        )
+        while got is not None:
+            self._handle_incoming(*got)
+            got = self._transport.recv(timeout=0.0)
+        frame = ctrl.next_ready()
+        if frame is None:
+            return None
+        self._trace_in(frame, frame.meta.get("client_id"))
+        return frame
+
+    def admission_stats(self) -> Dict[str, object]:
+        """Executor.stats() hook (``adm_*`` keys; nns-top --clients)."""
+        ctrl = self._controller
+        out: Dict[str, object] = {}
+        if ctrl is not None:
+            out.update(ctrl.snapshot())
+        if self.malformed_total:
+            out["malformed"] = self.malformed_total
+        t = self._transport
+        rejected_conns = getattr(t, "rejected_conns", 0) if t else 0
+        if rejected_conns:
+            out["rejected_conns"] = rejected_conns
+        return out
 
 
 @registry.element("tensor_query_serversink")
@@ -382,6 +707,11 @@ class TensorQueryServerSink(Sink):
     """Send results back to the requesting client (by client_id meta).
 
     Props: id (pairing key matching the serversrc, default "0").
+
+    Overload resilience: a reply whose client vanished is counted
+    (``reply_failed``) and skipped, never fatal — one dead client must
+    not poison the serving pipeline for everyone else. Each rendered (or
+    failed) reply releases the request's admission budget.
     """
 
     FACTORY_NAME = "tensor_query_serversink"
@@ -393,6 +723,7 @@ class TensorQueryServerSink(Sink):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.srv_id = str(self.get_property("id", "0"))
+        self.reply_failed = 0  # replies to vanished clients (skipped)
 
     def render(self, frame: Frame) -> None:
         transport = _get_server(self.srv_id)
@@ -412,4 +743,15 @@ class TensorQueryServerSink(Sink):
                 self.name, cat="edge",
                 frame_id=frame.meta.get("frame_id"), client_id=cid,
             )
-        transport.send(cid, encode_message(frame))
+        try:
+            transport.send(cid, encode_message(frame))
+        except (TransportError, OSError):
+            self.reply_failed += 1
+        finally:
+            # dead-lettered frames already released their budget at the
+            # fault-gate disposal (faults.py route path) — releasing
+            # again here would silently loosen the admission caps
+            if not frame.meta.get("_nns_budget_released"):
+                ctrl = _get_controller(self.srv_id)
+                if ctrl is not None:
+                    ctrl.release(cid)

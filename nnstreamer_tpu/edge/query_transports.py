@@ -11,8 +11,13 @@ connect-type TCP / MQTT / HYBRID (/ AITT, vendor-gated). Semantics:
   (the reference's broker-assisted mode). The server listens on an
   ephemeral TCP port and answers ``<topic>/whois`` discovery requests with
   ``host:port``; clients then speak plain TCP.
+- ``SHM``: co-located processes skip sockets entirely — request and
+  reply each ride one SPSC shared-memory ring (edge/shm.py over
+  native/nns_shm.cpp), one memcpy in, one out, no syscall per frame on
+  the hot path. Single client by design (the rings are SPSC); the
+  ``port`` property keys the segment names.
 
-Both adapters expose the same surface as the native TCP transport
+All adapters expose the same surface as the native TCP transport
 (connect/listen/send/recv/close/peer_count) so the query elements stay
 transport-agnostic, like the reference elements over nns_edge handles.
 """
@@ -104,7 +109,15 @@ class MqttQueryTransport:
 
 
 class HybridServerTransport:
-    """TCP data plane + MQTT discovery: answers whois with host:port."""
+    """TCP data plane + MQTT discovery: answers whois with host:port.
+
+    ``max_conns``/``reject_payload`` (set by the serversrc's admission
+    layer before listen) pass through to the TCP data plane; the python
+    transport enforces them, the native one admits at request level
+    only."""
+
+    max_conns = 0
+    reject_payload = None
 
     def __init__(self, topic: str = "nns-query", data_host: str = "127.0.0.1",
                  data_port: int = 0) -> None:
@@ -116,7 +129,11 @@ class HybridServerTransport:
         self._addr = ""
 
     def listen(self, host: str, port: int) -> int:
-        self._tcp = make_transport()
+        # conn caps need the python transport's acceptor-side rejection
+        self._tcp = make_transport(prefer_native=not self.max_conns)
+        if self.max_conns:
+            self._tcp.max_conns = self.max_conns
+            self._tcp.reject_payload = self.reject_payload
         tcp_port = self._tcp.listen(self.data_host, self.data_port)
         self._addr = f"{self.data_host}:{tcp_port}"
         try:
@@ -143,6 +160,10 @@ class HybridServerTransport:
     def _on_whois(self, topic: str, payload: bytes) -> None:
         self._announce()
 
+    @property
+    def rejected_conns(self) -> int:
+        return getattr(self._tcp, "rejected_conns", 0) if self._tcp else 0
+
     def send(self, cid, payload: bytes) -> None:
         self._tcp.send(cid, payload)
 
@@ -159,6 +180,94 @@ class HybridServerTransport:
         if self._tcp is not None:
             self._tcp.close()
             self._tcp = None
+
+
+class ShmServerTransport:
+    """connect-type=SHM server side: two SPSC rings for ONE co-located
+    client — ``/nns-shm-<port>`` carries requests (client writes, server
+    reads), ``/nns-shm-<port+1>`` carries replies. The server creates
+    both segments (it starts first and owns their lifetime: closing
+    marks them closed so the client drains then sees EOS)."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        from nnstreamer_tpu.edge.shm import DEFAULT_CAPACITY, ShmTransport
+
+        cap = capacity or DEFAULT_CAPACITY
+        self._req = ShmTransport(cap)
+        self._rep = ShmTransport(cap)
+        self._port = 0
+
+    def listen(self, host: str, port: int) -> int:
+        port = port or (os.getpid() % 50000 + 10000)
+        self._req.listen(host, port)
+        try:
+            self._rep.listen(host, port + 1)
+        except TransportError:
+            self._req.close()
+            raise
+        self._port = port
+        return port
+
+    def send(self, cid, payload: bytes) -> None:
+        self._rep.send(0, payload)
+
+    def recv(self, timeout: Optional[float] = None):
+        got = self._req.recv(timeout=timeout)
+        if got is None:
+            return None
+        # one fixed client id: the rings are SPSC, so "which client" is
+        # structural — 1 keeps the serversink's client_id path uniform
+        return (1, got[1])
+
+    def peer_count(self) -> int:
+        return self._rep.peer_count()
+
+    def close(self) -> None:
+        self._req.close()
+        self._rep.close()
+
+
+class ShmClientTransport:
+    """connect-type=SHM client side: opens the server's ring pair
+    (requests written to ``<port>``, replies read from ``<port+1>``)."""
+
+    def __init__(self) -> None:
+        self._req = None
+        self._rep = None
+
+    def connect(self, host: str, port: int) -> None:
+        from nnstreamer_tpu.edge.shm import ShmTransport
+
+        req = ShmTransport()
+        rep = ShmTransport()
+        req.connect(host, port)
+        try:
+            rep.connect(host, port + 1)
+        except TransportError:
+            req.close()
+            raise
+        self._req, self._rep = req, rep
+
+    def send(self, cid, payload: bytes) -> None:
+        if self._req is None:
+            raise TransportError("shm query transport not connected")
+        self._req.send(0, payload)
+
+    def recv(self, timeout: Optional[float] = None):
+        if self._rep is None:
+            raise TransportError("shm query transport not connected")
+        return self._rep.recv(timeout=timeout)
+
+    def peer_count(self) -> int:
+        return 1 if self._req is not None else 0
+
+    def close(self) -> None:
+        if self._req is not None:
+            self._req.close()
+            self._req = None
+        if self._rep is not None:
+            self._rep.close()
+            self._rep = None
 
 
 class HybridClientTransport:

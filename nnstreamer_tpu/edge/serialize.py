@@ -8,7 +8,7 @@ SURVEY.md §5.8).
 Layout (little-endian):
 
     uint8  version (2; v1 — no meta blob, flags always 0 — still decodes)
-    uint8  kind    (0 = DATA, 1 = EOS)
+    uint8  kind    (0 = DATA, 1 = EOS, 2 = NACK)
     int64  pts     (ns; -1 = unknown)
     int64  duration(ns; -1 = unknown)
     uint32 flags   (bit 0: a meta blob follows the header)
@@ -21,8 +21,16 @@ tensor_query_client stamps — crosses tensor_query/edgesrc hops, so the
 client's trace span and the server-side spans for the same frame share
 an identity and ``trace.merge()`` can line them up on one timeline.
 Per-hop-local keys (``client_id``, the transport pairing tag;
-``wall_t0``, a perf_counter reading meaningless in another process)
-never ride the wire.
+``wall_t0``, a perf_counter reading meaningless in another process;
+``admit_t``, the server's local admission stamp; ``_nns_srv``, the
+serversrc pairing key) never ride the wire.
+
+``KIND_NACK`` (docs/edge-serving.md) is the admission layer's explicit
+rejection: no tensors, just a meta blob carrying ``nack_reason``
+(max-clients / overload / client-backpressure / rate / malformed /
+deadline / failed), a ``retry_after_ms`` hint, and — when known — the
+``frame_id`` of the rejected request. ``decode_message`` returns it as
+a :class:`Nack` so clients can back off instead of timing out.
 """
 
 from __future__ import annotations
@@ -43,10 +51,46 @@ VERSION = 2
 _DECODABLE_VERSIONS = (1, 2)
 KIND_DATA = 0
 KIND_EOS = 1
+KIND_NACK = 2
 FLAG_META = 1
 
 # meta keys that must NOT cross a hop: local to the process that set them
-_WIRE_META_SKIP = frozenset({"client_id", "wall_t0"})
+_WIRE_META_SKIP = frozenset({
+    "client_id", "wall_t0", "admit_t", "_nns_srv", "_nns_budget_released",
+})
+
+
+class Nack:
+    """A structured rejection from the serving plane (docs/
+    edge-serving.md): the request was NOT processed; ``retry_after_ms``
+    hints when a retry might be admitted (reason ``deadline`` is the one
+    terminal NACK — the request was admitted but shed)."""
+
+    __slots__ = ("reason", "retry_after_ms", "frame_id")
+
+    def __init__(self, reason: str, retry_after_ms: float = 0.0,
+                 frame_id=None) -> None:
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
+        self.frame_id = frame_id
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (
+            f"Nack(reason={self.reason!r}, "
+            f"retry_after_ms={self.retry_after_ms})"
+        )
+
+
+def encode_nack(reason: str, retry_after_ms: float = 0.0,
+                frame_id=None) -> bytes:
+    meta = {"nack_reason": reason, "retry_after_ms": float(retry_after_ms)}
+    if frame_id is not None:
+        meta["frame_id"] = frame_id
+    enc = json.dumps(meta, separators=(",", ":")).encode()
+    return (
+        _HDR.pack(VERSION, KIND_NACK, -1, -1, FLAG_META)
+        + _META_LEN.pack(len(enc)) + enc
+    )
 
 
 def _wire_meta(frame) -> dict:
@@ -80,7 +124,8 @@ def encode_message(frame) -> bytes:
 
 
 def decode_message(data: bytes):
-    """→ Frame, or EOS_FRAME. Raises ValueError on malformed input."""
+    """→ Frame, EOS_FRAME, or :class:`Nack`. Raises ValueError on
+    malformed input."""
     if len(data) < _HDR.size:
         raise ValueError(f"edge message too short: {len(data)}")
     version, kind, pts, dur, flags = _HDR.unpack_from(data)
@@ -106,6 +151,12 @@ def decode_message(data: bytes):
         if not isinstance(meta, dict):
             raise ValueError("edge message meta is not an object")
         off += meta_len
+    if kind == KIND_NACK:
+        return Nack(
+            str(meta.get("nack_reason", "unspecified")),
+            float(meta.get("retry_after_ms", 0.0) or 0.0),
+            meta.get("frame_id"),
+        )
     tensors = decode_frame_tensors(data[off:])
     return Frame(
         tensors,

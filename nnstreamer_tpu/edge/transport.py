@@ -21,6 +21,7 @@ import ctypes
 import socket
 import struct
 import threading
+import time
 from collections import deque
 from typing import Optional, Tuple
 
@@ -126,7 +127,17 @@ _LEN = struct.Struct("<Q")
 
 
 class PyTransport:
-    """Pure-python fallback; same wire framing and semantics."""
+    """Pure-python fallback; same wire framing and semantics.
+
+    Connection admission (docs/edge-serving.md): a server with
+    ``max_conns`` > 0 rejects accepts beyond the cap — the over-cap
+    socket is sent ``reject_payload`` (one framed message, typically an
+    admission NACK from edge/serialize.py) and closed, instead of
+    silently holding a reader thread forever. ``rejected_conns`` counts
+    them (acceptor-thread single-writer)."""
+
+    max_conns = 0            # 0 = unbounded (instance attr overrides)
+    reject_payload: Optional[bytes] = None
 
     def __init__(self) -> None:
         self._is_server = False
@@ -139,6 +150,7 @@ class PyTransport:
         self._q_cv = threading.Condition()
         self._threads = []
         self._running = False
+        self.rejected_conns = 0
 
     # -- shared plumbing ---------------------------------------------------
     def _enqueue(self, cid: int, data: bytes) -> None:
@@ -190,17 +202,49 @@ class PyTransport:
             except OSError:
                 break
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            over_cap = False
             with self._conn_lock:
                 # reap finished readers so client churn can't grow the list
                 self._threads = [t for t in self._threads if t.is_alive()]
-                cid = self._next_id
-                self._next_id += 1
-                self._conns[cid] = sock
+                if self.max_conns and len(self._conns) >= self.max_conns:
+                    over_cap = True
+                else:
+                    cid = self._next_id
+                    self._next_id += 1
+                    self._conns[cid] = sock
+                    t = threading.Thread(
+                        target=self._reader, args=(cid, sock), daemon=True
+                    )
+                    self._threads.append(t)
+                    t.start()
+            if over_cap:
+                # reject on a short-lived thread: the NACK send can block
+                # up to its 1 s timeout on a hostile/slow peer, and a
+                # stream of over-cap connections must not serialize the
+                # accept loop behind it (counter bumped HERE — acceptor
+                # thread stays the single writer)
+                self.rejected_conns += 1
                 t = threading.Thread(
-                    target=self._reader, args=(cid, sock), daemon=True
+                    target=self._reject_conn, args=(sock,), daemon=True
                 )
-                self._threads.append(t)
+                with self._conn_lock:
+                    self._threads.append(t)
                 t.start()
+
+    def _reject_conn(self, sock: socket.socket) -> None:
+        try:
+            payload = self.reject_payload
+            if payload:
+                sock.settimeout(1.0)
+                sock.sendall(_LEN.pack(len(payload)) + payload)
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # -- public API --------------------------------------------------------
     def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -266,6 +310,13 @@ class PyTransport:
         self._running = False
         if self._listen_sock is not None:
             try:
+                # shutdown BEFORE close: closing a listening socket does
+                # not reliably wake a thread blocked in accept() (the fd
+                # stays referenced); shutdown forces accept to return
+                self._listen_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._listen_sock.close()
             except OSError:
                 pass
@@ -277,8 +328,88 @@ class PyTransport:
                 except OSError:
                     pass
             self._conns.clear()
+            threads = list(self._threads)
         with self._q_cv:
             self._q_cv.notify_all()
+        # join the acceptor/readers under one bounded budget: their
+        # sockets just closed, so they exit promptly — and a server
+        # torn down by Executor.stop() must not read as a thread leak
+        # merely because the sweep ran before the daemons noticed
+        me = threading.current_thread()
+        deadline = time.monotonic() + 2.0
+        for t in threads:
+            if t is me:
+                continue
+            t.join(timeout=max(0.05, deadline - time.monotonic()))
+
+
+class ChaosCounter:
+    """Mutable send counter shared across reconnects, so the injection
+    schedule stays deterministic when the wrapped transport is rebuilt
+    (the client reconnect path replaces its transport object)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+
+class ChaosTransport:
+    """Deterministic network-fault injector wrapping a query transport
+    (docs/fault-tolerance.md, docs/edge-serving.md): the chaos harness's
+    answer to "does the NACK/reconnect machinery actually work" without
+    waiting for real packet loss.
+
+    - ``drop_every_n``: every Nth send severs the connection mid-stream
+      (the inner transport is closed, the send raises TransportError) —
+      exercising the client's reconnect-with-backoff and the server's
+      disconnect bookkeeping.
+    - ``truncate_every_n``: every Nth send transmits a truncated edge
+      header instead of the payload (framing intact, message garbage) —
+      the server answers with a structured ``malformed`` NACK and the
+      client retries.
+
+    Counting is shared via :class:`ChaosCounter` so schedules survive
+    the reconnects they themselves cause."""
+
+    def __init__(self, inner, counter: Optional[ChaosCounter] = None,
+                 drop_every_n: int = 0, truncate_every_n: int = 0) -> None:
+        self.inner = inner
+        self.counter = counter if counter is not None else ChaosCounter()
+        self.drop_every_n = max(0, int(drop_every_n))
+        self.truncate_every_n = max(0, int(truncate_every_n))
+
+    # -- fault injection on the send path ----------------------------------
+    def send(self, client_id: int, data: bytes) -> None:
+        self.counter.n += 1
+        n = self.counter.n
+        if self.drop_every_n and n % self.drop_every_n == 0:
+            self.inner.close()
+            raise TransportError(
+                f"chaos: connection dropped mid-stream (send {n})"
+            )
+        if self.truncate_every_n and n % self.truncate_every_n == 0:
+            # a well-framed message whose edge header is cut short: the
+            # peer's decode_message raises, never mis-parses
+            self.inner.send(client_id, data[: min(len(data), 6)])
+            return
+        self.inner.send(client_id, data)
+
+    # -- passthrough -------------------------------------------------------
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        return self.inner.listen(host, port)
+
+    def connect(self, host: str, port: int) -> None:
+        self.inner.connect(host, port)
+
+    def recv(self, timeout: Optional[float] = None) -> RecvResult:
+        return self.inner.recv(timeout=timeout)
+
+    def peer_count(self) -> int:
+        return self.inner.peer_count()
+
+    def close(self) -> None:
+        self.inner.close()
 
 
 def make_transport(prefer_native: bool = True):

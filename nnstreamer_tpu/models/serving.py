@@ -53,6 +53,7 @@ import numpy as np
 from nnstreamer_tpu.models import decode as dec
 from nnstreamer_tpu.models import transformer as tfm
 from nnstreamer_tpu.models.speculative import ngram_lookup
+from nnstreamer_tpu.parallel.mesh import shard_map as _shard_map
 
 
 def quantize_kv(t):
@@ -1187,10 +1188,10 @@ class ContinuousBatcher:
                 check_vma=False,
             )
             self._step_greedy = jax.jit(
-                jax.shard_map(step_impl(False), mesh=mesh, **specs), **_don
+                _shard_map(step_impl(False), mesh=mesh, **specs), **_don
             )
             self._step_sampling = jax.jit(
-                jax.shard_map(step_impl(True), mesh=mesh, **specs), **_don
+                _shard_map(step_impl(True), mesh=mesh, **specs), **_don
             )
         else:
             self._step_greedy = jax.jit(step_impl(False), **_don)
@@ -1276,7 +1277,7 @@ class ContinuousBatcher:
             def _pump_sm(f):
                 def g(tok, pos, active, cache, hist, budget, stop, temp,
                       topk, topp, keys, dcache, n_steps):
-                    return jax.shard_map(
+                    return _shard_map(
                         _ft.partial(f, n_steps=n_steps), mesh=mesh,
                         **pspecs,
                     )(tok, pos, active, cache, hist, budget, stop, temp,

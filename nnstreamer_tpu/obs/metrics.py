@@ -65,6 +65,22 @@ METRIC_CATALOG: Dict[str, str] = {
         "tensor_query_client request round-trip time, microseconds "
         "(histogram; includes serialization and the remote pipeline)"
     ),
+    "nns_admission_rejects_total": (
+        "query-server admission rejections by reason label: max-clients "
+        "/ overload / client-backpressure / rate / malformed (counter)"
+    ),
+    "nns_deadline_shed_total": (
+        "frames dropped at executor dequeue because their client SLO "
+        "(deadline_ms meta) already expired, per node (counter)"
+    ),
+    "nns_client_queue_depth": (
+        "admitted-but-unserved requests queued per client at a query "
+        "server, by client label (gauge)"
+    ),
+    "nns_edge_nacks_total": (
+        "structured NACKs a tensor_query_client received, by reason "
+        "label (counter)"
+    ),
 }
 
 # default ladder: quarter-octave buckets from 1 µs up past 100 s —

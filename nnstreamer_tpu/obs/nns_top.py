@@ -18,6 +18,10 @@ Data sources (pick one):
 FPS is computed by differencing ``frames`` between polls when a
 previous snapshot exists (the live rate), falling back to each row's
 cumulative ``fps`` field (which includes compile/warmup).
+
+``--clients`` switches to the per-client admission view (one row per
+query-server client: queued/inflight/admitted/rejected, plus reject
+reasons — docs/edge-serving.md).
 """
 
 from __future__ import annotations
@@ -45,12 +49,19 @@ def _num(row: dict, key: str, nd: int = 1) -> str:
 
 def _notes(row: dict) -> str:
     """Compressed per-row flags: retry/circuit-breaker state from
-    FaultStats/cb_* counters, sanitizer findings, serving counters."""
+    FaultStats/cb_* counters, admission/shedding counters, sanitizer
+    findings, serving counters."""
     notes = []
     if row.get("error_retries"):
         notes.append(f"retry={row['error_retries']}")
     if row.get("error_routed"):
         notes.append(f"routed={row['error_routed']}")
+    if row.get("adm_rejected"):
+        notes.append(f"rej={row['adm_rejected']}")
+    if row.get("adm_inflight"):
+        notes.append(f"infl={row['adm_inflight']}")
+    if row.get("deadline_shed"):
+        notes.append(f"shed={row['deadline_shed']}")
     if row.get("cb_opens"):
         state = "OPEN" if row.get("cb_open") else "closed"
         notes.append(f"cb={state}({row['cb_opens']})")
@@ -120,6 +131,53 @@ def render(
     return "\n".join(lines)
 
 
+_CLIENT_COLUMNS = (
+    ("SERVER", 22), ("CLIENT", 14), ("QUEUED", 8), ("INFLIGHT", 10),
+    ("ADMITTED", 10), ("REJECTED", 0),
+)
+
+
+def render_clients(snap: dict) -> str:
+    """The ``--clients`` view: one row per (query server, client) from
+    the admission controller's per-client counters (docs/
+    edge-serving.md), plus a per-server footer with the reject reasons.
+    Empty when no node in the snapshot serves an admission-controlled
+    fleet."""
+    nodes: Dict[str, dict] = snap.get("nodes", {})
+    lines = []
+    head = "".join(
+        name.ljust(w) if w else name for name, w in _CLIENT_COLUMNS
+    )
+    for name, row in nodes.items():
+        clients = row.get("adm_clients")
+        if not isinstance(clients, dict):
+            continue
+        if not lines:
+            lines.append(head)
+            lines.append("-" * max(len(head), 64))
+        for cid, c in sorted(clients.items()):
+            cells = [
+                name[:21], str(cid)[:13], str(c.get("queued", 0)),
+                str(c.get("inflight", 0)), str(c.get("admitted", 0)),
+                str(c.get("rejected", 0)),
+            ]
+            lines.append("".join(
+                v.ljust(w) if w else v
+                for v, (_, w) in zip(cells, _CLIENT_COLUMNS)
+            ))
+        footer = []
+        reasons = row.get("adm_rejected_by_reason") or {}
+        for reason, count in sorted(reasons.items()):
+            footer.append(f"{reason}={count}")
+        if row.get("adm_rejected_conns"):
+            footer.append(f"conn-rejects={row['adm_rejected_conns']}")
+        if footer:
+            lines.append(f"  {name}: " + " ".join(footer))
+    if not lines:
+        return "(no admission-controlled query server in this snapshot)"
+    return "\n".join(lines)
+
+
 def _fetch(source: str) -> dict:
     if source.startswith(("http://", "https://")):
         url = source.rstrip("/")
@@ -171,6 +229,8 @@ def main(argv=None) -> int:
                     help="refresh period, seconds (default 1)")
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (scripting)")
+    ap.add_argument("--clients", action="store_true",
+                    help="per-client admission view (query servers)")
     args = ap.parse_args(argv)
 
     prev = None
@@ -185,7 +245,10 @@ def main(argv=None) -> int:
         dt = (now - prev_t) if prev_t is not None else None
         if not args.once and sys.stdout.isatty():
             sys.stdout.write("\x1b[2J\x1b[H")
-        print(render(snap, prev, dt))
+        if args.clients:
+            print(render_clients(snap))
+        else:
+            print(render(snap, prev, dt))
         if args.once:
             return 0
         prev, prev_t = snap, now

@@ -1,0 +1,19 @@
+"""jax-version compatibility shims for the Pallas kernels.
+
+One home (the parallel layer's analogue is ``parallel/mesh.py
+shard_map``): the next upstream rename gets fixed once, not once per
+kernel module.
+"""
+
+from __future__ import annotations
+
+
+def compiler_params(pltpu, **kw):
+    """Version-portable TPU compiler params: newer jax renames
+    ``TPUCompilerParams`` -> ``CompilerParams`` (the fields used by the
+    in-tree kernels exist in both spellings). ``pltpu`` is passed in
+    because the kernels import it lazily (CPU runs interpret)."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pragma: no cover - depends on the installed jax
+        cls = pltpu.TPUCompilerParams
+    return cls(**kw)

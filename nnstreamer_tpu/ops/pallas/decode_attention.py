@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
 NEG_INF = -1e30
 
 
@@ -182,7 +184,8 @@ def decode_attention(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, 1, h, d), jnp.float32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
 NEG_INF = -1e30
 
 
@@ -158,7 +160,8 @@ def flash_attention(
             pltpu.VMEM((bq,), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from nnstreamer_tpu.parallel.mesh import shard_map as _shard_map
+
 
 def pipeline_forward_local(
     stage_params,
@@ -73,7 +75,7 @@ def make_pipeline_forward(
     stacked_params leaves are [L, ...], sharded over ``axis`` on the
     leading dim; L must divide by the axis size. x and y are replicated.
     """
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(
             pipeline_forward_local,
             axis_name=axis,

@@ -45,6 +45,8 @@ from nnstreamer_tpu.obs import metrics as obs_metrics
 from nnstreamer_tpu.pipeline.faults import (
     FaultGate,
     PipelineStallError,
+    frame_deadline_expired,
+    notify_shed,
     resolve_fault_policy,
     watchdog_timeout_ms,
 )
@@ -286,6 +288,12 @@ class Node:
         self._needs_notify = False  # set for multi-pad scheduler nodes
         self.fault_stats = None  # FaultStats when an error policy is active
         self.fault_gate = None   # the gate itself (watchdog backoff check)
+        # deadline-aware shedding (docs/edge-serving.md): frames whose
+        # client SLO expired are dropped at dequeue, before this node
+        # spends device time on them; counted so the sanitizer's
+        # offered == delivered + dropped + routed invariant still latches
+        self.deadline_shed = 0
+        self._shed_ctr = None    # nns_deadline_shed_total handle (lazy)
         # nns-obs handles (None/empty with metrics off — the default):
         # wired by Executor._build when a registry is active
         self._lat_hist = None        # per-invoke latency histogram
@@ -377,6 +385,28 @@ class Node:
                 self.name, type(self).__name__, t0, now - t0,
                 {"frame": self.frames_processed},
             )
+
+    def shed_if_expired(self, item) -> bool:
+        """Deadline-aware shedding at dequeue (the executor ingress):
+        a frame whose client SLO already expired is dropped BEFORE it
+        consumes this node's (device) time; the edge layer NACKs the
+        client so the request still gets a terminal outcome. The check
+        is one meta lookup for frames without a deadline — the common
+        case stays effectively free."""
+        meta = getattr(item, "meta", None)
+        if not meta or "deadline_ms" not in meta:
+            return False
+        if not frame_deadline_expired(meta):
+            return False
+        self.deadline_shed += 1
+        if self._shed_ctr is None and self.ex.metrics is not None:
+            self._shed_ctr = self.ex.metrics.counter(
+                "nns_deadline_shed_total", element=self.name
+            )
+        if self._shed_ctr is not None:
+            self._shed_ctr.inc()
+        notify_shed(item, self.name)
+        return True
 
     def make_fault_gate(self, policy, elem=None) -> Optional[FaultGate]:
         """Build this node's error-policy applicator (None when the
@@ -494,6 +524,8 @@ class FusedNode(Node):
             item = self.pop(0)
             if item is EOS_FRAME:
                 break
+            if self.shed_if_expired(item):
+                continue
             if first.qos_would_drop(item):
                 # downstream rate limiter will drop this frame: skip the
                 # whole fused program (reference upstream-QoS work skip)
@@ -521,6 +553,10 @@ class FusedNode(Node):
         collector = self.make_batch_collector(cfg, self.seg.first)
         while True:
             frames, eos, wait_s = collector.collect()
+            if frames:
+                frames = [
+                    f for f in frames if not self.shed_if_expired(f)
+                ]
             if frames:
                 t0 = time.perf_counter()
                 try:
@@ -580,6 +616,8 @@ class TensorOpHostNode(Node):
                 for f in self.elem.flush():
                     self.push_out(0, f)
                 break
+            if self.shed_if_expired(item):
+                continue
             if self.elem.qos_would_drop(item):
                 for q in self.elem.qos_sources:
                     q.skipped_upstream += 1
@@ -614,6 +652,10 @@ class TensorOpHostNode(Node):
         stats = elem.batch_stats
         while True:
             frames, eos, wait_s = collector.collect()
+            if frames:
+                frames = [
+                    f for f in frames if not self.shed_if_expired(f)
+                ]
             if frames:
                 t0 = time.perf_counter()
                 try:
@@ -659,6 +701,8 @@ class HostNode(Node):
                 for f in self.elem.flush():
                     self.push_out(0, f)
                 break
+            if self.shed_if_expired(item):
+                continue
             if self.elem.qos_would_drop(item):
                 for q in self.elem.qos_sources:
                     q.skipped_upstream += 1
@@ -1342,6 +1386,16 @@ class Executor:
             fstats = n.fault_stats
             if fstats is not None and (fstats.errors or fstats.retries):
                 s.update(fstats.snapshot())
+            # deadline-aware shedding (docs/edge-serving.md)
+            if n.deadline_shed:
+                s["deadline_shed"] = n.deadline_shed
+            # admission control (edge/admission.py): per-server budget
+            # and per-client counters when the element serves a fleet
+            astats = getattr(elem, "admission_stats", None)
+            if callable(astats):
+                got = astats()
+                if got:
+                    s.update({f"adm_{k}": v for k, v in got.items()})
             # circuit-breaker fallback (tensor_filter fallback-framework/
             # fallback-model): primary failures, opens, fallback serves
             cstats = getattr(elem, "circuit_stats", None)
@@ -1394,6 +1448,10 @@ class Executor:
                 ):
                     if count:
                         dropped[reason] = dropped.get(reason, 0) + count
+            if n.deadline_shed:
+                dropped["deadline-shed"] = (
+                    dropped.get("deadline-shed", 0) + n.deadline_shed
+                )
         return {
             "produced": produced,
             "rendered": rendered,

@@ -189,6 +189,77 @@ def backoff_s(attempt: int, policy: FaultPolicy, rng: random.Random) -> float:
     return (0.5 + 0.5 * rng.random()) * full_ms / 1000.0
 
 
+def frame_deadline_expired(meta: Dict[str, Any],
+                           now: Optional[float] = None) -> bool:
+    """True when a frame's client SLO can no longer be met: the frame
+    carries a ``deadline_ms`` budget (stamped by tensor_query_client or
+    any producer) AND an ``admit_t`` local-monotonic admission stamp
+    (tensor_query_serversrc, or the producer itself), and the budget has
+    elapsed. Frames without BOTH keys never expire — shedding is strictly
+    opt-in per request (docs/edge-serving.md)."""
+    deadline_ms = meta.get("deadline_ms")
+    if deadline_ms is None:
+        return False
+    t0 = meta.get("admit_t")
+    if t0 is None:
+        return False
+    if now is None:
+        now = time.monotonic()
+    try:
+        return (now - float(t0)) * 1000.0 >= float(deadline_ms)
+    except (TypeError, ValueError):
+        return False
+
+
+def notify_shed(frame, node_name: str) -> None:
+    """A node shed `frame` at dequeue (deadline missed before device
+    time was spent). Record the trace event, and — when the frame is an
+    admitted edge request (``_nns_srv`` meta) — NACK the client and
+    release its admission budget so the request still reaches a terminal
+    outcome. The edge import is lazy: pipelines that never shed edge
+    frames never load the query layer."""
+    from nnstreamer_tpu import trace
+
+    tracer = trace.get()
+    meta = frame.meta
+    if tracer is not None:
+        tracer.fault(
+            node_name, "deadline-shed", None,
+            frame_id=meta.get("frame_id"),
+            deadline_ms=meta.get("deadline_ms"),
+        )
+    srv = meta.get("_nns_srv")
+    if srv is not None:
+        from nnstreamer_tpu.edge.query import nack_for_shed
+
+        nack_for_shed(
+            srv, meta.get("client_id"), frame_id=meta.get("frame_id")
+        )
+
+
+def notify_discard(frame, node_name: str, action: str) -> None:
+    """A fault policy disposed of ``frame`` (``drop``: consumed outright;
+    ``route``: delivered to a dead-letter consumer). When the frame is an
+    admitted edge request (``_nns_srv`` meta), return its admission
+    budget — and for drops, NACK the client (reason ``failed``) so the
+    request still reaches a terminal outcome instead of a silent
+    client-side timeout. Routed frames get no NACK: the dead-letter
+    consumer now owns the request's fate (it may even reply through the
+    serversink). Lazy edge import, same discipline as notify_shed."""
+    meta = getattr(frame, "meta", None)
+    if not meta:
+        return
+    srv = meta.get("_nns_srv")
+    if srv is None:
+        return
+    from nnstreamer_tpu.edge.query import discard_admitted
+
+    discard_admitted(
+        srv, meta.get("client_id"), action,
+        frame_id=meta.get("frame_id"),
+    )
+
+
 def make_error_frame(frame, exc: Exception, element: str):
     """Dead-letter frame: the ORIGINAL input tensors (so the consumer can
     replay or inspect the offending payload) plus structured error meta."""
@@ -300,7 +371,14 @@ class FaultGate:
             if self.route is not None:
                 self.stats.routed += 1
                 self._trace("route", exc)
-                self.route(make_error_frame(frame, exc, self.name))
+                err = make_error_frame(frame, exc, self.name)
+                if frame.meta.get("_nns_srv") is not None:
+                    # the admission budget is released HERE (below); a
+                    # dead-letter consumer replying through the
+                    # serversink must not release it a second time
+                    err = err.with_meta(_nns_budget_released=True)
+                self.route(err)
+                notify_discard(frame, self.name, "route")
                 return None
             self.stats.routed_unlinked += 1
             self.stats.dropped += 1
@@ -309,11 +387,13 @@ class FaultGate:
                 "%s: on-error=route but the error pad is unlinked; "
                 "dropping frame (%s: %s)", self.name, type(exc).__name__, exc,
             )
+            notify_discard(frame, self.name, "drop")
             return None
         self.stats.dropped += 1
         self._trace("drop", exc, attempts=attempts)
         _log.debug("%s: dropped frame after %s: %s",
                    self.name, type(exc).__name__, exc)
+        notify_discard(frame, self.name, "drop")
         return None
 
     def _trace(self, action: str, exc: Exception, **extra) -> None:

@@ -392,10 +392,12 @@ class Sanitizer:
         """Latch offered == delivered + dropped + routed for one node at
         clean EOS (the caller filters to eligible 1:1 nodes)."""
         snap = self.node_snapshot(node)
-        dropped = 0
+        # deadline sheds are counted drops: the frame was popped
+        # (offered) and disposed of with a reason before processing
+        dropped = getattr(node, "deadline_shed", 0)
         fs = getattr(node, "fault_stats", None)
         if fs is not None:
-            dropped = fs.dropped
+            dropped += fs.dropped
         balance = (
             snap["san_offered"]
             - snap["san_delivered"] - snap["san_routed"] - dropped
