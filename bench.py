@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import platform as _platform
 import statistics
 import sys
 import time
@@ -876,47 +877,7 @@ def _run() -> None:
 
     _mark("int8 measured")
 
-    # HOST-PATH EXECUTOR CEILINGS (platform-independent): trivial
-    # pipelines over host tensors measure what the executor itself —
-    # threads, channels, Frame plumbing, sync policies — costs per
-    # frame, i.e. the fps/core ceiling it imposes on any pipeline.
-    # Runs in a CPU-pinned subprocess so a TPU-attached bench process
-    # doesn't turn the trivial jit into a tunnel round-trip. Chain =
-    # 3 nodes / 2 hops; branched = tee → 2 branches → mux(slowest) =
-    # 6 nodes / 7 hops + grouping (the multi-branch pressure case).
-    def _executor_ceilings():
-        import subprocess
-
-        code = r"""
-import time, jax
-jax.config.update("jax_platforms", "cpu")
-from nnstreamer_tpu.pipeline.parse import parse_pipeline
-N = 20000
-chain = (f"tensorsrc dimensions=4 num-frames={N} ! "
-         "tensor_filter framework=passthrough ! tensor_sink sync-window=64")
-branched = (f"tensorsrc dimensions=4 num-frames={N // 2} ! tee name=t "
-            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_0 "
-            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_1 "
-            "tensor_mux name=m sync-mode=slowest ! tensor_sink "
-            "sync-window=64")
-for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
-    p = parse_pipeline(desc)
-    t0 = time.perf_counter()
-    p.run(timeout=600)
-    print(f"{label} {n / (time.perf_counter() - t0):.1f}")
-"""
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        out = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=900, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        vals = {}
-        for line in out.stdout.splitlines():
-            bits = line.split()
-            if len(bits) == 2:
-                vals[bits[0]] = float(bits[1])
-        return vals.get("chain"), vals.get("branched")
-
+    # host-path executor ceilings (see _executor_ceilings)
     executor_chain_fps = executor_branched_fps = None
     try:
         executor_chain_fps, executor_branched_fps = _executor_ceilings()
@@ -1006,6 +967,10 @@ for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
                 "vit_mb32_bytes_accessed": vit_bytes32,
                 "platform": dev.platform,
                 "device": str(dev.device_kind),
+                # --gate only hard-fails against a same-host reference:
+                # the executor ceilings are host-CPU numbers, and e.g.
+                # the TPU relay host vs a CI container differ ~5×
+                "host": _platform.node(),
             }
         )
     )
@@ -1243,6 +1208,173 @@ def _watch() -> None:
     log("watch-deadline-reached")
 
 
+def _executor_ceilings():
+    """Executor-only fps ceilings: pipelines over host tensors measure
+    what the executor itself — threads, channels, Frame plumbing, sync
+    policies — costs per frame, i.e. the fps/core ceiling it imposes on
+    any pipeline. Runs in a CPU-pinned subprocess so a TPU-attached
+    bench process doesn't turn the trivial jit into a tunnel round-trip
+    (and so the --gate numbers compare like-for-like with a TPU
+    capture's). Chain = 3 nodes / 2 hops; branched = tee → 2 branches →
+    mux(slowest) = 6 nodes / 7 hops + grouping (the multi-branch
+    pressure case)."""
+    import subprocess
+
+    code = r"""
+import time, jax
+jax.config.update("jax_platforms", "cpu")
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+N = 20000
+chain = (f"tensorsrc dimensions=4 num-frames={N} ! "
+         "tensor_filter framework=passthrough ! tensor_sink sync-window=64")
+branched = (f"tensorsrc dimensions=4 num-frames={N // 2} ! tee name=t "
+            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_0 "
+            "t. ! queue ! tensor_filter framework=passthrough ! m.sink_1 "
+            "tensor_mux name=m sync-mode=slowest ! tensor_sink "
+            "sync-window=64")
+for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
+    p = parse_pipeline(desc)
+    t0 = time.perf_counter()
+    p.run(timeout=600)
+    print(f"{label} {n / (time.perf_counter() - t0):.1f}")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=900, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    vals = {}
+    for line in out.stdout.splitlines():
+        bits = line.split()
+        if len(bits) == 2:
+            vals[bits[0]] = float(bits[1])
+    return vals.get("chain"), vals.get("branched")
+
+
+# --gate compares these keys; all must be measurable on a CPU-pinned
+# host so the gate needs no relay window. Thresholds are per-key
+# fractions of allowed drop vs the reference capture.
+GATE_KEYS = {
+    "executor_chain_fps": 0.25,
+    "executor_branched_fps": 0.25,
+}
+
+
+def _gate_reference(argv) -> tuple[str, dict] | tuple[None, None]:
+    """Resolve the reference record: an explicit path after --gate, or
+    BENCH_MEASURED_PATH, or the newest BENCH_MEASURED_*.json beside
+    this file (highest round number wins, mtime breaks ties)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    tail = argv[argv.index("--gate") + 1:][:1]
+    if tail and not tail[0].startswith("-"):
+        # explicit path: caller-relative (CWD), like any CLI file arg
+        paths = [os.path.abspath(tail[0])]
+    elif os.environ.get("BENCH_MEASURED_PATH"):
+        paths = [os.path.abspath(os.environ["BENCH_MEASURED_PATH"])]
+    else:
+        import glob
+        import re
+
+        def _key(p):
+            m = re.search(r"_r(\d+)\.json$", p)
+            return (int(m.group(1)) if m else -1, os.path.getmtime(p))
+
+        paths = sorted(
+            glob.glob(os.path.join(here, "BENCH_MEASURED_*.json")),
+            key=_key, reverse=True,
+        )
+    for p in paths:
+        try:
+            with open(p) as f:
+                return os.path.basename(p), json.load(f)
+        except Exception as exc:  # noqa: BLE001 — try the next candidate
+            print(f"[gate] unreadable reference {p}: {exc!r}",
+                  file=sys.stderr)
+    return None, None
+
+
+def _gate() -> int:
+    """Bench regression gate: re-measure the host-side executor
+    ceilings and fail (exit 1) when any gated metric has regressed more
+    than the allowed fraction vs the last measured capture — so a slide
+    like r04→r05's executor_chain_fps ~21k→13.5k can't land silently.
+    Exit 0 on pass, 2 when no reference/measurement is available
+    (a missing baseline is a setup problem, not a regression).
+
+    The gated ceilings are host-CPU numbers, so a floor breach is only
+    a hard fail (exit 1) when the reference was captured on THIS host —
+    against a foreign/unstamped reference (TPU relay host vs a CI
+    container differ ~5× on raw fps) a breach reports
+    ``stale-reference`` and exits 2 so cross-host runs can't
+    false-fail. BENCH_GATE_FORCE=1 hard-compares anyway;
+    BENCH_GATE_PCT overrides the allowed drop for every key."""
+    ref_name, ref = _gate_reference(sys.argv)
+    if not ref:
+        print(json.dumps({"gate": "skip",
+                          "reason": "no readable BENCH_MEASURED reference"}))
+        return 2
+    same_host = (
+        ref.get("host") == _platform.node()
+        or os.environ.get("BENCH_GATE_FORCE") == "1"
+    )
+    try:
+        chain, branched = _executor_ceilings()
+    except Exception as exc:  # noqa: BLE001 — a gate that cannot
+        # measure must not masquerade as a pass
+        print(json.dumps({"gate": "error", "reason": repr(exc)}))
+        return 2
+    fresh = {"executor_chain_fps": chain, "executor_branched_fps": branched}
+    override = None
+    raw_pct = os.environ.get("BENCH_GATE_PCT")
+    if raw_pct:
+        try:
+            override = float(raw_pct)
+        except ValueError:
+            print(json.dumps({
+                "gate": "error",
+                "reason": f"BENCH_GATE_PCT={raw_pct!r} is not a number",
+            }))
+            return 2
+        if override > 1.0:
+            # the name says percent: 25 means "allow a 25% drop", not a
+            # 2500% one (which would disable the gate silently)
+            override /= 100.0
+    failures, checked, skipped = [], {}, []
+    for key, allowed in GATE_KEYS.items():
+        if override is not None:
+            allowed = override
+        ref_v, new_v = ref.get(key), fresh.get(key)
+        if not ref_v or not new_v:  # absent/null/0 on either side
+            skipped.append(key)
+            continue
+        floor = float(ref_v) * (1.0 - allowed)
+        checked[key] = {
+            "reference": _round(float(ref_v)), "measured": _round(new_v),
+            "floor": _round(floor),
+            "delta_pct": _round(100.0 * (new_v - float(ref_v)) / float(ref_v)),
+        }
+        if new_v < floor:
+            failures.append(key)
+    if not checked:
+        print(json.dumps({"gate": "skip", "reference": ref_name,
+                          "reason": "no gated key present in both records",
+                          "skipped": skipped}))
+        return 2
+    status = "pass"
+    if failures:
+        status = "fail" if same_host else "stale-reference"
+    print(json.dumps({
+        "gate": status,
+        "reference": ref_name,
+        "reference_host": ref.get("host"),
+        "host": _platform.node(),
+        "failed": failures,
+        "checked": checked,
+        "skipped": skipped,
+    }, indent=1))
+    return (1 if same_host else 2) if failures else 0
+
+
 def _pipeline_batched(smoke: bool) -> None:
     """``--pipeline batched``: micro-batched vs per-frame pipeline FPS
     (pipeline/batching.py), ONE JSON line. ``--smoke`` pins CPU and
@@ -1319,6 +1451,8 @@ def main() -> None:
         return _run()
     if "--watch" in sys.argv:
         return _watch()
+    if "--gate" in sys.argv:
+        return _gate()
     if "--pipeline" in sys.argv:
         mode = sys.argv[sys.argv.index("--pipeline") + 1 :][:1]
         if mode != ["batched"]:

@@ -125,6 +125,13 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "max-inflight / per-client-inflight / rate); overload degrades "
         "as unbounded queueing and silent latency collapse",
     ),
+    "NNS-W112": (
+        Severity.WARNING, "replica-no-failover-policy",
+        "a multi-replica filter (replicas=N) keeps the default "
+        "on-error=stop: losing every replica then kills the whole "
+        "pipeline, and in a serving pipeline admitted clients hang "
+        "instead of receiving terminal NACKs",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

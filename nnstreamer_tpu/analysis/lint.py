@@ -721,6 +721,40 @@ def _admission_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _replica_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W112: replicas=N promises the stream survives a dying
+    replica, but with the default on-error=stop the day EVERY replica is
+    down (ReplicaExhaustedError) the whole pipeline dies with it — and
+    in a serving pipeline the admitted clients hang instead of getting
+    terminal NACKs. A failover deployment needs a disposal policy
+    (docs/resilience.md)."""
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.pipeline.faults import resolve_fault_policy
+
+    for e in pipeline.elements:
+        if not isinstance(e, TensorFilter):
+            continue
+        try:
+            n = int(e.get_property("replicas") or 0)
+        except (TypeError, ValueError):
+            continue  # NNS-E005 already covers the bad value
+        if n <= 1:
+            continue
+        try:
+            policy = resolve_fault_policy([e])
+        except Exception:  # noqa: BLE001 — bad policy props have their
+            continue       # own diagnostics
+        if not policy.active:
+            report.add(
+                "NNS-W112", e.name,
+                f"replicas={n} with on-error=stop: replica exhaustion "
+                "kills the pipeline instead of disposing frames "
+                "(drop/route/retry + NACK for admitted requests)",
+                "set on-error=drop|route|retry on the replicated filter "
+                "(docs/resilience.md)",
+            )
+
+
 # -- pass 4: resources -------------------------------------------------------
 
 def _resource_pass(
@@ -883,6 +917,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _fanout_join_pass(pipeline, report)
     _skewed_join_pass(pipeline, report)
     _admission_pass(pipeline, report)
+    _replica_failover_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)

@@ -186,6 +186,32 @@ class FaultyBackend(Backend):
       default invoke_batched chains invoke(), so one poisoned frame
       fails the whole window (the batch-split path under test).
     - ``seed:7`` — RNG seed (default 0).
+
+    Device-plane modes (pipeline/device_faults.py, docs/resilience.md):
+
+    - ``oom_every_n:5`` — every Nth invoke raises DeviceOOMError (host
+      path; drives the circuit after repeated hits).
+    - ``oom_above_rows:2`` — any dispatch wider than N rows raises
+      DeviceOOMError: in ``invoke_batched`` by window length, and via
+      the ``device_probe(rows)`` hook FusedSegment.process_batch calls
+      with the padded bucket before dispatching — a deterministic
+      "this device fits bucket N" boundary that exercises the fused
+      OOM-degrade ladder.
+    - ``compile_fail:true`` (with ``traceable:true``) — the traceable fn
+      raises DeviceCompileError whenever it is being TRACED (jit/vmap
+      compile of the fused program fails) while the eager path — the
+      same fn on concrete arrays — still works: the compile-fallback
+      breaker's scenario. ``compile_fail_first_n:K`` bounds the outage
+      to the first K traces so recovery probes can observe a comeback.
+    - ``device_lost_at:7`` — invoke N and every later one raise
+      DeviceLostError (a lost device stays lost; replica-failover
+      food). ``device_lost_for:M`` bounds the outage to M invokes so
+      circuit-recovery probes can observe a comeback. With
+      ``only_replica:<i>`` the loss applies only to the replica whose
+      opened ``_replica:<i>`` index matches (parallel/replicas.py
+      stamps it), so a 2-replica failover run kills exactly one.
+    - ``traceable:true`` — expose a traceable fn (so the backend can
+      fuse); trace-time injections above apply there.
     """
 
     name = "faulty"
@@ -216,9 +242,30 @@ class FaultyBackend(Backend):
             opts.get("raise_type", "backend").lower(), BackendError
         )
         self._rng = random.Random(int(opts.get("seed", "0")))
+        # device-plane chaos (pipeline/device_faults.py)
+        self._oom_every_n = int(opts.get("oom_every_n", "0"))
+        self._oom_above_rows = int(opts.get("oom_above_rows", "0"))
+        self._compile_fail = _parse_bool(opts.get("compile_fail", "false"))
+        self._compile_fail_first_n = int(opts.get("compile_fail_first_n", "0"))
+        self._device_lost_at = int(opts.get("device_lost_at", "0"))
+        self._device_lost_for = int(opts.get("device_lost_for", "0"))
+        self._traceable = _parse_bool(opts.get("traceable", "false"))
+        # replica scoping: parallel/replicas.py opens each replica with
+        # `_replica:<i>` appended to custom; only_replica:<i> restricts
+        # the device-plane injections to that one instance so failover
+        # runs kill exactly the replica they mean to
+        self._replica_idx = opts.get("_replica")
+        only = opts.get("only_replica")
+        self._inject = (
+            only is None
+            or (self._replica_idx is not None
+                and int(only) == int(self._replica_idx))
+        )
         self.invokes = 0
         self.failures = 0
         self.batched_calls = 0
+        self.device_faults = 0
+        self.traces = 0  # traceable-fn trace-time entries observed
 
     def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
         if self._spec is None:
@@ -240,6 +287,50 @@ class FaultyBackend(Backend):
             self.failures += 1
             raise self._exc(f"faulty: injected failure on invoke {n}")
 
+    def _device_fault(self, exc_cls, msg: str):
+        from nnstreamer_tpu.pipeline.device_faults import DeviceFaultError
+
+        assert issubclass(exc_cls, DeviceFaultError)
+        self.failures += 1
+        self.device_faults += 1
+        raise exc_cls(msg)
+
+    def _maybe_device_fail(self) -> None:
+        if not self._inject:
+            return
+        from nnstreamer_tpu.pipeline.device_faults import (
+            DeviceLostError,
+            DeviceOOMError,
+        )
+
+        n = self.invokes
+        if self._device_lost_at and n >= self._device_lost_at and (
+            not self._device_lost_for
+            or n < self._device_lost_at + self._device_lost_for
+        ):
+            self._device_fault(
+                DeviceLostError, f"faulty: device lost at invoke {n}"
+            )
+        if self._oom_every_n and n % self._oom_every_n == 0:
+            self._device_fault(
+                DeviceOOMError, f"faulty: RESOURCE_EXHAUSTED on invoke {n}"
+            )
+
+    def device_probe(self, rows: int) -> None:
+        """Deterministic device-capacity boundary for the fused batched
+        path: FusedSegment.process_batch probes every member backend
+        with the padded bucket before dispatching, so a bucket wider
+        than ``oom_above_rows`` OOMs exactly like a real
+        RESOURCE_EXHAUSTED from the stacked program would."""
+        from nnstreamer_tpu.pipeline.device_faults import DeviceOOMError
+
+        if self._inject and self._oom_above_rows and rows > self._oom_above_rows:
+            self._device_fault(
+                DeviceOOMError,
+                f"faulty: RESOURCE_EXHAUSTED allocating {rows} rows "
+                f"(fits {self._oom_above_rows})",
+            )
+
     def invoke(self, tensors: Tuple[Any, ...]) -> Tuple[Any, ...]:
         import time as _t
 
@@ -254,12 +345,49 @@ class FaultyBackend(Backend):
                         f"faulty: corrupted frame — tensor shape "
                         f"{np.asarray(t).shape} != spec {ts.shape}"
                     )
+        self._maybe_device_fail()
         self._maybe_fail()
         return tensors
 
     def invoke_batched(self, batch):
         self.batched_calls += 1
+        self.device_probe(len(batch))
         return super().invoke_batched(batch)
+
+    def traceable_fn(self) -> Optional[Callable]:
+        """Identity fn when ``traceable:true`` (the backend then fuses
+        like a jax model); with ``compile_fail`` the fn raises
+        DeviceCompileError when it sees TRACERS (a jit/vmap compile of
+        the fused program) but passes concrete arrays through — the
+        compile-breaker's exact scenario: the jitted path is broken,
+        the eager path still serves."""
+        if not self._traceable:
+            return None
+
+        def fn(tensors):
+            import jax
+
+            tracing = any(
+                isinstance(t, jax.core.Tracer) for t in tensors
+            )
+            if tracing:
+                self.traces += 1
+                if self._inject and self._compile_fail and (
+                    not self._compile_fail_first_n
+                    or self.traces <= self._compile_fail_first_n
+                ):
+                    from nnstreamer_tpu.pipeline.device_faults import (
+                        DeviceCompileError,
+                    )
+
+                    self.device_faults += 1
+                    raise DeviceCompileError(
+                        f"faulty: injected compilation failure "
+                        f"(trace {self.traces})"
+                    )
+            return tensors
+
+        return fn
 
 
 @registry.filter_backend("framecounter")
@@ -286,3 +414,12 @@ class FrameCounterBackend(Backend):
         out = np.array([self._count], dtype=np.uint32)
         self._count += 1
         return (out,)
+
+    # warm restart (docs/resilience.md): the running count is exactly
+    # the kind of per-element state Executor.snapshot()/restore() exists
+    # to carry across a drain/resume round-trip
+    def state_snapshot(self) -> dict:
+        return {"count": self._count}
+
+    def state_restore(self, snap: dict) -> None:
+        self._count = int(snap.get("count", 0))

@@ -42,17 +42,29 @@ _cache_initialized = False
 
 
 def _init_persistent_cache() -> None:
-    """[jax] persistent_cache = DIR enables XLA's on-disk compilation cache
-    — the checkpoint/resume analogue for an inference framework (SURVEY.md
-    §5.4: compiled-executable persistence), cutting model-open time on
-    every process restart."""
+    """``NNS_TPU_COMPILE_CACHE_DIR`` (or ``[jax] persistent_cache``)
+    enables XLA's on-disk compilation cache — the checkpoint/resume
+    analogue for an inference framework (SURVEY.md §5.4:
+    compiled-executable persistence), cutting model-open time on every
+    process restart. The warm-restart path (Executor.drain/snapshot/
+    resume, docs/resilience.md) leans on it: a restarted pipeline
+    replays its programs from disk and reaches steady-state fps in
+    seconds instead of a cold recompile.
+
+    Corruption tolerant by construction: cache errors are forced
+    non-fatal (``jax_raise_persistent_cache_errors=False``), so a
+    truncated/garbage entry logs and recompiles — a stale cache can
+    slow a restart down, never crash it."""
     global _cache_initialized
     if _cache_initialized:
         return
     _cache_initialized = True
     from nnstreamer_tpu.config import conf
 
-    cache_dir = conf().get("jax", "persistent_cache")
+    cache_dir = (
+        os.environ.get("NNS_TPU_COMPILE_CACHE_DIR")
+        or conf().get("jax", "persistent_cache")
+    )
     if not cache_dir:
         return
     cache_dir = os.path.expanduser(cache_dir)
@@ -86,6 +98,9 @@ def _init_persistent_cache() -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # a bad cache entry (truncated write, version skew, bit rot) must
+        # log + recompile, never kill the pipeline
+        jax.config.update("jax_raise_persistent_cache_errors", False)
         _log.info("persistent compilation cache at %s", cache_dir)
     except Exception as exc:  # cache is an optimization, never fatal
         _log.warning("persistent cache setup failed: %s", exc)

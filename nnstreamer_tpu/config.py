@@ -77,6 +77,14 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         # stall watchdog: >0 arms the executor monitor thread that turns
         # a no-progress-with-queued-data hang into PipelineStallError
         "watchdog_timeout_ms": "0",
+        # device-resilience defaults (pipeline/device_faults.py,
+        # docs/resilience.md); per-element oom-policy/device-fallback
+        # properties override. Env: NNS_TPU_EXECUTOR_OOM_POLICY etc.
+        "oom_policy": "degrade",
+        "device_fallback": "true",
+        "device_fallback_after": "3",
+        "device_probe_every": "64",
+        "oom_reprobe_ms": "30000.0",
         # nns-san runtime sanitizer (pipeline/sanitize.py): instrumented
         # channels assert negotiated-spec conformance per frame, latch
         # offered == delivered + dropped + routed per node at EOS, watch

@@ -115,6 +115,24 @@ FAULT_PROPS: Dict[str, PropSpec] = {
     ),
 }
 
+#: device-resilience property surface (pipeline/device_faults.py,
+#: docs/resilience.md): spread into tensor_filter's PROPERTIES; the
+#: resolver merges element values over the [executor] defaults.
+DEVICE_PROPS: Dict[str, PropSpec] = {
+    "oom-policy": PropSpec(
+        "enum", None, ("degrade", "stop"),
+        desc="on device OOM: degrade (shrink the batch bucket, remember "
+        "the safe ceiling, re-probe after a cooldown) or stop "
+        "(default degrade; docs/resilience.md)",
+    ),
+    "device-fallback": PropSpec(
+        "bool", None,
+        desc="serve from the host/eager path when the compiled device "
+        "program fails (compile failure, repeated device faults); "
+        "probes the device path for recovery (default true)",
+    ),
+}
+
 
 def install_error_pad(elem: "Element") -> None:
     """Expose the dead-letter error pad on ``elem`` when its ``on-error``
@@ -312,6 +330,11 @@ class TensorOp(Element):
     # Plan-time resolved FaultPolicy (pipeline/faults.py) for host-path
     # ops; fused segments carry their own on FusedSegment.
     fault_policy: Optional[Any] = None
+
+    # Plan-time resolved device-resilience policy dict
+    # (pipeline/device_faults.py resolve_device_policy); fused segments
+    # carry their own on FusedSegment.
+    device_policy: Optional[Any] = None
 
     # Bumped whenever the op's make_fn() result changes without a shape
     # change (model hot swap via reload_model): part of FusedSegment's

@@ -45,7 +45,9 @@ class TensorChaos(HostElement):
     tensors are shape-truncated and tagged ``chaos_corrupted`` meta),
     ``delay-ms``/``delay-every-n`` (latency injection), ``hang-on-frame``/
     ``hang-ms`` (one bounded hang, for stall-watchdog tests),
-    ``raise-type`` (element|value|runtime), ``seed``. Combine with
+    ``raise-type`` (element|value|runtime), ``device-fault-kind``/
+    ``device-fault-every-n`` (typed device-plane faults for the
+    resilience layer, docs/resilience.md), ``seed``. Combine with
     ``on-error`` to exercise this element's own policy, or place it
     upstream of a strict backend (``framework=faulty
     custom=strict_shapes:true``) to drive the downstream policy."""
@@ -79,6 +81,19 @@ class TensorChaos(HostElement):
             "enum", "element", ("element", "value", "runtime"),
             desc="exception class injected failures raise",
         ),
+        # device-plane chaos (pipeline/device_faults.py): raise a TYPED
+        # device fault so the resilience layer — classifier, replica
+        # failover, NACK/release accounting — is drivable from any
+        # pipeline position without a faulty backend
+        "device-fault-kind": PropSpec(
+            "enum", "", ("", "oom", "compile", "device_lost", "transient"),
+            desc="device fault class device-fault-every-n injects "
+            "(docs/resilience.md)",
+        ),
+        "device-fault-every-n": PropSpec(
+            "int", 0,
+            desc="every Nth frame raises the typed device fault (0 = never)",
+        ),
         "seed": PropSpec("int", 0, desc="RNG seed (reproducible chaos)"),
         **FAULT_PROPS,
     }
@@ -99,10 +114,30 @@ class TensorChaos(HostElement):
                 f"{'/'.join(_RAISES)}"
             )
         self._exc = _RAISES[raise_type]
+        self.device_fault_kind = str(
+            self.get_property("device-fault-kind", "") or ""
+        ).lower()
+        self.device_fault_every_n = int(
+            self.get_property("device-fault-every-n", 0)
+        )
+        if self.device_fault_every_n and not self.device_fault_kind:
+            raise ValueError(
+                f"{self.name}: device-fault-every-n needs device-fault-kind"
+            )
         self._rng = random.Random(int(self.get_property("seed", 0)))
         self._n = 0
         self._hung = False
         install_error_pad(self)
+
+    def _device_exc(self):
+        from nnstreamer_tpu.pipeline import device_faults as df
+
+        return {
+            "oom": df.DeviceOOMError,
+            "compile": df.DeviceCompileError,
+            "device_lost": df.DeviceLostError,
+            "transient": df.DeviceFaultError,
+        }[self.device_fault_kind]
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs
@@ -126,6 +161,11 @@ class TensorChaos(HostElement):
                 time.sleep(0.025)
         if self.delay_ms > 0 and n % self.delay_every_n == 0:
             time.sleep(self.delay_ms / 1000.0)
+        if self.device_fault_every_n and n % self.device_fault_every_n == 0:
+            raise self._device_exc()(
+                f"{self.name}: injected {self.device_fault_kind} device "
+                f"fault on frame {n}"
+            )
         if self.fail_every_n and n % self.fail_every_n == 0:
             raise self._exc(f"{self.name}: injected failure on frame {n}")
         if self.fail_rate and self._rng.random() < self.fail_rate:

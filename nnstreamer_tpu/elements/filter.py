@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from nnstreamer_tpu import registry
 from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps, InvokeStats
 from nnstreamer_tpu.elements.base import (
+    DEVICE_PROPS,
     FAULT_PROPS,
     NegotiationError,
     PropSpec,
@@ -173,6 +174,9 @@ class TensorFilter(TensorOp):
         ),
         # per-frame error policy (pipeline/faults.py)
         **FAULT_PROPS,
+        # device-resilience policy (pipeline/device_faults.py): OOM
+        # bucket degradation + compiled-path fallback circuit
+        **DEVICE_PROPS,
         # graceful degradation: after fallback-after CONSECUTIVE backend
         # failures the filter hot-swaps to the fallback backend (circuit
         # breaker) instead of dying, probing the primary every
@@ -188,6 +192,28 @@ class TensorFilter(TensorOp):
         ),
         "fallback-probe-every": PropSpec(
             "int", 64, desc="frames between primary recovery probes"
+        ),
+        # replica failover (parallel/replicas.py, docs/resilience.md):
+        # replicas=N opens N backend instances and load-balances frames
+        # over them; a replica with replica-unhealthy-after consecutive
+        # device faults leaves the rotation (its in-flight frame fails
+        # over), probed for recovery every replica-probe-every frames
+        "replicas": PropSpec(
+            "int", None,
+            desc="open N backend replicas with failover (default off)",
+        ),
+        "replica-devices": PropSpec(
+            "str", "",
+            desc="comma list of device indices to pin replicas to "
+            "(round-robin when fewer than replicas)",
+        ),
+        "replica-unhealthy-after": PropSpec(
+            "int", 3,
+            desc="consecutive device faults that bench a replica",
+        ),
+        "replica-probe-every": PropSpec(
+            "int", 64,
+            desc="frames between benched-replica recovery probes",
         ),
     }
 
@@ -250,6 +276,48 @@ class TensorFilter(TensorOp):
         self.fallback_probe_every = max(
             1, int(self.get_property("fallback-probe-every", 64))
         )
+        # replica failover (parallel/replicas.py): replicas=N dispatches
+        # per-frame over N opened backends — a fusion barrier like the
+        # fallback circuit (health is per-frame, a fused program is not)
+        self.replicas = int(self.get_property("replicas", 0) or 0)
+        self.replica_devices = [
+            int(d) for d in str(
+                self.get_property("replica-devices", "") or ""
+            ).split(",") if str(d).strip()
+        ]
+        self.replica_unhealthy_after = max(
+            1, int(self.get_property("replica-unhealthy-after", 3))
+        )
+        self.replica_probe_every = max(
+            1, int(self.get_property("replica-probe-every", 64))
+        )
+        self._replica_set = None  # ReplicaSet, built lazily post-negotiate
+        self._replica_backends: list = []
+        # warm-restart state arriving before the backend/replica set
+        # exist (both build lazily on the first frame) — stashed here
+        # and applied as each comes up, the Node._pending_restore
+        # discipline one level down
+        self._pending_state: Optional[Dict[str, Any]] = None
+        if self.replicas > 1 and self.shared_key:
+            # shared key = ONE opened backend for all sharers; replicas =
+            # N independent copies. Both at once is a contradiction.
+            raise ValueError(
+                f"{self.name}: replicas={self.replicas} cannot combine "
+                "with shared-tensor-filter-key (one shared instance vs "
+                "N independent copies)"
+            )
+        if self.replicas > 1 and self._fallback_conf:
+            # host_process dispatches through the replica set before the
+            # fallback circuit is ever consulted — accepting both would
+            # silently never open the fallback backend. Survival past
+            # replica exhaustion is the on-error policy's job
+            # (docs/resilience.md degradation ladder).
+            raise ValueError(
+                f"{self.name}: replicas={self.replicas} cannot combine "
+                "with fallback-framework/fallback-model (failover "
+                "replaces the fallback circuit; use on-error for "
+                "post-exhaustion disposal)"
+            )
         self._fb_backend: Optional[Backend] = None
         self._fb_open_error: Optional[Exception] = None
         self._consec_failures = 0
@@ -267,11 +335,28 @@ class TensorFilter(TensorOp):
         self._elem_stats = InvokeStats()
 
     # -- lifecycle ---------------------------------------------------------
-    def _open_backend(self) -> Backend:
+    def _open_backend(self, custom_extra: str = "") -> Backend:
         cls = registry.get(registry.KIND_FILTER, self.fprops.framework)
         b: Backend = cls()
-        b.open(self.fprops)
+        props = self.fprops
+        if custom_extra:
+            joined = ",".join(x for x in (props.custom, custom_extra) if x)
+            props = dataclasses.replace(props, custom=joined)
+        b.open(props)
         return b
+
+    def _replica_custom(self, i: int) -> str:
+        """Per-replica custom-string suffix: the index (chaos injectors
+        scope device-plane faults to one replica via ``only_replica``)
+        plus the pinned device when ``replica-devices`` says so."""
+        extra = f"_replica:{i}"
+        if self.replica_devices:
+            dev = self.replica_devices[i % len(self.replica_devices)]
+            # `device` is the key the jax backend's per-stage placement
+            # actually reads (jax_backend.open) — pinning replicas to
+            # distinct chips is the whole point of replica-devices
+            extra += f",device:{dev}"
+        return extra
 
     def _ensure_open(self) -> Backend:
         if self.backend is None:
@@ -279,8 +364,12 @@ class TensorFilter(TensorOp):
                 self.backend = _shared_acquire(
                     self.shared_key, self.fprops, self._open_backend
                 )
+            elif self.replicas > 1:
+                # replica 0 doubles as the negotiation/model-info backend
+                self.backend = self._open_backend(self._replica_custom(0))
             else:
                 self.backend = self._open_backend()
+            self._apply_pending_state()
         return self.backend
 
     def stop(self) -> None:
@@ -291,6 +380,18 @@ class TensorFilter(TensorOp):
                 self.backend.close()
             self.backend = None
             self._traceable = None
+        # replicas 1..N-1 (replica 0 IS self.backend, closed above)
+        for b in self._replica_backends[1:]:
+            try:
+                b.close()
+            except Exception as exc:  # noqa: BLE001 — teardown best-effort
+                _log.warning("%s: replica close failed: %s", self.name, exc)
+        self._replica_backends = []
+        if self._replica_set is not None:
+            # stats survive teardown (like _elem_stats): post-run
+            # assertions and nns-top's final poll read them after stop
+            self._replica_last_stats = self._replica_set.stats()
+            self._replica_set = None
         if self._fb_backend is not None:
             self._fb_backend.close()
             self._fb_backend = None
@@ -383,6 +484,10 @@ class TensorFilter(TensorOp):
             # circuit-breaker hot swap needs per-frame invokes: the filter
             # is a deliberate fusion barrier in degradable mode
             return False
+        if self.replicas > 1:
+            # replica failover is per-frame health-tracked dispatch —
+            # a fused program cannot change replicas mid-stream
+            return False
         b = self._ensure_open()
         return b.traceable_fn() is not None
 
@@ -412,7 +517,123 @@ class TensorFilter(TensorOp):
             raise RuntimeError(f"{self.name}: backend not traceable")
         return self._apply_combinations(traced)
 
+    # -- replica failover (parallel/replicas.py) ---------------------------
+    def _ensure_replicas(self):
+        """Open replicas 1..N-1 beside the negotiation backend (replica
+        0) and build the ReplicaSet over all N. Lazy: model copies load
+        on the first frame, after negotiation settled the specs."""
+        if self._replica_set is None:
+            from nnstreamer_tpu.parallel.replicas import ReplicaSet
+
+            backends = [self._ensure_open()]
+            try:
+                for i in range(1, self.replicas):
+                    backends.append(
+                        self._open_backend(self._replica_custom(i))
+                    )
+            except Exception:
+                # replica 0 is self.backend (stop() owns it); close the
+                # partially-opened tail or a retried first frame leaks a
+                # fresh copy of every model arena per attempt
+                for b in backends[1:]:
+                    try:
+                        b.close()
+                    except Exception as exc:  # noqa: BLE001 — best-effort
+                        _log.warning(
+                            "%s: replica close failed: %s", self.name, exc
+                        )
+                raise
+            self._replica_backends = backends
+            self._replica_set = ReplicaSet(
+                [self._make_replica_invoke(b) for b in backends],
+                unhealthy_after=self.replica_unhealthy_after,
+                probe_every=self.replica_probe_every,
+            )
+            self._apply_pending_state()
+        return self._replica_set
+
+    def _make_replica_invoke(self, b: Backend):
+        def invoke(frame: Frame) -> Frame:
+            fn = self._apply_combinations(b.invoke_timed)
+            t0 = time.perf_counter_ns()
+            out = fn(frame.tensors)
+            self._elem_stats.record(time.perf_counter_ns() - t0)
+            return frame.with_tensors(out)
+
+        return invoke
+
+    def replica_stats(self) -> Dict[str, Any]:
+        """Failover observability (Executor.stats() surfaces these as
+        ``rep_*``); {} when replicas are off so stats stay noise-free."""
+        if self._replica_set is None:
+            return getattr(self, "_replica_last_stats", {})
+        return self._replica_set.stats()
+
+    # -- warm restart (docs/resilience.md) ---------------------------------
+    def state_snapshot(self) -> Dict[str, Any]:
+        """Executor.snapshot() hook: the opened backend's own state (a
+        framecounter-style stateful backend) plus replica health, so a
+        drain/snapshot/resume round-trip neither re-serves a benched
+        replica nor re-discovers its sickness frame by frame."""
+        d: Dict[str, Any] = {}
+        hook = getattr(self.backend, "state_snapshot", None)
+        if callable(hook):
+            d["backend"] = hook()
+        if self._replica_set is not None:
+            d["replica_set"] = self._replica_set.snapshot()
+            # replicas 1..N-1 are independent backend copies with their
+            # own state (replica 0 IS self.backend, captured above) —
+            # index-aligned list, None for stateless replicas
+            reps = []
+            for b in self._replica_backends[1:]:
+                h = getattr(b, "state_snapshot", None)
+                reps.append(h() if callable(h) else None)
+            if any(r is not None for r in reps):
+                d["replica_backends"] = reps
+        return d
+
+    def state_restore(self, snap: Dict[str, Any]) -> None:
+        """Restoring into a FRESH executor happens before the first
+        frame, when the backend is unopened and the replica set unbuilt
+        — applying eagerly would silently drop replica health and
+        backend state. Stash and apply what exists now; _ensure_open /
+        _ensure_replicas re-apply the rest once their target is up."""
+        self._pending_state = dict(snap)
+        self._apply_pending_state()
+
+    def _apply_pending_state(self) -> None:
+        snap = self._pending_state
+        if not snap:
+            return
+        if "backend" in snap and self.backend is not None:
+            hook = getattr(self.backend, "state_restore", None)
+            if callable(hook):
+                hook(snap["backend"])
+            del snap["backend"]
+        if "replica_set" in snap and self._replica_set is not None:
+            self._replica_set.restore(snap["replica_set"])
+            del snap["replica_set"]
+        if "replica_backends" in snap and self._replica_backends:
+            for b, s in zip(
+                self._replica_backends[1:], snap["replica_backends"]
+            ):
+                if s is None:
+                    continue
+                h = getattr(b, "state_restore", None)
+                if callable(h):
+                    h(s)
+            del snap["replica_backends"]
+        if not snap:
+            self._pending_state = None
+
     def host_process(self, frame: Frame) -> Frame:
+        if self.replicas > 1:
+            # device faults fail the frame over to the next healthy
+            # replica; ReplicaExhaustedError (nothing healthy) falls to
+            # this node's on-error policy — for admitted edge requests
+            # that NACKs the client and releases its admission budget
+            # exactly once (PR-6 accounting)
+            return self._ensure_replicas().dispatch(frame)
         if not self._fallback_conf:
             return self._invoke_primary(frame)
         # circuit breaker (docs/fault-tolerance.md): consecutive primary
@@ -555,6 +776,10 @@ class TensorFilter(TensorOp):
         if getattr(self, "_flexible_input", False):
             return False
         if self._fallback_conf:
+            return False
+        if self.replicas > 1:
+            # failover granularity is one frame: a window dispatched to
+            # a dying replica would fail over whole
             return False
         return bool(getattr(self._ensure_open(), "batchable", False))
 

@@ -2042,7 +2042,19 @@ class ContinuousBatcher:
                     )
                     r = min(r, (self.max_len - pos_max) // k)
                 remaining, stop = self._pump_host_state(active_np)
-                r = min(r, int(remaining.max()))  # budget caps rounds
+                # NOT clamped by remaining budget: slots that exhaust
+                # their budget mid-scan idle out ON DEVICE (active &=
+                # budget > 0), exactly like step_pump's fixed n_steps.
+                # Clamping here looked like a harmless economy but made
+                # the STATIC scan length a function of live budgets —
+                # so a warm-up drain compiled rounds=2/1 programs, the
+                # measured drain then built rounds=4 inside the timed
+                # region, and every budget tail recompiled its way down
+                # a 4→2→1 program ladder: the spec×cb throughput
+                # collapse (BENCH_CPU_FULL_r05: 8.0/4.8 vs 25.5 plain).
+                # The only static clamp that stays is write-room
+                # (cache-bounds correctness), quantized so the window
+                # tail costs log2 variants, not one per length.
                 if r >= 1:
                     while r & (r - 1):  # power-of-two floor (see above)
                         r &= r - 1

@@ -81,6 +81,16 @@ METRIC_CATALOG: Dict[str, str] = {
         "structured NACKs a tensor_query_client received, by reason "
         "label (counter)"
     ),
+    "nns_device_faults_total": (
+        "device-plane faults classified per element, by kind label: "
+        "oom / compile / device_lost / transient (counter; "
+        "docs/resilience.md)"
+    ),
+    "nns_degraded_segments": (
+        "1 while a segment serves degraded — device circuit open "
+        "(host/eager path) or OOM batch ceiling below the full ladder — "
+        "else 0, per element (gauge; docs/resilience.md)"
+    ),
 }
 
 # default ladder: quarter-octave buckets from 1 µs up past 100 s —

@@ -278,6 +278,11 @@ class BatchCollector:
     ``drop`` is the per-frame upstream-QoS predicate (frames a
     downstream rate limiter will certainly discard are skipped before
     they can occupy batch slots).
+
+    ``cap`` is an optional live window-limit callable — the OOM bucket
+    governor's ceiling (pipeline/device_faults.py): a degraded segment
+    collects at most ``min(max_batch, cap())`` per window, re-read per
+    collect so upward re-probes widen collection again.
     """
 
     def __init__(
@@ -286,11 +291,13 @@ class BatchCollector:
         stop_event: threading.Event,
         config: BatchConfig,
         drop: Optional[Callable[[Any], bool]] = None,
+        cap: Optional[Callable[[], int]] = None,
     ) -> None:
         self.chan = chan
         self.stop_event = stop_event
         self.config = config
         self.drop = drop
+        self.cap = cap
         self._pending_eos = False
 
     def collect(self) -> Tuple[List[Any], bool, float]:
@@ -298,6 +305,9 @@ class BatchCollector:
             self._pending_eos = False
             return [], True, 0.0
         cfg = self.config
+        limit = cfg.max_batch
+        if self.cap is not None:
+            limit = max(1, min(limit, self.cap()))
         batch: List[Any] = []
         # first frame: plain blocking pop (frame path latency untouched)
         while True:
@@ -309,13 +319,13 @@ class BatchCollector:
             batch.append(item)
             break
         # drain-what's-there: everything already queued rides this batch
-        eos = self._drain_queued(batch, cfg.max_batch)
+        eos = self._drain_queued(batch, limit)
         wait_s = 0.0
         if (
             not eos
             and len(batch) == 1
             and cfg.timeout_ms > 0.0
-            and cfg.max_batch > 1
+            and limit > 1
         ):
             # trickle-fed: bounded wait for stragglers. One wake is
             # enough — whatever arrived by then is the batch (waiting
@@ -332,7 +342,7 @@ class BatchCollector:
                 else:
                     batch.append(item)
                 if not eos:
-                    eos = self._drain_queued(batch, cfg.max_batch)
+                    eos = self._drain_queued(batch, limit)
             wait_s = time.perf_counter() - t0
         if eos and batch:
             # deliver the flushed batch now; report EOS on the next call

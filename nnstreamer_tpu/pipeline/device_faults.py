@@ -1,0 +1,393 @@
+"""Device-plane resilience: classify backend faults, degrade, recover.
+
+PR 3/PR 6 made the frame and client planes fault-tolerant, but the thing
+the paper makes TPU-native — the device plane — still died on first
+contact: an XLA ``RESOURCE_EXHAUSTED`` inside a fused segment, a
+Pallas/jit compile failure, or a lost device killed the executor with no
+degradation path. This module is the missing layer
+(docs/resilience.md):
+
+- :func:`classify_device_fault` buckets backend exceptions into
+  ``oom | compile | device_lost | transient`` (None for ordinary
+  element errors — those stay with pipeline/faults.py's per-frame
+  policies). Classification is by typed :class:`DeviceFaultError`
+  first (the chaos injectors raise these), then by status-message
+  sniffing on real XLA runtime errors.
+- :class:`BucketGovernor` is the OOM ladder: on OOM the batch bucket
+  HALVES (next ladder rung down) and the segment remembers the safe
+  ceiling, so adaptive batching can never OOM-loop; after a cooldown
+  it re-probes one rung up, reclaiming headroom when the pressure
+  (a neighbor's arena, fragmentation) goes away.
+- :class:`DeviceCircuit` is the compile/dispatch breaker: a compile
+  failure (deterministic — retrying recompiles forever) opens it
+  immediately, repeated device faults open it after ``after``
+  consecutive hits; while open the segment serves from the host/eager
+  path (FusedSegment.process_eager) and probes the jitted path every
+  ``probe_every`` frames, closing on recovery. ``device_degraded``
+  surfaces in Executor.stats() and nns-obs.
+
+The executor (FusedNode/TensorOpHostNode batched loops) wires these per
+segment; parallel/replicas.py reuses the classifier for replica health.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("device_faults")
+
+DEVICE_FAULT_KINDS = ("oom", "compile", "device_lost", "transient")
+
+
+class DeviceFaultError(RuntimeError):
+    """Typed device-plane fault (base). The chaos injectors
+    (backends/fakes.py FaultyBackend, elements/chaos.py tensor_chaos)
+    raise these so every degradation path is deterministically
+    testable; real XLA errors classify by message instead."""
+
+    kind = "transient"
+
+
+class DeviceOOMError(DeviceFaultError):
+    """Device memory exhausted (XLA RESOURCE_EXHAUSTED analogue)."""
+
+    kind = "oom"
+
+
+class DeviceCompileError(DeviceFaultError):
+    """XLA/Pallas compilation failed for this program."""
+
+    kind = "compile"
+
+
+class DeviceLostError(DeviceFaultError):
+    """The accelerator went away (preemption, reset, link loss)."""
+
+    kind = "device_lost"
+
+
+class ReplicaExhaustedError(RuntimeError):
+    """Every replica in a ReplicaSet is unhealthy (parallel/replicas.py);
+    carries the last underlying device fault as __cause__."""
+
+
+# status markers, checked in order — OOM before compile: an OOM raised
+# DURING compilation ("while allocating ... for buffer assignment") is a
+# memory problem, shrinking helps, recompiling the same program doesn't
+_OOM_MARKERS = (
+    "resource_exhausted", "out of memory", "out_of_memory", "oom",
+    "allocation failure", "ran out of memory",
+)
+_COMPILE_MARKERS = (
+    "compilation failure", "compilation failed", "failed to compile",
+    "mosaic", "unimplemented", "unsupported hlo", "lowering",
+)
+_DEVICE_LOST_MARKERS = (
+    "device lost", "device_lost", "device is lost", "device unavailable",
+    "failed to connect", "socket closed", "connection reset",
+    "deadline_exceeded", "device not found", "tpu driver",
+)
+
+
+def _is_xla_error(exc: BaseException) -> bool:
+    # jaxlib.xla_extension.XlaRuntimeError without a hard jaxlib import
+    # (class path moved across jax releases; the name has not)
+    for klass in type(exc).__mro__:
+        if klass.__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+            return True
+    return False
+
+
+def classify_device_fault(exc: BaseException) -> Optional[str]:
+    """``oom | compile | device_lost | transient`` for device-plane
+    faults; None for ordinary element errors (bad input, user code) —
+    those belong to the per-frame on-error policies, not the device
+    resilience layer."""
+    if isinstance(exc, DeviceFaultError):
+        return exc.kind
+    if not _is_xla_error(exc):
+        return None
+    msg = str(exc).lower()
+    for marker in _OOM_MARKERS:
+        if marker in msg:
+            return "oom"
+    for marker in _COMPILE_MARKERS:
+        if marker in msg:
+            return "compile"
+    for marker in _DEVICE_LOST_MARKERS:
+        if marker in msg:
+            return "device_lost"
+    return "transient"
+
+
+def _executor_device_defaults() -> dict:
+    """[executor] device-resilience defaults (env ``NNS_TPU_EXECUTOR_*``
+    outranks ini — the standard config layering). Malformed values fall
+    back with a warning, same discipline as the batching/fault
+    defaults."""
+    from nnstreamer_tpu.config import conf
+
+    c = conf()
+
+    def _num(key: str, cast, fallback):
+        raw = c.get("executor", key, str(fallback))
+        try:
+            return cast(raw)
+        except ValueError:
+            _log.warning(
+                "[executor] %s=%r is not a valid %s; using %s",
+                key, raw, cast.__name__, fallback,
+            )
+            return fallback
+
+    oom_policy = c.get("executor", "oom_policy", "degrade").strip().lower()
+    if oom_policy not in ("degrade", "stop"):
+        _log.warning(
+            "[executor] oom_policy=%r not one of degrade/stop; "
+            "using 'degrade'", oom_policy,
+        )
+        oom_policy = "degrade"
+    return {
+        "oom-policy": oom_policy,
+        "device-fallback": c.get_bool("executor", "device_fallback", True),
+        "device-fallback-after": _num("device_fallback_after", int, 3),
+        "device-probe-every": _num("device_probe_every", int, 64),
+        "oom-reprobe-ms": _num("oom_reprobe_ms", float, 30000.0),
+    }
+
+
+def resolve_device_policy(elements: Sequence[Any]) -> Dict[str, Any]:
+    """Merge element-level ``oom-policy``/``device-fallback`` properties
+    over the ``[executor]`` defaults — chain-order scan, first element
+    that sets a knob wins (the resolve_batch_config discipline)."""
+    from nnstreamer_tpu.elements.base import _parse_bool
+
+    defaults = _executor_device_defaults()
+    oom_policy: Optional[str] = None
+    fallback: Optional[bool] = None
+    for e in elements:
+        get = getattr(e, "get_property", None)
+        if get is None:
+            continue
+        if oom_policy is None and get("oom-policy") is not None:
+            raw = str(get("oom-policy")).strip().lower()
+            if raw not in ("degrade", "stop"):
+                raise ValueError(
+                    f"{getattr(e, 'name', e)}: oom-policy={raw!r} not one "
+                    "of degrade/stop"
+                )
+            oom_policy = raw
+        if fallback is None and get("device-fallback") is not None:
+            fallback = _parse_bool(get("device-fallback"))
+    return {
+        "oom-policy": oom_policy or defaults["oom-policy"],
+        "device-fallback": (
+            defaults["device-fallback"] if fallback is None else fallback
+        ),
+        "device-fallback-after": max(1, defaults["device-fallback-after"]),
+        "device-probe-every": max(1, defaults["device-probe-every"]),
+        "oom-reprobe-ms": max(0.0, defaults["oom-reprobe-ms"]),
+    }
+
+
+class BucketGovernor:
+    """Per-segment safe batch ceiling under OOM (single-writer: the
+    node's service thread; observers get GIL-atomic reads).
+
+    ``cap()`` is the window limit the batch collector and the split
+    loop honor. On OOM, ``on_oom(attempted)`` drops the ceiling to the
+    next ladder rung below the attempted bucket (None when already at
+    1 — nothing left to shrink) and stamps a cooldown; once it
+    elapses, ``cap()`` offers ONE rung above the ceiling as a probe,
+    and ``on_ok``/``on_oom`` of that probe raises the ceiling or
+    pushes the cooldown out again. The ladder is the segment's bucket
+    ladder, so every ceiling is a real compiled-bucket size."""
+
+    __slots__ = ("ladder", "ceiling", "cooldown_s", "ooms", "reprobes",
+                 "_probe_at", "_clock")
+
+    def __init__(
+        self,
+        ladder: Sequence[int],
+        cooldown_s: float = 30.0,
+        clock=time.monotonic,
+    ) -> None:
+        self.ladder: Tuple[int, ...] = tuple(sorted(set(int(b) for b in ladder))) or (1,)
+        self.ceiling = self.ladder[-1]
+        self.cooldown_s = cooldown_s
+        self.ooms = 0          # OOM events observed
+        self.reprobes = 0      # successful upward re-probes
+        self._probe_at: Optional[float] = None  # monotonic reprobe gate
+        self._clock = clock
+
+    @property
+    def degraded(self) -> bool:
+        return self.ceiling < self.ladder[-1]
+
+    def _stamp_cooldown(self) -> Optional[float]:
+        """cooldown <= 0 means NEVER re-probe upward (a zero cooldown
+        would otherwise offer the probe rung on every cap() call — a
+        persistently-OOMing probe width then livelocks the service
+        loop's shrink-retry ladder)."""
+        if self.cooldown_s <= 0:
+            return None
+        return self._clock() + self.cooldown_s
+
+    def cap(self) -> int:
+        """Current window limit — the ceiling, or one rung above it
+        when the reprobe cooldown has elapsed (the probe window)."""
+        if (
+            self.degraded
+            and self._probe_at is not None
+            and self._clock() >= self._probe_at
+        ):
+            i = self.ladder.index(self.ceiling)
+            return self.ladder[min(i + 1, len(self.ladder) - 1)]
+        return self.ceiling
+
+    def on_ok(self, bucket: int) -> bool:
+        """A dispatch at ``bucket`` rows succeeded. Returns True when
+        this confirmed an upward probe (the ceiling moved) — a probe
+        only confirms at the probe width itself; narrower dispatches
+        during the probe window leave the ceiling untouched. The host
+        path dispatches arbitrary widths (no bucket padding), so the
+        confirmed width snaps DOWN to its ladder rung — the ceiling
+        must stay a real rung or cap()'s ladder walk breaks."""
+        below = [b for b in self.ladder if b <= bucket]
+        rung = below[-1] if below else self.ladder[0]
+        if rung > self.ceiling:
+            # a probe succeeded: reclaim one rung; keep probing upward
+            # (after another cooldown) until back at the full ladder
+            self.ceiling = rung
+            self.reprobes += 1
+            _log.warning(
+                "OOM ceiling re-probed up to %d%s", rung,
+                "" if self.degraded else " (fully recovered)",
+            )
+            self._probe_at = (
+                self._stamp_cooldown() if self.degraded else None
+            )
+            return True
+        return False
+
+    def on_oom(self, attempted: int) -> Optional[int]:
+        """Shrink below ``attempted``; returns the new ceiling, or None
+        when attempted was already the smallest bucket (the caller then
+        treats the OOM like any other device fault)."""
+        self.ooms += 1
+        below = [b for b in self.ladder if b < max(1, int(attempted))]
+        self._probe_at = self._stamp_cooldown()
+        if not below:
+            return None
+        if below[-1] < self.ceiling or attempted > self.ceiling:
+            self.ceiling = min(self.ceiling, below[-1])
+        return below[-1]
+
+    def snapshot(self) -> dict:
+        return {
+            "ceiling": self.ceiling,
+            "max": self.ladder[-1],
+            "ooms": self.ooms,
+            "reprobes": self.reprobes,
+        }
+
+    def restore(self, snap: dict) -> None:
+        """Warm-restart: re-arm the remembered safe ceiling (and its
+        reprobe cooldown) so a restarted pipeline does not re-discover
+        the OOM boundary by OOMing again."""
+        ceiling = int(snap.get("ceiling", self.ladder[-1]))
+        below = [b for b in self.ladder if b <= ceiling]
+        self.ceiling = below[-1] if below else self.ladder[0]
+        self.ooms = int(snap.get("ooms", 0))
+        self.reprobes = int(snap.get("reprobes", 0))
+        if self.degraded:
+            self._probe_at = self._stamp_cooldown()
+
+
+class DeviceCircuit:
+    """Compile/dispatch circuit breaker for one execution node.
+
+    ``record_fault(kind)`` returns True when the caller should serve
+    the frame from the degraded (host/eager) path: immediately for
+    ``compile`` (deterministic — a per-frame recompile loop is the
+    failure mode this exists to prevent), after ``after`` CONSECUTIVE
+    device faults otherwise. While open, ``should_probe()`` goes True
+    every ``probe_every`` degraded frames; a successful probe
+    ``close()``s the circuit. Mirrors tensor_filter's
+    fallback-framework breaker, one level down the stack."""
+
+    __slots__ = ("after", "probe_every", "open", "kinds", "_consec",
+                 "_since_probe", "opens", "closes", "eager_invokes",
+                 "faults")
+
+    def __init__(self, after: int = 3, probe_every: int = 64) -> None:
+        self.after = max(1, int(after))
+        self.probe_every = max(1, int(probe_every))
+        self.open = False
+        self.faults = 0                      # classified device faults
+        self.kinds: Dict[str, int] = {}      # kind -> count
+        self.opens = 0
+        self.closes = 0
+        self.eager_invokes = 0               # frames served degraded
+        self._consec = 0
+        self._since_probe = 0
+
+    def record_fault(self, kind: str) -> bool:
+        self.faults += 1
+        self.kinds[kind] = self.kinds.get(kind, 0) + 1
+        self._consec += 1
+        if self.open:
+            return True
+        if kind == "compile" or self._consec >= self.after:
+            self.open = True
+            self.opens += 1
+            self._since_probe = 0
+            _log.warning(
+                "device circuit OPEN after %d fault(s) (last: %s); "
+                "serving from the host/eager path", self._consec, kind,
+            )
+            return True
+        return False
+
+    def record_ok(self) -> None:
+        self._consec = 0
+
+    def should_probe(self) -> bool:
+        """Call once per degraded frame; True on the probe beat."""
+        self._since_probe += 1
+        if self._since_probe >= self.probe_every:
+            self._since_probe = 0
+            return True
+        return False
+
+    def close(self) -> None:
+        if self.open:
+            self.open = False
+            self.closes += 1
+            _log.warning("device circuit closed: jitted path recovered")
+        self._consec = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "open": self.open,
+            "faults": self.faults,
+            "kinds": dict(self.kinds),
+            "opens": self.opens,
+            "closes": self.closes,
+            "eager_invokes": self.eager_invokes,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.open = bool(snap.get("open", False))
+        self.faults = int(snap.get("faults", 0))
+        self.kinds = {
+            str(k): int(v) for k, v in (snap.get("kinds") or {}).items()
+        }
+        self.opens = int(snap.get("opens", 0))
+        self.closes = int(snap.get("closes", 0))
+        self.eager_invokes = int(snap.get("eager_invokes", 0))
+        self._consec = 0
+        self._since_probe = 0

@@ -26,6 +26,7 @@ progressing — into typed PipelineStallErrors with a per-node snapshot.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -42,6 +43,11 @@ from nnstreamer_tpu.elements.base import (
 from nnstreamer_tpu import trace
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import metrics as obs_metrics
+from nnstreamer_tpu.pipeline.device_faults import (
+    BucketGovernor,
+    DeviceCircuit,
+    classify_device_fault,
+)
 from nnstreamer_tpu.pipeline.faults import (
     FaultGate,
     PipelineStallError,
@@ -285,6 +291,7 @@ class Node:
         self.thread: Optional[threading.Thread] = None
         self.frames_processed = 0
         self.proc_time_ema_ms = 0.0
+        self.max_invoke_ms = 0.0  # slowest observed invoke (drain sizing)
         self._needs_notify = False  # set for multi-pad scheduler nodes
         self.fault_stats = None  # FaultStats when an error policy is active
         self.fault_gate = None   # the gate itself (watchdog backoff check)
@@ -294,6 +301,16 @@ class Node:
         # offered == delivered + dropped + routed invariant still latches
         self.deadline_shed = 0
         self._shed_ctr = None    # nns_deadline_shed_total handle (lazy)
+        # device-resilience (pipeline/device_faults.py): wired by the
+        # fused/host-op service loops from the plan-time device policy;
+        # None on every other node kind (and when resilience is off)
+        self.device_circuit = None   # DeviceCircuit
+        self.bucket_governor = None  # BucketGovernor (OOM batch ladder)
+        self._device_ctrs: Dict[str, Any] = {}  # kind -> counter (lazy)
+        self._deg_gauge = None       # nns_degraded_segments handle (lazy)
+        # warm-restart state restored before the service loop built its
+        # governor/circuit/gate (Executor.restore on a fresh executor)
+        self._pending_restore: Optional[Dict[str, Any]] = None
         # nns-obs handles (None/empty with metrics off — the default):
         # wired by Executor._build when a registry is active
         self._lat_hist = None        # per-invoke latency histogram
@@ -372,6 +389,8 @@ class Node:
         dt = (now - t0) * 1000.0
         a = 0.2
         self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+        if dt > self.max_invoke_ms:
+            self.max_invoke_ms = dt
         if lat is not None:
             lat.observe((now - t0) * 1e6)
             self._frames_ctr.inc()
@@ -408,6 +427,115 @@ class Node:
         notify_shed(item, self.name)
         return True
 
+    # -- device resilience (pipeline/device_faults.py) --------------------
+    def _device_fault(self, exc: Exception) -> Optional[str]:
+        """Classify ``exc``; for device-plane faults record the
+        nns_device_faults_total counter + a trace event and return the
+        kind, else None (ordinary element errors stay with the per-frame
+        policies). Cold path: one event per fault, never per frame."""
+        kind = classify_device_fault(exc)
+        if kind is None:
+            return None
+        if self.ex.metrics is not None:
+            ctr = self._device_ctrs.get(kind)
+            if ctr is None:
+                ctr = self.ex.metrics.counter(
+                    "nns_device_faults_total", element=self.name, kind=kind
+                )
+                self._device_ctrs[kind] = ctr
+            ctr.inc()
+        tracer = trace.get()
+        if tracer is not None:
+            tracer.fault(self.name, f"device-{kind}", exc)
+        return kind
+
+    def _update_degraded_gauge(self) -> None:
+        """Refresh nns_degraded_segments for this node (0/1): degraded
+        means the circuit is open (serving eager) or the OOM governor
+        holds the batch ceiling below the full ladder. Called on state
+        TRANSITIONS only (fault/recovery events), never per frame."""
+        if self.ex.metrics is None:
+            return
+        if self._deg_gauge is None:
+            self._deg_gauge = self.ex.metrics.gauge(
+                "nns_degraded_segments", element=self.name
+            )
+        circ, gov = self.device_circuit, self.bucket_governor
+        self._deg_gauge.set(
+            1 if (
+                (circ is not None and circ.open)
+                or (gov is not None and gov.degraded)
+            ) else 0
+        )
+
+    def device_snapshot(self) -> Dict[str, Any]:
+        """Warm-restart payload for this node (Executor.snapshot)."""
+        d: Dict[str, Any] = {
+            "frames": self.frames_processed,
+            "deadline_shed": self.deadline_shed,
+        }
+        if self.bucket_governor is not None:
+            d["governor"] = self.bucket_governor.snapshot()
+        if self.device_circuit is not None:
+            d["circuit"] = self.device_circuit.snapshot()
+        fs = self.fault_stats
+        if fs is not None:
+            d["faults"] = {
+                "errors": fs.errors, "dropped": fs.dropped,
+                "routed": fs.routed,
+                "routed_unlinked": fs.routed_unlinked,
+                "retries": fs.retries,
+                "retry_exhausted": fs.retry_exhausted,
+            }
+        return d
+
+    def restore_state(self, d: Dict[str, Any]) -> None:
+        """Apply a device_snapshot(): counters land immediately;
+        governor/circuit/fault-stats parts are stashed and applied by
+        the service loop once it has built those objects (they do not
+        exist before run())."""
+        self.frames_processed = int(d.get("frames", self.frames_processed))
+        self.deadline_shed = int(d.get("deadline_shed", self.deadline_shed))
+        self._pending_restore = d
+
+    def _apply_pending_restore(self) -> None:
+        """Called from the service loop after governor/circuit/gate are
+        built: re-arm the remembered OOM ceiling, circuit state, and
+        fault counters from a warm-restart snapshot. Sections whose
+        target object does not exist YET stay stashed (restore() on a
+        just-started executor can race the service loop's
+        _build_resilience — consuming them then would silently lose the
+        remembered OOM ceiling); the loop's own post-build call picks
+        them up."""
+        d = self._pending_restore
+        if not d:
+            return
+        pending: Dict[str, Any] = {}
+        if "governor" in d:
+            if self.bucket_governor is not None:
+                self.bucket_governor.restore(d["governor"])
+            else:
+                pending["governor"] = d["governor"]
+        if "circuit" in d:
+            if self.device_circuit is not None:
+                self.device_circuit.restore(d["circuit"])
+            else:
+                pending["circuit"] = d["circuit"]
+        snap = d.get("faults")
+        if snap:
+            fs = self.fault_stats
+            if fs is not None:
+                fs.errors = int(snap.get("errors", 0))
+                fs.dropped = int(snap.get("dropped", 0))
+                fs.routed = int(snap.get("routed", 0))
+                fs.routed_unlinked = int(snap.get("routed_unlinked", 0))
+                fs.retries = int(snap.get("retries", 0))
+                fs.retry_exhausted = int(snap.get("retry_exhausted", 0))
+            else:
+                pending["faults"] = snap
+        self._pending_restore = pending or None
+        self._update_degraded_gauge()
+
     def make_fault_gate(self, policy, elem=None) -> Optional[FaultGate]:
         """Build this node's error-policy applicator (None when the
         policy is ``stop`` — the default path stays untouched). Called
@@ -439,10 +567,13 @@ class Node:
         self.fault_gate = gate  # watchdog reads backoff_deadline
         return gate
 
-    def make_batch_collector(self, cfg, elem):
+    def make_batch_collector(self, cfg, elem, cap=None):
         """BatchCollector on input pad 0 with the upstream-QoS drop
         predicate for `elem` (one definition of skipped-upstream
-        accounting for both batched service loops)."""
+        accounting for both batched service loops). ``cap`` is the OOM
+        bucket governor's live ceiling callable (docs/resilience.md):
+        a degraded segment must not even COLLECT windows wider than it
+        can dispatch."""
         from nnstreamer_tpu.pipeline.batching import BatchCollector
 
         drop = None
@@ -455,7 +586,7 @@ class Node:
                 return False
 
         return BatchCollector(
-            self.in_queues[0], self.ex.stop_event, cfg, drop=drop
+            self.in_queues[0], self.ex.stop_event, cfg, drop=drop, cap=cap
         )
 
     def stat_batch(self, t0: float, n: int, bucket: int, wait_s: float) -> None:
@@ -467,6 +598,8 @@ class Node:
         dt = (now - t0) * 1000.0
         a = 0.2
         self.proc_time_ema_ms = (1 - a) * self.proc_time_ema_ms + a * dt
+        if dt > self.max_invoke_ms:
+            self.max_invoke_ms = dt
         lat = self._lat_hist
         if lat is not None:
             # one latency observation per INVOKE (the device dispatch is
@@ -495,7 +628,14 @@ class SourceNode(Node):
         self.elem = elem
 
     def run(self) -> None:
-        while not self.ex.stop_event.is_set():
+        pause = self.ex.pause_event
+        stop = self.ex.stop_event
+        while not stop.is_set():
+            if pause.is_set():
+                # Executor.drain(): park at a frame boundary — nothing
+                # new enters the graph until resume() clears the event
+                time.sleep(0.005)
+                continue
             t0 = time.perf_counter()
             item = self.elem.generate()
             if item is EOS_FRAME:
@@ -512,10 +652,44 @@ class FusedNode(Node):
         super().__init__(ex, seg.name)
         self.seg = seg
 
+    def _build_resilience(self, cfg) -> None:
+        """Instantiate the device circuit + OOM bucket governor from the
+        plan-time device policy (pipeline/device_faults.py): the circuit
+        guards every path, the governor only batched segments (bucket 1
+        has nothing left to shrink)."""
+        pol = self.seg.device_policy
+        if pol is None:
+            return
+        if pol.get("device-fallback"):
+            self.device_circuit = DeviceCircuit(
+                after=pol["device-fallback-after"],
+                probe_every=pol["device-probe-every"],
+            )
+        if (
+            cfg is not None and cfg.active
+            and pol.get("oom-policy") == "degrade"
+        ):
+            self.bucket_governor = BucketGovernor(
+                cfg.buckets,
+                cooldown_s=pol["oom-reprobe-ms"] / 1000.0,
+            )
+
     def run(self) -> None:
-        self.seg.build()  # compile before first frame (PAUSED-state parity)
-        gate = self.make_fault_gate(self.seg.fault_policy, self.seg.first)
         cfg = self.seg.batch_config
+        self._build_resilience(cfg)
+        try:
+            # compile before first frame (PAUSED-state parity)
+            self.seg.build()
+        except Exception as exc:
+            # a compile failure at build opens the circuit (when armed)
+            # exactly like one on the first frame would
+            kind = self._device_fault(exc)
+            circ = self.device_circuit
+            if kind is None or circ is None or not circ.record_fault(kind):
+                raise
+            self._update_degraded_gauge()
+        gate = self.make_fault_gate(self.seg.fault_policy, self.seg.first)
+        self._apply_pending_restore()
         if cfg is not None and cfg.active:
             self._run_batched(cfg, gate)
             return
@@ -534,23 +708,164 @@ class FusedNode(Node):
                 continue
             t0 = time.perf_counter()
             if gate is None:
-                out = self.seg.process(item)
+                out = self._process_frame(item)
             else:
-                delivered, out = gate.process(item, self.seg.process)
+                delivered, out = gate.process(item, self._process_frame)
                 if not delivered:
                     continue
             self.stat(t0)
             self.push_out(0, out)
         self.broadcast_eos()
 
+    # -- device-resilient invoke paths ------------------------------------
+    def _process_frame(self, item):
+        """seg.process with the device circuit around it: repeated
+        device faults (or one compile failure) open the circuit and this
+        frame — and the stream after it — serves from the eager path;
+        while open, periodic probes close it on recovery. Below the
+        open threshold the typed exception propagates to the node's
+        error policy (stop/drop/retry/route), PR-3 semantics."""
+        circ = self.device_circuit
+        if circ is None:
+            return self.seg.process(item)
+        if circ.open:
+            return self._degraded_process(item)
+        try:
+            out = self.seg.process(item)
+        except _Stop:
+            raise
+        except Exception as exc:
+            kind = self._device_fault(exc)
+            if kind is None:
+                raise
+            if circ.record_fault(kind):
+                self._update_degraded_gauge()
+                circ.eager_invokes += 1
+                return self.seg.process_eager(item)
+            raise
+        circ.record_ok()
+        return out
+
+    def _degraded_process(self, item):
+        """Serve one frame while the circuit is open: eager path, with
+        the compiled path probed every probe-every frames — a probe
+        that succeeds closes the circuit and serves its frame from the
+        recovered program."""
+        circ = self.device_circuit
+        if circ.should_probe():
+            try:
+                out = self.seg.process(item)
+            except _Stop:
+                raise
+            except Exception as exc:
+                kind = self._device_fault(exc)
+                if kind is None:
+                    raise
+                circ.record_fault(kind)  # stays open; kind counted
+            else:
+                circ.close()
+                self._update_degraded_gauge()
+                return out
+        circ.eager_invokes += 1
+        return self.seg.process_eager(item)
+
+    def _serve_degraded(self, chunk, gate):
+        """Eager per-frame service of a window while the circuit is
+        open (vmap IS tracing, so a broken compile path cannot serve a
+        stacked window)."""
+        outs = []
+        for f in chunk:
+            if gate is None:
+                outs.append(self._degraded_process(f))
+            else:
+                delivered, out = gate.process(f, self._degraded_process)
+                if delivered:
+                    outs.append(out)
+        return outs
+
+    def _invoke_window(self, frames, cfg, gate):
+        """One collected window through the degradation ladder
+        (docs/resilience.md). Returns (outs, rows_dispatched):
+
+        1. the window is chunked to the OOM governor's live ceiling;
+        2. a chunk that OOMs shrinks the ceiling one ladder rung and is
+           RETRIED (never dropped) — at bucket 1 the OOM stops being
+           shrinkable and falls through to (3);
+        3. other device faults feed the circuit; once open, the chunk
+           (and the stream) serves from the eager path;
+        4. anything non-device-plane keeps PR-3 semantics: the failed
+           window splits per-frame through the error-policy gate."""
+        gov = self.bucket_governor
+        circ = self.device_circuit
+        outs: List = []
+        rows = 0
+        pending = deque([frames])
+        while pending:
+            chunk = pending.popleft()
+            cap = gov.cap() if gov is not None else None
+            if cap is not None and len(chunk) > cap:
+                # split to the live ceiling; remainder keeps its order
+                pending.appendleft(chunk[cap:])
+                chunk = chunk[:cap]
+            if circ is not None and circ.open:
+                outs.extend(self._serve_degraded(chunk, gate))
+                rows += len(chunk)
+                continue
+            try:
+                if len(chunk) == 1:
+                    # lone frame: the per-frame program, no stack/split
+                    got, bucket = [self.seg.process(chunk[0])], 1
+                else:
+                    got, bucket = self.seg.process_batch(chunk, cfg)
+            except _Stop:
+                raise
+            except Exception as exc:
+                kind = self._device_fault(exc)
+                if kind == "oom" and gov is not None:
+                    attempted = (
+                        cfg.bucket_for(len(chunk)) if len(chunk) > 1 else 1
+                    )
+                    if gov.on_oom(attempted) is not None:
+                        self._update_degraded_gauge()
+                        pending.appendleft(chunk)  # retry, shrunk
+                        continue
+                    # bucket 1 still OOMs: nothing left to shrink —
+                    # treat like any other device fault below
+                if kind is not None and circ is not None:
+                    if circ.record_fault(kind):
+                        self._update_degraded_gauge()
+                        outs.extend(self._serve_degraded(chunk, gate))
+                        rows += len(chunk)
+                        continue
+                # not device-plane (or circuit below threshold/absent):
+                # the error-policy split — one bad frame must not
+                # discard its batchmates
+                if gate is None:
+                    raise
+                for f in chunk:
+                    delivered, out = gate.process(f, self._process_frame)
+                    if delivered:
+                        outs.append(out)
+                # per-frame programs pad nothing: rows == chunk size
+                rows += len(chunk)
+                continue
+            if circ is not None:
+                circ.record_ok()
+            if gov is not None and gov.on_ok(bucket):
+                self._update_degraded_gauge()
+            outs.extend(got)
+            rows += bucket
+        return outs, rows
+
     def _run_batched(self, cfg, gate=None) -> None:
-        """Micro-batched service loop: drain up to max-batch frames, ONE
-        batched device invoke, split results back in order. With an
-        error policy active, a FAILED batch is split and re-run
-        per-frame through the gate — one bad frame must not discard its
-        batchmates (the per-frame rerun classifies each: retried,
-        delivered, dropped, or routed)."""
-        collector = self.make_batch_collector(cfg, self.seg.first)
+        """Micro-batched service loop: drain up to max-batch frames (the
+        OOM governor's ceiling when degraded), ONE batched device invoke
+        per chunk, split results back in order. Failure handling is the
+        degradation ladder in _invoke_window."""
+        gov = self.bucket_governor
+        collector = self.make_batch_collector(
+            cfg, self.seg.first, cap=(gov.cap if gov is not None else None)
+        )
         while True:
             frames, eos, wait_s = collector.collect()
             if frames:
@@ -559,28 +874,9 @@ class FusedNode(Node):
                 ]
             if frames:
                 t0 = time.perf_counter()
-                try:
-                    if len(frames) == 1:
-                        # lone frame: the per-frame program, no stack/split
-                        outs = [self.seg.process(frames[0])]
-                        bucket = 1
-                    else:
-                        outs, bucket = self.seg.process_batch(frames, cfg)
-                except _Stop:
-                    raise
-                except Exception:
-                    if gate is None:
-                        raise
-                    outs = []
-                    # per-frame programs pad nothing: bucket == batch size
-                    # (a smaller bucket would book negative pad rows)
-                    bucket = len(frames)
-                    for f in frames:
-                        delivered, out = gate.process(f, self.seg.process)
-                        if delivered:
-                            outs.append(out)
-                self.seg.batch_stats.record(len(frames), bucket, wait_s)
-                self.stat_batch(t0, len(frames), bucket, wait_s)
+                outs, rows = self._invoke_window(frames, cfg, gate)
+                self.seg.batch_stats.record(len(frames), rows, wait_s)
+                self.stat_batch(t0, len(frames), rows, wait_s)
                 for f in outs:
                     self.push_out(0, f)
             if eos:
@@ -610,6 +906,7 @@ class TensorOpHostNode(Node):
         if cfg.active and self.elem.is_batch_capable():
             self._run_batched(cfg, gate)
             return
+        self._apply_pending_restore()
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
@@ -640,7 +937,10 @@ class TensorOpHostNode(Node):
         """Host micro-batching for backends that declared the
         ``batchable`` capability (backends/base.py) — host backends that
         did not (tflite's set/invoke/get is strictly per-frame) keep the
-        per-frame loop above."""
+        per-frame loop above. A window whose batched invoke OOMs rides
+        the same degradation ladder as fused segments: the bucket
+        governor shrinks the window ceiling and the chunk retries
+        (docs/resilience.md)."""
         from nnstreamer_tpu.pipeline.batching import BatchStats
 
         elem = self.elem
@@ -648,7 +948,16 @@ class TensorOpHostNode(Node):
             # host elements sit outside fused segments, so plan time did
             # not hand them a shared stats object
             elem.batch_stats = BatchStats()
-        collector = self.make_batch_collector(cfg, elem)
+        pol = getattr(elem, "device_policy", None)
+        if pol is not None and pol.get("oom-policy") == "degrade":
+            self.bucket_governor = BucketGovernor(
+                cfg.buckets, cooldown_s=pol["oom-reprobe-ms"] / 1000.0
+            )
+        gov = self.bucket_governor
+        self._apply_pending_restore()
+        collector = self.make_batch_collector(
+            cfg, elem, cap=(gov.cap if gov is not None else None)
+        )
         stats = elem.batch_stats
         while True:
             frames, eos, wait_s = collector.collect()
@@ -658,22 +967,7 @@ class TensorOpHostNode(Node):
                 ]
             if frames:
                 t0 = time.perf_counter()
-                try:
-                    outs = elem.host_process_batch(frames)
-                except _Stop:
-                    raise
-                except Exception:
-                    # split the failed window per-frame through the
-                    # policy (retry/drop/route each) — one bad frame
-                    # must not discard its batchmates
-                    if gate is None:
-                        raise
-                    outs = []
-                    for f in frames:
-                        delivered, out = gate.process(f, elem.host_process)
-                        if not delivered or out is None:
-                            continue
-                        outs.extend(out if isinstance(out, list) else [out])
+                outs = self._invoke_host_window(frames, gate)
                 # host path never pads: bucket == batch size
                 stats.record(len(frames), len(frames), wait_s)
                 self.stat_batch(t0, len(frames), len(frames), wait_s)
@@ -685,6 +979,48 @@ class TensorOpHostNode(Node):
                 break
         self.broadcast_eos()
 
+    def _invoke_host_window(self, frames, gate) -> List:
+        """One collected window through the host-path ladder: chunks
+        bounded by the OOM governor's live ceiling; an OOM'd chunk
+        shrinks the ceiling and retries; everything else keeps PR-3
+        semantics (the failed window splits per-frame through the
+        error-policy gate)."""
+        elem = self.elem
+        gov = self.bucket_governor
+        outs: List = []
+        pending = deque([frames])
+        while pending:
+            chunk = pending.popleft()
+            cap = gov.cap() if gov is not None else None
+            if cap is not None and len(chunk) > cap:
+                pending.appendleft(chunk[cap:])
+                chunk = chunk[:cap]
+            try:
+                outs.extend(elem.host_process_batch(chunk))
+            except _Stop:
+                raise
+            except Exception as exc:
+                kind = self._device_fault(exc)
+                if kind == "oom" and gov is not None and len(chunk) > 1:
+                    if gov.on_oom(len(chunk)) is not None:
+                        self._update_degraded_gauge()
+                        pending.appendleft(chunk)  # retry, shrunk
+                        continue
+                # split the failed window per-frame through the
+                # policy (retry/drop/route each) — one bad frame
+                # must not discard its batchmates
+                if gate is None:
+                    raise
+                for f in chunk:
+                    delivered, out = gate.process(f, elem.host_process)
+                    if not delivered or out is None:
+                        continue
+                    outs.extend(out if isinstance(out, list) else [out])
+                continue
+            if gov is not None and gov.on_ok(len(chunk)):
+                self._update_degraded_gauge()
+        return outs
+
 
 class HostNode(Node):
     def __init__(self, ex, elem: HostElement) -> None:
@@ -695,6 +1031,7 @@ class HostNode(Node):
         gate = self.make_fault_gate(
             getattr(self.elem, "fault_policy", None), self.elem
         )
+        self._apply_pending_restore()
         while True:
             item = self.pop(0)
             if item is EOS_FRAME:
@@ -915,6 +1252,9 @@ class Executor:
     def __init__(self, plan: ExecPlan) -> None:
         self.plan = plan
         self.stop_event = threading.Event()
+        # warm-restart support (docs/resilience.md): drain() sets this to
+        # park sources at a frame boundary; resume() clears it
+        self.pause_event = threading.Event()
         self.errors: List[Exception] = []
         self._err_lock = threading.Lock()
         self.nodes: List[Node] = []
@@ -1222,6 +1562,135 @@ class Executor:
             )
             return
 
+    # -- warm restart: drain / snapshot / resume (docs/resilience.md) ------
+    def drain(
+        self, timeout: float = 30.0, settle_s: Optional[float] = None
+    ) -> bool:
+        """Quiesce the graph at a frame boundary: park the sources
+        (nothing new enters), then wait until every channel is empty and
+        no node has progressed across a settle window — the in-flight
+        frames have all reached sinks (or been disposed by policy).
+        True once quiescent; False on timeout or error (the pipeline
+        keeps running either way — call resume() to continue).
+
+        Granularity: like the stall watchdog, the detector cannot see
+        inside one invoke, so the settle window must outlast the slowest
+        single invoke's tail. It auto-sizes to 2x the slowest invoke
+        observed so far (min 60 ms, capped at timeout/2); pass
+        ``settle_s`` explicitly when the pipeline's worst invoke has not
+        been seen yet (e.g. draining right after start)."""
+        self.pause_event.set()
+        if settle_s is None:
+            worst_ms = max(
+                (n.max_invoke_ms for n in self.nodes), default=0.0
+            )
+            settle_s = min(max(0.06, 2.0 * worst_ms / 1000.0),
+                           max(0.06, timeout / 2.0))
+        polls_needed = max(3, int(math.ceil(settle_s / 0.02)))
+        deadline = time.monotonic() + timeout
+        last = None
+        settled = 0
+        while time.monotonic() < deadline:
+            if self.errors:
+                return False
+            counts = tuple(n.frames_processed for n in self.nodes)
+            empty = not any(
+                len(q) for n in self.nodes for q in n.in_queues
+            )
+            if empty and counts == last:
+                settled += 1
+                if settled >= polls_needed:
+                    return True
+            else:
+                settled = 0
+            last = counts
+            time.sleep(0.02)
+        return False
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Warm-restart snapshot: per-node stats + OOM batch ceilings +
+        device-circuit fault history, plus any element/backend state
+        exposed through a ``state_snapshot()`` hook (framecounter-style
+        stateful backends). Call after drain() for a frame-boundary-
+        consistent capture; JSON-serializable by construction so it can
+        ride save_snapshot()/read_snapshot() (the parallel/checkpoint.py
+        conventions: atomic replace, step-named files)."""
+        snap: Dict[str, Any] = {"version": 1, "nodes": {}, "elements": {}}
+        for n in self.nodes:
+            snap["nodes"][n.name] = n.device_snapshot()
+        for e in self.plan.pipeline.elements:
+            hook = getattr(e, "state_snapshot", None)
+            if hook is None:
+                hook = getattr(
+                    getattr(e, "backend", None), "state_snapshot", None
+                )
+            if callable(hook):
+                snap["elements"][e.name] = hook()
+        return snap
+
+    def save_snapshot(self, path: str) -> Dict[str, Any]:
+        """snapshot() to a JSON file via write-then-atomic-replace (the
+        checkpoint.py discipline: a crashed writer never leaves a
+        half-written snapshot where resume will read it)."""
+        import json
+        import os
+
+        snap = self.snapshot()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)
+        return snap
+
+    @staticmethod
+    def read_snapshot(path: str) -> Dict[str, Any]:
+        import json
+
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Apply a snapshot(): node counters land now; governor/circuit/
+        fault-stats state is stashed per node and re-armed by the
+        service loops (before start()) or applied directly (already
+        running). Elements restore through their ``state_restore()``
+        hook. Unknown node/element names are skipped — a restarted
+        pipeline may legitimately differ at the edges."""
+        by_name = {n.name: n for n in self.nodes}
+        for name, d in (snap.get("nodes") or {}).items():
+            node = by_name.get(name)
+            if node is None:
+                _log.warning("restore: no node %r in this pipeline", name)
+                continue
+            node.restore_state(d)
+            if not self._started:
+                continue
+            # already running: the loop-built objects exist — apply now
+            node._apply_pending_restore()
+        elems = {e.name: e for e in self.plan.pipeline.elements}
+        for name, d in (snap.get("elements") or {}).items():
+            e = elems.get(name)
+            if e is None:
+                _log.warning("restore: no element %r in this pipeline", name)
+                continue
+            hook = getattr(e, "state_restore", None)
+            if hook is None:
+                hook = getattr(
+                    getattr(e, "backend", None), "state_restore", None
+                )
+            if callable(hook):
+                hook(d)
+
+    def resume(self, snap: Optional[Dict[str, Any]] = None) -> None:
+        """Un-park the sources after drain() — with ``snap``, restore it
+        first, so drain()+snapshot() / restore()+resume() round-trips
+        warm-restart a pipeline with its exact per-element stats, batch
+        ceilings, and fault history (the persistent XLA compilation
+        cache makes the recompile side fast; docs/resilience.md)."""
+        if snap is not None:
+            self.restore(snap)
+        self.pause_event.clear()
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until every sink saw EOS (or error). True if completed."""
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1389,6 +1858,25 @@ class Executor:
             # deadline-aware shedding (docs/edge-serving.md)
             if n.deadline_shed:
                 s["deadline_shed"] = n.deadline_shed
+            # device resilience (pipeline/device_faults.py,
+            # docs/resilience.md): circuit + OOM-ladder state when the
+            # node has seen device-plane trouble
+            circ = n.device_circuit
+            if circ is not None and (circ.faults or circ.opens):
+                s["device_degraded"] = 1 if circ.open else 0
+                s["device_faults"] = circ.faults
+                s["device_fault_kinds"] = dict(circ.kinds)
+                s["device_eager_invokes"] = circ.eager_invokes
+                s["device_circuit_opens"] = circ.opens
+            gov = n.bucket_governor
+            if gov is not None and gov.ooms:
+                s["oom_events"] = gov.ooms
+                s["batch_ceiling"] = gov.ceiling
+                s["oom_reprobes"] = gov.reprobes
+                if gov.degraded:
+                    s["device_degraded"] = 1
+                else:
+                    s.setdefault("device_degraded", 0)
             # admission control (edge/admission.py): per-server budget
             # and per-client counters when the element serves a fleet
             astats = getattr(elem, "admission_stats", None)
@@ -1403,6 +1891,13 @@ class Executor:
                 got = cstats()
                 if got:
                     s.update({f"cb_{k}": v for k, v in got.items()})
+            # replica failover (parallel/replicas.py): health, failovers,
+            # per-replica serve/fault counts when replicas=N is on
+            rstats = getattr(elem, "replica_stats", None)
+            if callable(rstats):
+                got = rstats()
+                if got:
+                    s.update({f"rep_{k}": v for k, v in got.items()})
             # sanitizer counters (pipeline/sanitize.py): per-node frame
             # accounting as the instrumented channels saw it
             if self.sanitizer is not None:

@@ -244,6 +244,9 @@ class Pipeline:
             BatchStats,
             resolve_batch_config,
         )
+        from nnstreamer_tpu.pipeline.device_faults import (
+            resolve_device_policy,
+        )
         from nnstreamer_tpu.pipeline.faults import resolve_fault_policy
 
         for e in self._toposort():
@@ -269,6 +272,7 @@ class Pipeline:
                     if e.batch_stats is None:
                         e.batch_stats = BatchStats()
                     e.fault_policy = resolve_fault_policy([e])
+                    e.device_policy = resolve_device_policy([e])
                 continue
             ups = self.in_links(e)
             up = ups[0].src if len(ups) == 1 else None
@@ -293,6 +297,7 @@ class Pipeline:
         for seg in segments:
             seg.batch_config = resolve_batch_config(seg.ops)
             seg.fault_policy = resolve_fault_policy(seg.ops)
+            seg.device_policy = resolve_device_policy(seg.ops)
             for op in seg.ops:
                 op.batch_stats = seg.batch_stats
         return ExecPlan(self, segments, seg_of)
@@ -415,6 +420,17 @@ class FusedSegment:
         # the member ops' on-error/retry-* properties. Segments never
         # carry a route policy — route ops are fusion barriers.
         self.fault_policy = None
+        # device-resilience policy (pipeline/device_faults.py): resolved
+        # at plan time like the fault policy; the executor builds the
+        # OOM bucket governor + device circuit from it per node
+        self.device_policy = None
+        # eager (un-jitted) program: the degraded path the device
+        # circuit serves from — no XLA compile, minimal device arena
+        self._eager: Optional[tuple] = None
+        # device_probe hooks of member backends (chaos injectors):
+        # resolved once, empty for real pipelines so the hot path pays
+        # one len() check per batched dispatch
+        self._probes: Optional[list] = None
         # set by the executor when its sanitizer is active: pad rows in
         # process_batch are then poison, not last-frame replicas. One
         # flag resolved at build — the hot path never re-reads config.
@@ -505,7 +521,20 @@ class FusedSegment:
                 jax.block_until_ready(
                     self._jitted_for(sig, bucket)(*zeros)
                 )
-            except Exception as exc:  # warmup is an optimization
+            except Exception as exc:
+                from nnstreamer_tpu.pipeline.device_faults import (
+                    classify_device_fault,
+                )
+
+                if classify_device_fault(exc) == "compile":
+                    # deterministic: re-trying per frame would recompile
+                    # forever — surface it so the executor's build
+                    # handler opens the device circuit at PAUSED state,
+                    # not mid-stream. OOM/transient warmup faults stay
+                    # swallowed: the runtime governor ladder degrades
+                    # those gracefully, frame by frame.
+                    raise
+                # otherwise the warmup is an optimization
                 _log.warning("%s: batched warmup failed: %s", self.name, exc)
         return fn
 
@@ -515,6 +544,39 @@ class FusedSegment:
         for op in self.ops:
             f = op.transform_meta(f)
         return f
+
+    def process_eager(self, frame: Frame) -> Frame:
+        """Run the composed ops WITHOUT jit — the degraded path the
+        device circuit (pipeline/device_faults.py) serves from when the
+        compiled program cannot: no XLA compile (a deterministic compile
+        failure would just recur), per-op dispatch instead of one fused
+        arena (an OOM'd segment gets room back). Semantics identical to
+        process(); slower by construction."""
+        versions = tuple(op.fn_version for op in self.ops)
+        if self._eager is None or self._eager[0] != versions:
+            self._eager = (versions, self._compose())
+        out = self._eager[1](*frame.tensors)
+        f = frame.with_tensors(tuple(out))
+        for op in self.ops:
+            f = op.transform_meta(f)
+        return f
+
+    def _device_probes(self) -> list:
+        """Member backends' ``device_probe(rows)`` hooks (chaos
+        injectors declare one; real backends don't, so this is [] and
+        the batched hot path pays a single truthiness check)."""
+        if self._probes is None:
+            self._probes = [
+                hook
+                for op in self.ops
+                for hook in (
+                    getattr(
+                        getattr(op, "backend", None), "device_probe", None
+                    ),
+                )
+                if hook is not None
+            ]
+        return self._probes
 
     def process_batch(self, frames, cfg) -> Tuple[List[Frame], int]:
         """ONE batched device invoke for a window of same-spec frames.
@@ -535,6 +597,12 @@ class FusedSegment:
             # back to per-frame programs, semantics identical
             return [self.process(f) for f in frames], n
         bucket = cfg.bucket_for(n)
+        probes = self._device_probes()
+        if probes:
+            # deterministic capacity boundary (chaos injectors): probe
+            # with the PADDED bucket — that is the width the device sees
+            for probe in probes:
+                probe(bucket)
         fn = self._jitted_for(sig, bucket)
         pad = bucket - n
         filler = None

@@ -450,7 +450,7 @@ def test_deadline_shed_in_pipeline_accounting(monkeypatch):
         frames.append(Frame((np.full(4, float(i), np.float32),), meta=meta))
     src = AppSrc("a0", iterable=frames, spec=frames[0].spec())
     filt = TensorFilter(
-        framework="passthrough", input="4", inputtype="float32"
+        "shed-filt", framework="passthrough", input="4", inputtype="float32"
     )
     sink = TensorSink("out")
     p = Pipeline("shed").chain(src, filt, sink)
@@ -463,7 +463,7 @@ def test_deadline_shed_in_pipeline_accounting(monkeypatch):
     totals = ex.totals()
     assert totals["dropped"].get("deadline-shed") == 5
     assert totals["balance"] == 0
-    assert ex.stats()["tensor_filter0"]["deadline_shed"] == 5
+    assert ex.stats()["shed-filt"]["deadline_shed"] == 5
     assert not ex.sanitizer.codes  # NNS-S002 did NOT fire under shedding
     assert not ex.leaked_threads
 

@@ -5,6 +5,11 @@ flexible-tensor wire header, the sparse encoding, and the edge message
 codec."""
 
 import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed; property tests are optional"
+)
 from hypothesis import given, settings, strategies as st
 
 from nnstreamer_tpu.tensors.meta import decode_frame_tensors, encode_frame_tensors

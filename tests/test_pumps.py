@@ -285,6 +285,49 @@ def test_spec_pump_room_clamp_falls_back_near_max_len(params):
     assert a.result(ra) == b.result(rb)
 
 
+def test_spec_pump_budget_tail_stays_on_warm_programs(params):
+    """Regression for the BENCH_CPU_FULL_r05 spec×cb throughput
+    collapse (8.0/4.8 vs 37.5 tok/s plain): ``rounds`` is a STATIC
+    scan length, so clamping it by live request budgets compiled a
+    fresh XLA program for every budget tail — warm-up built rounds=2/1
+    programs, the measured drain then compiled rounds=4 inside the
+    timed region and re-compiled its way down a 4→2→1 ladder as
+    budgets shrank. Pin: after the first pump, draining uneven budget
+    tails runs entirely on warm programs (zero new compiles), and per
+    program launch spec emits at least as many tokens as a plain pump
+    of the same depth — the "spec×cb ≥ plain-cb" cliff guard in
+    deterministic launch-count terms rather than flaky wall-clock."""
+    b = _twin(params)
+    prompts = [_rep_prompt(12, 80 + s, period=4) for s in range(3)]
+    # uneven budgets: with the bug, remaining.max() walks 11→…→1 and
+    # each power-of-two floor below 4 is a brand-new program
+    rids = [b.submit(p, 5 + 3 * s) for s, p in enumerate(prompts)]
+    b.spec_pump(rounds=4, k=4, ngram=1)
+    warm = b._spec_pump_greedy._cache_size()
+    spec_launches = 1
+    while any(b.result(r) is None for r in rids):
+        b.spec_pump(rounds=4, k=4, ngram=1)
+        spec_launches += 1
+    assert b._spec_pump_greedy._cache_size() == warm, (
+        "budget tail recompiled spec_pump: the static scan length must "
+        "not depend on live budgets (slots idle out on device)"
+    )
+    assert warm == 1  # one (rounds=4, k=4) greedy program, ever
+    # spec×cb ≥ plain-cb per launch: a spec pump certifies ≥ rounds
+    # tokens per active stream (1 per round even at zero acceptance),
+    # a plain pump of depth n emits exactly n — so spec must never
+    # need more launches than plain step_pump(4) on the same load.
+    a = _twin(params)
+    ra = [a.submit(p, 5 + 3 * s) for s, p in enumerate(prompts)]
+    plain_launches = 0
+    while any(a.result(r) is None for r in ra):
+        a.step_pump(4)
+        plain_launches += 1
+    assert spec_launches <= plain_launches
+    assert _tokens(a, ra) == _tokens(b, rids)  # and byte-identical
+    assert b.stats()["spec_accepted_tokens"] > 0  # non-trivial run
+
+
 def test_ngram_device_proposer_mines_recent_context(params):
     """device_ngram_propose finds the most recent suffix match and
     proposes its continuation; -1 where nothing matches."""
