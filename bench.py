@@ -194,9 +194,15 @@ def _run() -> None:
     p50 = statistics.median(lat)
 
     _mark("p50 measured")
-    # streaming-ingest variant: fresh host frame every iteration, H2D via
-    # async device_put overlapping compute (the converter's real ingest path,
-    # vs the on-device-resident loop above).
+    # streaming-ingest variant: fresh host frame every iteration, staged
+    # through the transfer engine (pipeline/transfer.py stage_iter): a
+    # feeder thread keeps up to 3 async device_put uploads in flight, so
+    # frame N+1's wire time overlaps frame N's compute — the executor's
+    # resident-streaming H2D discipline, vs the on-device-resident loop
+    # above. On CPU the stager passes host frames through (the jitted
+    # ingest IS the cheaper copy), so the number converges on raw invoke.
+    from nnstreamer_tpu.pipeline import transfer as _transfer
+
     host_frames = [
         np.ascontiguousarray(rng.integers(0, 255, (batch, 224, 224, 3), np.uint8))
         for _ in range(8)
@@ -204,8 +210,11 @@ def _run() -> None:
     iters_h = 512 if on_tpu else 24
     out = None
     t0 = time.perf_counter()
-    for i in range(iters_h):
-        x = jax.device_put(host_frames[i % 8], dev)
+    staged = _transfer.stage_iter(
+        (host_frames[i % 8] for i in range(iters_h)),
+        device=dev if on_tpu else None,
+    )
+    for i, x in enumerate(staged):
         out = fn(x)
         if (i + 1) % 128 == 0:
             out.block_until_ready()
@@ -883,6 +892,11 @@ def _run() -> None:
         executor_chain_fps, executor_branched_fps = _executor_ceilings()
     except Exception as exc:  # noqa: BLE001
         print(f"[bench] executor ceilings failed: {exc!r}", file=sys.stderr)
+    overlap_efficiency = None
+    try:
+        overlap_efficiency = _overlap_efficiency()
+    except Exception as exc:  # noqa: BLE001
+        print(f"[bench] overlap efficiency failed: {exc!r}", file=sys.stderr)
     _mark("executor ceilings measured")
 
     # achieved MFU from XLA cost analysis + public per-chip peak
@@ -939,6 +953,10 @@ def _run() -> None:
                 "pipeline_media_fps": _round(pipeline_media_fps),
                 "executor_chain_fps": _round(executor_chain_fps),
                 "executor_branched_fps": _round(executor_branched_fps),
+                "overlap_efficiency": (
+                    round(overlap_efficiency, 4)
+                    if overlap_efficiency is not None else None
+                ),
                 "raw_invoke_bs1_fps": round(fps, 1),
                 "p50_sync_latency_ms": round(p50, 3),
                 "amortized_frame_ms": round(dt / iters * 1000, 3),
@@ -1251,12 +1269,70 @@ for label, desc, n in (("chain", chain, N), ("branched", branched, N // 2)):
     return vals.get("chain"), vals.get("branched")
 
 
+def _overlap_efficiency():
+    """Fused-segment overlap efficiency: fraction of the segment's
+    steady-state wall window covered by its in-flight frame spans.
+    Tracer complete events on a ringed FusedNode span dequeue→delivery,
+    so with the double-buffer ring healthy the union of spans tiles the
+    wall densely; per-frame dead time the ring can't hide — channel
+    waits, stat/metrics indirection, delivery stalls — opens gaps and
+    drags the number down. Runs in a CPU-pinned subprocess like
+    _executor_ceilings so --gate needs no relay window."""
+    import subprocess
+
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nnstreamer_tpu import trace
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+N = 4000
+desc = (f"tensorsrc dimensions=64:64 num-frames={N} ! "
+        "tensor_transform mode=arithmetic option=add:1.0 ! "
+        "tensor_sink sync-window=64")
+tracer = trace.enable()
+tracer.clear()
+p = parse_pipeline(desc)
+p.run(timeout=600)
+spans = sorted(
+    (ev["ts"], ev["ts"] + ev["dur"])
+    for ev in tracer.events()
+    if ev.get("cat") == "FusedNode" and ev.get("ph") == "X"
+)
+# steady state only: the head holds the jit compile + warmup stalls
+spans = spans[len(spans) // 10:]
+if len(spans) > 1:
+    wall = spans[-1][1] - spans[0][0]
+    covered = 0.0
+    cur_s, cur_e = spans[0]
+    for s, e in spans[1:]:
+        if s > cur_e:
+            covered += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    covered += cur_e - cur_s
+    if wall > 0:
+        print(f"overlap {covered / wall:.4f}")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=900, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in out.stdout.splitlines():
+        bits = line.split()
+        if len(bits) == 2 and bits[0] == "overlap":
+            return float(bits[1])
+    return None
+
+
 # --gate compares these keys; all must be measurable on a CPU-pinned
 # host so the gate needs no relay window. Thresholds are per-key
 # fractions of allowed drop vs the reference capture.
 GATE_KEYS = {
     "executor_chain_fps": 0.25,
     "executor_branched_fps": 0.25,
+    "overlap_efficiency": 0.25,
 }
 
 
@@ -1323,7 +1399,29 @@ def _gate() -> int:
         # measure must not masquerade as a pass
         print(json.dumps({"gate": "error", "reason": repr(exc)}))
         return 2
-    fresh = {"executor_chain_fps": chain, "executor_branched_fps": branched}
+    overlap = None
+    if ref.get("overlap_efficiency"):
+        # measured (and gated) only when the reference carries the key;
+        # pre-PR-8 references don't, and measuring an ungated metric
+        # would just burn a subprocess
+        try:
+            overlap = _overlap_efficiency()
+        except Exception as exc:  # noqa: BLE001
+            print(f"[gate] overlap measurement failed: {exc!r}",
+                  file=sys.stderr)
+        if overlap is None:
+            # same rule as the ceilings: a gated key that cannot be
+            # measured must not masquerade as a pass — the overlap
+            # ceiling would otherwise self-disable on the first
+            # measurement failure
+            print(json.dumps({"gate": "error",
+                              "reason": "overlap_efficiency unmeasurable"}))
+            return 2
+    fresh = {
+        "executor_chain_fps": chain,
+        "executor_branched_fps": branched,
+        "overlap_efficiency": overlap,
+    }
     override = None
     raw_pct = os.environ.get("BENCH_GATE_PCT")
     if raw_pct:

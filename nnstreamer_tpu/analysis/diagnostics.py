@@ -132,6 +132,13 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "pipeline, and in a serving pipeline admitted clients hang "
         "instead of receiving terminal NACKs",
     ),
+    "NNS-W113": (
+        Severity.WARNING, "host-split-device-segments",
+        "a host-bound element sits between two device-capable "
+        "(traceable) filters: every frame materializes to host and "
+        "back mid-stream, defeating the resident device-to-device "
+        "segment handoff",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -755,6 +755,104 @@ def _replica_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W113: a host-bound element between two device-capable
+    (traceable) filters forces every frame through host memory and back
+    mid-stream — the resident device-to-device segment handoff
+    (docs/streaming.md) only works across contiguous device segments
+    and pure plumbing (queue/capsfilter/tee carry device arrays
+    untouched). Device capability is read STATICALLY from the
+    framework's backend class (no backend open, no model load): the
+    class overrides ``traceable_fn``."""
+    from nnstreamer_tpu import registry
+    from nnstreamer_tpu.backends.base import Backend
+    from nnstreamer_tpu.elements.base import TensorOp
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.flow import CapsFilter, Queue, Tee
+    from nnstreamer_tpu.elements.routing import Routing
+
+    def device_capable(e) -> bool:
+        if not isinstance(e, TensorFilter):
+            return False
+        fw = e.get_property("framework")
+        if not fw or str(fw) == "auto":
+            return False
+        if e.get_property("fallback-framework"):
+            return False  # deliberate per-frame fusion barrier
+        try:
+            if int(e.get_property("replicas") or 0) > 1:
+                return False  # idem
+        except (TypeError, ValueError):
+            pass
+        try:
+            cls = registry.get(registry.KIND_FILTER, str(fw))
+        except KeyError:
+            return False  # unknown framework has its own diagnostic
+        return cls.traceable_fn is not Backend.traceable_fn
+
+    def transparent(e) -> bool:
+        # plumbing a device array rides through untouched: thread/
+        # buffer boundaries and fan-out that never read tensor bytes
+        return isinstance(e, (Queue, CapsFilter, Tee))
+
+    def reaches_capable(e, links) -> bool:
+        seen = {e}
+        frontier = [n for n in links(e) if n not in seen]
+        while frontier:
+            n = frontier.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            if device_capable(n):
+                return True
+            if transparent(n):
+                frontier.extend(links(n))
+        return False
+
+    def ups(e):
+        return [ln.src for ln in pipeline.in_links(e)]
+
+    def downs(e):
+        return [ln.dst for ln in pipeline.out_links(e)]
+
+    def host_bound(e) -> bool:
+        # elements that read/produce tensor bytes on host. Routing
+        # (mux/demux/split/join) regroups frames without touching
+        # bytes, so it passes device arrays through; traceable
+        # TensorOps (tensor_transform, device filters) FUSE into the
+        # chain — no split to warn about.
+        if transparent(e) or isinstance(e, Routing):
+            return False
+        if isinstance(e, TensorFilter):
+            fw = e.get_property("framework")
+            if not fw or str(fw) == "auto":
+                return False  # can't tell statically; never open here
+            try:
+                cls = registry.get(registry.KIND_FILTER, str(fw))
+            except KeyError:
+                return False
+            return cls.traceable_fn is Backend.traceable_fn
+        if isinstance(e, TensorOp):
+            try:
+                return not e.is_traceable()
+            except Exception:  # noqa: BLE001 — can't tell without opening
+                return False
+        return hasattr(e, "host_process")
+
+    for e in pipeline.elements:
+        if not host_bound(e):
+            continue
+        if reaches_capable(e, ups) and reaches_capable(e, downs):
+            report.add(
+                "NNS-W113", e.name,
+                "host-bound element between two device-capable filters: "
+                "frames materialize to host and back mid-stream, "
+                "defeating the resident segment handoff",
+                "move the host step before/after the device chain, or "
+                "give it a traceable equivalent (docs/streaming.md)",
+            )
+
+
 # -- pass 4: resources -------------------------------------------------------
 
 def _resource_pass(
@@ -918,6 +1016,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _skewed_join_pass(pipeline, report)
     _admission_pass(pipeline, report)
     _replica_failover_pass(pipeline, report)
+    _resident_handoff_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)

@@ -82,6 +82,14 @@ class Backend(ABC):
     #: window (stacking, engine-side batching) opt in.
     batchable: bool = False
 
+    #: whether invoke() is the identity over its tensors (the
+    #: passthrough test backend). A fused segment made only of identity
+    #: ops short-circuits the device entirely — no jitted program, no
+    #: per-frame XLA dispatch — so a passthrough filter measures the
+    #: EXECUTOR's overhead, not jax's (bench executor ceilings,
+    #: docs/streaming.md).
+    IS_IDENTITY: bool = False
+
     def __init__(self) -> None:
         self.props: Optional[FilterProps] = None
         self.stats = InvokeStats()

@@ -24,6 +24,7 @@ class PassthroughBackend(Backend):
     input spec; output spec == input spec."""
 
     name = "passthrough"
+    IS_IDENTITY = True
 
     def open(self, props: FilterProps) -> None:
         self.props = props

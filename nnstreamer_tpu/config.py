@@ -85,6 +85,15 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "device_fallback_after": "3",
         "device_probe_every": "64",
         "oom_reprobe_ms": "30000.0",
+        # resident streaming executor (pipeline/transfer.py,
+        # docs/streaming.md): ring_depth = in-flight frames per device
+        # node (H2D of N+1 / compute of N / D2H of N-1 overlap; 1 =
+        # synchronous dispatch-and-deliver), donate = hand node-owned
+        # activation buffers (staged uploads, stacked batch windows) to
+        # the fused program for reuse. Per-element ring-depth property
+        # overrides. Env: NNS_TPU_EXECUTOR_RING_DEPTH etc.
+        "ring_depth": "2",
+        "donate": "true",
         # nns-san runtime sanitizer (pipeline/sanitize.py): instrumented
         # channels assert negotiated-spec conformance per frame, latch
         # offered == delivered + dropped + routed per node at EOS, watch

@@ -134,6 +134,21 @@ DEVICE_PROPS: Dict[str, PropSpec] = {
 }
 
 
+#: resident-streaming property surface (pipeline/transfer.py,
+#: docs/streaming.md): spread into tensor_filter's PROPERTIES; the
+#: executor resolves element value over the [executor] ring_depth
+#: config default.
+STREAM_PROPS: Dict[str, PropSpec] = {
+    "ring-depth": PropSpec(
+        "int", None,
+        desc="in-flight frames per device node: H2D of frame N+1 and "
+        "D2H of frame N-1 overlap compute of frame N (default "
+        "[executor] ring_depth = 2; 1 = synchronous dispatch-and-"
+        "deliver; docs/streaming.md)",
+    ),
+}
+
+
 def install_error_pad(elem: "Element") -> None:
     """Expose the dead-letter error pad on ``elem`` when its ``on-error``
     property says ``route`` — or ``retry``, whose exhausted frames
@@ -200,6 +215,21 @@ class Element:
     # optional overflow for exhausted frames.
     error_pad: Optional[int] = None
     error_pad_required: bool = False
+
+    # Device-resident handoff capability (docs/streaming.md). The
+    # executor negotiates per link from the consumer side: fused
+    # segments (and anything not known to read tensor bytes on host)
+    # receive device arrays untouched — adjacent segments chain in
+    # device memory; host-path TensorOp nodes count as host readers
+    # and get ONE coalesced async D2H per frame at delivery instead of
+    # a synchronous per-tensor fetch. WANTS_HOST opts any other
+    # element into that prefetched-host delivery.
+    WANTS_HOST: bool = False
+    # Pure plumbing (queue, capsfilter): host-path elements that never
+    # read tensor bytes, so device arrays ride through untouched and a
+    # device-resident handoff chains ACROSS them (the executor's
+    # placement negotiation treats them as device-capable consumers).
+    DEVICE_PASSTHROUGH: bool = False
 
     # Per-class property schema (merged over the MRO by property_schema()).
     # Subclasses add their own entries; nns-lint validates launch-string
@@ -336,6 +366,12 @@ class TensorOp(Element):
     # carry their own on FusedSegment.
     device_policy: Optional[Any] = None
 
+    # Plan-time resolved in-flight ring depth for host-path ops
+    # (pipeline/transfer.py); fused segments carry their own on
+    # FusedSegment. Host nodes stay synchronous (1) unless the element
+    # sets ring-depth explicitly.
+    ring_depth: int = 1
+
     # Bumped whenever the op's make_fn() result changes without a shape
     # change (model hot swap via reload_model): part of FusedSegment's
     # compiled-program cache key, so a same-shape reload cannot keep
@@ -352,6 +388,13 @@ class TensorOp(Element):
         """False → run as a host node (fusion barrier) instead of fusing
         (e.g. tensor_filter with a host-library backend)."""
         return True
+
+    def is_identity(self) -> bool:
+        """True → this op's fn is the identity over its tensors (the
+        passthrough backend): a segment of only-identity ops skips the
+        jitted program entirely (FusedSegment short-circuit,
+        docs/streaming.md)."""
+        return False
 
     def is_batch_capable(self) -> bool:
         """True → the host path may collect a micro-batch and call

@@ -33,6 +33,7 @@ from nnstreamer_tpu.backends.base import Backend, BackendError, FilterProps, Inv
 from nnstreamer_tpu.elements.base import (
     DEVICE_PROPS,
     FAULT_PROPS,
+    STREAM_PROPS,
     NegotiationError,
     PropSpec,
     Spec,
@@ -172,6 +173,9 @@ class TensorFilter(TensorOp):
             "str", None,
             desc="comma list of padded batch sizes (default 1,2,4,...,max-batch)",
         ),
+        # resident streaming (pipeline/transfer.py, docs/streaming.md):
+        # in-flight frame ring depth for this filter's device node
+        **STREAM_PROPS,
         # per-frame error policy (pipeline/faults.py)
         **FAULT_PROPS,
         # device-resilience policy (pipeline/device_faults.py): OOM
@@ -516,6 +520,18 @@ class TensorFilter(TensorOp):
         if traced is None:
             raise RuntimeError(f"{self.name}: backend not traceable")
         return self._apply_combinations(traced)
+
+    def is_identity(self) -> bool:
+        """True when the backend declares IS_IDENTITY and no pad
+        combination rewires tensors: the fused segment then serves the
+        frame without any device program (docs/streaming.md)."""
+        if self.in_combination is not None or self.out_combination is not None:
+            return False
+        try:
+            b = self._ensure_open()
+        except Exception:  # noqa: BLE001 — open failures surface later
+            return False
+        return getattr(type(b), "IS_IDENTITY", False)
 
     # -- replica failover (parallel/replicas.py) ---------------------------
     def _ensure_replicas(self):

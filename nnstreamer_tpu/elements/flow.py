@@ -48,6 +48,11 @@ class Queue(TensorOp):
 
     FACTORY_NAME = "queue"
 
+    # never reads tensor bytes: device arrays pass through, so adjacent
+    # fused segments hand off device-resident ACROSS a queue
+    # (docs/streaming.md)
+    DEVICE_PASSTHROUGH = True
+
     PROPERTIES = {
         "max-size-buffers": PropSpec(
             "int", 64, desc="depth of the downstream element's input queue"
@@ -81,6 +86,10 @@ class CapsFilter(TensorOp):
     to zero cost on tensor links, host passthrough on media links."""
 
     FACTORY_NAME = "capsfilter"
+
+    # identity over tensor bytes: device-resident handoff chains across
+    # it like queue (docs/streaming.md)
+    DEVICE_PASSTHROUGH = True
 
     # caps tokens carry arbitrary media fields (media/width/height/...):
     # the schema is open-ended, so PROPS_ANY opts out of unknown-property

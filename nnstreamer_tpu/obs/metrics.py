@@ -91,6 +91,13 @@ METRIC_CATALOG: Dict[str, str] = {
         "(host/eager path) or OOM batch ceiling below the full ladder — "
         "else 0, per element (gauge; docs/resilience.md)"
     ),
+    "nns_transfer_bytes_total": (
+        "bytes crossing the host<->device boundary through the "
+        "transfer engine, by direction label: h2d (staged uploads) / "
+        "d2h (coalesced fetches) — zero d2h between adjacent fused "
+        "segments is the resident-handoff invariant (counter; "
+        "docs/streaming.md)"
+    ),
 }
 
 # default ladder: quarter-octave buckets from 1 µs up past 100 s —

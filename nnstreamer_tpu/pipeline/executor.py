@@ -56,6 +56,7 @@ from nnstreamer_tpu.pipeline.faults import (
     resolve_fault_policy,
     watchdog_timeout_ms,
 )
+from nnstreamer_tpu.pipeline import transfer
 from nnstreamer_tpu.pipeline.graph import ExecPlan, FusedSegment, Link
 from nnstreamer_tpu.pipeline.sanitize import (
     Sanitizer,
@@ -281,6 +282,78 @@ class _MeteredChan(_Chan):
         return out
 
 
+class _FrameRing:
+    """In-flight frame window for a device node (docs/streaming.md).
+
+    The resident streaming discipline: a node SUBMITS frame N (async
+    dispatch), and only once ``depth`` frames are in flight does the
+    oldest one DELIVER downstream — so H2D staging of frame N+1, compute
+    of frame N, and D2H of frame N-1 all overlap on the device's stream.
+    Delivery is strictly FIFO, so in-order semantics and the sanitizer's
+    offered == delivered accounting hold at every depth, and a fault
+    mid-ring degrades only after the older in-flight frames have drained
+    in order (the ladder in _invoke_window never reorders either).
+
+    ``to_host`` arms the D2H half: when every consumer on the out pad
+    negotiated host tensors, entering the ring starts ONE coalesced
+    async fetch for the frame (pipeline/transfer.py) and delivery
+    materializes the — by then usually landed — host copy. Device-
+    capable consumers (an adjacent fused segment) get the device arrays
+    untouched: the resident handoff, zero host materialization."""
+
+    __slots__ = ("node", "depth", "to_host", "_q")
+
+    def __init__(self, node: "Node", depth: int, to_host: bool) -> None:
+        self.node = node
+        self.depth = max(1, int(depth))
+        self.to_host = to_host
+        self._q: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def put(self, frame, t0: Optional[float] = None) -> None:
+        """Submit one output frame; delivers the oldest in-flight frame
+        once the ring is full. ``t0`` (per-frame paths) defers the
+        node's stat() to delivery so frames_processed counts frames
+        that actually left the node."""
+        fetch = None
+        if self.to_host and any(
+            transfer.is_device_array(t) for t in frame.tensors
+        ):
+            fetch = transfer.fetch_frame(frame)
+        self._q.append((frame, fetch, t0))
+        while len(self._q) >= self.depth:
+            self._deliver_one()
+
+    def flush(self) -> None:
+        """Deliver everything in flight, oldest first (EOS, idle input,
+        and pre-degradation drains)."""
+        while self._q:
+            self._deliver_one()
+
+    def _deliver_one(self) -> None:
+        frame, fetch, t0 = self._q.popleft()
+        node = self.node
+        if fetch is not None:
+            try:
+                frame = frame.with_tensors(fetch.finish()).mark_synced()
+            except _Stop:
+                raise
+            except Exception as exc:
+                # async dispatch means a device fault can surface HERE,
+                # at materialization, long after _process_frame's try
+                # blocks returned — feed it to the node's fault
+                # machinery (circuit + error policy) instead of letting
+                # it skip the whole ladder
+                if node.dispose_fault(frame, exc):
+                    return  # disposed with accounting (drop/route)
+                raise
+        if t0 is not None:
+            node.stat(t0)
+        node.push_out(0, frame)
+
+
 class Node:
     def __init__(self, ex: "Executor", name: str) -> None:
         self.ex = ex
@@ -311,6 +384,9 @@ class Node:
         # warm-restart state restored before the service loop built its
         # governor/circuit/gate (Executor.restore on a fresh executor)
         self._pending_restore: Optional[Dict[str, Any]] = None
+        # in-flight frame ring (docs/streaming.md): built by the device
+        # service loops; None on nodes that deliver synchronously
+        self._ring: Optional[_FrameRing] = None
         # nns-obs handles (None/empty with metrics off — the default):
         # wired by Executor._build when a registry is active
         self._lat_hist = None        # per-invoke latency histogram
@@ -338,6 +414,39 @@ class Node:
         block on a single queue don't need it (chan.get wakes them);
         multi-pad nodes override to wake their scheduler and set
         _needs_notify so producers know to call it."""
+
+    def inflight(self) -> int:
+        """Frames submitted but not yet delivered (the node's ring):
+        drain() quiescence and the stall watchdog must see them — a
+        frame parked in a ring is neither queued nor delivered."""
+        ring = self._ring
+        return len(ring) if ring is not None else 0
+
+    def _out_wants_host(self) -> bool:
+        """Link-level placement negotiation for pad 0 (docs/streaming.md):
+        True when EVERY consumer reads tensor bytes on host — a
+        host-library filter node, or an element declaring WANTS_HOST —
+        so the producer pre-fetches ONE coalesced async D2H per frame,
+        overlapped with the next frame's compute, instead of each
+        consumer paying a synchronous per-tensor fetch. Any
+        device-capable consumer (an adjacent fused segment — the
+        resident handoff) keeps frames on device untouched."""
+        consumers = self.outs.get(0)
+        if not consumers:
+            return False
+        for dst, _pad in consumers:
+            elem = getattr(dst, "elem", None)
+            if elem is not None and getattr(type(elem), "WANTS_HOST", False):
+                continue
+            if isinstance(dst, TensorOpHostNode) and not getattr(
+                type(elem), "DEVICE_PASSTHROUGH", False
+            ):
+                # host-path op that reads tensor bytes; queue/capsfilter
+                # (DEVICE_PASSTHROUGH) carry device arrays untouched, so
+                # the handoff chains across them
+                continue
+            return False
+        return True
 
     def broadcast_eos(self) -> None:
         for pad in self.outs:
@@ -448,6 +557,27 @@ class Node:
         if tracer is not None:
             tracer.fault(self.name, f"device-{kind}", exc)
         return kind
+
+    def dispose_fault(self, frame, exc: Exception) -> bool:
+        """Handle a fault that surfaced OUTSIDE an invoke's try block —
+        async dispatch errors materialize at ring delivery (the
+        coalesced fetch), and H2D staging can fail before the invoke:
+        classify + count it (device circuit included), then dispose of
+        the frame through the per-frame error policy with full
+        accounting. False → no disposal policy (stop): the caller
+        re-raises, PR-3 semantics. The frame cannot be re-invoked at
+        this point, so ``retry`` degrades to route-or-drop exactly like
+        an exhausted retry budget."""
+        kind = self._device_fault(exc)
+        circ = self.device_circuit
+        if kind is not None and circ is not None and circ.record_fault(kind):
+            self._update_degraded_gauge()
+        gate = self.fault_gate
+        if gate is None or gate.policy.on_error == "stop":
+            return False
+        gate.stats.errors += 1
+        gate._dispose(frame, exc, 0)
+        return True
 
     def _update_degraded_gauge(self) -> None:
         """Refresh nns_degraded_segments for this node (0/1): degraded
@@ -694,8 +824,35 @@ class FusedNode(Node):
             self._run_batched(cfg, gate)
             return
         first = self.seg.first
+        ring = _FrameRing(
+            self, self.seg.ring_depth or 1, self._out_wants_host()
+        )
+        self._ring = ring
+        # H2D staging (pipeline/transfer.py): host tensors become fresh
+        # device arrays via async device_put BEFORE dispatch, so frame
+        # N+1's wire time overlaps frame N's compute. Bypassed on a
+        # process-local CPU backend (the jitted ingest IS the cheaper
+        # copy) and for identity segments (nothing dispatches at all).
+        stage_on = (
+            not transfer.default_backend_is_cpu()
+            and not self.seg.is_identity()
+        )
+        # donation needs exclusive buffer ownership and replay safety:
+        # _process_frame stages a PRIVATE device copy of an all-host
+        # frame and donates THAT, so the circuit's eager fallback can
+        # always restage from the caller's intact host buffers. A retry
+        # gate re-invokes through its own callback (no donate kwarg),
+        # so gated streams keep un-donated semantics.
+        donate_ok = self.seg.donate and gate is None
+        chan = self.in_queues[0]
+        stop = self.ex.stop_event
         while True:
-            item = self.pop(0)
+            item = chan.get_nowait()
+            if item is _EMPTY:
+                # idle input: deliver what's in flight rather than
+                # holding frames across the gap, then block
+                ring.flush()
+                item = chan.get(stop)
             if item is EOS_FRAME:
                 break
             if self.shed_if_expired(item):
@@ -707,31 +864,59 @@ class FusedNode(Node):
                     q.skipped_upstream += 1
                 continue
             t0 = time.perf_counter()
+            if stage_on:
+                if donate_ok and not any(
+                    transfer.is_device_array(t) for t in item.tensors
+                ):
+                    # all-host frame: _process_frame stages the private
+                    # upload and donates it. A frame carrying an
+                    # upstream device array (resident handoff, tee
+                    # share) is partly someone ELSE's memory — never
+                    # donated, staged below instead.
+                    ring.put(self._process_frame(item, donate=True), t0)
+                    continue
+                try:
+                    staged = transfer.stage_frame(item)
+                except _Stop:
+                    raise
+                except Exception as exc:
+                    # H2D put failed before any invoke: same off-ladder
+                    # disposal as an async delivery fault
+                    if self.dispose_fault(item, exc):
+                        continue
+                    raise
+                if staged is not item:
+                    item = staged
             if gate is None:
                 out = self._process_frame(item)
             else:
                 delivered, out = gate.process(item, self._process_frame)
                 if not delivered:
                     continue
-            self.stat(t0)
-            self.push_out(0, out)
+            ring.put(out, t0)
+        ring.flush()
         self.broadcast_eos()
 
     # -- device-resilient invoke paths ------------------------------------
-    def _process_frame(self, item):
+    def _process_frame(self, item, donate: bool = False):
         """seg.process with the device circuit around it: repeated
         device faults (or one compile failure) open the circuit and this
         frame — and the stream after it — serves from the eager path;
         while open, periodic probes close it on recovery. Below the
         open threshold the typed exception propagates to the node's
-        error policy (stop/drop/retry/route), PR-3 semantics."""
+        error policy (stop/drop/retry/route), PR-3 semantics.
+        ``donate`` requires an ALL-HOST frame: a private device copy is
+        staged HERE and donated, so every replay path — the circuit's
+        eager fallback, a later retry attempt — reads the caller's
+        intact host buffers, never a donated (deleted) array."""
         circ = self.device_circuit
-        if circ is None:
-            return self.seg.process(item)
-        if circ.open:
+        if circ is not None and circ.open:
             return self._degraded_process(item)
+        dev = transfer.stage_frame(item, force=True) if donate else item
+        if circ is None:
+            return self.seg.process(dev, donate)
         try:
-            out = self.seg.process(item)
+            out = self.seg.process(dev, donate)
         except _Stop:
             raise
         except Exception as exc:
@@ -866,7 +1051,18 @@ class FusedNode(Node):
         collector = self.make_batch_collector(
             cfg, self.seg.first, cap=(gov.cap if gov is not None else None)
         )
+        # window-granular double buffer: delivery of window K's frames
+        # (and their coalesced D2H when the link negotiated host) lags
+        # up to ring_depth frames behind the dispatch of window K+1
+        ring = _FrameRing(
+            self, self.seg.ring_depth or 1, self._out_wants_host()
+        )
+        self._ring = ring
         while True:
+            if not self.in_queues[0]:
+                # idle input: don't hold delivered-able frames across
+                # the collector's blocking wait
+                ring.flush()
             frames, eos, wait_s = collector.collect()
             if frames:
                 frames = [
@@ -878,9 +1074,10 @@ class FusedNode(Node):
                 self.seg.batch_stats.record(len(frames), rows, wait_s)
                 self.stat_batch(t0, len(frames), rows, wait_s)
                 for f in outs:
-                    self.push_out(0, f)
+                    ring.put(f)
             if eos:
                 break
+        ring.flush()
         self.broadcast_eos()
 
 
@@ -907,9 +1104,33 @@ class TensorOpHostNode(Node):
             self._run_batched(cfg, gate)
             return
         self._apply_pending_restore()
+        # in-flight ring (docs/streaming.md): host nodes stay
+        # synchronous (depth 1) unless the element set ring-depth — a
+        # host backend whose invoke dispatches async work (or holds
+        # device outputs) then overlaps delivery with the next invoke.
+        # A DEVICE_PASSTHROUGH node (queue/capsfilter) carries device
+        # arrays; when ITS consumers read bytes on host it arms the
+        # coalesced prefetch, so a handoff chained across a queue still
+        # lands as ONE overlapped D2H instead of the reader paying
+        # per-tensor synchronous fetches.
+        depth = getattr(self.elem, "ring_depth", 1) or 1
+        to_host = (
+            getattr(type(self.elem), "DEVICE_PASSTHROUGH", False)
+            and self._out_wants_host()
+        )
+        if to_host and depth < 2:
+            depth = 2  # overlap the fetch with the next hop
+        ring = _FrameRing(self, depth, to_host)
+        self._ring = ring
+        chan = self.in_queues[0]
+        stop = self.ex.stop_event
         while True:
-            item = self.pop(0)
+            item = chan.get_nowait()
+            if item is _EMPTY:
+                ring.flush()
+                item = chan.get(stop)
             if item is EOS_FRAME:
+                ring.flush()
                 for f in self.elem.flush():
                     self.push_out(0, f)
                 break
@@ -930,7 +1151,8 @@ class TensorOpHostNode(Node):
             if out is None:  # absorbed (e.g. batching mid-window)
                 continue
             for f in out if isinstance(out, list) else [out]:
-                self.push_out(0, f)
+                ring.put(f)
+        ring.flush()
         self.broadcast_eos()
 
     def _run_batched(self, cfg, gate=None) -> None:
@@ -1153,43 +1375,17 @@ class SinkNode(Node):
             return tuple(keys)
 
         def _batch_fetch(frames: List) -> List:
-            """One stacked D2H transfer per tensor index for a window of
-            same-shaped device frames, instead of a per-frame fetch in
-            each render's to_host — per-transfer cost dominates small
-            results on a remote-attached device, so W frames' labels
-            must ride ONE transfer. Falls back to the per-frame path on
-            any heterogeneity (returning None so the caller restores
-            the overlapped per-frame prefetch the stacked path
-            replaces)."""
-            if len(frames) < 2:
-                return None
+            """ONE coalesced D2H for the whole window's tensors
+            (pipeline/transfer.py fetch_window) instead of a fetch per
+            tensor per frame — per-transfer cost dominates small
+            results on a remote-attached device, so W frames × T
+            tensors must not pay W·T round trips. The packed path
+            degrades internally (local CPU arrays fetch by memcpy,
+            cross-device windows fall back per-tensor with placement
+            untouched); None only on a hard failure, restoring the
+            per-frame prefetch."""
             try:
-                import jax.numpy as jnp
-                import numpy as np
-
-                n_t = len(frames[0].tensors)
-                if any(len(f.tensors) != n_t for f in frames):
-                    return None
-                cols = []
-                for i in range(n_t):
-                    ts = [f.tensors[i] for f in frames]
-                    if not all(hasattr(t, "devices") for t in ts):
-                        return None
-                    if len({t.shape for t in ts}) != 1:
-                        return None
-                    # a window spanning devices (per-stage placement
-                    # pipelines) must not be stacked — the eager stack
-                    # would silently migrate buffers; per-frame fetch
-                    # keeps placement untouched
-                    if len({d for t in ts for d in t.devices()}) > 1:
-                        return None
-                    cols.append(np.asarray(jnp.stack(ts)))
-                return [
-                    f.with_tensors(
-                        [cols[i][j] for i in range(n_t)]
-                    ).mark_synced()
-                    for j, f in enumerate(frames)
-                ]
+                return transfer.fetch_window(frames)
             except Exception:  # noqa: BLE001 — fetch is an optimization
                 return None
 
@@ -1242,6 +1438,15 @@ class SinkNode(Node):
                 if len(pending) >= window:
                     flush()
             else:
+                if getattr(self.elem, "READS_HOST", True) and any(
+                    transfer.is_device_array(t) for t in item.tensors
+                ):
+                    # one coalesced (and tallied) fetch per frame via
+                    # the transfer engine, instead of render()'s
+                    # per-tensor on-demand np.asarray
+                    item = item.with_tensors(
+                        transfer.fetch_frame(item).finish()
+                    ).mark_synced()
                 self.elem.render(item)
                 self._mark_render(1, (item,))
             self.stat(t0)
@@ -1289,6 +1494,8 @@ class Executor:
         self.metrics = obs_metrics.get()
         self._metrics_server = None
         self._t_run0: Optional[float] = None
+        # transfer-tally baseline, re-snapshotted at start()
+        self._transfer_t0: Dict[str, int] = transfer.tally.snapshot()
         self._t_run_end: Optional[float] = None
         self._build()
 
@@ -1444,6 +1651,11 @@ class Executor:
             return
         self._started = True
         self._t_run0 = time.perf_counter()
+        # run-scoped transfer accounting (pipeline/transfer.py): the
+        # module tally is process-global, so this run's H2D/D2H bytes
+        # are the delta against this baseline (totals()["transfer"],
+        # mirrored into nns_transfer_bytes_total at stop)
+        self._transfer_t0 = transfer.tally.snapshot()
         if self.metrics is not None:
             port = obs_metrics.resolve_port()
             if port is not None:
@@ -1537,8 +1749,12 @@ class Executor:
                 continue
             if now - t_last <= timeout_s:
                 continue
-            if not any(len(q) for n in self.nodes for q in n.in_queues):
-                t_last = now  # idle, not stuck: nothing is waiting to move
+            if not any(
+                len(q) for n in self.nodes for q in n.in_queues
+            ) and not any(n.inflight() for n in self.nodes):
+                # idle, not stuck: nothing queued AND nothing parked in
+                # an in-flight ring is waiting to move
+                t_last = now
                 continue
             if any(
                 n.fault_gate is not None
@@ -1594,9 +1810,12 @@ class Executor:
             if self.errors:
                 return False
             counts = tuple(n.frames_processed for n in self.nodes)
+            # a frame parked in a node's in-flight ring is neither
+            # queued nor delivered — quiescence must wait for the
+            # idle-input flush to hand it downstream
             empty = not any(
                 len(q) for n in self.nodes for q in n.in_queues
-            )
+            ) and not any(n.inflight() for n in self.nodes)
             if empty and counts == last:
                 settled += 1
                 if settled >= polls_needed:
@@ -1738,6 +1957,9 @@ class Executor:
         deadline = time.monotonic() + 5.0  # total, not per-thread
         for t in threads:
             t.join(timeout=max(0.05, deadline - time.monotonic()))
+        if self.metrics is not None:
+            # after the join so late in-flight fetches are counted
+            transfer.mirror_into(self.metrics)
         for e in self.plan.pipeline.elements:
             e.stop()
         leaked = [t.name for t in threads if t.is_alive()]
@@ -1954,4 +2176,21 @@ class Executor:
             "created": created,
             "balance": produced + sum(created.values())
             - rendered - sum(dropped.values()),
+            "transfer": self.transfer_totals(),
+        }
+
+    def transfer_totals(self) -> Dict[str, int]:
+        """This run's host<->device traffic through the transfer engine
+        (pipeline/transfer.py), bytes by direction — the module tally
+        minus the baseline start() snapshotted. ``d2h == 0`` across a
+        device-resident handoff chain is the zero-host-materialization
+        invariant docs/streaming.md promises (and tests assert).
+        The tally is process-global, so executors running CONCURRENTLY
+        in one process see each other's traffic in this delta — assert
+        on it from serial runs."""
+        now = transfer.tally.snapshot()
+        base = self._transfer_t0
+        return {
+            "h2d": now["h2d_bytes"] - base["h2d_bytes"],
+            "d2h": now["d2h_bytes"] - base["d2h_bytes"],
         }

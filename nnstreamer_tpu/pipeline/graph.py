@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
+import numpy as np
 
 from nnstreamer_tpu.elements.base import (
     Element,
@@ -248,6 +249,10 @@ class Pipeline:
             resolve_device_policy,
         )
         from nnstreamer_tpu.pipeline.faults import resolve_fault_policy
+        from nnstreamer_tpu.pipeline.transfer import (
+            donation_enabled,
+            resolve_ring_depth,
+        )
 
         for e in self._toposort():
             # non-traceable TensorOps (host-bound backends) execute as host
@@ -273,6 +278,14 @@ class Pipeline:
                         e.batch_stats = BatchStats()
                     e.fault_policy = resolve_fault_policy([e])
                     e.device_policy = resolve_device_policy([e])
+                    # host nodes keep the synchronous loop unless the
+                    # element asks for a ring explicitly (a host
+                    # backend's invoke can't overlap with itself, so
+                    # the config-level default would only add latency)
+                    raw = e.get_property("ring-depth")
+                    e.ring_depth = (
+                        resolve_ring_depth([e]) if raw is not None else 1
+                    )
                 continue
             ups = self.in_links(e)
             up = ups[0].src if len(ups) == 1 else None
@@ -298,6 +311,8 @@ class Pipeline:
             seg.batch_config = resolve_batch_config(seg.ops)
             seg.fault_policy = resolve_fault_policy(seg.ops)
             seg.device_policy = resolve_device_policy(seg.ops)
+            seg.ring_depth = resolve_ring_depth(seg.ops)
+            seg.donate = donation_enabled()
             for op in seg.ops:
                 op.batch_stats = seg.batch_stats
         return ExecPlan(self, segments, seg_of)
@@ -435,6 +450,18 @@ class FusedSegment:
         # process_batch are then poison, not last-frame replicas. One
         # flag resolved at build — the hot path never re-reads config.
         self.sanitize_poison = False
+        # resident streaming (pipeline/transfer.py, docs/streaming.md):
+        # ring_depth = in-flight frames the executor keeps for this
+        # segment; donate = node-owned activation buffers (staged
+        # uploads, stacked windows) are donated to the program so XLA
+        # reuses them for outputs. Both resolved at plan time.
+        self.ring_depth: Optional[int] = None
+        self.donate = False
+        # identity short-circuit: a segment of only-identity ops (the
+        # passthrough backend) serves frames without ANY device program
+        # — per-frame XLA dispatch is pure overhead there. Resolved on
+        # first use (backends must be open).
+        self._identity: Optional[bool] = None
         from nnstreamer_tpu.pipeline.batching import BatchStats
 
         self.batch_stats = BatchStats()
@@ -471,22 +498,81 @@ class FusedSegment:
 
         return composed
 
-    def _jitted_for(self, sig: tuple, bucket: int = 0) -> Callable:
+    def is_identity(self) -> bool:
+        """True when every member op declares is_identity(): process()
+        then returns the frame untouched (no compile, no dispatch)."""
+        if self._identity is None:
+            try:
+                self._identity = all(op.is_identity() for op in self.ops)
+            except Exception:  # noqa: BLE001 — unopened backend: not identity
+                self._identity = False
+        return self._identity
+
+    def _jitted_for(
+        self, sig: tuple, bucket: int = 0, donate: bool = False
+    ) -> Callable:
         # fn_version ticks on model hot swap (reload_model): same shapes,
         # different weights — the old program must not be served
         versions = tuple(op.fn_version for op in self.ops)
-        key = (sig, bucket, versions)
+        key = (sig, bucket, versions, donate)
         last = self._last
         if last is not None and last[0] == key:
             return last[1]
         fn = self._cache.get(key)
         if fn is None:
             composed = self._compose()
-            fn = jax.jit(jax.vmap(composed) if bucket else composed)
+            target = jax.vmap(composed) if bucket else composed
+            # donate_argnums on the activations: the caller OWNS these
+            # buffers (staged uploads / stacked windows — never an
+            # upstream element's arrays), so XLA may reuse them for
+            # outputs instead of growing the device arena per in-flight
+            # frame (docs/streaming.md). Only inputs whose (shape,
+            # dtype) matches an output can actually be aliased — a
+            # uint8 image feeding a float program would just be deleted
+            # with an XLA "unusable donation" warning, so those stay
+            # un-donated.
+            kw = {}
+            if donate:
+                argnums = self._aliasable_argnums(target, sig, bucket)
+                if argnums:
+                    kw = {"donate_argnums": argnums}
+            fn = jax.jit(target, **kw)
             self._cache[key] = fn
             self.n_traces += 1
         self._last = (key, fn)
         return fn
+
+    @staticmethod
+    def _aliasable_argnums(target, sig, bucket: int) -> tuple:
+        """Input indices whose buffer XLA can actually reuse for an
+        output: exact (shape, dtype) match, each output absorbing at
+        most one input. eval_shape runs abstractly (no compile, no
+        device) — a trace failure just disables donation for this
+        entry."""
+        try:
+            shapes = [
+                jax.ShapeDtypeStruct(
+                    (bucket, *shape) if bucket else shape, dtype
+                )
+                for shape, dtype in sig
+            ]
+            outs = jax.eval_shape(target, *shapes)
+            pool: Dict[tuple, int] = {}
+            for o in outs:
+                k = (tuple(o.shape), np.dtype(o.dtype))
+                pool[k] = pool.get(k, 0) + 1
+            argnums = []
+            for i, (shape, dtype) in enumerate(sig):
+                k = (
+                    ((bucket, *shape) if bucket else tuple(shape)),
+                    np.dtype(dtype),
+                )
+                if pool.get(k, 0) > 0:
+                    pool[k] -= 1
+                    argnums.append(i)
+            return tuple(argnums)
+        except Exception:  # noqa: BLE001 — donation is an optimization
+            return ()
 
     def _negotiated_sig(self) -> Optional[tuple]:
         spec = self.first.in_specs[0] if self.first.in_specs else None
@@ -505,9 +591,19 @@ class FusedSegment:
         (smaller buckets stay lazy: they only appear at trickle/EOS
         boundaries where a one-off compile stall is tolerable)."""
         sig = self._negotiated_sig()
-        if sig is None:
+        if sig is None or self.is_identity():
             return None
-        fn = self._jitted_for(sig)
+        # warm the variants steady state will actually SERVE: the cache
+        # key includes `donate`, so warming the un-donated program when
+        # the executor then calls the donated one would leave the first
+        # live frame stalling on a full XLA compile at PLAYING. The
+        # per-frame path donates only off-CPU (the staging path); the
+        # batched path donates its stacked windows everywhere.
+        from nnstreamer_tpu.pipeline.transfer import default_backend_is_cpu
+
+        fn = self._jitted_for(
+            sig, 0, self.donate and not default_backend_is_cpu()
+        )
         cfg = self.batch_config
         if cfg is not None and cfg.active:
             try:
@@ -519,7 +615,7 @@ class FusedSegment:
                     for shape, dtype in sig
                 ]
                 jax.block_until_ready(
-                    self._jitted_for(sig, bucket)(*zeros)
+                    self._jitted_for(sig, bucket, self.donate)(*zeros)
                 )
             except Exception as exc:
                 from nnstreamer_tpu.pipeline.device_faults import (
@@ -538,9 +634,18 @@ class FusedSegment:
                 _log.warning("%s: batched warmup failed: %s", self.name, exc)
         return fn
 
-    def process(self, frame: Frame) -> Frame:
-        out = self._jitted_for(self._sig_of(frame.tensors))(*frame.tensors)
-        f = frame.with_tensors(out)
+    def process(self, frame: Frame, donate: bool = False) -> Frame:
+        """One frame through the compiled program. ``donate=True`` hands
+        the frame's tensors to XLA for output reuse — ONLY legal when
+        the caller owns every buffer (the executor's staged-H2D path;
+        donated arrays are deleted, so a shared/reused input would die
+        under its other holders)."""
+        identity = self._identity
+        if identity or (identity is None and self.is_identity()):
+            f = frame
+        else:
+            fn = self._jitted_for(self._sig_of(frame.tensors), 0, donate)
+            f = frame.with_tensors(fn(*frame.tensors))
         for op in self.ops:
             f = op.transform_meta(f)
         return f
@@ -590,6 +695,9 @@ class FusedSegment:
         import jax.numpy as jnp
 
         n = len(frames)
+        if self.is_identity():
+            # no program to batch for: per-frame passthrough, no padding
+            return [self.process(f) for f in frames], n
         sig = self._sig_of(frames[0].tensors)
         if any(self._sig_of(f.tensors) != sig for f in frames[1:]):
             # heterogeneous window (flexible stream / renegotiation
@@ -603,7 +711,10 @@ class FusedSegment:
             # with the PADDED bucket — that is the width the device sees
             for probe in probes:
                 probe(bucket)
-        fn = self._jitted_for(sig, bucket)
+        # the stacked cols are freshly built below — this call owns
+        # them, so donation is always safe here (an OOM retry restacks
+        # from the still-live member frames)
+        fn = self._jitted_for(sig, bucket, self.donate)
         pad = bucket - n
         filler = None
         if pad and self.sanitize_poison:

@@ -298,11 +298,21 @@ def disable() -> None:
         _tracer = None
 
 
+_env_checked = False
+
+
 def get() -> Optional[Tracer]:
-    """Active tracer or None (the hot-path check: one global read)."""
+    """Active tracer or None (the hot-path check: one global read).
+    The ``NNS_TRACE`` env opt-in is resolved on the FIRST miss only —
+    this runs per frame per node at multi-kfps, and an environ lookup
+    each call is a measurable slice of the executor's frame budget."""
     t = _tracer
-    if t is None and os.environ.get("NNS_TRACE"):
-        t = enable()
+    if t is None:
+        global _env_checked
+        if not _env_checked:
+            _env_checked = True
+            if os.environ.get("NNS_TRACE"):
+                t = enable()
     return t
 
 
