@@ -139,6 +139,12 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "back mid-stream, defeating the resident device-to-device "
         "segment handoff",
     ),
+    "NNS-W114": (
+        Severity.WARNING, "duplicate-model-no-sharing",
+        "two or more tensor_filter instances open the same "
+        "model/framework without shared-tensor-filter-key or a serving "
+        "plane: each loads its own copy of the weights on device",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -755,6 +755,49 @@ def _replica_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _model_sharing_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W114: duplicate model, no sharing — two+ tensor_filter
+    instances naming the same model/framework without a
+    ``shared-tensor-filter-key`` or a serving ``plane`` each open their
+    own backend: N copies of the weights resident on device where one
+    would serve (docs/serving-plane.md). Replicated filters
+    (``replicas=N``) duplicate on purpose and are exempt."""
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    groups: Dict[tuple, List] = {}
+    for e in pipeline.elements:
+        if not isinstance(e, TensorFilter):
+            continue
+        model = str(e.get_property("model") or "").strip()
+        if not model:
+            continue  # model-less fakes: nothing resident to duplicate
+        if str(e.get_property("shared-tensor-filter-key") or "").strip():
+            continue
+        if str(e.get_property("plane") or "").strip():
+            continue
+        try:
+            if int(e.get_property("replicas") or 0) > 1:
+                continue  # deliberate copies (failover)
+        except (TypeError, ValueError):
+            pass  # NNS-E005 already covers the bad value
+        fw = str(e.get_property("framework") or "auto").strip()
+        groups.setdefault((fw, model), []).append(e)
+    for (fw, model), elems in groups.items():
+        if len(elems) < 2:
+            continue
+        names = ", ".join(e.name for e in elems)
+        for e in elems:
+            report.add(
+                "NNS-W114", e.name,
+                f"model {model!r} ({fw}) is opened {len(elems)}x "
+                f"without sharing ({names}): {len(elems)} weight "
+                "copies resident where one would serve",
+                "set one shared-tensor-filter-key on the group, or "
+                "serve them through a plane=<name> "
+                "(docs/serving-plane.md)",
+            )
+
+
 def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W113: a host-bound element between two device-capable
     (traceable) filters forces every frame through host memory and back
@@ -1017,6 +1060,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _admission_pass(pipeline, report)
     _replica_failover_pass(pipeline, report)
     _resident_handoff_pass(pipeline, report)
+    _model_sharing_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)

@@ -90,6 +90,15 @@ class Backend(ABC):
     #: docs/streaming.md).
     IS_IDENTITY: bool = False
 
+    #: whether invoke() accepts device-resident input arrays (the
+    #: backend stages/reshards them itself — jax device_put). The
+    #: executor's link negotiation (Node._out_wants_host) keeps the
+    #: device-resident handoff alive into such a backend's host node
+    #: (a device-pinned placement stage) instead of forcing a coalesced
+    #: D2H; host-library backends read tensor bytes on host and leave
+    #: this False (docs/streaming.md, docs/serving-plane.md).
+    DEVICE_INPUT_OK: bool = False
+
     def __init__(self) -> None:
         self.props: Optional[FilterProps] = None
         self.stats = InvokeStats()

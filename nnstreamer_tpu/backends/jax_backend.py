@@ -127,6 +127,7 @@ class JaxBackend(Backend):
     (TPU when present), optionally sharded over a mesh (see parallel/)."""
 
     name = "jax"
+    DEVICE_INPUT_OK = True  # invoke() device_puts/reshards its inputs
 
     def __init__(self) -> None:
         super().__init__()
@@ -381,6 +382,39 @@ class JaxBackend(Backend):
             # abstract evaluation of the jitted function
             outs = jax.eval_shape(wrapped, *dummies)
         self._out_spec = _spec_from_avals(_as_tuple(outs))
+
+    def plane_fn(self):
+        """``(fn, device)`` for the serving plane (serving_plane/
+        sharding.py). Unlike :meth:`traceable_fn` — which refuses when a
+        device pin makes FUSION illegal — the plane builds its own
+        program and honors the pin itself, so ``plane= device=N``
+        batches on chip N instead of silently degrading to a per-frame
+        host loop. Mesh-sharded state still returns (None, None): the
+        plane's own ``plane-mode=shard`` is the sharded path."""
+        fn = self._fn
+        if fn is None or self._mesh_spec or self._shardings is not None:
+            return None, None
+        return (lambda tensors: _as_tuple(fn(*tensors))), self._device
+
+    def pin_device(self, idx: int) -> None:
+        """Post-open per-stage placement — the Hermes planner's entry
+        (serving_plane/placement.py): pin this stage to device ``idx``
+        and recompile so weights land there once. Inter-stage hops then
+        ride async device_put (ICI on real chips). The ``device:``
+        custom option builds the same state at open; this hook exists
+        because the planner runs after backends opened (it reuses them
+        for the memory estimate)."""
+        devs = jax.devices()
+        if not (0 <= idx < len(devs)):
+            raise BackendError(
+                f"jax: device {idx} out of range (have {len(devs)})"
+            )
+        if self._mesh_spec or self._shardings is not None:
+            raise BackendError("jax: device pin and mesh are exclusive")
+        self._device = devs[idx]
+        if self._in_spec is not None and self._in_spec.is_static \
+                and self._jitted is not None:
+            self._compile()
 
     def set_shardings(
         self, in_shardings, out_shardings=None, param_shardings=None

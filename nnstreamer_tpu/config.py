@@ -57,6 +57,29 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "default_port": "3000",  # reference edge_common.h:36-37
         "timeout_sec": "10",  # reference tensor_query_common.h:28
     },
+    "plane": {
+        # serving-plane defaults (serving_plane/plane.py,
+        # docs/serving-plane.md); per-filter plane-* properties
+        # override. Env: NNS_TPU_PLANE_MAX_BATCH etc.
+        "max_batch": "8",
+        "timeout_ms": "1.0",
+        # single | shard (data-parallel mesh) | replicas (K failover
+        # copies, parallel/replicas.py semantics)
+        "mode": "single",
+        # devices backing the plane: mesh size (shard) / replica count
+        "devices": "1",
+        # replica health (mode=replicas): consecutive device faults
+        # that bench a replica, and probe cadence for re-admission
+        "unhealthy_after": "3",
+        "probe_every": "64",
+        # a submit with no service inside this window fails typed
+        # (service thread dead / program wedged), never hangs a node
+        "submit_timeout_s": "30",
+        # Hermes placement bound for place_pipeline (placement.py):
+        # bytes per device, K/M/G suffixes accepted; empty = the
+        # planner requires an explicit bound argument
+        "memory_per_device": "",
+    },
     "executor": {
         # micro-batching defaults for fused segments / batchable filters
         # (pipeline/batching.py); per-element properties on tensor_filter

@@ -219,6 +219,47 @@ class TensorFilter(TensorOp):
             "int", 64,
             desc="frames between benched-replica recovery probes",
         ),
+        # per-stage device placement (serving_plane/placement.py,
+        # docs/serving-plane.md): pin this filter's backend to one jax
+        # device; inter-stage hops become staged device_put transfers
+        "device": PropSpec(
+            "int", None,
+            desc="pin this stage to jax device N (Hermes placement; "
+            "default: planner/runtime choice)",
+        ),
+        # serving plane (serving_plane/, docs/serving-plane.md): filters
+        # naming one plane share ONE continuously-batched device program
+        # across executors — N client streams, one model instance
+        "plane": PropSpec(
+            "str", "",
+            desc="attach to the named process-wide serving plane "
+            "(cross-executor continuous batching)",
+        ),
+        "plane-weight": PropSpec(
+            "float", None,
+            desc="this stream's weighted-fair share on the plane "
+            "(default 1.0)",
+        ),
+        "plane-mode": PropSpec(
+            "enum", None, ("single", "shard", "replicas"),
+            desc="plane backing: one device / data-sharded mesh / "
+            "K failover replicas (default [plane] mode)",
+        ),
+        "plane-devices": PropSpec(
+            "int", None,
+            desc="devices backing the plane: mesh size (shard) or "
+            "replica count (replicas); default [plane] devices",
+        ),
+        "plane-max-batch": PropSpec(
+            "int", None,
+            desc="cross-stream batch cap per plane dispatch "
+            "(default [plane] max_batch = 8)",
+        ),
+        "plane-timeout-ms": PropSpec(
+            "float", None,
+            desc="plane straggler wait when trickle-fed "
+            "(default [plane] timeout_ms = 1.0)",
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -247,12 +288,28 @@ class TensorFilter(TensorOp):
                 str(self.get_property("outputtype", "float32")),
                 str(self.get_property("outputname", "")),
             )
+        custom = str(self.get_property("custom", ""))
+        # device= placement pin (serving_plane/placement.py): rides the
+        # custom string so the jax backend's existing per-stage
+        # placement path (open() reads options["device"]) serves both
+        # the explicit prop and the Hermes planner
+        dev_raw = self.get_property("device")
+        if dev_raw is not None and str(dev_raw).strip() != "":
+            try:
+                dev_idx = int(dev_raw)
+            except (TypeError, ValueError) as exc:
+                raise ValueError(
+                    f"{self.name}: bad device={dev_raw!r}: {exc}"
+                ) from exc
+            custom = ",".join(
+                x for x in (custom, f"device:{dev_idx}") if x
+            )
         self.fprops = FilterProps(
             framework=framework,
             model=model_list,
             input_spec=in_override,
             output_spec=out_override,
-            custom=str(self.get_property("custom", "")),
+            custom=custom,
             accelerator=str(self.get_property("accelerator", "")),
             invoke_dynamic=bool(self.get_property("invoke-dynamic", False)),
         )
@@ -297,6 +354,39 @@ class TensorFilter(TensorOp):
         )
         self._replica_set = None  # ReplicaSet, built lazily post-negotiate
         self._replica_backends: list = []
+        # serving plane (serving_plane/plane.py, docs/serving-plane.md):
+        # plane=<name> attaches this filter as ONE client stream of a
+        # process-wide shared batcher — a fusion barrier like replicas
+        # (cross-executor batching is per-frame dispatch by definition)
+        self.plane = str(self.get_property("plane", "") or "")
+        raw_w = self.get_property("plane-weight")
+        self.plane_weight = float(raw_w) if raw_w is not None else 1.0
+        self._plane = None          # ModelPlane once acquired
+        self._plane_stream = None   # this filter's PlaneStream
+        self._plane_cfg = None      # resolved PlaneConfig
+        self._plane_last_stats: Dict[str, Any] = {}
+        if self.plane:
+            # cross-stream batching rides the host batched loop: the
+            # LOCAL collector drains a window per round-trip (one
+            # submit amortizes two thread wakes over the window), the
+            # plane flattens windows from many streams into one device
+            # batch. Default the collector on, window-matched to the
+            # plane; explicit batching= / max-batch= props still win.
+            from nnstreamer_tpu.serving_plane.plane import (
+                resolve_plane_config,
+            )
+
+            self._plane_cfg = resolve_plane_config([self])
+            if self.get_property("batching") is None:
+                self.set_property("batching", "true")
+            if self.get_property("max-batch") is None:
+                self.set_property(
+                    "max-batch", str(self._plane_cfg.max_batch)
+                )
+            if self.get_property("batch-timeout-ms") is None:
+                self.set_property(
+                    "batch-timeout-ms", str(self._plane_cfg.timeout_ms)
+                )
         # warm-restart state arriving before the backend/replica set
         # exist (both build lazily on the first frame) — stashed here
         # and applied as each comes up, the Node._pending_restore
@@ -310,6 +400,28 @@ class TensorFilter(TensorOp):
                 "with shared-tensor-filter-key (one shared instance vs "
                 "N independent copies)"
             )
+        if self.plane:
+            # the plane owns sharing, replication, and degradation for
+            # its model instance; the per-filter variants of the same
+            # mechanisms would silently fight it
+            if self.shared_key:
+                raise ValueError(
+                    f"{self.name}: plane={self.plane!r} cannot combine "
+                    "with shared-tensor-filter-key (the plane IS the "
+                    "shared instance)"
+                )
+            if self.replicas > 1:
+                raise ValueError(
+                    f"{self.name}: plane={self.plane!r} cannot combine "
+                    "with replicas=N (use plane-mode=replicas — the "
+                    "plane replicates its own program)"
+                )
+            if self._fallback_conf:
+                raise ValueError(
+                    f"{self.name}: plane={self.plane!r} cannot combine "
+                    "with fallback-framework/fallback-model (plane "
+                    "faults dispose per stream via on-error)"
+                )
         if self.replicas > 1 and self._fallback_conf:
             # host_process dispatches through the replica set before the
             # fallback circuit is ever consulted — accepting both would
@@ -364,7 +476,9 @@ class TensorFilter(TensorOp):
 
     def _ensure_open(self) -> Backend:
         if self.backend is None:
-            if self.shared_key:
+            if self.plane:
+                self.backend = self._acquire_plane().backend
+            elif self.shared_key:
                 self.backend = _shared_acquire(
                     self.shared_key, self.fprops, self._open_backend
                 )
@@ -377,6 +491,19 @@ class TensorFilter(TensorOp):
         return self.backend
 
     def stop(self) -> None:
+        if self._plane is not None:
+            # the plane owns the backend(s); this filter only drops its
+            # stream + registry ref (last sharer out closes everything)
+            from nnstreamer_tpu.serving_plane import plane as plane_mod
+
+            self._plane_last_stats = self.plane_stats()
+            if self._plane_stream is not None:
+                self._plane.detach(self._plane_stream)
+                self._plane_stream = None
+            plane_mod.release(self.plane, self._plane)
+            self._plane = None
+            self.backend = None
+            self._traceable = None
         if self.backend is not None:
             if not self.shared_key or _shared_release(
                 self.shared_key, self.backend
@@ -492,6 +619,10 @@ class TensorFilter(TensorOp):
             # replica failover is per-frame health-tracked dispatch —
             # a fused program cannot change replicas mid-stream
             return False
+        if self.plane:
+            # cross-executor batching happens IN the plane: this filter
+            # must dispatch per frame into the shared queue
+            return False
         b = self._ensure_open()
         return b.traceable_fn() is not None
 
@@ -585,6 +716,84 @@ class TensorFilter(TensorOp):
             return getattr(self, "_replica_last_stats", {})
         return self._replica_set.stats()
 
+    # -- serving plane (serving_plane/plane.py) ----------------------------
+    def _acquire_plane(self):
+        """Get-or-create the named plane and attach this filter as one
+        stream. Lazy like _ensure_replicas, but reached at NEGOTIATION
+        (the plane's backend doubles as the model-info surface), so the
+        plane's service thread predates every executor start."""
+        if self._plane is None:
+            from nnstreamer_tpu.serving_plane import plane as plane_mod
+
+            cfg = self._plane_cfg or plane_mod.resolve_plane_config(
+                [self]
+            )
+            # a sharer that set no plane-* knobs INHERITS the first
+            # attacher's bound config instead of colliding with it
+            explicit = any(
+                self.get_property(k) is not None
+                for k in ("plane-max-batch", "plane-timeout-ms",
+                          "plane-mode", "plane-devices")
+            )
+
+            def opener(i: int, replicated: bool) -> Backend:
+                if replicated:
+                    # the _replica:<i> suffix keeps chaos scoping
+                    # (FaultyBackend only_replica) working at plane
+                    # granularity too
+                    return self._open_backend(f"_replica:{i}")
+                return self._open_backend()
+
+            self._plane = plane_mod.acquire(
+                self.plane, _props_signature(self.fprops), cfg, opener,
+                cfg_explicit=explicit,
+            )
+        if self._plane_stream is None:
+            try:
+                self._plane_stream = self._plane.attach(
+                    self.name, self.plane_weight
+                )
+            except ValueError:
+                # same element name in another pipeline of this process:
+                # disambiguate rather than refuse (names are only unique
+                # per pipeline)
+                self._plane_stream = self._plane.attach(
+                    f"{self.name}@{id(self) & 0xffff:04x}",
+                    self.plane_weight,
+                )
+        return self._plane
+
+    def plane_stats(self) -> Dict[str, Any]:
+        """Plane observability (Executor.stats() surfaces these as
+        ``plane_*``, nns-top --models aggregates them); {} when this
+        filter serves no plane. Plane-wide numbers plus THIS stream's
+        admit/serve counters (sharers must not report each other's)."""
+        if not self.plane:
+            return {}
+        if self._plane is None:
+            return self._plane_last_stats
+        d = self._plane.stats()
+        s = self._plane_stream
+        if s is not None:
+            d["stream"] = s.sid
+            d["stream_admitted"] = s.admitted
+            d["stream_served"] = s.served
+            d["stream_errors"] = s.errors
+        return d
+
+    def wants_host_input(self) -> bool:
+        """Link-level placement negotiation hook (executor
+        ``_out_wants_host``, docs/streaming.md): False when this
+        filter's backend accepts device-resident inputs (it stages /
+        reshards them itself — the jax backend's device_put path), so
+        an upstream device node hands frames over WITHOUT forcing a
+        coalesced D2H. Host-library backends (torch/tflite) keep True:
+        they read tensor bytes on host and want the prefetch."""
+        b = self.backend
+        if b is None:
+            return True
+        return not getattr(type(b), "DEVICE_INPUT_OK", False)
+
     # -- warm restart (docs/resilience.md) ---------------------------------
     def state_snapshot(self) -> Dict[str, Any]:
         """Executor.snapshot() hook: the opened backend's own state (a
@@ -643,6 +852,28 @@ class TensorFilter(TensorOp):
             self._pending_state = None
 
     def host_process(self, frame: Frame) -> Frame:
+        if self.plane:
+            # one stream's frame into the shared cross-executor batch;
+            # plane invoke errors surface HERE, per frame, where this
+            # node's on-error policy (and, for admitted edge requests,
+            # the NACK/release accounting) already applies per stream
+            plane = self._acquire_plane()
+            in_comb, out_comb = self.in_combination, self.out_combination
+            send = frame
+            if in_comb is not None:
+                send = frame.with_tensors(
+                    tuple(frame.tensors[i] for _, i in in_comb)
+                )
+            t0 = time.perf_counter_ns()
+            served = plane.submit(self._plane_stream, send)
+            self._elem_stats.record(time.perf_counter_ns() - t0)
+            if out_comb is None:
+                return frame.with_tensors(served.tensors)
+            model_out = served.tensors
+            return frame.with_tensors(tuple(
+                frame.tensors[i] if kind == "i" else model_out[i]
+                for kind, i in out_comb
+            ))
         if self.replicas > 1:
             # device faults fail the frame over to the next healthy
             # replica; ReplicaExhaustedError (nothing healthy) falls to
@@ -797,12 +1028,46 @@ class TensorFilter(TensorOp):
             # failover granularity is one frame: a window dispatched to
             # a dying replica would fail over whole
             return False
+        if self.plane:
+            # the local window IS the plane submission unit: one
+            # round-trip per collected window instead of per frame
+            return True
         return bool(getattr(self._ensure_open(), "batchable", False))
 
     def host_process_batch(self, frames: List[Frame]) -> List[Frame]:
         """One invoke_batched() call for the window: combinations applied
         per frame, ONE timed section (and one shared-lock acquisition)
         amortized over the whole batch."""
+        if self.plane:
+            # the whole local window rides ONE plane round-trip; the
+            # plane flattens it with other streams' windows into one
+            # device dispatch (serving_plane/plane.py). A window error
+            # raises whole — the executor's ladder then splits per
+            # frame through host_process, per-stream accounting intact.
+            plane = self._acquire_plane()
+            in_comb, out_comb = self.in_combination, self.out_combination
+            model_ins = [
+                f.tensors if in_comb is None
+                else tuple(f.tensors[i] for _, i in in_comb)
+                for f in frames
+            ]
+            t0 = time.perf_counter_ns()
+            model_outs = plane.submit_window(
+                self._plane_stream, model_ins
+            )
+            per = (time.perf_counter_ns() - t0) // max(1, len(frames))
+            outs: List[Frame] = []
+            for f, model_out in zip(frames, model_outs):
+                self._elem_stats.record(per)
+                if out_comb is None:
+                    tensors = tuple(model_out)
+                else:
+                    tensors = tuple(
+                        f.tensors[i] if kind == "i" else model_out[i]
+                        for kind, i in out_comb
+                    )
+                outs.append(f.with_tensors(tensors))
+            return outs
         sig0 = tuple((t.shape, t.dtype) for t in frames[0].tensors)
         if any(
             tuple((t.shape, t.dtype) for t in f.tensors) != sig0

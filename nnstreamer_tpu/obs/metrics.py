@@ -91,6 +91,25 @@ METRIC_CATALOG: Dict[str, str] = {
         "(host/eager path) or OOM batch ceiling below the full ladder — "
         "else 0, per element (gauge; docs/resilience.md)"
     ),
+    "nns_plane_batch_occupancy": (
+        "frames per cross-stream serving-plane dispatch, by plane "
+        "label (histogram; occupancy vs plane-max-batch is the "
+        "multiplexing win — docs/serving-plane.md)"
+    ),
+    "nns_plane_queue_depth": (
+        "queued-but-undispatched requests across all client streams of "
+        "a serving plane, sampled at each dispatch, by plane label "
+        "(gauge; docs/serving-plane.md)"
+    ),
+    "nns_plane_stream_admitted_total": (
+        "requests a client stream submitted into its serving plane, by "
+        "plane and stream label (counter; docs/serving-plane.md)"
+    ),
+    "nns_plane_stream_served_total": (
+        "requests a serving plane completed back to a client stream, "
+        "by plane and stream label (counter; admitted minus served is "
+        "the stream's in-flight/errored tail — docs/serving-plane.md)"
+    ),
     "nns_transfer_bytes_total": (
         "bytes crossing the host<->device boundary through the "
         "transfer engine, by direction label: h2d (staged uploads) / "

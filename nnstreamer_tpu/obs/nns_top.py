@@ -22,6 +22,11 @@ cumulative ``fps`` field (which includes compile/warmup).
 ``--clients`` switches to the per-client admission view (one row per
 query-server client: queued/inflight/admitted/rejected, plus reject
 reasons — docs/edge-serving.md).
+
+``--models`` switches to the per-plane serving view (one row per
+serving plane: mode/devices, attached streams, cross-stream queue
+depth, dispatches, batch occupancy — plus a per-stream admit/serve
+footer; docs/serving-plane.md).
 """
 
 from __future__ import annotations
@@ -178,6 +183,70 @@ def render_clients(snap: dict) -> str:
     return "\n".join(lines)
 
 
+_MODEL_COLUMNS = (
+    ("PLANE", 16), ("MODE", 10), ("DEV", 5), ("STREAMS", 9),
+    ("Q", 5), ("DISP", 8), ("BATCH", 7), ("OCC%", 7), ("FRAMES", 0),
+)
+
+
+def render_models(snap: dict) -> str:
+    """The ``--models`` view: one row per serving plane from the
+    ``plane_*`` stats the attached filters surface (multiple sharers
+    report the same plane — deduped by name), plus a per-stream
+    admit/serve footer. Empty when nothing in the snapshot serves
+    through a plane."""
+    nodes: Dict[str, dict] = snap.get("nodes", {})
+    lines = []
+    head = "".join(
+        name.ljust(w) if w else name for name, w in _MODEL_COLUMNS
+    )
+    seen = set()
+    for _name, row in nodes.items():
+        pname = row.get("plane_name")
+        if not pname or pname in seen:
+            continue
+        seen.add(pname)
+        if not lines:
+            lines.append(head)
+            lines.append("-" * max(len(head), 64))
+        cells = [
+            str(pname)[:15],
+            str(row.get("plane_mode", "-")),
+            str(row.get("plane_devices", "-")),
+            str(row.get("plane_streams", "-")),
+            str(row.get("plane_queue_depth", "-")),
+            str(row.get("plane_dispatches", "-")),
+            _num(row, "plane_avg_batch"),
+            _num(row, "plane_occupancy_pct"),
+            str(row.get("plane_frames", "-")),
+        ]
+        lines.append("".join(
+            c.ljust(w) if w else c
+            for c, (_, w) in zip(cells, _MODEL_COLUMNS)
+        ))
+        per_stream = row.get("plane_per_stream")
+        if isinstance(per_stream, dict):
+            for sid, s in sorted(per_stream.items()):
+                lines.append(
+                    f"  {str(sid)[:20]}: admitted={s.get('admitted', 0)} "
+                    f"served={s.get('served', 0)} "
+                    f"queued={s.get('queued', 0)} "
+                    f"errors={s.get('errors', 0)} "
+                    f"weight={s.get('weight', 1.0)}"
+                )
+        reps = row.get("plane_replicas")
+        if isinstance(reps, dict):
+            lines.append(
+                f"  replicas: healthy={reps.get('healthy')}/"
+                f"{reps.get('replicas')} "
+                f"failovers={reps.get('failovers', 0)} "
+                f"exhaustions={reps.get('exhaustions', 0)}"
+            )
+    if not lines:
+        return "(no serving plane in this snapshot)"
+    return "\n".join(lines)
+
+
 def _fetch(source: str) -> dict:
     if source.startswith(("http://", "https://")):
         url = source.rstrip("/")
@@ -231,6 +300,8 @@ def main(argv=None) -> int:
                     help="render one frame and exit (scripting)")
     ap.add_argument("--clients", action="store_true",
                     help="per-client admission view (query servers)")
+    ap.add_argument("--models", action="store_true",
+                    help="per-plane serving view (shared model planes)")
     args = ap.parse_args(argv)
 
     prev = None
@@ -247,6 +318,8 @@ def main(argv=None) -> int:
             sys.stdout.write("\x1b[2J\x1b[H")
         if args.clients:
             print(render_clients(snap))
+        elif args.models:
+            print(render_models(snap))
         else:
             print(render(snap, prev, dt))
         if args.once:

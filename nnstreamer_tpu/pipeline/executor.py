@@ -438,13 +438,21 @@ class Node:
             elem = getattr(dst, "elem", None)
             if elem is not None and getattr(type(elem), "WANTS_HOST", False):
                 continue
-            if isinstance(dst, TensorOpHostNode) and not getattr(
-                type(elem), "DEVICE_PASSTHROUGH", False
-            ):
-                # host-path op that reads tensor bytes; queue/capsfilter
-                # (DEVICE_PASSTHROUGH) carry device arrays untouched, so
-                # the handoff chains across them
-                continue
+            if isinstance(dst, TensorOpHostNode):
+                probe = getattr(elem, "wants_host_input", None)
+                if callable(probe) and not probe():
+                    # device-capable host node (a device-pinned/placed
+                    # jax filter stages its own inputs): the resident
+                    # handoff chains INTO it — placement's same-chip
+                    # case costs no transfer, the cross-chip case pays
+                    # one device_put, never a host round-trip
+                    # (docs/serving-plane.md)
+                    return False
+                if not getattr(type(elem), "DEVICE_PASSTHROUGH", False):
+                    # host-path op that reads tensor bytes;
+                    # queue/capsfilter (DEVICE_PASSTHROUGH) carry device
+                    # arrays untouched, so the handoff chains across
+                    continue
             return False
         return True
 
@@ -2120,6 +2128,14 @@ class Executor:
                 got = rstats()
                 if got:
                     s.update({f"rep_{k}": v for k, v in got.items()})
+            # serving plane (serving_plane/plane.py): shared-batcher
+            # occupancy/queue plus THIS stream's admit/serve counts
+            # when the filter serves through a plane
+            plstats = getattr(elem, "plane_stats", None)
+            if callable(plstats):
+                got = plstats()
+                if got:
+                    s.update({f"plane_{k}": v for k, v in got.items()})
             # sanitizer counters (pipeline/sanitize.py): per-node frame
             # accounting as the instrumented channels saw it
             if self.sanitizer is not None:

@@ -1,0 +1,53 @@
+"""nns-plane: the multi-stream, multi-chip model serving plane.
+
+ROADMAP item 2 ("millions of users"): thousands of concurrent pipelines
+multiplexed onto shared accelerators, not one pipeline per process. The
+subsystem turns N independent executors into one serving system:
+
+- :mod:`plane` — :class:`ModelPlane`, a process-wide shared device
+  batcher per model: N client streams (one per attached tensor_filter,
+  across executors) feed ONE continuously-batched device program, with
+  per-stream FIFO reassembly and weighted-fair scheduling
+  (:mod:`scheduler`), so one hot stream cannot starve the rest.
+- :mod:`sharding` — the programs a plane dispatches to: a single-device
+  vmapped program, a data-sharded program over an N-device mesh
+  (``parallel/mesh.py``), or K device-pinned replicas behind the PR-7
+  :class:`~nnstreamer_tpu.parallel.replicas.ReplicaSet` failover core.
+- :mod:`placement` — the Hermes-style planner (PAPERS.md): assign a
+  composite pipeline's stages to devices under a per-chip memory bound,
+  keeping adjacent stages co-resident (PR-8 device handoff) while they
+  fit and spilling to the next chip when they don't.
+
+Pipeline surface: ``tensor_filter plane=<name>`` attaches a filter (one
+stream) to the named plane; ``device=<idx>`` pins a stage
+(docs/serving-plane.md).
+"""
+
+from nnstreamer_tpu.serving_plane.placement import (
+    PlacementError,
+    place_pipeline,
+    plan_placement,
+)
+from nnstreamer_tpu.serving_plane.plane import (
+    ModelPlane,
+    PlaneClosedError,
+    PlaneConfig,
+    acquire,
+    release,
+    resolve_plane_config,
+)
+from nnstreamer_tpu.serving_plane.scheduler import PlaneStream, StreamScheduler
+
+__all__ = [
+    "ModelPlane",
+    "PlaneClosedError",
+    "PlaneConfig",
+    "PlaneStream",
+    "PlacementError",
+    "StreamScheduler",
+    "acquire",
+    "place_pipeline",
+    "plan_placement",
+    "release",
+    "resolve_plane_config",
+]

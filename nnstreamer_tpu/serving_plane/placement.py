@@ -1,0 +1,245 @@
+"""Hermes-style per-stage device placement under per-chip memory bounds.
+
+A composite pipeline (detector ! crop ! classifier ...) can exceed one
+chip's HBM even though every stage fits alone. Hermes (PAPERS.md)
+places each stage on a device subject to a per-device memory bound,
+keeping the chain's locality; this module is that planner for the
+pipeline surface:
+
+- :func:`estimate_backend_bytes` / :func:`estimate_stage_bytes` —
+  per-stage resident-memory estimates: the params pytree (weights,
+  placed once — docs/streaming.md) plus negotiated input/output
+  activation bytes, derived abstractly (``eval_shape``-style spec
+  arithmetic, no device allocation).
+- :func:`plan_placement` — greedy chain packing: stages stay on the
+  current chip while the bound holds — adjacent co-resident stages
+  keep the PR-8 device-resident handoff (no host hop, no cross-chip
+  transfer) — and spill to the next chip with room when it doesn't.
+  Explicit ``device=`` pins are honored as hard constraints.
+- :func:`place_pipeline` — apply a plan to a built pipeline: each
+  tensor_filter's backend is pinned via ``pin_device`` (jax backend),
+  so inter-stage hops become async ``device_put`` transfers (ICI on
+  real chips; the staged-transfer path) exactly where the plan put a
+  chip boundary.
+
+The per-chip bound defaults to ``[plane] memory_per_device`` (bytes;
+``K``/``M``/``G`` suffixes accepted).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("serving_plane.placement")
+
+
+class PlacementError(RuntimeError):
+    """No placement satisfies the memory bound (a stage exceeds one
+    chip, or the chips are collectively full)."""
+
+
+def parse_bytes(raw: str) -> int:
+    """``"256M"`` → 268435456 (K/M/G binary suffixes; plain ints pass
+    through)."""
+    s = str(raw).strip()
+    if not s:
+        raise ValueError("empty byte size")
+    mult = 1
+    suffix = s[-1].upper()
+    if suffix in ("K", "M", "G"):
+        mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}[suffix]
+        s = s[:-1]
+    return int(float(s) * mult)
+
+
+def params_bytes(tree: Any) -> int:
+    """Total bytes of a params pytree (weights resident on device)."""
+    if tree is None:
+        return 0
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return total
+
+
+def spec_bytes(spec: Any) -> int:
+    """Activation bytes of a TensorsSpec (0 for flexible/None specs)."""
+    if spec is None or not getattr(spec, "is_static", False):
+        return 0
+    total = 0
+    for t in spec:
+        total += int(
+            np.prod(t.shape, dtype=np.int64)
+        ) * np.dtype(t.dtype.np_dtype).itemsize
+    return total
+
+
+def estimate_backend_bytes(backend: Any) -> int:
+    """Resident bytes an opened backend will hold on its device:
+    params (the dominant term for real models) + one in-flight set of
+    input/output activations. Abstract arithmetic over specs — nothing
+    is allocated."""
+    total = params_bytes(getattr(backend, "_params", None))
+    try:
+        in_spec, out_spec = backend.get_model_info()
+    except Exception:  # noqa: BLE001 — shape-polymorphic: activations unknown
+        return total
+    return total + spec_bytes(in_spec) + spec_bytes(out_spec)
+
+
+def estimate_stage_bytes(elem: Any) -> int:
+    """Per-stage estimate for a tensor_filter element (opens the
+    backend it will serve with anyway — no throwaway copy)."""
+    backend = elem._ensure_open()
+    return estimate_backend_bytes(backend)
+
+
+def plan_placement(
+    costs: Sequence[int],
+    per_device_bytes: int,
+    n_devices: int,
+    pinned: Optional[Dict[int, int]] = None,
+) -> List[int]:
+    """Assign each stage (chain order) a device index under the bound.
+
+    Greedy chain packing: stay on the current device while the stage
+    fits (co-resident neighbors keep the device-resident handoff);
+    otherwise move to the first device with room, preferring the NEXT
+    one so the chain keeps flowing forward. ``pinned`` maps stage index
+    → device index as hard constraints. Raises :class:`PlacementError`
+    when a stage fits nowhere."""
+    if n_devices < 1:
+        raise PlacementError("need at least one device")
+    if per_device_bytes <= 0:
+        raise PlacementError(
+            f"per-device memory bound must be positive, got "
+            f"{per_device_bytes}"
+        )
+    used = [0] * n_devices
+    out: List[int] = []
+    d = 0
+    for i, cost in enumerate(costs):
+        cost = int(cost)
+        if cost > per_device_bytes:
+            raise PlacementError(
+                f"stage {i} needs {cost} bytes, over the per-device "
+                f"bound {per_device_bytes}"
+            )
+        if pinned and i in pinned:
+            d = int(pinned[i])
+            if not (0 <= d < n_devices):
+                raise PlacementError(
+                    f"stage {i} pinned to device {d}, have {n_devices}"
+                )
+            if used[d] + cost > per_device_bytes:
+                raise PlacementError(
+                    f"stage {i} pinned to device {d} but only "
+                    f"{per_device_bytes - used[d]} bytes remain there"
+                )
+        elif used[d] + cost > per_device_bytes:
+            # spill: first device with room, scanning forward from the
+            # current chip then wrapping (chain locality first)
+            for step in range(1, n_devices + 1):
+                cand = (d + step) % n_devices
+                if used[cand] + cost <= per_device_bytes:
+                    d = cand
+                    break
+            else:
+                raise PlacementError(
+                    f"stage {i} ({cost} bytes) fits on no device "
+                    f"(per-device bound {per_device_bytes}, used {used})"
+                )
+        used[d] += cost
+        out.append(d)
+    return out
+
+
+def _configured_bound() -> Optional[int]:
+    from nnstreamer_tpu.config import conf
+
+    raw = conf().get("plane", "memory_per_device", "")
+    if not raw:
+        return None
+    try:
+        return parse_bytes(raw)
+    except ValueError:
+        _log.warning(
+            "[plane] memory_per_device=%r is not a byte size; placement "
+            "stays manual", raw,
+        )
+        return None
+
+
+def place_pipeline(
+    pipeline: Any,
+    per_device_bytes: Optional[int] = None,
+    n_devices: Optional[int] = None,
+) -> Dict[str, int]:
+    """Plan + apply placement for a pipeline's tensor_filter stages.
+
+    Estimates each stage (opening its backend — the same instance the
+    run will use), plans under the bound (default ``[plane]
+    memory_per_device``), and pins each stage's backend to its assigned
+    device. Stages the plan co-locates on device 0 with no estimated
+    cost elsewhere stay untouched (default placement, fully fusable);
+    any stage landing off device 0 — or explicitly ``device=``-pinned —
+    becomes a placed host node whose inter-stage hops ride staged
+    ``device_put`` transfers. Returns {element name: device index}.
+    """
+    import jax
+
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    if per_device_bytes is None:
+        per_device_bytes = _configured_bound()
+    if per_device_bytes is None:
+        raise PlacementError(
+            "no memory bound: pass per_device_bytes or set "
+            "[plane] memory_per_device"
+        )
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    n_devices = max(1, min(int(n_devices), len(devs)))
+    order, leftover = pipeline.toposort_partial()
+    stages = [e for e in order + leftover if isinstance(e, TensorFilter)]
+    if not stages:
+        return {}
+    pinned: Dict[int, int] = {}
+    for i, e in enumerate(stages):
+        raw = e.get_property("device")
+        if raw is not None and str(raw).strip() != "":
+            pinned[i] = int(raw)
+    costs = [estimate_stage_bytes(e) for e in stages]
+    plan = plan_placement(costs, per_device_bytes, n_devices, pinned)
+    out: Dict[str, int] = {}
+    for e, d, cost in zip(stages, plan, costs):
+        out[e.name] = d
+        if d == 0 and e.get_property("device") is None:
+            # default device and unpinned: leave the stage fusable (the
+            # resident handoff needs no pin to stay on chip 0)
+            continue
+        e.set_property("device", d)
+        pin = getattr(e.backend, "pin_device", None)
+        if callable(pin):
+            pin(d)
+        else:
+            _log.warning(
+                "%s: backend %s has no pin_device; placement on device "
+                "%d is advisory only", e.name, type(e.backend).__name__, d,
+            )
+    _log.info(
+        "placement: %s under %d bytes/device over %d device(s)",
+        out, per_device_bytes, n_devices,
+    )
+    return out
