@@ -1704,6 +1704,143 @@ def _pipeline_plane(smoke: bool) -> None:
     print(json.dumps(rec))
 
 
+def _pipeline_llm(smoke: bool) -> None:
+    """``--pipeline llm``: paged-vs-slot KV capacity at ONE fixed KV
+    HBM budget (models/serving.py kv_layout, docs/llm-serving.md), ONE
+    JSON line next to the lm-cb cells of the full record. Two numbers:
+
+    - live-request capacity: the slot layout holds exactly
+      ``budget_tokens / max_len`` requests by construction; the paged
+      layout admits until its watermark defers — the acceptance bar is
+      ≥ 2× at the same budget, with a shared system prompt exercising
+      prefix sharing (``nns_kv_prefix_hits_total`` must be > 0);
+    - decode tok/s at EQUAL occupancy (the capacity win must not cost
+      the decode path).
+
+    ``--smoke`` pins CPU and shrinks the model; never run concurrently
+    with a tier-1 measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from nnstreamer_tpu.models import transformer as tfm
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    rng = np.random.default_rng(0)
+    if on_tpu:
+        model_kw = dict(vocab=32000, d_model=512, n_heads=8, n_layers=4)
+        dtype = jnp.bfloat16
+    else:
+        model_kw = dict(vocab=512, d_model=64, n_heads=4, n_layers=2)
+        dtype = jnp.float32
+    params = tfm.init_params(jax.random.PRNGKey(7), **model_kw)
+    n_heads = model_kw["n_heads"]
+    max_len, prompt_len, block_size = 192, 32, 16
+    slot_slots = 6
+    budget_tokens = slot_slots * max_len  # the fixed KV HBM budget
+    kv_blocks = budget_tokens // block_size
+    sys_prompt = np.tile(
+        rng.integers(1, model_kw["vocab"], (32,)), 2
+    ).astype(np.int32)[:64]  # 4 shared blocks
+    decode_budget = 24
+
+    def _prompt(i):
+        return np.concatenate(
+            [sys_prompt,
+             rng.integers(1, model_kw["vocab"], (16,)).astype(np.int32)]
+        )
+
+    def _mk(layout, n_slots):
+        kw = dict(compute_dtype=dtype)
+        if layout == "paged":
+            kw.update(kv_layout="paged", block_size=block_size,
+                      kv_blocks=kv_blocks)
+        return ContinuousBatcher(
+            params, n_heads, n_slots=n_slots, max_len=max_len,
+            prompt_len=prompt_len, **kw,
+        )
+
+    def _capacity(cb, n_try):
+        """Admit until the batcher defers (slot: submit() returns None;
+        paged: a submitted request stays un-activated because the
+        watermark would be breached) — peak concurrently-live
+        requests at this KV budget."""
+        rids = []
+        live = 0
+        for i in range(n_try):
+            rid = cb.submit(_prompt(i), decode_budget)
+            if rid is None:
+                break
+            rids.append(rid)
+            for _ in range(8):  # let prefill/activation settle
+                cb.step_pump(1)
+                st = cb.stats()
+                if st.get("kv_prefill_queue", 0) == 0:
+                    break
+            st = cb.stats()
+            if st.get("kv_prefill_queue", 0) > 0:  # watermark deferred
+                break
+            if st.get("kv_preemptions", 0) > 0:
+                break
+            live = sum(
+                1 for r in rids
+                if cb.result(r) is None
+            )
+        while any(cb.result(r) is None for r in rids):
+            cb.step_pump(8)
+        return live, cb.stats()
+
+    slot_cap, _ = _capacity(_mk("slot", slot_slots), 64)
+    _mark("slot capacity measured")
+    paged_cap, paged_st = _capacity(_mk("paged", 64), 64)
+    _mark("paged capacity measured")
+
+    def _tok_s(cb, n_req):
+        prompts = [_prompt(100 + i) for i in range(n_req)]
+        rids = [cb.submit(p, decode_budget) for p in prompts]
+        while any(cb.result(r) is None for r in rids):
+            cb.step_pump(8)  # warm compile drain
+        t0 = time.perf_counter()
+        rids = [cb.submit(p, decode_budget) for p in prompts]
+        while any(cb.result(r) is None for r in rids):
+            cb.step_pump(8)
+        return n_req * decode_budget / (time.perf_counter() - t0)
+
+    slot_tok_s = _tok_s(_mk("slot", slot_slots), slot_slots)
+    _mark("slot tok/s measured")
+    paged_tok_s = _tok_s(_mk("paged", slot_slots), slot_slots)
+    _mark("paged tok/s measured")
+    rec = {
+        "metric": "llm_paged_vs_slot_capacity_at_fixed_kv_hbm",
+        "kv_budget_tokens": budget_tokens,
+        "block_size": block_size,
+        "max_len": max_len,
+        "decode_budget": decode_budget,
+        "slot_capacity": slot_cap,
+        "paged_capacity": paged_cap,
+        "capacity_ratio": (
+            round(paged_cap / slot_cap, 2) if slot_cap else None
+        ),
+        "slot_tok_s": _round(slot_tok_s, 1),
+        "paged_tok_s": _round(paged_tok_s, 1),
+        "tok_s_ratio": (
+            round(paged_tok_s / slot_tok_s, 3) if slot_tok_s else None
+        ),
+        "nns_kv_prefix_hits_total": paged_st.get("kv_prefix_hits", 0),
+        "kv_prefix_hit_tokens": paged_st.get("kv_prefix_hit_tokens", 0),
+        "kv_preemptions": paged_st.get("kv_preemptions", 0),
+        "platform": dev.platform,
+        "device": str(dev.device_kind),
+        "host": _platform.node(),
+    }
+    print(json.dumps(rec))
+
+
 def main() -> None:
     if "--probe" in sys.argv:
         return _probe()
@@ -1719,6 +1856,8 @@ def main() -> None:
             return _pipeline_batched("--smoke" in sys.argv)
         if mode == ["plane"]:
             return _pipeline_plane("--smoke" in sys.argv)
+        if mode == ["llm"]:
+            return _pipeline_llm("--smoke" in sys.argv)
         print(f"unknown --pipeline mode {mode}", file=sys.stderr)
         return 2
 

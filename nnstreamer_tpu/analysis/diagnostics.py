@@ -145,6 +145,14 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "model/framework without shared-tensor-filter-key or a serving "
         "plane: each loads its own copy of the weights on device",
     ),
+    "NNS-W115": (
+        Severity.WARNING, "oversized-static-kv-cache",
+        "an LLM serving element's slot-layout KV cache (n-slots × "
+        "max-len, sized for the worst case of every slot) exceeds the "
+        "declared device memory bound while kv-layout=paged is "
+        "available: a block-table arena serves the same requests in "
+        "the actually-used tokens, with prefix sharing on top",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

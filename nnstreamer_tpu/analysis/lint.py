@@ -798,6 +798,70 @@ def _model_sharing_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _kv_cache_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W115: oversized static KV cache — a tensor_llm_serversink
+    whose slot-layout cache (2 · L · n-slots · max-len · KV · Dh,
+    every slot sized for the worst case) exceeds the declared memory
+    bound (``kv-memory-bound`` prop, or ``[llm] memory_bound``) while
+    ``kv-layout=paged`` is available. Static estimate from the element's
+    props and custom model options — no model is loaded (the sink is
+    LINT_SKIP_NEGOTIATE for exactly that reason)."""
+    from nnstreamer_tpu.backends.base import FilterProps
+    from nnstreamer_tpu.config import conf
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink
+    from nnstreamer_tpu.serving_plane.placement import parse_bytes
+
+    for e in pipeline.elements:
+        if not isinstance(e, LlmServerSink):
+            continue
+        layout = str(e.get_property("kv-layout") or "").strip() or (
+            conf().get("llm", "kv_layout", "slot")
+        )
+        if layout == "paged":
+            continue
+        bound_raw = str(e.get_property("kv-memory-bound") or "").strip()
+        if not bound_raw:
+            bound_raw = conf().get("llm", "memory_bound", "").strip()
+        if not bound_raw:
+            continue  # no declared bound: nothing to check against
+        try:
+            bound = parse_bytes(bound_raw)
+        except (TypeError, ValueError):
+            continue  # NNS-E005-shaped value; not this pass's finding
+        opts = FilterProps(
+            custom=str(e.get_property("custom") or "")
+        ).custom_dict()
+        # zoo:transformer_lm defaults (models/zoo.py)
+        d_model = int(opts.get("d_model", 256))
+        n_layers = int(opts.get("n_layers", 4))
+        n_heads = int(opts.get("n_heads", 8)) or 1
+        n_kv = int(opts.get("n_kv_heads", n_heads))
+        hd = d_model // n_heads
+        cache_dtype = str(e.get_property("cache-dtype") or "auto")
+        if cache_dtype == "int8":
+            per_elem = 1.0 + 4.0 / max(hd, 1)  # int8 payload + scales
+        else:
+            dt = str(opts.get("compute_dtype", "float32"))
+            per_elem = 2.0 if dt == "bfloat16" else 4.0
+        n_slots = int(e.get_property("n-slots") or 4)
+        max_len = int(e.get_property("max-len") or 256)
+        est = int(
+            2 * n_layers * n_slots * max_len * n_kv * hd * per_elem
+        )
+        if est <= bound:
+            continue
+        report.add(
+            "NNS-W115", e.name,
+            f"slot-layout KV cache ≈ {est / (1 << 20):.0f} MiB "
+            f"(2·L{n_layers}·slots{n_slots}·len{max_len}·kv{n_kv}·"
+            f"hd{hd}) exceeds the declared bound {bound_raw} — every "
+            "slot is sized for the worst-case request",
+            "set kv-layout=paged (block-table arena sized by kv-blocks "
+            "to the bound; prefix sharing and chunked prefill come "
+            "with it — docs/llm-serving.md)",
+        )
+
+
 def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W113: a host-bound element between two device-capable
     (traceable) filters forces every frame through host memory and back
@@ -1061,6 +1125,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _replica_failover_pass(pipeline, report)
     _resident_handoff_pass(pipeline, report)
     _model_sharing_pass(pipeline, report)
+    _kv_cache_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)

@@ -80,6 +80,26 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         # planner requires an explicit bound argument
         "memory_per_device": "",
     },
+    "llm": {
+        # continuous-batching LLM serving defaults
+        # (tensor_llm_serversink props override; docs/llm-serving.md).
+        # kv_layout: slot (one contiguous worst-case cache per slot) |
+        # paged (block arena + per-request block tables with prefix
+        # sharing, chunked prefill and preemption-by-eviction)
+        "kv_layout": "slot",
+        # tokens per KV block (paged); must divide prompt-len/max-len
+        "block_size": "16",
+        # total usable blocks in the arena (paged); empty = enough for
+        # every slot at max-len (no memory saving — size it BELOW that
+        # to serve more live requests at the same HBM)
+        "kv_blocks": "",
+        # prefill buckets advanced per pump (paged chunked prefill):
+        # bounds how long one request's prompt can stall decoders
+        "prefill_chunks": "1",
+        # declared KV memory bound for nns-lint NNS-W115 (bytes, K/M/G
+        # suffixes); empty = lint stays silent
+        "memory_bound": "",
+    },
     "executor": {
         # micro-batching defaults for fused segments / batchable filters
         # (pipeline/batching.py); per-element properties on tensor_filter

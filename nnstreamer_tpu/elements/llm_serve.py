@@ -88,7 +88,10 @@ class _LlmServer:
     def __init__(self, model: str, options: Dict[str, str], n_slots: int,
                  max_len: int, prompt_len: int, default_new: int,
                  stream: bool = False, speculate: int = 0,
-                 speculate_model: str = "", pump_tokens: int = 1):
+                 speculate_model: str = "", pump_tokens: int = 1,
+                 kv_layout: str = "slot", block_size: int = 16,
+                 kv_blocks: int = 0, cache_dtype: str = "auto",
+                 prefill_chunks: int = 1):
         from nnstreamer_tpu.models import zoo
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
@@ -127,9 +130,21 @@ class _LlmServer:
                 draft_params=dm.params,
                 draft_n_heads=int(d_opts.get("n_heads", 8)),
             )
+        kv_kw = {}
+        if kv_layout != "slot":
+            # paged KV (nnstreamer_tpu/kv/, docs/llm-serving.md):
+            # block-table cache with prefix sharing, chunked prefill
+            # and preemption-by-eviction; incompatible with a draft
+            # model for now (ContinuousBatcher validates)
+            kv_kw = dict(
+                kv_layout=kv_layout, block_size=block_size,
+                kv_blocks=kv_blocks or None,
+                prefill_chunks=prefill_chunks,
+            )
         self.cb = ContinuousBatcher(
             m.params, n_heads, n_slots=n_slots, max_len=max_len,
-            prompt_len=prompt_len, **draft_kw,
+            prompt_len=prompt_len, cache_dtype=cache_dtype,
+            **kv_kw, **draft_kw,
         )
         self.default_new = default_new
         self._lock = threading.Lock()
@@ -177,6 +192,10 @@ class _LlmServer:
         )
         if "seed" in frame.meta:
             kw["seed"] = int(frame.meta["seed"])
+        if "deadline_ms" in frame.meta:
+            # SLO accounting (nns-top --requests); the edge layer's
+            # deadline shedding is upstream of this element
+            kw["deadline_s"] = float(frame.meta["deadline_ms"]) / 1000.0
         while True:
             if self.stopped:
                 raise ElementError("tensor_llm_serversink: stopped")
@@ -274,6 +293,11 @@ class _LlmServer:
         sagging acceptance rate / k pinned at 2 — visible in --stats,
         not only in wall time)."""
         st = self.cb.stats()
+        # per-request SLO rows for nns-top --requests (serving_requests
+        # once the executor prefixes the row)
+        st["requests"] = {
+            str(rid): row for rid, row in self.cb.requests().items()
+        }
         if self.speculate == -1:
             st["spec_k"] = self._spec_k
             # the EMA is the auto controller's state — in fixed-k mode
@@ -314,7 +338,13 @@ class LlmServerSink(Sink):
     speculate is unset), pump (=N: target tokens per program launch —
     step_pump(N)/spec_pump over device-scanned rounds, ONE
     device→host read per pump instead of one per token; default 1
-    keeps per-token stepping for minimum admission latency)."""
+    keeps per-token stepping for minimum admission latency),
+    kv-layout/block-size/kv-blocks/prefill-chunks (paged KV cache:
+    block-table arena with prefix sharing, chunked prefill and
+    preemption-by-eviction — docs/llm-serving.md; defaults from the
+    [llm] config section), cache-dtype (int8 stores the KV cache
+    quantized), kv-memory-bound (declared HBM budget consumed by
+    nns-lint NNS-W115)."""
 
     FACTORY_NAME = "tensor_llm_serversink"
 
@@ -335,6 +365,18 @@ class LlmServerSink(Sink):
         "speculate": PropSpec("str", "0", desc="k, or 'auto'"),
         "speculate-model": PropSpec("str", "", desc="zoo:<draft model>"),
         "pump": PropSpec("int", 1, desc="target tokens per launch"),
+        # paged KV cache (nnstreamer_tpu/kv/, docs/llm-serving.md);
+        # empty strings defer to the [llm] config section
+        "kv-layout": PropSpec("str", "", desc="slot | paged ([llm] default)"),
+        "block-size": PropSpec("int", 0, desc="tokens per KV block (paged)"),
+        "kv-blocks": PropSpec("int", 0, desc="arena blocks (paged; 0=auto)"),
+        "cache-dtype": PropSpec("str", "auto", desc="auto | int8"),
+        "prefill-chunks": PropSpec(
+            "int", 0, desc="prefill buckets per pump (paged; 0=[llm])"
+        ),
+        "kv-memory-bound": PropSpec(
+            "str", "", desc="declared KV HBM bound (lint NNS-W115)"
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -349,6 +391,21 @@ class LlmServerSink(Sink):
         ).custom_dict()
         from nnstreamer_tpu.elements.base import _parse_bool
 
+        from nnstreamer_tpu.config import conf
+
+        cfg = conf()
+        kv_layout = str(self.get_property("kv-layout", "")).strip() or (
+            cfg.get("llm", "kv_layout", "slot")
+        )
+        block_size = int(self.get_property("block-size", 0)) or (
+            cfg.get_int("llm", "block_size", 16)
+        )
+        kv_blocks = int(self.get_property("kv-blocks", 0)) or (
+            cfg.get_int("llm", "kv_blocks", 0)
+        )
+        prefill_chunks = int(self.get_property("prefill-chunks", 0)) or (
+            cfg.get_int("llm", "prefill_chunks", 1)
+        )
         self._create_kw = dict(
             model=str(self.get_property("model", "zoo:transformer_lm")),
             options=options,
@@ -363,6 +420,11 @@ class LlmServerSink(Sink):
             ),
             speculate_model=str(self.get_property("speculate-model", "")),
             pump_tokens=int(self.get_property("pump", 1)),
+            kv_layout=kv_layout,
+            block_size=block_size,
+            kv_blocks=kv_blocks,
+            cache_dtype=str(self.get_property("cache-dtype", "auto")),
+            prefill_chunks=prefill_chunks,
         )
         self._server: Optional[_LlmServer] = None
 

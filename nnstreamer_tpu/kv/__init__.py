@@ -1,0 +1,31 @@
+"""nns-kv: paged KV-cache management for continuous-batching LLM serving.
+
+The slot-layout :class:`~nnstreamer_tpu.models.serving.ContinuousBatcher`
+allocates one contiguous ``[L, B, max_len, KV, Dh]`` cache sized for the
+worst-case request: HBM for short requests is wasted, shared system
+prompts re-prefill per request, and a long prefill stalls every decoding
+slot. This package is the paged alternative behind
+``ContinuousBatcher(kv_layout="paged")`` (docs/llm-serving.md):
+
+- :mod:`blocks` — BlockPool: fixed-size token blocks carved from one
+  device-resident arena per layer, ref-counted with copy-on-write, and a
+  rolling-prefix-hash index so requests sharing a token prefix share
+  physical blocks;
+- :mod:`gather` — the jitted block-table gather/scatter read/write the
+  step/pump/spec programs run on (bitwise parity with the contiguous
+  slot path, pinned by tests/test_kv_paged.py);
+- :mod:`sched` — chunked-prefill admission jobs, watermark block
+  accounting with preemption-by-eviction, and the per-request SLO
+  ledger (queue/prefill/TTFT/TPOT → nns-obs).
+"""
+
+from nnstreamer_tpu.kv.blocks import BlockPool, NoBlocksError
+from nnstreamer_tpu.kv.sched import PrefillJob, SLOLedger, SLORecord
+
+__all__ = [
+    "BlockPool",
+    "NoBlocksError",
+    "PrefillJob",
+    "SLOLedger",
+    "SLORecord",
+]

@@ -1,0 +1,167 @@
+"""Jitted block-table gather/scatter: the paged cache's device read/write.
+
+The paged step/pump/spec programs run the SAME attention math as the
+contiguous slot layout (models/serving.batched_decode_step and friends)
+— the only difference is where the cache bytes live:
+
+- :func:`gather_cache` materializes, inside the program, a per-slot
+  contiguous view ``[L, B, max_len, ...]`` from the block arena
+  ``[L, N, bs, ...]`` through the block table ``[B, max_len//bs]``.
+  Logical token position ``p`` lands at view column ``p`` exactly as in
+  the slot cache, so masks, RoPE positions and reduction orders are
+  identical — the bitwise-parity invariant tests/test_kv_paged.py pins.
+  Unallocated table entries point at scratch block 0; their columns are
+  masked (``> pos``) so they contribute exact zeros, same as the slot
+  cache's never-written tail.
+- :func:`scatter_window` writes the updated view's touched blocks back:
+  a ``width``-token write starting at per-slot ``pos`` spans at most
+  ``(width + bs - 2)//bs + 1`` blocks — a static, small unrolled loop.
+  Inactive lanes are routed to scratch with their unchanged content, so
+  shared (read-only) blocks are never scattered by construction: the
+  write window always lies in blocks the owning request holds privately
+  (the pool's copy-on-write discipline).
+
+Host-path helpers (:func:`write_block_fn`, :func:`read_block_fn`,
+:func:`copy_block_fn`) build the admission-time ops: stage→block
+scatter (quantizing when the arena is int8, exactly like the slot
+layout's insert_slot), block→stage gather for prefix-seeded prefill,
+and the device side of copy-on-write.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models.serving import dequantize_kv, quantize_kv
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def gather_cache(arena, tables):
+    """arena leaves [L, N, bs, ...] → contiguous view [L, B, nb*bs, ...]
+    through ``tables`` [B, nb] int32 (works for the fp ``(k, v)`` tree
+    and the int8 ``((k8, ksc), (v8, vsc))`` tree alike)."""
+    b, nb = tables.shape
+
+    def g(a):
+        t = jnp.take(a, tables, axis=1)  # [L, B, nb, bs, ...]
+        return t.reshape((a.shape[0], b, nb * a.shape[2]) + a.shape[3:])
+
+    return _tree_map(g, arena)
+
+
+def scatter_window(arena, tables, view, pos, width: int, active):
+    """Write the ``[pos, pos+width)`` token window of the updated
+    contiguous ``view`` back into the arena blocks the tables map.
+
+    ``width`` is static (1 for a decode step, k for a verify chunk); the
+    write can straddle at most ``(width + bs - 2)//bs + 1`` blocks, each
+    handled by one unrolled scatter. Inactive slots (and out-of-range
+    block indices) are routed to scratch block 0 carrying its own
+    unchanged content — a no-op write, duplicate-index-safe because
+    every duplicate writes identical bytes."""
+    first = jax.tree_util.tree_leaves(arena)[0]
+    blk = first.shape[2]
+    b, nb = tables.shape
+    nblk = (int(width) + blk - 2) // blk + 1
+    base = pos // blk
+
+    for j in range(nblk):
+        lb = base + j  # [B] logical block this unroll writes
+        safe = jnp.clip(lb, 0, nb - 1)
+        valid = active & (lb * blk < pos + width) & (lb < nb)
+        phys = jnp.take_along_axis(tables, safe[:, None], axis=1)[:, 0]
+        phys = jnp.where(valid, phys, 0)
+        start = safe * blk
+
+        def put(a, v, phys=phys, valid=valid, start=start):
+            # v [L, B, T, ...] → the block-wide rows [L, B, bs, ...]
+            def one(vb, s):
+                return jax.lax.dynamic_slice_in_dim(vb, s, blk, axis=1)
+
+            rows = jax.vmap(one, in_axes=(1, 0), out_axes=1)(v, start)
+            old = jnp.take(a, phys, axis=1)
+            keep = valid.reshape((1, b) + (1,) * (old.ndim - 2))
+            return a.at[:, phys].set(
+                jnp.where(keep, rows.astype(a.dtype), old)
+            )
+
+        arena = _tree_map(put, arena, view)
+    return arena
+
+
+def make_paged_ops(quantized: bool, compute_dtype):
+    """Admission-path jitted ops over one arena layout.
+
+    Returns ``(write_block, read_block, copy_block)``:
+
+    - ``write_block(arena, blk, ks, vs)`` — land one block of staged
+      K/V (``[L, 1, bs, KV, Dh]`` compute dtype) at arena block ``blk``,
+      quantizing per token per head when the arena is int8 (the same
+      quantize_kv the slot layout's insert_slot applies, so paged and
+      slot int8 payloads are bitwise identical);
+    - ``read_block(arena, blk)`` — one block back as compute-dtype
+      ``(ks, vs)`` (dequantized when int8): the prefix-seeded prefill
+      stage source;
+    - ``copy_block(arena, src, dst)`` — the device half of
+      copy-on-write.
+    """
+
+    def write_block(arena, blk, ks, vs):
+        if quantized:
+            (ka, ksc), (va, vsc) = arena
+            k8, ks_ = quantize_kv(ks)
+            v8, vs_ = quantize_kv(vs)
+            return (
+                (ka.at[:, blk].set(k8[:, 0]), ksc.at[:, blk].set(ks_[:, 0])),
+                (va.at[:, blk].set(v8[:, 0]), vsc.at[:, blk].set(vs_[:, 0])),
+            )
+        ka, va = arena
+        return (
+            ka.at[:, blk].set(ks[:, 0].astype(ka.dtype)),
+            va.at[:, blk].set(vs[:, 0].astype(va.dtype)),
+        )
+
+    def read_block(arena, blk):
+        if quantized:
+            (ka, ksc), (va, vsc) = arena
+            ks = dequantize_kv(ka[:, blk], ksc[:, blk])
+            vs = dequantize_kv(va[:, blk], vsc[:, blk])
+        else:
+            ka, va = arena
+            ks, vs = ka[:, blk], va[:, blk]
+        return (
+            ks.astype(compute_dtype)[:, None],
+            vs.astype(compute_dtype)[:, None],
+        )
+
+    def copy_block(arena, src, dst):
+        return _tree_map(lambda a: a.at[:, dst].set(a[:, src]), arena)
+
+    return (
+        jax.jit(write_block, donate_argnums=0),
+        jax.jit(read_block),
+        jax.jit(copy_block, donate_argnums=0),
+    )
+
+
+def init_arena(n_layers: int, n_blocks: int, block_size: int, kv: int,
+               hd: int, quantized: bool, compute_dtype):
+    """Zeroed arena tree (+1 scratch block at index 0), mirroring the
+    slot cache's init values: int8 payloads zero with unit scales, fp
+    zeros — so scratch/unwritten columns are finite and masked columns
+    contribute exact zeros either way."""
+    shape = (n_layers, n_blocks + 1, block_size, kv, hd)
+    if quantized:
+        sshape = shape[:-1]
+        return (
+            (jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.float32)),
+            (jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.float32)),
+        )
+    return (
+        jnp.zeros(shape, compute_dtype),
+        jnp.zeros(shape, compute_dtype),
+    )

@@ -741,6 +741,27 @@ def device_ngram_propose(hist, pos, k: int, g: int, wrap: bool = False):
     return jax.vmap(one)(hist, pos)
 
 
+def spec_emit_hist(toks, m, final, active, hist, pos_, windowed: bool):
+    """Emitted row [B, k] for one speculative round — the m-1 accepted
+    proposals then the correction/bonus token, -1 beyond — recorded into
+    the device history so later rounds mine a complete context. ONE
+    implementation shared by the slot and paged spec programs (the
+    device-side form of spec_step's host commit loop)."""
+    kk = toks.shape[1]
+    j = jnp.arange(kk)[None, :]
+    prop_part = jnp.concatenate(
+        [toks[:, 1:], jnp.full((toks.shape[0], 1), -1, jnp.int32)],
+        axis=1,
+    )
+    emit = jnp.where(
+        j < (m - 1)[:, None], prop_part,
+        jnp.where(j == (m - 1)[:, None], final[:, None], -1),
+    )
+    emit = jnp.where(active[:, None], emit, -1)
+    hist = hist_write_row(hist, emit, pos_ + 1, m, wrap=windowed)
+    return emit, hist
+
+
 @dataclass
 class _Request:
     rid: int
@@ -775,13 +796,15 @@ class _PendingInsert:
     the compiled step runs lock-free)."""
 
     slot: int
-    ks: jax.Array
-    vs: jax.Array
-    first_tok: Any  # device int32 scalar (fetched at apply)
+    ks: Optional[jax.Array]
+    vs: Optional[jax.Array]
+    first_tok: Any  # device int32 scalar (fetched at apply) or int
     fill: int  # cache fill level (= absolute position count)
     req: _Request
     draft_kv: Optional[Tuple[jax.Array, jax.Array]] = None
     hist_row: Optional[np.ndarray] = None  # device n-gram context seed
+    blocks: Optional[List[int]] = None  # paged: the slot's block table
+    resumed: bool = False  # paged: re-admission after preemption
 
 
 class _DraftEngine:
@@ -980,6 +1003,10 @@ class ContinuousBatcher:
         windowed: bool = False,
         draft_params: Optional[Dict] = None,
         draft_n_heads: Optional[int] = None,
+        kv_layout: str = "slot",
+        block_size: int = 16,
+        kv_blocks: Optional[int] = None,
+        prefill_chunks: int = 1,
     ):
         """``windowed=True`` makes max_len a sliding attention window
         over a ring-buffer cache: generations AND prompts of any length
@@ -1007,6 +1034,39 @@ class ContinuousBatcher:
         if cache_dtype not in ("auto", "int8"):
             raise ValueError(f"unknown cache_dtype {cache_dtype!r}")
         quantized_cache = cache_dtype == "int8"
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        self._paged = kv_layout == "paged"
+        if self._paged:
+            # paged KV (nnstreamer_tpu/kv/, docs/llm-serving.md): the
+            # cache is a block arena behind per-slot block tables; the
+            # decode math is the SAME batched step on a gathered view
+            # (bitwise parity pinned by tests/test_kv_paged.py). The
+            # windowed ring, slot-sharded meshes, draft models and the
+            # Pallas kernel keep the slot layout for now.
+            for flag, why in (
+                (windowed, "windowed (ring) caches"),
+                (mesh is not None, "mesh-sharded slots"),
+                (draft_params is not None, "draft models"),
+                (attn_impl != "xla", f"attn_impl={attn_impl!r}"),
+            ):
+                if flag:
+                    raise ValueError(
+                        f"kv_layout='paged' does not support {why}; "
+                        "use the slot layout"
+                    )
+            block_size = int(block_size)
+            if block_size < 1 or max_len % block_size:
+                raise ValueError(
+                    f"block_size({block_size}) must divide "
+                    f"max_len({max_len})"
+                )
+            if prompt_len % block_size:
+                raise ValueError(
+                    f"block_size({block_size}) must divide "
+                    f"prompt_len({prompt_len}) so staged prefill chunks "
+                    "land on block boundaries"
+                )
         if attn_impl == "pallas":
             from nnstreamer_tpu.ops.pallas.decode_attention import (
                 make_decode_attention,
@@ -1038,21 +1098,72 @@ class ContinuousBatcher:
         self._done_pool: "OrderedDict[int, _Request]" = OrderedDict()
         self._keep_results = keep_results
 
+        # nns-obs: the SLO histograms + paged-pool gauges emit through
+        # the registry resolved ONCE here (the FaultGate discipline)
+        from nnstreamer_tpu.obs import metrics as _obs_metrics
+
+        self._obs_reg = _obs_metrics.get()
+        from nnstreamer_tpu.kv.sched import SLOLedger
+
+        self._slo = SLOLedger(keep=keep_results, obs_registry=self._obs_reg)
+
         L, d = params["blocks"]["ln1"].shape
         hd = d // n_heads
         kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, n_heads)
         shape = (L, n_slots, max_len, kv, hd)
-        if quantized_cache:
-            sshape = shape[:-1]
-            self._cache = (
-                (jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.float32)),
-                (jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.float32)),
+        if self._paged:
+            from nnstreamer_tpu.kv import gather as _kvg
+            from nnstreamer_tpu.kv.blocks import BlockPool
+
+            self._kvg = _kvg
+            self.block_size = block_size
+            self._blocks_per_slot = max_len // block_size
+            if kv_blocks is None:
+                # no-saving default: enough blocks for every slot at
+                # max_len — memory savings come from setting kv_blocks
+                # BELOW this (the bench's fixed-HBM-budget cell)
+                kv_blocks = n_slots * self._blocks_per_slot
+            if kv_blocks < self._blocks_per_slot:
+                raise ValueError(
+                    f"kv_blocks({kv_blocks}) cannot hold even one "
+                    f"max_len request ({self._blocks_per_slot} blocks)"
+                )
+            self._pool = BlockPool(
+                int(kv_blocks), block_size, obs_registry=self._obs_reg
             )
+            # self._cache IS the block arena in paged mode: every
+            # donated-launch/commit/failure-latch path stays identical
+            self._cache = _kvg.init_arena(
+                L, int(kv_blocks), block_size, kv, hd, quantized_cache,
+                compute_dtype,
+            )
+            self._tables = np.zeros(
+                (n_slots, self._blocks_per_slot), np.int32
+            )
+            self._n_alloc = np.zeros((n_slots,), np.int32)
+            self._tables_dev = jnp.asarray(self._tables)
+            self._tables_dirty = False
+            self._write_block, self._read_block, self._copy_block = (
+                _kvg.make_paged_ops(quantized_cache, compute_dtype)
+            )
+            self._prefill_q: deque = deque()
+            self._prefill_chunks = max(1, int(prefill_chunks))
+            self._prefixes_paged: Dict[int, Tuple[np.ndarray, List[int]]] = {}
         else:
-            self._cache = (
-                jnp.zeros(shape, compute_dtype),
-                jnp.zeros(shape, compute_dtype),
-            )
+            self._pool = None
+            if quantized_cache:
+                sshape = shape[:-1]
+                self._cache = (
+                    (jnp.zeros(shape, jnp.int8),
+                     jnp.ones(sshape, jnp.float32)),
+                    (jnp.zeros(shape, jnp.int8),
+                     jnp.ones(sshape, jnp.float32)),
+                )
+            else:
+                self._cache = (
+                    jnp.zeros(shape, compute_dtype),
+                    jnp.zeros(shape, compute_dtype),
+                )
         self._tok = jnp.zeros((n_slots,), jnp.int32)
         self._pos = jnp.zeros((n_slots,), jnp.int32)
         self._active = np.zeros((n_slots,), bool)
@@ -1067,6 +1178,20 @@ class ContinuousBatcher:
         # the multi-step pumps' running record — tokens never have to
         # come back to the host just to propose continuations
         self._hist = jnp.full((n_slots, max_len), -1, jnp.int32)
+        # device-carried pump state: remaining budgets, stop ids and the
+        # active mask live ON DEVICE between pumps (the scan already
+        # computes their next values — they used to be recomputed and
+        # re-shipped from host EVERY pump even when no slot changed).
+        # _pump_state_locked() rebuilds + ships them only when the dirty
+        # flag says admission/finish/host-stepping touched a slot; a
+        # steady pump-only drain performs ZERO host-state H2D transfers
+        # (pinned in tests/test_pumps.py beside the no-new-compiles
+        # regression test).
+        self._budget_dev = jnp.zeros((n_slots,), jnp.int32)
+        self._stop_dev = jnp.full((n_slots,), -1, jnp.int32)
+        self._active_dev = jnp.zeros((n_slots,), bool)
+        self._pump_state_dirty = True
+        self._host_state_builds = 0  # regression-test observable
 
         if mesh is not None:
             # shard the slot axis over the mesh: the batched step runs
@@ -1173,7 +1298,36 @@ class ContinuousBatcher:
         # place, and on any TPU donation halves the cache's HBM
         # footprint — the carried state never has two live copies
         _don = dict(donate_argnums=(3, 4))
-        if mesh is not None and attn_impl == "pallas":
+        if self._paged:
+            # paged step: gather the block arena into the SAME
+            # contiguous per-slot view the slot layout carries, run the
+            # IDENTICAL step body on it, then scatter only the written
+            # token's block back (inactive lanes route to scratch).
+            # tables (arg 4) is NOT donated — it is the cached device
+            # copy reused across pumps; arena (3) and hist (5) are.
+            _kvg = self._kvg
+
+            def paged_step(sampling):
+                inner = step_impl(sampling)
+
+                def impl(tok, pos, active, arena, tables, hist, temp,
+                         topk, topp, keys):
+                    view = _kvg.gather_cache(arena, tables)
+                    new, view, pos2, hist = inner(
+                        tok, pos, active, view, hist, temp, topk, topp,
+                        keys,
+                    )
+                    arena = _kvg.scatter_window(
+                        arena, tables, view, pos, 1, active
+                    )
+                    return new, arena, pos2, hist
+
+                return impl
+
+            _pgdon = dict(donate_argnums=(3, 5))
+            self._step_greedy = jax.jit(paged_step(False), **_pgdon)
+            self._step_sampling = jax.jit(paged_step(True), **_pgdon)
+        elif mesh is not None and attn_impl == "pallas":
             # GSPMD cannot partition the kernel's custom call over the
             # slot-sharded cache — but the step is slot-parallel by
             # construction, so shard_map IS the partition: each device
@@ -1249,7 +1403,9 @@ class ContinuousBatcher:
                     None, length=n_steps,
                 )
                 tok, pos, active, cache, hist, budget, dcache = carry
-                return emits.T, tok, pos, active, cache, hist, dcache
+                # budget rides back out so the host can carry it on
+                # device across pumps instead of re-shipping host state
+                return emits.T, tok, pos, active, cache, hist, budget, dcache
 
             return impl
 
@@ -1257,7 +1413,61 @@ class ContinuousBatcher:
             donate_argnums=(3, 4, 11), static_argnames=("n_steps",)
         )
         _wd = draft_params is not None
-        if mesh is not None and attn_impl == "pallas":
+        if self._paged:
+            # paged pump: the scan gathers/scatters per step through the
+            # (static-within-a-pump) block table; budget/stop/active are
+            # the device-carried pump state like everywhere else
+            _kvg = self._kvg
+
+            def paged_pump_impl(sampling):
+                def impl(tok, pos, active, arena, tables, hist, budget,
+                         stop, temp, topk, topp, keys, n_steps):
+                    def body(carry, _):
+                        tok, pos, active, arena, hist, budget = carry
+                        view = _kvg.gather_cache(arena, tables)
+                        logits, view, pos2 = batched_decode_step(
+                            params, tok, pos, active, view, n_heads,
+                            compute_dtype, attn_fn=attn_fn,
+                        )
+                        if sampling:
+                            sub = jax.vmap(jax.random.fold_in)(keys, pos2)
+                            new = sample_tokens(
+                                logits, temp, topk, topp, sub
+                            )
+                        else:
+                            new = jnp.argmax(logits, -1).astype(jnp.int32)
+                        new = jnp.where(active, new, tok)
+                        emit = jnp.where(active, new, -1)
+                        arena = _kvg.scatter_window(
+                            arena, tables, view, pos, 1, active
+                        )
+                        hist = hist_write_row(
+                            hist, new[:, None], pos2,
+                            active.astype(jnp.int32),
+                        )
+                        budget = budget - active.astype(jnp.int32)
+                        active = active & (budget > 0) & ~(
+                            (new == stop) & (stop >= 0)
+                        )
+                        return (
+                            new, pos2, active, arena, hist, budget,
+                        ), emit
+
+                    carry, emits = jax.lax.scan(
+                        body, (tok, pos, active, arena, hist, budget),
+                        None, length=n_steps,
+                    )
+                    tok, pos, active, arena, hist, budget = carry
+                    return emits.T, tok, pos, active, arena, hist, budget
+
+                return impl
+
+            _ppdon = dict(
+                donate_argnums=(3, 5), static_argnames=("n_steps",)
+            )
+            self._pump_greedy = jax.jit(paged_pump_impl(False), **_ppdon)
+            self._pump_sampling = jax.jit(paged_pump_impl(True), **_ppdon)
+        elif mesh is not None and attn_impl == "pallas":
             # same shard_map partition as the single step: the scan is
             # slot-parallel, each device pumps its local slots with the
             # kernel inline
@@ -1270,7 +1480,7 @@ class ContinuousBatcher:
             pspecs = dict(
                 in_specs=(vec, vec, vec, cac, vec, vec, vec, vec, vec,
                           vec, vec, cac),
-                out_specs=(vec, vec, vec, vec, cac, vec, cac),
+                out_specs=(vec, vec, vec, vec, cac, vec, vec, cac),
                 check_vma=False,
             )
 
@@ -1326,22 +1536,9 @@ class ContinuousBatcher:
             m = jnp.where(active, m, 0)
             if windowed:
                 cache = commit_ring_chunk(cache, cks, cvs, pos_, m, active)
-            # emitted row [B, k]: the m-1 accepted proposals then the
-            # correction/bonus token, -1 beyond — the device-side form
-            # of spec_step's host commit loop, recorded into hist so
-            # later rounds mine a complete context
-            kk = toks.shape[1]
-            j = jnp.arange(kk)[None, :]
-            prop_part = jnp.concatenate(
-                [toks[:, 1:], jnp.full((toks.shape[0], 1), -1, jnp.int32)],
-                axis=1,
+            emit, hist = spec_emit_hist(
+                toks, m, final, active, hist, pos_, windowed
             )
-            emit = jnp.where(
-                j < (m - 1)[:, None], prop_part,
-                jnp.where(j == (m - 1)[:, None], final[:, None], -1),
-            )
-            emit = jnp.where(active[:, None], emit, -1)
-            hist = hist_write_row(hist, emit, pos_ + 1, m, wrap=windowed)
             return m, final, cache, hist, pos_ + m, emit
 
         def spec_round_impl(spec_sampling):
@@ -1422,7 +1619,7 @@ class ContinuousBatcher:
                     jnp.transpose(emits, (1, 0, 2)).reshape(-1),
                     jnp.stack([acc, cols]),
                 ])
-                return packed, tok, pos, active, cache, hist, dcache
+                return packed, tok, pos, active, cache, hist, budget, dcache
 
             return impl
 
@@ -1431,12 +1628,120 @@ class ContinuousBatcher:
             static_argnames=("rounds", "k", "g"),
         )
         _use_draft = draft_params is not None and not windowed
-        self._spec_pump_greedy = jax.jit(
-            spec_pump_impl(False, _use_draft), **_sdon
-        )
-        self._spec_pump_sampling = jax.jit(
-            spec_pump_impl(True, _use_draft), **_sdon
-        )
+        if self._paged:
+            # paged speculative machinery: one verify round (spec_step)
+            # and the R-round device pump, both running the slot
+            # layout's verify/accept math on the gathered view and
+            # scattering the k-wide write window back per round
+            _kvg = self._kvg
+
+            def paged_spec_round(spec_sampling):
+                def impl(toks, pos_, active, arena, tables, hist, temp,
+                         topk, topp, keys):
+                    view = _kvg.gather_cache(arena, tables)
+                    logits, view = batched_verify_step(
+                        params, toks, pos_, active, view, n_heads,
+                        compute_dtype,
+                    )
+                    m, final = spec_accept(
+                        logits, toks, temp, topk, topp, keys, pos_,
+                        spec_sampling,
+                    )
+                    m = jnp.where(active, m, 0)
+                    arena = _kvg.scatter_window(
+                        arena, tables, view, pos_, toks.shape[1], active
+                    )
+                    _, hist = spec_emit_hist(
+                        toks, m, final, active, hist, pos_, False
+                    )
+                    return m, final, arena, hist, pos_ + m
+
+                return impl
+
+            # overwrite the slot-layout rounds (jit is lazy, nothing
+            # was compiled): spec_step builds layout-matched args
+            _pgdon = dict(donate_argnums=(3, 5))
+            self._spec_round_greedy = jax.jit(
+                paged_spec_round(False), **_pgdon
+            )
+            self._spec_round_sampling = jax.jit(
+                paged_spec_round(True), **_pgdon
+            )
+
+            def paged_spec_pump_impl(spec_sampling):
+                def impl(tok, pos, active, arena, tables, hist, budget,
+                         stop, temp, topk, topp, keys, rounds, k, g):
+                    def body(carry, _):
+                        (tok, pos, active, arena, hist, budget, acc,
+                         cols) = carry
+                        props = device_ngram_propose(hist, pos, k, g)
+                        props = jnp.where(active[:, None], props, -1)
+                        toks = jnp.concatenate(
+                            [tok[:, None], props], axis=1
+                        )
+                        view = _kvg.gather_cache(arena, tables)
+                        logits, view = batched_verify_step(
+                            params, toks, pos, active, view, n_heads,
+                            compute_dtype,
+                        )
+                        m, final = spec_accept(
+                            logits, toks, temp, topk, topp, keys, pos,
+                            spec_sampling,
+                        )
+                        m = jnp.where(active, m, 0)
+                        arena = _kvg.scatter_window(
+                            arena, tables, view, pos, k, active
+                        )
+                        emit, hist = spec_emit_hist(
+                            toks, m, final, active, hist, pos, False
+                        )
+                        acc = acc + jnp.sum(jnp.maximum(m - 1, 0))
+                        cols = cols + jnp.sum(
+                            (props >= 0).astype(jnp.int32)
+                        )
+                        budget = budget - m
+                        hit_stop = jnp.any(
+                            (emit == stop[:, None]) & (stop[:, None] >= 0),
+                            axis=1,
+                        )
+                        active = active & (budget > 0) & ~hit_stop
+                        tok = jnp.where(m > 0, final, tok)
+                        return (tok, pos + m, active, arena, hist,
+                                budget, acc, cols), emit
+
+                    zero = jnp.zeros((), jnp.int32)
+                    (tok, pos, active, arena, hist, budget, acc,
+                     cols), emits = jax.lax.scan(
+                        body,
+                        (tok, pos, active, arena, hist, budget, zero,
+                         zero),
+                        None, length=rounds,
+                    )
+                    packed = jnp.concatenate([
+                        jnp.transpose(emits, (1, 0, 2)).reshape(-1),
+                        jnp.stack([acc, cols]),
+                    ])
+                    return packed, tok, pos, active, arena, hist, budget
+
+                return impl
+
+            _psdon = dict(
+                donate_argnums=(3, 5),
+                static_argnames=("rounds", "k", "g"),
+            )
+            self._spec_pump_greedy = jax.jit(
+                paged_spec_pump_impl(False), **_psdon
+            )
+            self._spec_pump_sampling = jax.jit(
+                paged_spec_pump_impl(True), **_psdon
+            )
+        else:
+            self._spec_pump_greedy = jax.jit(
+                spec_pump_impl(False, _use_draft), **_sdon
+            )
+            self._spec_pump_sampling = jax.jit(
+                spec_pump_impl(True, _use_draft), **_sdon
+            )
         self._draft = (
             _DraftEngine(
                 draft_params, draft_n_heads or n_heads, n_slots, max_len,
@@ -1476,31 +1781,36 @@ class ContinuousBatcher:
             jnp.zeros(self._stage_shape, self.compute_dtype),
         )
 
+    def _chunk_step(self, tokens, pos: int, stage, want_logits: bool):
+        """ONE prompt_len bucket of chunked prefill at absolute ``pos``.
+        Every copy of the chunked-prefill invariant (full-width pad
+        writes overwritten before masked; verify_chunk's absolute pos;
+        the vocab-head projection only when logits are wanted) lives
+        HERE — the slot layout's synchronous _stage_chunks and the
+        paged incremental job path (_prefill_chunk_one) both drive it.
+        Returns (logits or None, advanced stage, tokens consumed)."""
+        P = self.prompt_len
+        n = min(P, int(tokens.shape[0]))
+        chunk = np.zeros((1, P), np.int32)
+        chunk[0, :n] = tokens[:n]
+        args = (jnp.asarray(chunk), jnp.asarray(pos, jnp.int32), stage)
+        if want_logits:
+            logits, stage, _ = self._prefill_chunk(*args)
+            return logits, stage, n
+        return None, self._advance_chunk(*args), n
+
     def _stage_chunks(self, tokens, base: int, stage, want_logits: bool):
         """Advance a staging cache with ``tokens`` written at absolute
-        positions base..base+t-1, one prompt_len bucket per verify_chunk
-        call. Every copy of the chunked-prefill invariant (full-width pad
-        writes overwritten before masked; bucket-stride chunk starts;
-        verify_chunk's absolute pos) lives HERE. Returns (final chunk's
-        logits or None, advanced stage)."""
-        P = self.prompt_len
+        positions base..base+t-1, one _chunk_step bucket at a time.
+        Returns (final chunk's logits or None, advanced stage)."""
         t = tokens.shape[0]
         cpos = 0
         logits = None
         while cpos < t:
-            n = min(P, t - cpos)
-            chunk = np.zeros((1, P), np.int32)
-            chunk[0, :n] = tokens[cpos : cpos + n]
-            args = (
-                jnp.asarray(chunk), jnp.asarray(base + cpos, jnp.int32),
-                stage,
+            final = cpos + self.prompt_len >= t
+            logits, stage, n = self._chunk_step(
+                tokens[cpos:], base + cpos, stage, want_logits and final
             )
-            if want_logits and cpos + n >= t:
-                logits, stage, _ = self._prefill_chunk(*args)
-            else:
-                # non-final buckets only advance the cache (no
-                # vocab-head projection)
-                stage = self._advance_chunk(*args)
             cpos += n
         return logits, stage
 
@@ -1564,6 +1874,42 @@ class ContinuousBatcher:
         sliding-window semantics)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         plen = tokens.shape[0]
+        if self._paged:
+            # paged: prefill ONCE into pool blocks, register them in the
+            # prefix index, and PIN them (the registration holds one
+            # reference until unregister) — later submits hit the index
+            # whether or not they pass prefix=; the stored tokens are
+            # prepended for prefix= callers so matching sees one stream
+            if not (0 < plen < self.max_len):
+                raise ValueError(
+                    f"prefix length {plen} not in (0, max_len="
+                    f"{self.max_len})"
+                )
+            # _step_lock: the block writes below donate self._cache —
+            # they must serialize with in-flight step/pump launches
+            # that donate the same arena (submit() stays lock-free
+            # because its writes ride the pending queue; registration
+            # is setup-time, so the serialization is fine)
+            with self._step_lock:
+                _, stage = self._stage_chunks(
+                    tokens, 0, self._empty_stage(), False
+                )
+                bs = self.block_size
+                n_blocks = -(-plen // bs)
+                with self._lock:
+                    blocks = self._pool.alloc(n_blocks)
+                for i, b in enumerate(blocks):
+                    ks = stage[0][:, :, i * bs: (i + 1) * bs]
+                    vs = stage[1][:, :, i * bs: (i + 1) * bs]
+                    self._cache = self._write_block(
+                        self._cache, jnp.asarray(b, jnp.int32), ks, vs
+                    )
+                with self._lock:
+                    self._pool.register(tokens, blocks)
+                    pid = self._next_prefix
+                    self._next_prefix += 1
+                    self._prefixes_paged[pid] = (tokens, blocks)
+            return pid
         if self.windowed:
             P = self.prompt_len
             if plen <= 0 or plen % P:
@@ -1600,8 +1946,16 @@ class ContinuousBatcher:
 
     def unregister_prefix(self, pid: int) -> bool:
         """Release a registered prefix's device memory (in-flight
-        requests are unaffected — their slot cache holds a copy)."""
+        requests are unaffected — their slot cache holds a copy; paged
+        sharers hold their own block references, and the blocks stay
+        adoptable from the pool's cached tier until reclaimed)."""
         with self._lock:
+            if self._paged:
+                item = self._prefixes_paged.pop(pid, None)
+                if item is None:
+                    return False
+                self._pool.free(item[1])
+                return True
             return self._prefixes.pop(pid, None) is not None
 
     # -- client API --------------------------------------------------------
@@ -1615,10 +1969,20 @@ class ContinuousBatcher:
         seed: Optional[int] = None,
         stop_token: Optional[int] = None,
         prefix: Optional[int] = None,
+        deadline_s: Optional[float] = None,
     ) -> Optional[int]:
         """Claim a free slot for ``prompt`` [T]; returns a request id, or
         None when the batch is full (caller queues/retries — the
         admission queue is the caller's policy, not the batcher's).
+        ``deadline_s`` is SLO accounting only (surfaced by requests() /
+        nns-top --requests), never an eviction trigger.
+
+        Paged batchers (``kv_layout="paged"``) admit through the chunked
+        prefill queue instead of prefilling here: submit returns
+        immediately and the prompt advances one ``prompt_len`` bucket
+        per step/pump, interleaved with decode — a long prompt can no
+        longer stall decoding slots for whole prefills
+        (docs/llm-serving.md).
         Prompts longer than the prompt_len bucket prefill in bucket-sized
         chunks (decode.verify_chunk; decode.windowed_chunk on a ring when
         windowed), so T is bounded by the cache — or by nothing at all
@@ -1638,6 +2002,11 @@ class ContinuousBatcher:
             raise ValueError(f"max_new_tokens must be ≥ 1, got {max_new_tokens}")
         if t == 0:
             raise ValueError("empty prompt")
+        if self._paged:
+            return self._submit_paged(
+                prompt, max_new_tokens, temperature, top_k, top_p, seed,
+                stop_token, prefix, deadline_s,
+            )
         plen = 0
         pfx = None
         pfx_tokens = None
@@ -1699,6 +2068,7 @@ class ContinuousBatcher:
                 ),
             )
             self._slots[slot] = req
+            self._slo.submit(rid, deadline_s)
 
         try:
             P = self.prompt_len
@@ -1822,24 +2192,40 @@ class ContinuousBatcher:
 
     def _apply_batch_locked(self, batch, firsts) -> None:
         now = _time.perf_counter()
+        self._pump_state_dirty = True  # admission changes pump state
         for p, first in zip(batch, firsts):
             if self._slots[p.slot] is not p.req:
                 continue  # request vanished (defensive; cannot happen)
             first = int(first)
-            p.req.t_first = now
-            p.req.tokens.append(first)
-            if p.req.finished():
-                # budget 1 or an immediate stop token: the request ends
-                # on its prefill token and never occupies the batch
-                self._finish(p.slot)
-                continue
+            if p.blocks is not None:
+                # paged: point the slot's block table at its blocks
+                # BEFORE any finish path so _finish can free them
+                row = np.zeros((self._blocks_per_slot,), np.int32)
+                row[: len(p.blocks)] = p.blocks
+                self._tables[p.slot] = row
+                self._n_alloc[p.slot] = len(p.blocks)
+                self._tables_dirty = True
+            if not p.resumed:
+                p.req.t_first = now
+                p.req.tokens.append(first)
+                self._slo.admitted(p.req.rid)
+                self._slo.first_token(p.req.rid)
+                if p.req.finished():
+                    # budget 1 or an immediate stop token: the request
+                    # ends on its prefill token and never occupies the
+                    # batch
+                    self._finish(p.slot)
+                    continue
+            else:
+                self._slo.admitted(p.req.rid)
             if p.hist_row is not None:
                 Hh = p.hist_row.shape[0]
                 if p.fill < Hh:
                     p.hist_row[p.fill] = first
                 elif self.windowed:
                     p.hist_row[p.fill % Hh] = first
-            self._cache = self._insert(self._cache, p.ks, p.vs, p.slot)
+            if p.blocks is None:
+                self._cache = self._insert(self._cache, p.ks, p.vs, p.slot)
             self._tok = self._pin(self._tok.at[p.slot].set(first))
             self._pos = self._pin(self._pos.at[p.slot].set(p.fill))
             self._temp = self._pin(
@@ -1857,6 +2243,325 @@ class ContinuousBatcher:
                     self._hist.at[p.slot].set(jnp.asarray(p.hist_row))
                 )
             self._active[p.slot] = True
+
+    # -- paged KV: admission, chunked prefill, blocks, preemption ----------
+    def _submit_paged(self, prompt, max_new_tokens, temperature, top_k,
+                      top_p, seed, stop_token, prefix, deadline_s
+                      ) -> Optional[int]:
+        """Paged admission: claim a slot, match the prompt against the
+        pool's prefix index (adopting shared blocks NOW so they cannot
+        be reclaimed while queued), and enqueue a chunked-prefill job.
+        No device work happens here — prefill advances one bucket per
+        step/pump, interleaved with decode."""
+        from nnstreamer_tpu.kv.sched import PrefillJob
+
+        pfx_tokens = None
+        if prefix is not None:
+            with self._lock:
+                if prefix not in self._prefixes_paged:
+                    raise ValueError(f"unknown prefix id {prefix}")
+                pfx_tokens = self._prefixes_paged[prefix][0]
+        context = (
+            prompt if pfx_tokens is None
+            else np.concatenate([pfx_tokens, prompt]).astype(np.int32)
+        )
+        t = int(context.shape[0])
+        if t + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prefix+prompt({t})+{max_new_tokens} tokens would "
+                f"overflow max_len={self.max_len}"
+            )
+        with self._lock:
+            try:
+                slot = next(
+                    i for i, r in enumerate(self._slots) if r is None
+                )
+            except StopIteration:
+                return None
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _Request(
+                rid, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, stop_token=stop_token,
+                t_submit=_time.perf_counter(),
+                key=np.asarray(
+                    jax.random.PRNGKey(rid if seed is None else seed)
+                ),
+                prompt=context,
+            )
+            self._slots[slot] = req
+            self._slo.submit(rid, deadline_s)
+            # prefix matching happens lazily when the job starts staging
+            # (_prefill_chunk_one): adopted blocks stay pinned only for
+            # the short staging→activation window, so queued work never
+            # starves the pool
+            self._prefill_q.append(PrefillJob(slot, req, context))
+        return rid
+
+    def _match_and_adopt_locked(self, job, matchable) -> None:
+        m = self._pool.match(matchable)
+        for b in m.full:
+            self._pool.adopt(b)
+        if m.partial_block is not None:
+            self._pool.adopt(m.partial_block)
+        if m.n_tokens:
+            self._pool.record_hit_tokens(m.n_tokens)
+        job.matched_full = list(m.full)
+        job.matched_partial = m.partial_block
+        job.n_partial = m.n_partial
+        job.base = m.n_tokens
+
+    def _release_match_locked(self, job) -> None:
+        """Drop a job's adopted prefix pins (sharing-degradation path)."""
+        self._pool.free(job.matched_full)
+        if job.matched_partial is not None:
+            self._pool.free([job.matched_partial])
+        job.matched_full = []
+        job.matched_partial = None
+        job.n_partial = 0
+        job.base = 0
+
+    def _advance_prefill(self) -> None:
+        """Advance the front prefill job by ≤ ``prefill_chunks`` buckets
+        and activate it when staged + block-affordable — the chunked-
+        prefill interleave: a decoding slot waits at most this many
+        chunk programs per pump, whatever someone else's prompt length.
+        Caller holds _step_lock; _lock is taken only for bookkeeping."""
+        for _ in range(self._prefill_chunks):
+            with self._lock:
+                job = self._prefill_q[0] if self._prefill_q else None
+            if job is None:
+                return
+            self._slo.prefilling(job.req.rid)
+            if not job.done_staging():
+                self._prefill_chunk_one(job)
+            if job.done_staging():
+                if self._prefill_finalize(job):
+                    with self._lock:
+                        if self._prefill_q and self._prefill_q[0] is job:
+                            self._prefill_q.popleft()
+                else:
+                    return  # blocks not affordable yet (watermark)
+
+    def _prefill_chunk_one(self, job) -> None:
+        """One ``prompt_len`` bucket of chunked prefill for ``job``
+        (device work — caller holds _step_lock only)."""
+        P = self.prompt_len
+        ctx = job.tokens
+        t = job.fill
+        if job.stage is None:
+            if not job.no_rematch:
+                with self._lock:
+                    # match context[:-1] for fresh requests: the LAST
+                    # token must run through the model even on a full
+                    # prefix hit — its logits pick the first generated
+                    # token. Resumes (known_first set) may match their
+                    # whole context. The sharing-degradation fallback
+                    # sets no_rematch: re-adopting the released prefix
+                    # here would restore the exact pre-degrade state and
+                    # livelock the queue head.
+                    self._match_and_adopt_locked(
+                        job,
+                        ctx if job.known_first is not None else ctx[:-1],
+                    )
+            if (job.base == 0 and job.matched_partial is None
+                    and t <= P and job.known_first is None):
+                # bucket-sized fresh prompt: the SAME single fast-path
+                # program the slot layout admits through (bitwise parity
+                # with contiguous admission)
+                padded = np.zeros((1, P), np.int32)
+                padded[0, :t] = ctx
+                logits, (ks, vs), _ = self._prefill(jnp.asarray(padded))
+                job.logits_row = logits[0, t - 1]
+                job.stage = (ks, vs)
+                job.cpos = t
+                return
+            stage = self._empty_stage()
+            # seed matched prefix K/V into the stage so continuation
+            # chunks attend it (fp: bitwise the originally staged values)
+            bs = self.block_size
+            seeds = list(job.matched_full)
+            if job.matched_partial is not None:
+                seeds.append(job.matched_partial)
+            for i, b in enumerate(seeds):
+                ks, vs = self._read_block(self._cache, b)
+                stage = (
+                    jax.lax.dynamic_update_slice(
+                        stage[0], ks.astype(stage[0].dtype),
+                        (0, 0, i * bs, 0, 0),
+                    ),
+                    jax.lax.dynamic_update_slice(
+                        stage[1], vs.astype(stage[1].dtype),
+                        (0, 0, i * bs, 0, 0),
+                    ),
+                )
+            job.stage = stage
+        if job.done_staging():
+            return
+        start = job.base + job.cpos
+        final = start + P >= t
+        logits, stage, n = self._chunk_step(
+            ctx[start:], start, job.stage,
+            final and job.known_first is None,
+        )
+        if logits is not None:
+            job.logits_row = logits[0, n - 1]
+        job.stage = stage
+        job.cpos += n
+
+    def _prefill_finalize(self, job) -> bool:
+        """Allocate the job's blocks, land staged K/V, register its
+        prefix, and queue the activation. False = not affordable yet
+        under the watermark (every live request keeps one decode-growth
+        block of headroom), so the job waits — admission can defer but
+        never OOM the decode plane."""
+        from nnstreamer_tpu.kv.blocks import NoBlocksError
+
+        bs = self.block_size
+        t = job.fill
+        n_blocks = -(-t // bs)
+        n_full = len(job.matched_full)
+        fresh_needed = n_blocks - n_full  # includes the CoW copy
+        with self._lock:
+            n_live = int(self._active.sum())
+            if fresh_needed > 0 and (
+                self._pool.available() < fresh_needed + n_live
+            ):
+                if n_live == 0:
+                    # nothing is decoding, so waiting cannot help
+                    if job.matched_full or job.matched_partial is not None:
+                        # give back the adopted prefix pins and restart
+                        # staging unshared — degrade sharing to progress
+                        self._release_match_locked(job)
+                        job.stage = None
+                        job.cpos = 0
+                        job.no_rematch = True
+                        return False
+                    raise RuntimeError(
+                        "kv pool cannot admit a request with nothing "
+                        "decoding: kv_blocks too small for the prompt, "
+                        "or registered prefixes pin too much of the pool"
+                    )
+                return False
+            try:
+                fresh = (
+                    self._pool.alloc(fresh_needed)
+                    if fresh_needed > 0 else []
+                )
+            except NoBlocksError:
+                return False
+            if job.matched_partial is not None and fresh:
+                self._pool.note_cow()  # first fresh block is the copy
+        blocks = list(job.matched_full) + fresh
+        # land staged K/V into the fresh blocks (adopted full blocks
+        # already hold theirs; the CoW block's copied prefix rides the
+        # seeded stage, so one write covers copy + continuation)
+        if job.stage is not None:
+            for i in range(n_full, n_blocks):
+                ks = job.stage[0][:, :, i * bs: (i + 1) * bs]
+                vs = job.stage[1][:, :, i * bs: (i + 1) * bs]
+                self._cache = self._write_block(
+                    self._cache, jnp.asarray(blocks[i], jnp.int32), ks, vs
+                )
+        elif job.matched_partial is not None and fresh:
+            # fully-matched resume ending in a partial block: pure
+            # device-side copy-on-write
+            self._cache = self._copy_block(
+                self._cache, jnp.asarray(job.matched_partial, jnp.int32),
+                jnp.asarray(blocks[n_full], jnp.int32),
+            )
+        job.stage = None  # release staging memory
+        with self._lock:
+            if job.matched_partial is not None:
+                # the CoW copy replaced the shared partial block
+                self._pool.free([job.matched_partial])
+            self._pool.register(job.tokens, blocks)
+        req = job.req
+        if job.known_first is not None:
+            first_dev: Any = int(job.known_first)
+        else:
+            first_dev = self._sample1(
+                job.logits_row,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32),
+                jax.random.fold_in(jnp.asarray(req.key), t),
+            )
+        job.logits_row = None
+        hist_row = np.full((self.max_len,), -1, np.int32)
+        hist_row[:t] = job.tokens[: self.max_len]
+        with self._lock:
+            if not job.resumed:
+                req.fill0 = t
+            self._pending.append(
+                _PendingInsert(
+                    job.slot, None, None, first_dev, t, req,
+                    hist_row=hist_row, blocks=blocks,
+                    resumed=job.resumed,
+                )
+            )
+        return True
+
+    def _ensure_decode_room_locked(self, n: int) -> None:
+        """Watermark decode-growth accounting: every active slot gets
+        blocks covering its next ``n`` token writes, preempting the
+        youngest other request on exhaustion (its blocks free, shared
+        prefix blocks stay cached, and it re-enters the prefill queue
+        to resume from whatever prefix still matches) — eviction and
+        re-prefill instead of OOM. Caller holds _lock."""
+        from nnstreamer_tpu.kv.blocks import NoBlocksError
+        from nnstreamer_tpu.kv.sched import choose_victim
+
+        bs = self.block_size
+        for s, req in enumerate(self._slots):
+            if req is None or not self._active[s]:
+                continue
+            pos = req.fill0 + len(req.tokens) - 1
+            last = min(pos + int(n) - 1, self.max_len - 1)
+            need = last // bs + 1
+            while self._n_alloc[s] < need:
+                try:
+                    (b,) = self._pool.alloc(1)
+                except NoBlocksError:
+                    victim = choose_victim(self._slots, self._active, s)
+                    if victim is None:
+                        raise RuntimeError(
+                            "kv pool exhausted with one active request "
+                            "left: kv_blocks cannot cover a single "
+                            "stream's growth — raise kv_blocks"
+                        ) from None
+                    self._preempt_locked(victim)
+                    continue
+                self._tables[s, self._n_alloc[s]] = b
+                self._n_alloc[s] += 1
+                self._tables_dirty = True
+
+    def _preempt_locked(self, slot: int) -> None:
+        """Evict ``slot``'s request: free its blocks and queue a
+        re-prefill job for its full known stream (prompt + generated
+        tokens, pending token carried as known_first so the resumed
+        stream is exactly the original — greedy AND sampled, since
+        sampling keys by (seed, position))."""
+        from nnstreamer_tpu.kv.sched import PrefillJob
+
+        req = self._slots[slot]
+        self._pool.free(self._tables[slot, : self._n_alloc[slot]].tolist())
+        self._tables[slot] = 0
+        self._n_alloc[slot] = 0
+        self._tables_dirty = True
+        self._active[slot] = False
+        self._pump_state_dirty = True
+        self._slo.preempted(req.rid)
+        if len(req.tokens) > 1:
+            context = np.concatenate([
+                req.prompt, np.asarray(req.tokens[:-1], np.int32)
+            ])
+        else:
+            context = np.asarray(req.prompt, np.int32)
+        self._prefill_q.append(PrefillJob(
+            slot, req, context, known_first=int(req.tokens[-1]),
+            resumed=True,
+        ))
 
     # -- failure containment (donated-state launches) ----------------------
     def _mark_failed(self, exc: Exception) -> None:
@@ -1922,7 +2627,8 @@ class ContinuousBatcher:
 
     def _pump_host_state(self, active_np):
         """Per-slot budget remaining + stop ids for a device pump
-        (host-known state shipped down once per pump; [B] int32 each)."""
+        (host-known state; [B] int32 each). Only the dirty-rebuild path
+        of :meth:`_pump_state_locked` calls this now."""
         remaining = np.zeros((self.n_slots,), np.int32)
         stop = np.full((self.n_slots,), -1, np.int32)
         for s, req in enumerate(self._slots):
@@ -1932,6 +2638,35 @@ class ContinuousBatcher:
             if req.stop_token is not None:
                 stop[s] = req.stop_token
         return remaining, stop
+
+    def _pump_state_locked(self):
+        """Device-carried pump state: (budget remaining, stop ids,
+        active mask) as [B] device arrays.
+
+        The pump scans already compute next-pump values for all three
+        (budget decremented, stops latched, lanes idled out) — so the
+        arrays are CARRIED on device across pumps and the host rebuild +
+        H2D ship happens only when the dirty flag says a slot actually
+        changed outside a pump (submit admission, a finished/preempted
+        request, or a host-stepped path). A steady pump-only drain ships
+        ZERO host state — pinned by the no-new-H2D regression test in
+        tests/test_pumps.py. Caller holds _lock."""
+        if self._pump_state_dirty:
+            remaining, stop = self._pump_host_state(self._active)
+            self._budget_dev = self._pin(jnp.asarray(remaining))
+            self._stop_dev = self._pin(jnp.asarray(stop))
+            self._active_dev = self._pin(jnp.asarray(self._active.copy()))
+            self._pump_state_dirty = False
+            self._host_state_builds += 1
+        return self._budget_dev, self._stop_dev, self._active_dev
+
+    def _tables_device_locked(self):
+        """Cached device copy of the block tables (paged), re-shipped
+        only when an allocation/preemption/admission changed a row."""
+        if self._tables_dirty:
+            self._tables_dev = jnp.asarray(self._tables)
+            self._tables_dirty = False
+        return self._tables_dev
 
     def step_pump(self, n: int = 8) -> Dict[int, List[int]]:
         """Advance every active slot by up to ``n`` tokens in ONE
@@ -1951,29 +2686,46 @@ class ContinuousBatcher:
         self._check_failed()
         t0 = _time.perf_counter()
         with self._step_lock:
+            if self._paged:
+                self._advance_prefill()
             self._apply_pending()
             with self._lock:
                 if not self._active.any():
                     return {}
+                if self._paged:
+                    self._ensure_decode_room_locked(int(n))
                 active_np = self._active.copy()
                 sampling = any(
                     req is not None and active_np[s] and req.temperature > 0
                     for s, req in enumerate(self._slots)
                 )
-                remaining, stop = self._pump_host_state(active_np)
-                args = (
-                    self._tok, self._pos, jnp.asarray(active_np),
-                    self._cache, self._hist, jnp.asarray(remaining),
-                    jnp.asarray(stop), self._temp, self._topk,
-                    self._topp, self._keys,
-                    self._draft._cache if self._draft is not None
-                    else None,
-                )
+                budget_dev, stop_dev, active_dev = self._pump_state_locked()
+                if self._paged:
+                    args = (
+                        self._tok, self._pos, active_dev, self._cache,
+                        self._tables_device_locked(), self._hist,
+                        budget_dev, stop_dev, self._temp, self._topk,
+                        self._topp, self._keys,
+                    )
+                else:
+                    args = (
+                        self._tok, self._pos, active_dev, self._cache,
+                        self._hist, budget_dev, stop_dev, self._temp,
+                        self._topk, self._topp, self._keys,
+                        self._draft._cache if self._draft is not None
+                        else None,
+                    )
             fn = self._pump_sampling if sampling else self._pump_greedy
             try:
-                emits, tok, pos, _act, cache, hist, dcache = fn(
-                    *args, n_steps=int(n)
-                )
+                if self._paged:
+                    emits, tok, pos, act, cache, hist, budget = fn(
+                        *args, n_steps=int(n)
+                    )
+                    dcache = None
+                else:
+                    emits, tok, pos, act, cache, hist, budget, dcache = fn(
+                        *args, n_steps=int(n)
+                    )
                 emits_np = np.asarray(emits)  # ONE [B, n] transfer
             except Exception as exc:
                 # the launch donated _cache/_hist (and the draft cache):
@@ -1987,6 +2739,9 @@ class ContinuousBatcher:
                 self._hist = self._pin(hist)
                 self._tok = self._pin(tok)
                 self._pos = self._pin(pos)
+                # the scan's carried pump state becomes next pump's input
+                self._budget_dev = self._pin(budget)
+                self._active_dev = self._pin(act)
                 if self._draft is not None:
                     self._draft._cache = dcache
                 out, n_em = self._harvest_rows_locked(
@@ -2024,24 +2779,30 @@ class ContinuousBatcher:
         if self._draft is not None and self.windowed:
             return self._spec_fallback_rounds(int(rounds), k, ngram)
         with self._step_lock:
+            if self._paged:
+                self._advance_prefill()
             self._apply_pending()
             with self._lock:
                 if not self._active.any():
                     return {}
-                active_np = self._active.copy()
-                sampling = any(
-                    req is not None and active_np[s] and req.temperature > 0
-                    for s, req in enumerate(self._slots)
-                )
                 r = int(rounds)
                 if not self.windowed:
                     pos_max = max(
                         req.fill0 + len(req.tokens) - 1
                         for s, req in enumerate(self._slots)
-                        if req is not None and active_np[s]
+                        if req is not None and self._active[s]
                     )
                     r = min(r, (self.max_len - pos_max) // k)
-                remaining, stop = self._pump_host_state(active_np)
+                if r >= 1 and self._paged:
+                    # block room BEFORE the active snapshot: allocation
+                    # may preempt (deactivate) a victim slot, and the
+                    # launch/harvest must both see post-preemption state
+                    self._ensure_decode_room_locked(r * k)
+                active_np = self._active.copy()
+                sampling = any(
+                    req is not None and active_np[s] and req.temperature > 0
+                    for s, req in enumerate(self._slots)
+                )
                 # NOT clamped by remaining budget: slots that exhaust
                 # their budget mid-scan idle out ON DEVICE (active &=
                 # budget > 0), exactly like step_pump's fixed n_steps.
@@ -2058,23 +2819,40 @@ class ContinuousBatcher:
                 if r >= 1:
                     while r & (r - 1):  # power-of-two floor (see above)
                         r &= r - 1
-                    args = (
-                        self._tok, self._pos, jnp.asarray(active_np),
-                        self._cache, self._hist, jnp.asarray(remaining),
-                        jnp.asarray(stop), self._temp, self._topk,
-                        self._topp, self._keys,
-                        self._draft._cache if self._draft is not None
-                        else None,
+                    budget_dev, stop_dev, active_dev = (
+                        self._pump_state_locked()
                     )
+                    if self._paged:
+                        args = (
+                            self._tok, self._pos, active_dev,
+                            self._cache, self._tables_device_locked(),
+                            self._hist, budget_dev, stop_dev,
+                            self._temp, self._topk, self._topp,
+                            self._keys,
+                        )
+                    else:
+                        args = (
+                            self._tok, self._pos, active_dev,
+                            self._cache, self._hist, budget_dev,
+                            stop_dev, self._temp, self._topk,
+                            self._topp, self._keys,
+                            self._draft._cache if self._draft is not None
+                            else None,
+                        )
                     fn = (
                         self._spec_pump_sampling if sampling
                         else self._spec_pump_greedy
                     )
             if r >= 1:
                 try:
-                    packed, tok, pos, _act, cache, hist, dcache = fn(
-                        *args, rounds=r, k=k, g=int(ngram)
-                    )
+                    if self._paged:
+                        packed, tok, pos, act, cache, hist, budget = fn(
+                            *args, rounds=r, k=k, g=int(ngram)
+                        )
+                        dcache = None
+                    else:
+                        (packed, tok, pos, act, cache, hist, budget,
+                         dcache) = fn(*args, rounds=r, k=k, g=int(ngram))
                     packed_np = np.asarray(packed)  # ONE transfer
                 except Exception as exc:
                     self._mark_failed(exc)  # donated state consumed
@@ -2082,6 +2860,8 @@ class ContinuousBatcher:
                 acc, cols = int(packed_np[-2]), int(packed_np[-1])
                 emits_np = packed_np[:-2].reshape(self.n_slots, r, k)
                 with self._lock:
+                    self._budget_dev = self._pin(budget)
+                    self._active_dev = self._pin(act)
                     return self._spec_pump_commit_locked(
                         t0, active_np, r, acc, cols, emits_np, tok, pos,
                         cache, hist, dcache,
@@ -2175,20 +2955,32 @@ class ContinuousBatcher:
 
     def _plain_step_locked(self, t0) -> Dict[int, int]:
         """step() body; caller holds _step_lock."""
+        if self._paged:
+            self._advance_prefill()
         self._apply_pending()
         with self._lock:
             if not self._active.any():
                 return {}
+            if self._paged:
+                self._ensure_decode_room_locked(1)
             active_np = self._active.copy()
             sampling = any(
                 req is not None and active_np[s] and req.temperature > 0
                 for s, req in enumerate(self._slots)
             )
-            args = (
-                self._tok, self._pos, jnp.asarray(active_np),
-                self._cache, self._hist, self._temp, self._topk,
-                self._topp, self._keys,
-            )
+            if self._paged:
+                args = (
+                    self._tok, self._pos, jnp.asarray(active_np),
+                    self._cache, self._tables_device_locked(),
+                    self._hist, self._temp, self._topk, self._topp,
+                    self._keys,
+                )
+            else:
+                args = (
+                    self._tok, self._pos, jnp.asarray(active_np),
+                    self._cache, self._hist, self._temp, self._topk,
+                    self._topp, self._keys,
+                )
         try:
             if self._draft is not None:
                 # keep the draft cache position-synced with the target:
@@ -2218,6 +3010,9 @@ class ContinuousBatcher:
             self._n_steps += 1
             self._n_tokens += len(emitted)
             self._step_time_s += _time.perf_counter() - t0
+            # host-stepped path: budgets advanced outside a pump scan,
+            # so the device-carried pump state must rebuild next pump
+            self._pump_state_dirty = True
             return emitted
 
     def spec_step(self, k: int = 4, ngram: int = 2) -> Dict[int, int]:
@@ -2258,10 +3053,15 @@ class ContinuousBatcher:
         self._check_failed()
         t0 = _time.perf_counter()
         with self._step_lock:
+            if self._paged:
+                self._advance_prefill()
             self._apply_pending()
             with self._lock:
                 if not self._active.any():
                     return {}
+                if self._paged:
+                    # before the active snapshot — may preempt a victim
+                    self._ensure_decode_room_locked(int(k))
                 active_np = self._active.copy()
                 sampling = any(
                     req is not None and active_np[s] and req.temperature > 0
@@ -2339,11 +3139,21 @@ class ContinuousBatcher:
                 toks_host[:, 1:] = self._draft.propose(
                     self._tok, self._pos, jnp.asarray(active_np), k_round
                 )
-            args = (
-                jnp.asarray(toks_host), self._pos,
-                jnp.asarray(active_np), self._cache, self._hist,
-                self._temp, self._topk, self._topp, self._keys,
-            )
+            if self._paged:
+                with self._lock:
+                    tables_dev = self._tables_device_locked()
+                args = (
+                    jnp.asarray(toks_host), self._pos,
+                    jnp.asarray(active_np), self._cache, tables_dev,
+                    self._hist, self._temp, self._topk, self._topp,
+                    self._keys,
+                )
+            else:
+                args = (
+                    jnp.asarray(toks_host), self._pos,
+                    jnp.asarray(active_np), self._cache, self._hist,
+                    self._temp, self._topk, self._topp, self._keys,
+                )
             round_fn = (
                 self._spec_round_sampling if sampling
                 else self._spec_round_greedy
@@ -2398,6 +3208,7 @@ class ContinuousBatcher:
                     (toks_host[active_np, 1:] >= 0).sum()
                 )
                 self._step_time_s += _time.perf_counter() - t0
+                self._pump_state_dirty = True  # host-stepped path
                 return emitted
 
     def stats(self) -> Dict[str, float]:
@@ -2406,7 +3217,7 @@ class ContinuousBatcher:
         cumulative steps/tokens, decode rate, and current occupancy."""
         with self._lock:
             occupied = sum(r is not None for r in self._slots)
-            return {
+            st = {
                 "steps": self._n_steps,
                 "tokens_emitted": self._n_tokens,
                 "tokens_per_step": (
@@ -2431,8 +3242,16 @@ class ContinuousBatcher:
                 "slots_occupied": occupied,
                 "slots_free": self.n_slots - occupied,
                 "results_pending_pickup": len(self._done_pool),
-                "prefixes_registered": len(self._prefixes),
+                "prefixes_registered": len(
+                    self._prefixes_paged if self._paged else self._prefixes
+                ),
             }
+            if self._paged:
+                st.update(self._pool.stats())
+                st["kv_block_size"] = self.block_size
+                st["kv_prefill_queue"] = len(self._prefill_q)
+                st["kv_preemptions"] = self._slo.preemptions_total
+            return st
 
     def _lat_p50s_locked(self):
         """Cached latency medians (_lock held): the auto-speculation
@@ -2465,6 +3284,17 @@ class ContinuousBatcher:
             self._lat_req.append(req.t_done - req.t_submit)
         self._lat_version += 1
         self._active[slot] = False
+        self._pump_state_dirty = True  # slot left the batch
+        if self._paged:
+            # release the request's blocks (shared prefix blocks drop a
+            # reference and stay adoptable in the pool's cached tier)
+            self._pool.free(
+                self._tables[slot, : self._n_alloc[slot]].tolist()
+            )
+            self._tables[slot] = 0
+            self._n_alloc[slot] = 0
+            self._tables_dirty = True
+        self._slo.finished(req.rid, len(req.tokens))
         self._done_pool[req.rid] = req
         while len(self._done_pool) > self._keep_results:
             self._done_pool.popitem(last=False)  # evict oldest uncollected
@@ -2500,6 +3330,197 @@ class ContinuousBatcher:
                 if rid in self._done_pool:
                     out[rid] = list(self._done_pool[rid].tokens)
         return out
+
+    def requests(self) -> Dict[int, Dict[str, Any]]:
+        """Per-request SLO/state view — the data behind
+        ``nns-top --requests``: state (queued/prefilling/decoding/done),
+        blocks held (paged), queue/TTFT/TPOT latencies and deadline
+        headroom, from the SLO ledger."""
+        with self._lock:
+            extra: Dict[int, Dict[str, Any]] = {}
+            for s, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                row: Dict[str, Any] = {
+                    "slot": s, "tokens": len(req.tokens),
+                }
+                if self._paged:
+                    row["blocks"] = int(self._n_alloc[s])
+                extra[req.rid] = row
+            return self._slo.view(extra)
+
+    # -- warm restart (the PR-7 drain→snapshot→restore discipline) ---------
+    def snapshot(self) -> dict:
+        """Serializable serving state: every live request, the device
+        state (cache or block arena, per-slot vectors, token history)
+        and — paged — block tables, pool accounting and the SLO ledger.
+        Pending admissions are applied first; a paged batcher must have
+        drained its prefill queue (pump until ``kv_prefill_queue`` is 0)
+        so no half-staged prompt is lost."""
+        self._check_failed()
+        with self._step_lock:
+            self._apply_pending()
+            with self._lock:
+                if self._paged and self._prefill_q:
+                    raise RuntimeError(
+                        "snapshot with queued prefills: pump until the "
+                        "prefill queue drains first"
+                    )
+                reqs = []
+                for s, req in enumerate(self._slots):
+                    if req is None:
+                        continue
+                    reqs.append({
+                        "slot": s,
+                        "rid": req.rid,
+                        "budget": req.budget,
+                        "temperature": req.temperature,
+                        "top_k": req.top_k,
+                        "top_p": req.top_p,
+                        "stop_token": req.stop_token,
+                        "key": np.asarray(req.key).tolist(),
+                        "prompt": np.asarray(req.prompt).tolist(),
+                        "tokens": list(req.tokens),
+                        "fill0": req.fill0,
+                        "active": bool(self._active[s]),
+                    })
+                snap = {
+                    "layout": "paged" if self._paged else "slot",
+                    "n_slots": self.n_slots,
+                    "max_len": self.max_len,
+                    "requests": reqs,
+                    "device": jax.tree_util.tree_map(np.asarray, {
+                        "cache": self._cache,
+                        "tok": self._tok,
+                        "pos": self._pos,
+                        "temp": self._temp,
+                        "topk": self._topk,
+                        "topp": self._topp,
+                        "keys": self._keys,
+                        "hist": self._hist,
+                    }),
+                    "next_rid": self._next_rid,
+                    "counters": {
+                        "n_steps": self._n_steps,
+                        "n_tokens": self._n_tokens,
+                        "n_spec_rounds": self._n_spec_rounds,
+                        "n_spec_accepted": self._n_spec_accepted,
+                        "n_spec_columns": self._n_spec_columns,
+                    },
+                    "done": {
+                        rid: list(r.tokens)
+                        for rid, r in self._done_pool.items()
+                    },
+                    "slo": self._slo.snapshot(),
+                }
+                if self._paged:
+                    snap["tables"] = self._tables.copy()
+                    snap["n_alloc"] = self._n_alloc.copy()
+                    snap["pool"] = self._pool.snapshot()
+                    snap["prefixes"] = {
+                        pid: (tok.tolist(), list(blks))
+                        for pid, (tok, blks)
+                        in self._prefixes_paged.items()
+                    }
+                else:
+                    # slot layout: registered prefixes live as staged
+                    # K/V tuples — they must survive the restart too, or
+                    # restored callers holding a pid get ValueError (and
+                    # a reset _next_prefix would recycle their ids)
+                    snap["prefixes"] = {
+                        pid: {
+                            "kv": jax.tree_util.tree_map(
+                                np.asarray, stored
+                            ),
+                            "plen": int(pl),
+                            "tokens": np.asarray(tok).tolist(),
+                        }
+                        for pid, (stored, pl, tok)
+                        in self._prefixes.items()
+                    }
+                snap["next_prefix"] = self._next_prefix
+                return snap
+
+    def restore(self, snap: dict) -> None:
+        """Load a :meth:`snapshot` into a freshly built batcher of the
+        SAME configuration: decoding continues exactly where the
+        snapshot stopped (same streams, same block tables, same prefix
+        index — the remembered sharing survives the restart)."""
+        want = "paged" if self._paged else "slot"
+        if snap.get("layout") != want:
+            raise ValueError(
+                f"snapshot layout {snap.get('layout')!r} does not match "
+                f"this batcher's {want!r}"
+            )
+        if (snap.get("n_slots") != self.n_slots
+                or snap.get("max_len") != self.max_len):
+            raise ValueError("snapshot geometry mismatch")
+        with self._step_lock, self._lock:
+            dev = snap["device"]
+            self._cache = jax.tree_util.tree_map(
+                jnp.asarray, dev["cache"]
+            )
+            self._tok = self._pin(jnp.asarray(dev["tok"]))
+            self._pos = self._pin(jnp.asarray(dev["pos"]))
+            self._temp = self._pin(jnp.asarray(dev["temp"]))
+            self._topk = self._pin(jnp.asarray(dev["topk"]))
+            self._topp = self._pin(jnp.asarray(dev["topp"]))
+            self._keys = self._pin(jnp.asarray(dev["keys"]))
+            self._hist = self._pin(jnp.asarray(dev["hist"]))
+            self._slots = [None] * self.n_slots
+            self._active = np.zeros((self.n_slots,), bool)
+            for d in snap["requests"]:
+                req = _Request(
+                    d["rid"], d["budget"],
+                    temperature=d["temperature"], top_k=d["top_k"],
+                    top_p=d["top_p"], stop_token=d["stop_token"],
+                    key=np.asarray(d["key"], np.uint32),
+                    prompt=np.asarray(d["prompt"], np.int32),
+                    t_submit=_time.perf_counter(),
+                )
+                req.tokens = list(d["tokens"])
+                req.fill0 = int(d["fill0"])
+                self._slots[d["slot"]] = req
+                self._active[d["slot"]] = bool(d["active"])
+            self._next_rid = int(snap["next_rid"])
+            c = snap.get("counters", {})
+            self._n_steps = int(c.get("n_steps", 0))
+            self._n_tokens = int(c.get("n_tokens", 0))
+            self._n_spec_rounds = int(c.get("n_spec_rounds", 0))
+            self._n_spec_accepted = int(c.get("n_spec_accepted", 0))
+            self._n_spec_columns = int(c.get("n_spec_columns", 0))
+            self._done_pool = OrderedDict()
+            for rid, toks in snap.get("done", {}).items():
+                stub = _Request(int(rid), 0)
+                stub.tokens = list(toks)
+                stub.done = True
+                self._done_pool[int(rid)] = stub
+            self._slo.restore(snap.get("slo", {}))
+            if self._paged:
+                self._tables = np.asarray(snap["tables"], np.int32).copy()
+                self._n_alloc = np.asarray(
+                    snap["n_alloc"], np.int32
+                ).copy()
+                self._tables_dirty = True
+                self._pool.restore(snap["pool"])
+                self._prefixes_paged = {
+                    int(pid): (np.asarray(tok, np.int32), list(blks))
+                    for pid, (tok, blks)
+                    in snap.get("prefixes", {}).items()
+                }
+            else:
+                self._prefixes = {
+                    int(pid): (
+                        jax.tree_util.tree_map(jnp.asarray, d["kv"]),
+                        int(d["plen"]),
+                        np.asarray(d["tokens"], np.int32),
+                    )
+                    for pid, d in snap.get("prefixes", {}).items()
+                }
+            self._next_prefix = int(
+                snap.get("next_prefix", self._next_prefix)
+            )
+            self._pump_state_dirty = True
 
     @property
     def n_free(self) -> int:

@@ -110,6 +110,25 @@ METRIC_CATALOG: Dict[str, str] = {
         "by plane and stream label (counter; admitted minus served is "
         "the stream's in-flight/errored tail — docs/serving-plane.md)"
     ),
+    "nns_kv_blocks_in_use": (
+        "KV-cache blocks currently referenced by live requests in a "
+        "paged continuous batcher (gauge; capacity vs kv_blocks is the "
+        "paging headroom — docs/llm-serving.md)"
+    ),
+    "nns_kv_prefix_hits_total": (
+        "prompt blocks adopted from the paged KV prefix index instead "
+        "of re-prefilled — shared system prompts count once, not per "
+        "request (counter; docs/llm-serving.md)"
+    ),
+    "nns_request_ttft_ms": (
+        "per-request time to first token, submit → first token "
+        "materialized, milliseconds (histogram; the admission SLO — "
+        "docs/llm-serving.md)"
+    ),
+    "nns_request_tpot_ms": (
+        "per-request mean time per output token after the first, "
+        "milliseconds (histogram; the decode SLO — docs/llm-serving.md)"
+    ),
     "nns_transfer_bytes_total": (
         "bytes crossing the host<->device boundary through the "
         "transfer engine, by direction label: h2d (staged uploads) / "

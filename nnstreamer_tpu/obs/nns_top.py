@@ -27,6 +27,10 @@ reasons — docs/edge-serving.md).
 serving plane: mode/devices, attached streams, cross-stream queue
 depth, dispatches, batch occupancy — plus a per-stream admit/serve
 footer; docs/serving-plane.md).
+
+``--requests`` switches to the per-request LLM serving view (one row
+per request of a continuous batcher: state, KV blocks held, queue/
+TTFT/TPOT latencies, deadline headroom — docs/llm-serving.md).
 """
 
 from __future__ import annotations
@@ -247,6 +251,68 @@ def render_models(snap: dict) -> str:
     return "\n".join(lines)
 
 
+_REQUEST_COLUMNS = (
+    ("ELEMENT", 20), ("RID", 6), ("STATE", 12), ("BLOCKS", 8),
+    ("QUEUEms", 9), ("TTFTms", 9), ("TPOTms", 9), ("TOKENS", 8),
+    ("DEADLINE", 0),
+)
+
+
+def render_requests(snap: dict) -> str:
+    """The ``--requests`` view: one row per live/recent request of an
+    LLM serving element, from the batcher's SLO ledger
+    (``serving_requests`` in the element's stats row —
+    docs/llm-serving.md). Empty when nothing in the snapshot serves an
+    LLM batch."""
+    nodes: Dict[str, dict] = snap.get("nodes", {})
+    lines = []
+    head = "".join(
+        name.ljust(w) if w else name for name, w in _REQUEST_COLUMNS
+    )
+
+    def _ms(row, key):
+        v = row.get(key)
+        return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+    for name, row in nodes.items():
+        reqs = row.get("serving_requests")
+        if not isinstance(reqs, dict) or not reqs:
+            continue
+        if not lines:
+            lines.append(head)
+            lines.append("-" * max(len(head), 72))
+        for rid in sorted(reqs, key=int):
+            r = reqs[rid]
+            dl = r.get("deadline_s")
+            cells = [
+                name[:19], str(rid), str(r.get("state", "-"))[:11],
+                str(r.get("blocks", "-")),
+                _ms(r, "queue_ms"), _ms(r, "ttft_ms"), _ms(r, "tpot_ms"),
+                str(r.get("tokens", "-")),
+                (f"{dl:+.1f}s" if isinstance(dl, (int, float)) else "-"),
+            ]
+            lines.append("".join(
+                c.ljust(w) if w else c
+                for c, (_, w) in zip(cells, _REQUEST_COLUMNS)
+            ))
+        pre = row.get("serving_kv_preemptions")
+        blocks = row.get("serving_kv_blocks_in_use")
+        footer = []
+        if blocks is not None:
+            footer.append(
+                f"blocks={blocks}/{row.get('serving_kv_blocks', '?')}"
+            )
+        if row.get("serving_kv_prefix_hits"):
+            footer.append(f"prefix-hits={row['serving_kv_prefix_hits']}")
+        if pre:
+            footer.append(f"preemptions={pre}")
+        if footer:
+            lines.append(f"  {name}: " + " ".join(footer))
+    if not lines:
+        return "(no LLM serving element in this snapshot)"
+    return "\n".join(lines)
+
+
 def _fetch(source: str) -> dict:
     if source.startswith(("http://", "https://")):
         url = source.rstrip("/")
@@ -302,6 +368,8 @@ def main(argv=None) -> int:
                     help="per-client admission view (query servers)")
     ap.add_argument("--models", action="store_true",
                     help="per-plane serving view (shared model planes)")
+    ap.add_argument("--requests", action="store_true",
+                    help="per-request LLM serving view (SLO ledger)")
     args = ap.parse_args(argv)
 
     prev = None
@@ -320,6 +388,8 @@ def main(argv=None) -> int:
             print(render_clients(snap))
         elif args.models:
             print(render_models(snap))
+        elif args.requests:
+            print(render_requests(snap))
         else:
             print(render(snap, prev, dt))
         if args.once:

@@ -73,6 +73,59 @@ def test_llm_serve_pipeline_roundtrip():
         assert results[name] == _alone(prompt, 6), f"{name} diverged"
 
 
+def test_llm_serve_paged_kv_layout_matches_solo():
+    """kv-layout=paged through the element surface (docs/llm-serving.md):
+    generations stay byte-identical to solo decode, and the batcher's
+    paged/SLO stats surface through serving_stats (requests view +
+    kv_* counters for nns-top --requests)."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink, LlmServerSrc
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    rng = np.random.default_rng(1)
+    prompts = {
+        f"req{i}": rng.integers(1, 211, (5 + 2 * i,)).astype(np.int32)
+        for i in range(3)
+    }
+    src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+    sink = LlmServerSink(
+        **{"id": "pg0", "model": "zoo:transformer_lm",
+           "custom": MODEL_OPTS, "n-slots": 4, "max-len": 64,
+           "prompt-len": 16, "max-new-tokens": 5, "pump": 4,
+           "kv-layout": "paged", "block-size": 16, "kv-blocks": 12}
+    )
+    out_src = LlmServerSrc(**{"id": "pg0"})
+    out_sink = AppSink()
+    p = Pipeline().chain(src, sink)
+    p.chain(out_src, out_sink)
+    p.start()
+    try:
+        for name, prompt in prompts.items():
+            src.push(Frame((prompt,), meta={"req": name,
+                                            "deadline_ms": 60000}))
+        src.end_of_stream()
+        results = {}
+        while len(results) < len(prompts):
+            f = out_sink.pop(timeout=120)
+            assert f is not None, "serving pipeline drained early"
+            results[f.meta["req"]] = [
+                int(t) for t in np.asarray(f.tensors[0])[0]
+            ]
+        st = out_src.serving_stats()
+    finally:
+        p.stop()
+    for name, prompt in prompts.items():
+        assert results[name] == _alone(prompt, 5), f"{name} diverged"
+    assert st["kv_blocks"] == 12 and st["kv_blocks_in_use"] == 0
+    reqs = st["requests"]
+    assert len(reqs) == 3
+    assert all(r["state"] == "done" for r in reqs.values())
+    assert all(r.get("deadline_s") is not None for r in reqs.values())
+
+
 def test_llm_serve_cli_parses():
     """Both elements resolve from a pipeline description (the reference's
     pairing-by-id pattern, like tensor_repo)."""

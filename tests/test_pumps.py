@@ -328,6 +328,45 @@ def test_spec_pump_budget_tail_stays_on_warm_programs(params):
     assert b.stats()["spec_accepted_tokens"] > 0  # non-trivial run
 
 
+def test_steady_pumps_ship_no_host_state(params):
+    """Regression beside the no-new-compiles pin above: the per-slot
+    budget/stop/active pump state is CARRIED on device between pumps
+    (the scan already computes next-pump values), so a steady pump-only
+    drain must rebuild + re-ship host state ZERO times. It used to be
+    recomputed and H2D-shipped on EVERY pump even when no slot changed.
+    The cache invalidates exactly on submit (admission) and finish —
+    both pinned here; jax's transfer guard additionally proves the
+    steady-state pump launch performs no host→device transfer at all."""
+    b = _twin(params)
+    rids = [b.submit(_prompt(5 + s, 130 + s), 40) for s in range(3)]
+    b.step_pump(4)  # admissions applied, state shipped once
+    builds0 = b._host_state_builds
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            b.step_pump(4)
+    assert b._host_state_builds == builds0, (
+        "steady pumps rebuilt host pump state"
+    )
+    # admission invalidates: exactly one rebuild at the next pump
+    rids.append(b.submit(_prompt(4, 140), 30))
+    b.step_pump(4)
+    assert b._host_state_builds == builds0 + 1
+    # a finishing request invalidates too (slot leaves the batch)
+    b2 = _twin(params)
+    r2 = [b2.submit(_prompt(5, 141), 3), b2.submit(_prompt(6, 142), 40)]
+    b2.step_pump(4)  # request 0 finishes inside this pump
+    n = b2._host_state_builds
+    b2.step_pump(4)
+    assert b2._host_state_builds == n + 1
+    # and the carried state stays EXACT: drain to the per-token streams
+    a = _twin(params)
+    ra = [a.submit(_prompt(5 + s, 130 + s), 40) for s in range(3)]
+    ra.append(a.submit(_prompt(4, 140), 30))
+    _drain_steps(a, ra)
+    _drain_pump(b, rids, 4)
+    assert _tokens(a, ra) == _tokens(b, rids)
+
+
 def test_ngram_device_proposer_mines_recent_context(params):
     """device_ngram_propose finds the most recent suffix match and
     proposes its continuation; -1 where nothing matches."""
