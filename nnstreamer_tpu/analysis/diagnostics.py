@@ -153,6 +153,15 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "available: a block-table arena serves the same requests in "
         "the actually-used tokens, with prefix sharing on top",
     ),
+    "NNS-W116": (
+        Severity.WARNING, "host-postproc-splits-device-chain",
+        "a tensor_decoder whose decode math HAS a device (traceable) "
+        "path runs as a host node between two device-capable filters: "
+        "every frame materializes its (usually much larger) decoder "
+        "inputs to host mid-stream; postproc=device folds the decode "
+        "into the adjacent fused segment and only the small decoded "
+        "tensor ever leaves the device",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -874,6 +874,7 @@ def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
     from nnstreamer_tpu import registry
     from nnstreamer_tpu.backends.base import Backend
     from nnstreamer_tpu.elements.base import TensorOp
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
     from nnstreamer_tpu.elements.filter import TensorFilter
     from nnstreamer_tpu.elements.flow import CapsFilter, Queue, Tee
     from nnstreamer_tpu.elements.routing import Routing
@@ -946,18 +947,73 @@ def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
                 return False
         return hasattr(e, "host_process")
 
+    def host_postproc_with_device_path(e) -> bool:
+        """NNS-W116 static capability read (mirrors W113's backend-class
+        read — no negotiation, no model/labels load): a tensor_decoder
+        that will RUN host (postproc=host, or postproc=auto with a
+        subplugin that offers no auto-fuse make_fn) while its subplugin
+        declares a device decode path for these options."""
+        if not isinstance(e, TensorDecoder):
+            return False
+        if e.postproc == "device" or e.mode == "custom-code":
+            return False
+        try:
+            cls = registry.get(registry.KIND_DECODER, e.mode)
+        except KeyError:
+            return False  # unknown mode has its own diagnostic
+        probe = getattr(cls, "device_capable", None)
+        if probe is None or not probe(e.options):
+            return False
+        if e.postproc == "auto" and getattr(cls, "make_fn", None) is not None:
+            return False  # auto already fuses this subplugin
+        return True
+
+    def decoder_will_fuse(e) -> bool:
+        """Decoders whose is_traceable() is False only because lint
+        never negotiates: postproc=device always fuses (or fails
+        negotiation loudly), and auto fuses subplugins that offer a
+        make_fn for these options (image_labeling without labels)."""
+        if not isinstance(e, TensorDecoder) or e.mode == "custom-code":
+            return False
+        if e.postproc == "device":
+            return True
+        if e.postproc != "auto":
+            return False
+        try:
+            cls = registry.get(registry.KIND_DECODER, e.mode)
+        except KeyError:
+            return False
+        if getattr(cls, "make_fn", None) is None:
+            return False
+        probe = getattr(cls, "device_capable", None)
+        return probe is None or bool(probe(e.options))
+
     for e in pipeline.elements:
-        if not host_bound(e):
+        if not host_bound(e) or decoder_will_fuse(e):
             continue
-        if reaches_capable(e, ups) and reaches_capable(e, downs):
+        if not (reaches_capable(e, ups) and reaches_capable(e, downs)):
+            continue
+        if host_postproc_with_device_path(e):
+            # the specific diagnostic wins: there IS a device path, so
+            # the fix is one property, not a pipeline restructure
             report.add(
-                "NNS-W113", e.name,
-                "host-bound element between two device-capable filters: "
-                "frames materialize to host and back mid-stream, "
-                "defeating the resident segment handoff",
-                "move the host step before/after the device chain, or "
-                "give it a traceable equivalent (docs/streaming.md)",
+                "NNS-W116", e.name,
+                "fusable decoder runs as a host node between two "
+                "device segments: its (large) inputs materialize to "
+                "host every frame although the decode has a device "
+                "path",
+                "set postproc=device to fold the decode into the "
+                "adjacent fused segment (docs/on-device-ops.md)",
             )
+            continue
+        report.add(
+            "NNS-W113", e.name,
+            "host-bound element between two device-capable filters: "
+            "frames materialize to host and back mid-stream, "
+            "defeating the resident segment handoff",
+            "move the host step before/after the device chain, or "
+            "give it a traceable equivalent (docs/streaming.md)",
+        )
 
 
 # -- pass 4: resources -------------------------------------------------------

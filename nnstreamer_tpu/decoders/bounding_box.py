@@ -63,6 +63,12 @@ def load_box_priors(path: str) -> np.ndarray:
 
 @registry.decoder_plugin("bounding_boxes")
 class BoundingBoxDecoder:
+    @classmethod
+    def device_capable(cls, options: dict) -> bool:
+        """Static capability read for nns-lint NNS-W116 (no negotiation,
+        no priors load): every bounding-box mode has a device decode."""
+        return True
+
     def __init__(self) -> None:
         self._mode = "mobilenet-ssd"
         self._labels: Optional[List[str]] = None
@@ -161,6 +167,106 @@ class BoundingBoxDecoder:
             )
         w, h = self._out_wh
         return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    # -- device post-processing (tensor_decoder postproc=device) ----------
+    def device_decode(self, in_spec: TensorsSpec, options: dict):
+        """Traceable decode for every bounding-box mode: the exact math
+        of :meth:`_detections` (ops/detection.py — already pure jax),
+        emitted as a fused op so the decode runs inside the adjacent
+        device segment. Output: ONE float32 [max_out, 6] detections
+        tensor (x1, y1, x2, y2, class, score; rows with score 0 empty).
+        The RGBA rasterization host tail is dropped — a downstream
+        consumer reads structured rows, not pixels."""
+        self.negotiate(in_spec, options)  # validates count + options
+        mode = self._mode
+        max_out = 20 if mode == "mp-palm-detection" else 100
+        shapes = [
+            tuple(d for d in t.shape if d != 1) for t in in_spec
+        ]
+
+        import jax.numpy as jnp
+
+        if mode == "mobilenet-ssd":
+            p = dict(self._params)
+            priors = jnp.asarray(self._priors)
+            # resolve the loc/scores order STATICALLY from the
+            # negotiated shapes (the host path probes per frame)
+            loc_idx = 0 if (
+                len(shapes[0]) == 2 and shapes[0][-1] == 4
+            ) else 1
+
+            def fn(tensors):
+                loc = tensors[loc_idx].reshape(-1, 4)
+                scores = tensors[1 - loc_idx].reshape(loc.shape[0], -1)
+                return (det.ssd_postprocess(
+                    loc, scores, priors,
+                    threshold=p["threshold"],
+                    iou_threshold=p["iou_threshold"],
+                    y_scale=p["y_scale"], x_scale=p["x_scale"],
+                    h_scale=p["h_scale"], w_scale=p["w_scale"],
+                ),)
+
+        elif mode == "mobilenet-ssd-postprocess":
+            m = self._tensor_map
+            thr = self._pp_threshold
+
+            def fn(tensors):
+                loc = tensors[m[0]].reshape(-1, 4).astype(jnp.float32)
+                cls = tensors[m[1]].reshape(-1).astype(jnp.float32)
+                sco = tensors[m[2]].reshape(-1).astype(jnp.float32)
+                num = tensors[m[3]].reshape(-1).astype(jnp.float32)[0]
+                return (det.ssd_pp_postprocess(
+                    loc, cls, sco, num, threshold=thr
+                ),)
+
+        elif mode in ("ov-person-detection", "ov-face-detection"):
+            def fn(tensors):
+                return (det.ov_detection_postprocess(
+                    tensors[0].reshape(-1, 7)
+                ),)
+
+        elif mode == "yolov5":
+            p = dict(self._params)
+            iw, ih = self._in_wh
+            cols = shapes[0][-1]
+
+            def fn(tensors):
+                pred = tensors[0].reshape(-1, cols).astype(jnp.float32)
+                if p["pixel_coords"]:
+                    norm = jnp.asarray(
+                        [iw, ih, iw, ih], jnp.float32
+                    )
+                    pred = jnp.concatenate(
+                        [pred[:, :4] / norm, pred[:, 4:]], axis=-1
+                    )
+                return (det.yolov5_postprocess(
+                    pred, conf_threshold=p["conf_threshold"],
+                    iou_threshold=p["iou_threshold"], scaled=True,
+                ),)
+
+        elif mode == "mp-palm-detection":
+            anchors = jnp.asarray(self._anchors)
+            score_thr = self._params["score_threshold"]
+            in_size = self._in_wh[0]
+            cols = shapes[0][-1]
+
+            def fn(tensors):
+                boxes = tensors[0].reshape(-1, cols)
+                scores = tensors[1].reshape(-1)
+                return (det.mp_palm_postprocess(
+                    boxes, scores, anchors,
+                    score_threshold=score_thr, input_size=in_size,
+                ),)
+
+        else:  # pragma: no cover - _MODES is closed above
+            return None
+        from nnstreamer_tpu.tensors.spec import DType, TensorSpec
+
+        out = TensorsSpec.of(
+            TensorSpec((max_out, 6), DType.FLOAT32, name="detections"),
+            rate=in_spec.rate,
+        )
+        return out, fn
 
     # -- per-frame decode --------------------------------------------------
     def _detections(self, frame: Frame) -> np.ndarray:

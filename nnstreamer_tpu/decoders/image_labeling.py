@@ -27,6 +27,13 @@ from nnstreamer_tpu.decoders.render import load_labels  # shared loader
 
 @registry.decoder_plugin("image_labeling")
 class ImageLabelingDecoder:
+    @classmethod
+    def device_capable(cls, options: dict) -> bool:
+        """Static capability read for nns-lint NNS-W116: the argmax
+        decodes on device unless a labels file (option1) pins the
+        label-string lookup — a host tail by nature."""
+        return not options.get("option1")
+
     def __init__(self) -> None:
         self._labels: Optional[List[str]] = None
 
@@ -66,6 +73,16 @@ class ImageLabelingDecoder:
             return (jnp.argmax(flat, axis=-1).astype(jnp.uint32),)
 
         return fn
+
+    def device_decode(self, in_spec: TensorsSpec, options: dict):
+        """tensor_decoder postproc=device: the argmax fn plus its
+        negotiated tensor spec. None when a labels file is set —
+        label-string lookup is a host tail by nature."""
+        out = self.negotiate(in_spec, options)
+        fn = self.make_fn(in_spec, options)
+        if fn is None:
+            return None
+        return out, fn
 
     def decode(self, frame: Frame, options: dict) -> Frame:
         scores = np.asarray(frame.tensors[0])

@@ -24,6 +24,12 @@ _DEFAULT_LABELS = 21  # Pascal-VOC classes of deeplab-v3 (reference :95)
 
 @registry.decoder_plugin("image_segment")
 class ImageSegmentDecoder:
+    @classmethod
+    def device_capable(cls, options: dict) -> bool:
+        """Static capability read for nns-lint NNS-W116: every segment
+        mode decodes on device."""
+        return True
+
     def __init__(self) -> None:
         self._mode = "tflite-deeplab"
         self._num_labels = _DEFAULT_LABELS
@@ -54,6 +60,32 @@ class ImageSegmentDecoder:
             h, w = shape
         self._wh = (w, h)
         return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    # -- device post-processing (tensor_decoder postproc=device) ----------
+    def device_decode(self, in_spec: TensorsSpec, options: dict):
+        """Traceable per-pixel decode: the argmax / normalization as a
+        fused op — emits the [H, W] uint8 label (or depth-gray) map,
+        exactly ``meta["label_map"]`` of the host path. The palette
+        rasterization host tail is dropped."""
+        self.negotiate(in_spec, options)
+        w, h = self._wh
+        mode = self._mode
+        num_labels = self._num_labels
+        shape = tuple(d for d in in_spec[0].shape if d != 1)
+
+        def fn(tensors):
+            arr = tensors[0].reshape(shape)
+            if mode == "snpe-depth":
+                return (hm.depth_normalize(arr),)
+            return (hm.segment_argmax(arr, num_labels=num_labels),)
+
+        from nnstreamer_tpu.tensors.spec import DType, TensorSpec
+
+        out = TensorsSpec.of(
+            TensorSpec((h, w), DType.UINT8, name="label_map"),
+            rate=in_spec.rate,
+        )
+        return out, fn
 
     def decode(self, frame: Frame, options: dict) -> Frame:
         t = frame.tensors[0]

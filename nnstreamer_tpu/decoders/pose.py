@@ -44,6 +44,12 @@ def load_pose_labels(path: str) -> Tuple[List[str], List[List[int]]]:
 
 @registry.decoder_plugin("pose_estimation")
 class PoseDecoder:
+    @classmethod
+    def device_capable(cls, options: dict) -> bool:
+        """Static capability read for nns-lint NNS-W116: both heatmap
+        modes decode on device."""
+        return True
+
     def __init__(self) -> None:
         self._out_wh = (640, 480)
         self._in_wh = (257, 257)
@@ -71,6 +77,51 @@ class PoseDecoder:
             )
         w, h = self._out_wh
         return MediaSpec("video", width=w, height=h, format="RGBA", rate=in_spec.rate)
+
+    # -- device post-processing (tensor_decoder postproc=device) ----------
+    def device_decode(self, in_spec: TensorsSpec, options: dict):
+        """Traceable keypoint decode: grid argmax (+ offset refinement)
+        and the output-pixel scaling as fused ops — emits the [K, 3]
+        (x, y, score) keypoints tensor in output-pixel units, exactly
+        the values :meth:`decode` stamps into ``meta["keypoints"]``.
+        The skeleton rasterization host tail is dropped."""
+        self.negotiate(in_spec, options)
+        grid_shape = tuple(d for d in in_spec[0].shape if d != 1)
+        if len(grid_shape) != 3:
+            return None
+        gh, gw, k = grid_shape
+        ow, oh = self._out_wh
+        iw, ih = self._in_wh
+        offset_mode = self._offset_mode
+
+        import jax.numpy as jnp
+
+        def fn(tensors):
+            grid = tensors[0].reshape(gh, gw, k)
+            if offset_mode:
+                offs = tensors[1].reshape(gh, gw, 2 * k)
+                raw = hm.pose_keypoints_with_offsets(grid, offs)
+                x_in = raw[:, 0] / max(gw - 1, 1) * (iw - 1) + raw[:, 3]
+                y_in = raw[:, 1] / max(gh - 1, 1) * (ih - 1) + raw[:, 4]
+                xs = x_in / iw * ow
+                ys = y_in / ih * oh
+            else:
+                raw = hm.pose_keypoints_from_heatmap(grid)
+                xs = raw[:, 0] / max(gw - 1, 1) * ow
+                ys = raw[:, 1] / max(gh - 1, 1) * oh
+            return (
+                jnp.stack([xs, ys, raw[:, 2]], axis=-1).astype(
+                    jnp.float32
+                ),
+            )
+
+        from nnstreamer_tpu.tensors.spec import DType, TensorSpec
+
+        out = TensorsSpec.of(
+            TensorSpec((k, 3), DType.FLOAT32, name="keypoints"),
+            rate=in_spec.rate,
+        )
+        return out, fn
 
     def decode(self, frame: Frame, options: dict) -> Frame:
         heat = np.asarray(frame.tensors[0])

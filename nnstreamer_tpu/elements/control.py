@@ -374,7 +374,7 @@ class TensorCrop(Routing):
         import jax
         import jax.numpy as jnp
 
-        from nnstreamer_tpu.ops.image import crop_and_resize
+        from nnstreamer_tpu.ops.image import crop_regions
 
         ow, oh = self.out_size
         k = self.max_crops
@@ -386,17 +386,14 @@ class TensorCrop(Routing):
             n = b.shape[0]
             b = b[:k] if n >= k else jnp.pad(b, ((0, k - n), (0, 0)))
             xyxy = jnp.concatenate([b[:, :2], b[:, :2] + b[:, 2:4]], axis=-1)
-            crops = crop_and_resize(img.astype(jnp.float32), xyxy, oh, ow)
-            # zero-size regions → zeroed rows (the fused composite's
-            # below-threshold convention, models/face_pipeline.py)
-            valid = (b[:, 2] > 0) & (b[:, 3] > 0)
-            crops = jnp.where(valid[:, None, None, None], crops, 0.0)
-            if np.dtype(np_dtype).kind in "ui":
-                # clip to the dtype's own range: 0..255 would wrap int8
-                # on astype and clamp valid uint16 values above 255
-                info = np.iinfo(np_dtype)
-                crops = jnp.clip(jnp.round(crops), info.min, info.max)
-            return crops.astype(np_dtype), b.astype(jnp.int32)
+            # zero-size regions → zeroed rows, integer round+clip: the
+            # shared tensor_crop conventions (ops/image.crop_regions —
+            # one home for this epilogue, docs/on-device-ops.md)
+            crops = crop_regions(
+                img, xyxy, oh, ow,
+                valid=(b[:, 2] > 0) & (b[:, 3] > 0), out_dtype=np_dtype,
+            )
+            return crops, b.astype(jnp.int32)
 
         self._jit_crop = jax.jit(fn)
 

@@ -73,6 +73,12 @@ class TensorConverter(TensorOp):
         ),
         "input-dim": PropSpec("str", None, desc="octet framing dims"),
         "input-type": PropSpec("str", "uint8"),
+        "input-norm": PropSpec(
+            "str", None,
+            desc="MEAN:STD — fuse (x - MEAN)/STD uint8→float32 "
+            "normalization into the ingress (video input; the op "
+            "rides the downstream XLA segment, docs/on-device-ops.md)",
+        ),
         "script": PropSpec("str", None, desc="python3 subplugin script path"),
         # per-frame error policy (pipeline/faults.py)
         **FAULT_PROPS,
@@ -84,6 +90,25 @@ class TensorConverter(TensorOp):
         self.mode = self.get_property("mode")  # converter subplugin name
         self.input_dims = self.get_property("input-dim")
         self.input_types = self.get_property("input-type", "uint8")
+        raw_norm = self.get_property("input-norm")
+        self.input_norm = None
+        if raw_norm:
+            mean, sep, std = str(raw_norm).partition(":")
+            if not sep or not std:
+                # a missing STD must not silently default: (x-MEAN)/1.0
+                # is exactly the wrongly-scaled-features failure this
+                # property exists to prevent
+                raise ValueError(
+                    f"{self.name}: input-norm={raw_norm!r} (want MEAN:STD)"
+                )
+            try:
+                self.input_norm = (float(mean), float(std))
+            except ValueError as exc:
+                raise ValueError(
+                    f"{self.name}: input-norm={raw_norm!r} (want MEAN:STD)"
+                ) from exc
+            if self.input_norm[1] == 0.0:
+                raise ValueError(f"{self.name}: input-norm STD must be nonzero")
         self._batch: List[np.ndarray] = []
         self._batch_pts = None
         self._subplugin = None
@@ -95,6 +120,19 @@ class TensorConverter(TensorOp):
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         (spec,) = in_specs
         self._traceable_fn = None
+        if self.input_norm is not None and (
+            self.mode
+            or not (
+                isinstance(spec, MediaSpec) and spec.media_type == "video"
+            )
+        ):
+            # fail loudly: a silently un-applied normalization would
+            # feed downstream models un-normalized pixels (subplugin/
+            # custom modes convert on their own terms)
+            raise NegotiationError(
+                f"{self.name}: input-norm applies to direct video "
+                f"conversion only, got mode={self.mode!r} over {spec}"
+            )
         if self.mode and self.mode.startswith("custom-code"):
             _, _, name = self.mode.partition(":")
             with _custom_lock:
@@ -125,14 +163,32 @@ class TensorConverter(TensorOp):
                 if spec.width is None or spec.height is None:
                     raise NegotiationError(f"{self.name}: video size unknown")
                 c = spec.channels_per_pixel
+                dtype = DType.FLOAT32 if self.input_norm else DType.UINT8
                 out = TensorSpec(
-                    (self.frames_per_tensor, spec.height, spec.width, c), DType.UINT8
+                    (self.frames_per_tensor, spec.height, spec.width, c), dtype
                 )
                 rate = spec.rate / self.frames_per_tensor if spec.rate else None
                 if self.frames_per_tensor == 1:
                     # HWC → NHWC is one reshape: fuse it into the
-                    # downstream XLA program (no host copy, no queue hop)
-                    self._traceable_fn = lambda tensors: (tensors[0][None, ...],)
+                    # downstream XLA program (no host copy, no queue
+                    # hop). input-norm folds the uint8→float
+                    # normalization into the same fused op, so the
+                    # classic preprocessing transform costs zero extra
+                    # HBM round trips (docs/on-device-ops.md).
+                    if self.input_norm:
+                        mean, std = self.input_norm
+
+                        def _norm_fn(tensors):
+                            import jax.numpy as jnp
+
+                            x = jnp.asarray(tensors[0]).astype(jnp.float32)
+                            return (((x - mean) / std)[None, ...],)
+
+                        self._traceable_fn = _norm_fn
+                    else:
+                        self._traceable_fn = (
+                            lambda tensors: (tensors[0][None, ...],)
+                        )
                 return [TensorsSpec.of(out, rate=rate)]
             if spec.media_type == "audio":
                 if spec.channels is None:
@@ -229,8 +285,19 @@ class TensorConverter(TensorOp):
         t0 = frame.tensors[0]
         on_device = hasattr(t0, "devices")
         img = t0 if on_device else np.asarray(t0)  # HWC
+
+        def _norm(batch):
+            if self.input_norm is None:
+                return batch
+            mean, std = self.input_norm
+            if hasattr(batch, "devices"):
+                import jax.numpy as jnp
+
+                return (jnp.asarray(batch).astype(jnp.float32) - mean) / std
+            return (np.asarray(batch, np.float32) - mean) / std
+
         if self.frames_per_tensor == 1:
-            return frame.with_tensors((img[None, ...],))
+            return frame.with_tensors((_norm(img[None, ...]),))
         self._batch.append(img)
         if len(self._batch) == 1:
             self._batch_pts = frame.pts
@@ -242,6 +309,7 @@ class TensorConverter(TensorOp):
             batch = jnp.stack(self._batch, axis=0)
         else:
             batch = np.stack(self._batch, axis=0)
+        batch = _norm(batch)
         self._batch.clear()
         dur = (
             frame.duration * self.frames_per_tensor

@@ -58,6 +58,15 @@ class TensorDecoder(TensorOp):
 
     PROPERTIES = dict(
         {"mode": PropSpec("str", None, desc="decoder subplugin name"),
+         "postproc": PropSpec(
+             "enum", "auto", ("auto", "device", "host"),
+             desc="where the decode math runs (docs/on-device-ops.md): "
+             "device = fuse the subplugin's tensor math into the "
+             "adjacent XLA segment and emit the structured result "
+             "tensor (no host rasterization); host = force the host "
+             "node; auto = fuse only decodes whose negotiated output "
+             "is already a tensor (e.g. image_labeling)",
+         ),
          # per-frame error policy (pipeline/faults.py)
          **FAULT_PROPS},
         **{
@@ -69,6 +78,12 @@ class TensorDecoder(TensorOp):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.mode = str(self.get_property("mode", ""))
+        self.postproc = str(self.get_property("postproc", "auto")).lower()
+        if self.postproc not in ("auto", "device", "host"):
+            raise ValueError(
+                f"{self.name}: postproc={self.postproc!r} not "
+                "auto/device/host"
+            )
         if not self.mode:
             raise ValueError(f"{self.name}: tensor_decoder needs mode=")
         self.options = {
@@ -85,6 +100,11 @@ class TensorDecoder(TensorOp):
         if not isinstance(spec, TensorsSpec):
             raise NegotiationError(f"{self.name}: needs tensor input, got {spec}")
         if self.mode == "custom-code":
+            if self.postproc == "device":
+                raise NegotiationError(
+                    f"{self.name}: custom-code decoders are host "
+                    "callbacks; postproc=device cannot trace them"
+                )
             name = self.options["option1"]
             with _custom_lock:
                 fn = _custom_decoders.get(name)
@@ -96,10 +116,30 @@ class TensorDecoder(TensorOp):
             return [spec]  # custom decoders declare no static out spec
         sub = registry.get(registry.KIND_DECODER, self.mode)
         self._sub = sub() if isinstance(sub, type) else sub
+        if self.postproc == "device":
+            # device post-processing (docs/on-device-ops.md): the
+            # subplugin contributes its decode math as a traceable fn
+            # and the negotiated output becomes the structured result
+            # tensor — the pipeline compiler folds it into the adjacent
+            # FusedSegment, so the decode never leaves the device. Host
+            # tails (rasterization, label lookup) are dropped here; a
+            # downstream host element consumes the tensor instead.
+            dd = getattr(self._sub, "device_decode", None)
+            got = dd(spec, self.options) if dd is not None else None
+            if got is None:
+                raise NegotiationError(
+                    f"{self.name}: mode {self.mode!r} (with these "
+                    "options) has no device decode path; use "
+                    "postproc=host (docs/on-device-ops.md)"
+                )
+            out_spec, fn = got
+            self._traceable_fn = fn
+            return [out_spec]
         out = [self._sub.negotiate(spec, self.options)]
-        mk = getattr(self._sub, "make_fn", None)
-        if mk is not None:
-            self._traceable_fn = mk(spec, self.options)
+        if self.postproc != "host":
+            mk = getattr(self._sub, "make_fn", None)
+            if mk is not None:
+                self._traceable_fn = mk(spec, self.options)
         return out
 
     def is_traceable(self) -> bool:
@@ -111,4 +151,10 @@ class TensorDecoder(TensorOp):
     def host_process(self, frame: Frame):
         if self._custom_fn is not None:
             return self._custom_fn(frame, self.options)
+        if self.postproc == "device" and self._traceable_fn is not None:
+            # a device-path decoder can still land on the host loop (a
+            # LINKED error pad is a fusion barrier; NNS_NO_FUSE): serve
+            # the same traced math per frame so the negotiated
+            # structured-tensor spec holds — never the video tail
+            return frame.with_tensors(tuple(self._traceable_fn(frame.tensors)))
         return self._sub.decode(frame, self.options)

@@ -18,6 +18,21 @@ reference's innermost-first; translated to canonical axes internally):
 - mode=stand option={default|dc-average}[:TYPE][,per-channel:true]
 
 Applied to every tensor in the frame (multi-tensor parity).
+
+Image modes (docs/on-device-ops.md) — the pre-processing the reference
+delegates to host videoscale/videocrop, as fusable device ops
+(ops/image.py; Pallas-kernel-backed on TPU):
+
+- mode=resize option=H:W — bilinear resize of every HWC/NHWC image
+  tensor to H×W (dtype preserved).
+- mode=crop-resize option=H:W — the frame is (image, boxes) in either
+  order: image [H,W,C] or [1,H,W,C]; boxes [N,4] int (x,y,w,h) pixel
+  regions (tensor_crop convention — zero-size rows zero their crop),
+  [N,4] float (x1,y1,x2,y2) pixels, [N,6] decoded detections or [N,7]
+  OV rows (normalized coords, scaled by the image size). Emits ONE
+  [N,H,W,C] crop batch in the image dtype — the tensor_crop out-size=
+  cascade as a 1→1 fusable op, so detect→crop→landmark chains entirely
+  in device segments.
 """
 
 from __future__ import annotations
@@ -62,7 +77,7 @@ class TensorTransform(TensorOp):
         "mode": PropSpec(
             "enum", None,
             ("typecast", "arithmetic", "transpose", "dimchg", "clamp",
-             "stand"),
+             "stand", "resize", "crop-resize"),
         ),
         "option": PropSpec("str", "", desc="per-mode option string"),
         # per-frame error policy (pipeline/faults.py)
@@ -80,6 +95,8 @@ class TensorTransform(TensorOp):
             "dimchg",
             "clamp",
             "stand",
+            "resize",
+            "crop-resize",
         ):
             raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
         install_error_pad(self)
@@ -89,8 +106,69 @@ class TensorTransform(TensorOp):
         (spec,) = in_specs
         if not isinstance(spec, TensorsSpec):
             raise NegotiationError(f"{self.name}: needs tensor input, got {spec}")
+        if self.mode == "crop-resize":
+            # cross-tensor mode: (image, boxes) → one crop batch
+            return [self._crop_resize_spec(spec)]
         outs = [self._transform_spec(t) for t in spec]
         return [TensorsSpec(tuple(outs), spec.format, spec.rate)]
+
+    def _parse_hw(self) -> Tuple[int, int]:
+        try:
+            h, w = (int(x) for x in self.option.split(":"))
+        except ValueError as exc:
+            raise NegotiationError(
+                f"{self.name}: bad {self.mode} size {self.option!r} "
+                "(want H:W)"
+            ) from exc
+        if h <= 0 or w <= 0:
+            raise NegotiationError(
+                f"{self.name}: {self.mode} size must be positive, got "
+                f"{h}:{w}"
+            )
+        return h, w
+
+    def _crop_resize_layout(self, spec: TensorsSpec):
+        """Resolve the (image, boxes) tensor roles statically from the
+        negotiated spec: image is the rank-3 HWC / rank-4 [1,H,W,C]
+        tensor, boxes the rank-2 [N, 4|6|7] one."""
+        if spec.num_tensors != 2:
+            raise NegotiationError(
+                f"{self.name}: crop-resize needs (image, boxes), got "
+                f"{spec.num_tensors} tensors"
+            )
+        img_idx = next(
+            (i for i, t in enumerate(spec) if t.rank >= 3), None
+        )
+        if img_idx is None:
+            raise NegotiationError(
+                f"{self.name}: crop-resize found no image tensor "
+                f"(rank ≥ 3) in {spec}"
+            )
+        box_idx = 1 - img_idx
+        img, box = spec[img_idx], spec[box_idx]
+        if img.rank == 4 and img.shape[0] not in (1, None):
+            raise NegotiationError(
+                f"{self.name}: crop-resize crops one image per frame "
+                f"(batch {img.shape[0]})"
+            )
+        if img.rank not in (3, 4):
+            raise NegotiationError(
+                f"{self.name}: image must be HWC or [1,H,W,C], got {img}"
+            )
+        if box.rank != 2 or box.shape[-1] not in (4, 6, 7):
+            raise NegotiationError(
+                f"{self.name}: boxes must be [N, 4|6|7] (pixel regions, "
+                f"decoded detections, or OV rows), got {box}"
+            )
+        return img_idx, box_idx
+
+    def _crop_resize_spec(self, spec: TensorsSpec) -> TensorsSpec:
+        h, w = self._parse_hw()
+        img_idx, box_idx = self._crop_resize_layout(spec)
+        img, box = spec[img_idx], spec[box_idx]
+        c = img.shape[-1]
+        out = TensorSpec((box.shape[0], h, w, c), img.dtype, name="crops")
+        return TensorsSpec.of(out, rate=spec.rate)
 
     def _transform_spec(self, t: TensorSpec) -> TensorSpec:
         m = self.mode
@@ -115,6 +193,16 @@ class TensorTransform(TensorOp):
             if out_type:
                 return t.with_dtype(out_type)
             return t if t.dtype.is_float else t.with_dtype(DType.FLOAT32)
+        if m == "resize":
+            h, w = self._parse_hw()
+            if t.rank == 3:
+                return t.with_shape((h, w, t.shape[2]))
+            if t.rank == 4:
+                return t.with_shape((t.shape[0], h, w, t.shape[3]))
+            raise NegotiationError(
+                f"{self.name}: resize needs HWC/NHWC image tensors, "
+                f"got {t}"
+            )
         raise AssertionError(m)
 
     # -- option parsing ----------------------------------------------------
@@ -247,6 +335,62 @@ class TensorTransform(TensorOp):
                 return tuple(
                     jnp.clip(jnp.asarray(t), *_clamp_bounds(t, lo, hi)) for t in tensors
                 )
+
+        elif mode == "resize":
+            out_h, out_w = self._parse_hw()
+            from nnstreamer_tpu.ops.image import resize_bilinear
+
+            def fn(tensors):
+                return tuple(
+                    resize_bilinear(jnp.asarray(t), out_h, out_w)
+                    for t in tensors
+                )
+
+        elif mode == "crop-resize":
+            out_h, out_w = self._parse_hw()
+            img_idx, box_idx = self._crop_resize_layout(in_spec)
+            img_spec, box_spec = in_spec[img_idx], in_spec[box_idx]
+            img_rank4 = img_spec.rank == 4
+            ih, iw = (
+                img_spec.shape[1:3] if img_rank4 else img_spec.shape[0:2]
+            )
+            bcols = box_spec.shape[-1]
+            box_is_int = not box_spec.dtype.is_float
+            np_dtype = img_spec.dtype.np_dtype
+            from nnstreamer_tpu.ops.image import crop_regions
+
+            def fn(tensors):
+                img = tensors[img_idx]
+                if img_rank4:
+                    img = img[0]
+                b = jnp.asarray(tensors[box_idx]).astype(jnp.float32)
+                if bcols == 4 and box_is_int:
+                    # tensor_crop pixel regions (x, y, w, h)
+                    xyxy = jnp.concatenate(
+                        [b[:, :2], b[:, :2] + b[:, 2:4]], axis=-1
+                    )
+                    valid = (b[:, 2] > 0) & (b[:, 3] > 0)
+                elif bcols == 4:
+                    xyxy = b  # pixel x1,y1,x2,y2 — all rows live
+                    valid = None
+                elif bcols == 6:
+                    # decoded detections (normalized; score col 5)
+                    xyxy = b[:, :4] * jnp.asarray(
+                        [iw, ih, iw, ih], jnp.float32
+                    )
+                    valid = b[:, 5] > 0
+                else:
+                    # OV rows (image_id, label, conf, x1, y1, x2, y2)
+                    xyxy = b[:, 3:7] * jnp.asarray(
+                        [iw, ih, iw, ih], jnp.float32
+                    )
+                    valid = b[:, 2] > 0
+                # zeroed invalid rows + integer round/clip: the shared
+                # tensor_crop conventions (ops/image.crop_regions)
+                return (crop_regions(
+                    jnp.asarray(img), xyxy, out_h, out_w,
+                    valid=valid, out_dtype=np_dtype,
+                ),)
 
         elif mode == "stand":
             smode, per_channel, out_type = self._parse_stand()

@@ -112,6 +112,27 @@ def detections_to_regions(det, frame_w: int, frame_h: int, threshold: float = 0.
     return jnp.where(keep[:, None], out, 0.0).astype(jnp.int32)
 
 
+def apply_detect_regions_with_image(
+    det_params: Dict,
+    image,
+    frame_w: int,
+    frame_h: int,
+    max_faces: int = MAX_FACES,
+    threshold: float = 0.5,
+    compute_dtype=jnp.float32,
+):
+    """Detector head + image passthrough: (image, regions [max,4] int32).
+
+    The 2-tensor output that lets the element cascade fuse end to end
+    (docs/on-device-ops.md): a downstream ``tensor_transform
+    mode=crop-resize`` consumes (image, regions) as ONE traceable op, so
+    detect→crop→landmark runs as adjacent device segments with the PR-8
+    resident handoff — no tee, no tensor_crop Routing node, no host hop.
+    The image rides through untouched (same array, no copy on device)."""
+    det = apply_detect(det_params, image, max_faces, compute_dtype)
+    return image, detections_to_regions(det, frame_w, frame_h, threshold)
+
+
 def apply_composite(
     det_params: Dict,
     lmk_params: Dict,

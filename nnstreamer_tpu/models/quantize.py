@@ -147,6 +147,83 @@ def quantize_mobilenet(folded: Dict, act_scales) -> Dict:
     return q
 
 
+# -- weight-only int8 for the conv family (fused dequant epilogue) ---------
+
+def quantize_mobilenet_weights(folded: Dict) -> Dict:
+    """Folded fp32 tree → weight-only int8 serving tree: the 1×1 conv
+    kernels stored int8 with per-output-channel scales, NO activation
+    quantization (so no calibration pass). Served by
+    :func:`apply_int8w`: the dequant (w8·scale) runs as a fused epilogue
+    at the matmul operand inside the XLA segment — int8 weights are the
+    HBM-resident form (¼ the weight traffic of f32), float never leaves
+    the device, and the per-activation round/clip/cast of
+    :func:`apply_int8` disappears. This is the configuration that makes
+    int8 *win* on the microbatch cell instead of trailing fp
+    (ROADMAP item 4; docs/on-device-ops.md)."""
+    q: Dict = {"stem": folded["stem"], "classifier": folded["classifier"]}
+    blocks = []
+    for blk in folded["blocks"]:
+        qb: Dict = {"dw": blk["dw"]}
+        for part in ("expand", "project"):
+            if part in blk:
+                w8, sw = _quantize_w(blk[part]["w"])
+                qb[part] = {"w8": w8, "wscale": sw, "b": blk[part]["b"]}
+        blocks.append(qb)
+    q["blocks"] = blocks
+    w8, sw = _quantize_w(folded["head"]["w"])
+    q["head"] = {"w8": w8, "wscale": sw, "b": folded["head"]["b"]}
+    return q
+
+
+def dequantize_w(w8, wscale):
+    """Host/jnp reference of the fused dequant epilogue: int8 [I, O] ×
+    per-channel scale [O] → fp32 [1, 1, I, O] conv kernel. The parity
+    test pins apply_int8w against a float forward over these."""
+    return (w8.astype(jnp.float32) * wscale)[None, None]
+
+
+def _wo_conv1x1(x, qc: Dict):
+    """1×1 conv over int8 weights: dequantize at the operand read —
+    XLA fuses the elementwise ``w8·scale`` into the dot's prologue, so
+    the weights stream from HBM as int8 and widen on-chip."""
+    w = (qc["w8"].astype(jnp.float32) * qc["wscale"]).astype(x.dtype)
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ()))
+    ) + qc["b"].astype(x.dtype)
+
+
+def apply_int8w(qparams: Dict, x, compute_dtype=jnp.float32):
+    """uint8 NHWC batch → logits [N, classes]; weight-only int8 with
+    the fused on-device dequant epilogue (quantize_mobilenet_weights).
+    Float structure identical to the fp forward — the parity bar is
+    quantization error only, not path divergence."""
+    if x.dtype == jnp.uint8:
+        x = normalize_uint8(x, compute_dtype)
+    else:
+        x = x.astype(compute_dtype)
+
+    def w(a):
+        return a.astype(compute_dtype)
+
+    y = nn.relu6(
+        nn.conv2d(x, w(qparams["stem"]["w"]), stride=2) + w(qparams["stem"]["b"])
+    )
+    for blk, stride in zip(qparams["blocks"], _block_strides()):
+        r = y
+        if "expand" in blk:
+            y = nn.relu6(_wo_conv1x1(y, blk["expand"]))
+        y = nn.relu6(
+            nn.conv2d(y, w(blk["dw"]["w"]), stride=stride, groups=y.shape[-1])
+            + w(blk["dw"]["b"])
+        )
+        y = _wo_conv1x1(y, blk["project"])
+        if stride == 1 and y.shape[-1] == r.shape[-1]:
+            y = y + r
+    y = nn.relu6(_wo_conv1x1(y, qparams["head"]))
+    y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
+    return nn.dense(y, qparams["classifier"]).astype(jnp.float32)
+
+
 # -- weight-only int8 for the transformer family --------------------------
 
 _LM_QUANT_KEYS = ("wqkv", "wo", "w_gate", "w_up", "w_down")

@@ -92,6 +92,26 @@ def _mobilenet_v2(**options) -> ZooModel:
     )
     params = _load_params_overlay(params, options)
 
+    if options.get("quantize") == "int8w":
+        # weight-only int8 with the fused on-device dequant epilogue
+        # (models/quantize.py apply_int8w): int8 weights + per-channel
+        # scales device-resident, dequantized at the matmul operand
+        # inside the segment; no calibration pass, no per-activation
+        # quant math — the winning int8 configuration
+        # (docs/on-device-ops.md)
+        from nnstreamer_tpu.models import quantize as qz
+
+        qparams = qz.quantize_mobilenet_weights(qz.fold_mobilenet(params))
+
+        def qw_apply(p, image):
+            return qz.apply_int8w(p, image, compute_dtype=compute_dtype)
+
+        def qw_fn(image):
+            return qw_apply(qparams, image)
+
+        spec = _image_spec(batch, size, options.get("input_dtype", "uint8"))
+        return ZooModel("mobilenet_v2", qw_fn, spec, qparams, qw_apply)
+
     if options.get("quantize") == "int8":
         # the reference's *_quant.tflite slot, redesigned for the MXU's
         # s8×s8→s32 path (models/quantize.py): fold BN, calibrate
@@ -316,7 +336,10 @@ def _face_detect(**options) -> ZooModel:
     """Face detector. Default output: [max_faces,7] OV detection rows
     (decoder mode=ov-face-detection). ``output=regions`` emits int32
     [max_faces,4] pixel (x,y,w,h) for tensor_crop, scaled to
-    ``frame_size=W:H`` (defaults to the model input size)."""
+    ``frame_size=W:H`` (defaults to the model input size).
+    ``output=regions+image`` emits (image, regions) so a downstream
+    crop-resize transform fuses the whole cascade on device
+    (docs/on-device-ops.md)."""
     from nnstreamer_tpu.models import face_pipeline as fp
 
     seed = int(options.get("seed", 0))
@@ -331,6 +354,11 @@ def _face_detect(**options) -> ZooModel:
     )
 
     def apply_fn(p, image):
+        if out_mode in ("regions+image", "regions_image"):
+            return fp.apply_detect_regions_with_image(
+                p, image, fw, fh, max_faces=max_faces,
+                threshold=threshold, compute_dtype=dtype,
+            )
         det = fp.apply_detect(p, image, max_faces=max_faces, compute_dtype=dtype)
         if out_mode == "regions":
             return fp.detections_to_regions(det, fw, fh, threshold)

@@ -136,6 +136,11 @@ METRIC_CATALOG: Dict[str, str] = {
         "segments is the resident-handoff invariant (counter; "
         "docs/streaming.md)"
     ),
+    "nns_fused_postproc_total": (
+        "frames whose pre/post-processing (decode, resize/crop, "
+        "normalize) ran fused inside a device segment instead of as a "
+        "host node, per element (counter; docs/on-device-ops.md)"
+    ),
 }
 
 # default ladder: quarter-octave buckets from 1 µs up past 100 s —

@@ -74,6 +74,10 @@ def _notes(row: dict) -> str:
     if row.get("cb_opens"):
         state = "OPEN" if row.get("cb_open") else "closed"
         notes.append(f"cb={state}({row['cb_opens']})")
+    if row.get("fused_postproc"):
+        # pre/post-processing ops fused into this device segment
+        # (docs/on-device-ops.md)
+        notes.append("fused-post")
     san = {k: v for k, v in row.items() if k.startswith("san_") and v}
     for k, v in sorted(san.items()):
         notes.append(f"{k}={v}")

@@ -81,14 +81,29 @@ def nms(
     scores: jax.Array,
     iou_threshold: float,
     max_out: int,
+    impl: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy class-agnostic NMS with static shapes.
 
     Returns (keep_idx[max_out] int32, keep_score[max_out]); empty slots have
     score 0 and index -1. Implemented as a lax.fori_loop over ranked
     candidates with a masked IoU matrix — equivalent semantics to the
-    reference's sort + suppress loop, but compiled.
+    reference's sort + suppress loop, but compiled. ``impl="auto"``
+    swaps in the Pallas suppression kernel (ops/pallas/nms.py — no N×N
+    IoU matrix in HBM) on a real TPU backend; both implementations are
+    bit-identical (tests/test_ops_device.py).
     """
+    if impl not in ("auto", "jnp", "pallas"):
+        raise ValueError(f"nms impl {impl!r} not auto/jnp/pallas")
+    if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
+        from nnstreamer_tpu.ops.pallas.nms import nms as pallas_nms
+
+        # explicit impl=pallas off-TPU runs the interpreter (parity
+        # tests); auto never picks it there
+        return pallas_nms(
+            boxes, scores, iou_threshold, max_out,
+            interpret=jax.default_backend() != "tpu",
+        )
     n = boxes.shape[0]
     k = min(max_out, n)
     order = jnp.argsort(-scores)

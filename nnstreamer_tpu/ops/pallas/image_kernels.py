@@ -1,0 +1,189 @@
+"""Tiled bilinear crop/resize/normalize as a Pallas TPU kernel.
+
+The pre-processing half of the "beyond matmul" direction (PAPERS.md:
+Pushing Tensor Accelerators Beyond MatMul; GPTPU): bilinear resampling is
+two small matrix contractions — ``out = Wy · img · Wxᵀ`` per channel,
+where ``Wy [out_h, H]`` / ``Wx [out_w, W]`` are interpolation-weight
+matrices with two non-zeros per row — so the crop runs on the MXU instead
+of the gather/scatter path XLA lowers ``image[y0i][:, x0i]`` to. The grid
+walks the N crop boxes; each step builds its weight matrices from the
+box's corners (SMEM scalars) with ``broadcasted_iota`` and streams the
+whole source image from VMEM through two ``dot_general`` calls, with an
+optional fused ``*scale + offset`` normalization epilogue so a
+uint8→float input transform costs zero extra HBM round trips.
+
+Numerics match :func:`nnstreamer_tpu.ops.image.crop_and_resize` (the jnp
+reference): sample centers at ``box_lo + extent·(i+0.5)/out - 0.5``,
+edge clamping via clipping the sample coordinate — a clipped coordinate
+puts weight 1 on the edge row, exactly what the reference's index
+clamping computes. Parity is pinned by tests/test_ops_device.py in
+interpret mode (the CPU fallback, ops/pallas/_compat.py discipline).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
+
+def _weight_matrix(lo, hi, out_n: int, in_n: int):
+    """[out_n, in_n] bilinear interpolation weights for sampling the
+    interval [lo, hi) (pixel coords) at out_n output-pixel centers.
+    Built fully 2-D (TPU iota constraint)."""
+    o = jax.lax.broadcasted_iota(jnp.float32, (out_n, in_n), 0)
+    i = jax.lax.broadcasted_iota(jnp.float32, (out_n, in_n), 1)
+    ys = lo + (hi - lo) * (o + 0.5) / float(out_n) - 0.5
+    ys = jnp.clip(ys, 0.0, float(in_n - 1))
+    return jnp.maximum(0.0, 1.0 - jnp.abs(ys - i))
+
+
+def _crop_kernel(
+    boxes_ref, img_ref, out_ref, *,
+    h: int, w: int, c: int, out_h: int, out_w: int,
+    scale: Optional[float], offset: Optional[float],
+):
+    x1 = boxes_ref[0, 0]
+    y1 = boxes_ref[0, 1]
+    x2 = boxes_ref[0, 2]
+    y2 = boxes_ref[0, 3]
+    wy = _weight_matrix(y1, y2, out_h, h)          # [out_h, h]
+    wx = _weight_matrix(x1, x2, out_w, w)          # [out_w, w]
+    # the image block is [h, w, c] (crop grid: whole image every step)
+    # or [1, h, w, c] (resize grid: one batch element per step); the
+    # reshape collapses either into the [h, w·c] contraction operand
+    img = img_ref[:].astype(jnp.float32).reshape(h, w * c)
+    # y-interpolation: one MXU contraction over the source rows
+    tmp = jax.lax.dot_general(
+        wy, img, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(out_h, w, c)
+    # x-interpolation: contract the W axis → [out_h, c, out_w]
+    out = jax.lax.dot_general(
+        tmp, wx, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).transpose(0, 2, 1)
+    if scale is not None:
+        out = out * scale
+    if offset is not None:
+        out = out + offset
+    if jnp.issubdtype(out_ref.dtype, jnp.integer):
+        info = jnp.iinfo(out_ref.dtype)
+        out = jnp.clip(jnp.round(out), info.min, info.max)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "out_h", "out_w", "scale", "offset", "out_dtype", "interpret"
+    ),
+)
+def crop_and_resize(
+    image,
+    boxes,
+    out_h: int,
+    out_w: int,
+    scale: Optional[float] = None,
+    offset: Optional[float] = None,
+    out_dtype=None,
+    interpret: bool = False,
+):
+    """Pallas crop+resize: image [H, W, C], boxes [N, 4] pixel
+    (x1, y1, x2, y2) → [N, out_h, out_w, C].
+
+    ``scale``/``offset`` fuse a normalization epilogue (out·scale +
+    offset) into the kernel — the uint8→float preprocessing transform at
+    zero extra memory traffic. ``out_dtype`` defaults to the image dtype
+    (float outputs when a normalize epilogue is active); integer outputs
+    round-and-clip like the device-crop element."""
+    h, w, c = image.shape
+    if out_dtype is None:
+        out_dtype = (
+            jnp.float32 if (scale is not None or offset is not None)
+            else image.dtype
+        )
+    return _launch_crop(
+        image, boxes.astype(jnp.float32),
+        # crop grid: every step reads the whole (shared) image
+        pl.BlockSpec((h, w, c), lambda i: (0, 0, 0)),
+        out_h, out_w, scale, offset, out_dtype, interpret,
+    )
+
+
+def _launch_crop(
+    img, boxes, img_spec, out_h, out_w, scale, offset, out_dtype,
+    interpret,
+):
+    """One home for the crop-kernel launch (grid over boxes, per-box
+    SMEM-scalar spec, interpret-vs-Mosaic compiler params): the crop
+    and resize entry points differ only in how the image block is
+    indexed per grid step."""
+    n = boxes.shape[0]
+    h, w, c = img.shape[-3:]
+    kernel = functools.partial(
+        _crop_kernel,
+        h=h, w=w, c=c, out_h=out_h, out_w=out_w,
+        scale=scale, offset=offset,
+    )
+    if interpret:
+        kw = {}
+    else:  # pragma: no cover - real-TPU path (CPU tests interpret)
+        from jax.experimental.pallas import tpu as pltpu
+
+        kw = {
+            "compiler_params": _compiler_params(
+                pltpu, dimension_semantics=("parallel",)
+            ),
+        }
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, out_h, out_w, c), out_dtype),
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, 4), lambda i: (i, 0)), img_spec],
+        out_specs=pl.BlockSpec(
+            (1, out_h, out_w, c), lambda i: (i, 0, 0, 0)
+        ),
+        interpret=interpret,
+        **kw,
+    )(boxes, img)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("out_h", "out_w", "scale", "offset", "interpret"),
+)
+def resize_bilinear(
+    image,
+    out_h: int,
+    out_w: int,
+    scale: Optional[float] = None,
+    offset: Optional[float] = None,
+    interpret: bool = False,
+):
+    """Whole-image bilinear resize (+ optional normalize epilogue):
+    [N, H, W, C] or [H, W, C] → same rank with H, W replaced. A resize
+    IS a crop of the full image; the batch rides the grid axis (one
+    full-image box per batch element, image block indexed per step)."""
+    squeeze = image.ndim == 3
+    img = image[None] if squeeze else image
+    n, h, w, c = img.shape
+    out_dtype = (
+        jnp.float32 if (scale is not None or offset is not None)
+        else img.dtype
+    )
+    boxes = jnp.broadcast_to(
+        jnp.asarray([[0.0, 0.0, float(w), float(h)]], jnp.float32), (n, 4)
+    )
+    out = _launch_crop(
+        img, boxes,
+        # resize grid: one batch element per step
+        pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+        out_h, out_w, scale, offset, out_dtype, interpret,
+    )
+    return out[0] if squeeze else out

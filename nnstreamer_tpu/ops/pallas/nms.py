@@ -1,0 +1,135 @@
+"""Greedy NMS suppression as a Pallas TPU kernel.
+
+The sequential-suppression half of detection post-processing is the part
+XLA handles poorly: the jnp reference (ops/detection.nms) materializes
+the full N×N IoU matrix in HBM and walks it with a ``fori_loop``, so the
+O(N²) pairwise work is paid in memory traffic before the loop even
+starts. Here the kernel keeps the candidate list resident in VMEM as
+four coordinate *rows* ([1, N] each — the block-masked layout) and, per
+greedy step, computes ONE masked IoU row on the VPU against the live
+mask, suppressing in place: no N×N buffer, no HBM round trips between
+steps. The argsort ranking and the final top-k packing stay outside in
+plain jnp (they're single XLA ops); only the data-dependent suppression
+recurrence lives in the kernel.
+
+Interpret-mode CPU fallback per ops/pallas/_compat.py discipline; bit
+parity with ops/detection.nms is pinned by tests/test_ops_device.py
+(identical ranking, identical suppression predicate, identical packing).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
+
+def _nms_kernel(coords_ref, scores_ref, alive_ref, *, n: int, n_pad: int,
+                thr: float):
+    """coords [4, n_pad] rows (x1, y1, x2, y2) of score-ranked boxes,
+    scores [1, n_pad] → alive [1, n_pad] float32 0/1 mask."""
+    x1 = coords_ref[0:1, :]
+    y1 = coords_ref[1:2, :]
+    x2 = coords_ref[2:3, :]
+    y2 = coords_ref[3:4, :]
+    area = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)  # [1, n_pad]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)
+    alive_ref[:] = (scores_ref[:] > 0.0).astype(jnp.float32)
+
+    def step(i, _):
+        # the i-th ranked candidate: scalar corners via a [1,1] slice
+        bx1 = coords_ref[0:1, pl.ds(i, 1)]
+        by1 = coords_ref[1:2, pl.ds(i, 1)]
+        bx2 = coords_ref[2:3, pl.ds(i, 1)]
+        by2 = coords_ref[3:4, pl.ds(i, 1)]
+        barea = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+        iw = jnp.maximum(
+            jnp.minimum(x2, bx2) - jnp.maximum(x1, bx1), 0.0
+        )
+        ih = jnp.maximum(
+            jnp.minimum(y2, by2) - jnp.maximum(y1, by1), 0.0
+        )
+        inter = iw * ih
+        union = area + barea - inter
+        iou = jnp.where(union > 0.0, inter / union, 0.0)
+        keep_i = alive_ref[0:1, pl.ds(i, 1)]  # [1,1]: still live?
+        alive = alive_ref[:]
+        suppress = (
+            (iou > thr)
+            & (col > i)
+            & (keep_i > 0.0)
+        )
+        alive_ref[:] = jnp.where(suppress, 0.0, alive)
+        return 0
+
+    jax.lax.fori_loop(0, n, step, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("iou_threshold", "max_out", "interpret")
+)
+def nms(
+    boxes: jax.Array,
+    scores: jax.Array,
+    iou_threshold: float,
+    max_out: int,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in for ops/detection.nms: boxes [N,4] x1,y1,x2,y2 + scores
+    [N] → (keep_idx [max_out] int32, keep_score [max_out]); empty slots
+    score 0 / index -1. Ranking and packing are the reference's exact
+    jnp expressions, so the two implementations are bit-comparable."""
+    n = boxes.shape[0]
+    k = min(max_out, n)
+    order = jnp.argsort(-scores)
+    sboxes = boxes.astype(jnp.float32)[order]
+    sscores = scores.astype(jnp.float32)[order]
+    # lane-pad the candidate list; padded columns carry score 0 (never
+    # alive, never selected) and zero-area boxes (suppress nothing)
+    n_pad = max(128, -(-n // 128) * 128)
+    coords = jnp.zeros((4, n_pad), jnp.float32)
+    coords = coords.at[:, :n].set(sboxes.T)
+    srow = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(sscores)
+    kernel = functools.partial(
+        _nms_kernel, n=n, n_pad=n_pad, thr=float(iou_threshold)
+    )
+    if interpret:
+        kw = {}
+    else:  # pragma: no cover - real-TPU path (CPU tests interpret)
+        from jax.experimental.pallas import tpu as pltpu
+
+        kw = {
+            "compiler_params": _compiler_params(
+                pltpu, dimension_semantics=("arbitrary",)
+            ),
+        }
+    alive_row = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((4, n_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+        interpret=interpret,
+        **kw,
+    )(coords, srow)
+    alive = alive_row[0, :n] > 0.0
+    # packing identical to the jnp reference (bit-comparable selection)
+    kept_scores = jnp.where(alive, sscores, 0.0)
+    top = jnp.argsort(-kept_scores)[:k]
+    sel_scores = kept_scores[top]
+    sel_idx = jnp.where(sel_scores > 0, order[top], -1)
+    if k < max_out:
+        sel_idx = jnp.pad(sel_idx, (0, max_out - k), constant_values=-1)
+        sel_scores = jnp.pad(sel_scores, (0, max_out - k))
+    # the jnp reference preserves the caller's score dtype (it never
+    # casts); match it so impl="auto" traces the same output spec on
+    # every backend
+    return sel_idx.astype(jnp.int32), sel_scores.astype(scores.dtype)

@@ -393,6 +393,11 @@ class Node:
         self._frames_ctr = None      # frames counter
         self._depth_hists: List = []  # sampled queue depth per pad
         self._batch_hist = None      # batch-size histogram (lazy)
+        # nns_fused_postproc_total handle: armed by _build only for
+        # fused segments carrying pre/post-processing ops
+        # (docs/on-device-ops.md), so every other node pays one None
+        # check per stat
+        self._postproc_ctr = None
 
     def add_in_queue(self, size: int) -> int:
         self.in_queues.append(self.ex.make_chan(size, self, len(self.in_queues)))
@@ -511,6 +516,8 @@ class Node:
         if lat is not None:
             lat.observe((now - t0) * 1e6)
             self._frames_ctr.inc()
+            if self._postproc_ctr is not None:
+                self._postproc_ctr.inc()
             if not (self.frames_processed & 15):
                 # sampled queue-depth: every 16th frame, one len() read
                 # per pad (backpressure visibility without per-put cost)
@@ -744,6 +751,8 @@ class Node:
             # the unit the tail percentiles describe), n frames counted
             lat.observe((now - t0) * 1e6)
             self._frames_ctr.inc(n)
+            if self._postproc_ctr is not None:
+                self._postproc_ctr.inc(n)
             if self._batch_hist is None:
                 self._batch_hist = self.ex.metrics.histogram(
                     "nns_batch_size", lo=1.0, growth=2.0 ** 0.5,
@@ -1652,6 +1661,13 @@ class Executor:
                     )
                     for i in range(len(n.in_queues))
                 ]
+                if getattr(getattr(n, "seg", None), "postproc_ops", 0):
+                    # fused pre/post-processing frames
+                    # (docs/on-device-ops.md): one counter per segment
+                    # that carries decode/image/normalize ops
+                    n._postproc_ctr = self.metrics.counter(
+                        "nns_fused_postproc_total", element=n.name
+                    )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -2073,6 +2089,12 @@ class Executor:
                 got = sstats()
                 if got:
                     s.update({f"serving_{k}": v for k, v in got.items()})
+            # fused pre/post-processing (docs/on-device-ops.md): the
+            # number of decode/image/normalize ops riding this segment
+            # (nns-top renders the `fused-post` note from it)
+            pp = getattr(getattr(n, "seg", None), "postproc_ops", 0)
+            if pp:
+                s["fused_postproc"] = pp
             # micro-batching observability (fused segments and batchable
             # host filters): avg batch size, pad waste, straggler wait
             bstats = getattr(

@@ -307,12 +307,31 @@ class Pipeline:
         # executor-level [executor] config default) and share the stats
         # object with the ops so tensor_filter's read-only avg-batch-size/
         # pad-waste-pct/batch-wait-ms properties report their segment
+        from nnstreamer_tpu.elements.converter import TensorConverter
+        from nnstreamer_tpu.elements.decoder import TensorDecoder
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        def _postproc_op(op: TensorOp) -> bool:
+            """Member ops that are fused pre/post-processing rather than
+            model invokes (docs/on-device-ops.md): a device-path
+            decoder, an image-op transform, or a normalizing converter.
+            Counted per segment so the executor can emit
+            nns_fused_postproc_total and nns-top can flag the node."""
+            if isinstance(op, TensorDecoder):
+                return True  # only traceable decoders reach a segment
+            if isinstance(op, TensorTransform):
+                return op.mode in ("resize", "crop-resize")
+            if isinstance(op, TensorConverter):
+                return op.input_norm is not None
+            return False
+
         for seg in segments:
             seg.batch_config = resolve_batch_config(seg.ops)
             seg.fault_policy = resolve_fault_policy(seg.ops)
             seg.device_policy = resolve_device_policy(seg.ops)
             seg.ring_depth = resolve_ring_depth(seg.ops)
             seg.donate = donation_enabled()
+            seg.postproc_ops = sum(1 for op in seg.ops if _postproc_op(op))
             for op in seg.ops:
                 op.batch_stats = seg.batch_stats
         return ExecPlan(self, segments, seg_of)
@@ -457,6 +476,10 @@ class FusedSegment:
         # reuses them for outputs. Both resolved at plan time.
         self.ring_depth: Optional[int] = None
         self.donate = False
+        # fused pre/post-processing member count (docs/on-device-ops.md):
+        # resolved at plan time; >0 arms the nns_fused_postproc_total
+        # emitter and the nns-top `fused-post` note
+        self.postproc_ops = 0
         # identity short-circuit: a segment of only-identity ops (the
         # passthrough backend) serves frames without ANY device program
         # — per-frame XLA dispatch is pure overhead there. Resolved on

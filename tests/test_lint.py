@@ -516,7 +516,7 @@ def _embedded_pipeline_strings():
     for doc in ("elements.md", "linting.md", "batching.md",
                 "fault-tolerance.md", "sanitizer.md", "observability.md",
                 "edge-serving.md", "resilience.md", "streaming.md",
-                "serving-plane.md", "llm-serving.md"):
+                "serving-plane.md", "llm-serving.md", "on-device-ops.md"):
         with open(os.path.join(REPO, "docs", doc)) as f:
             for cand in _candidate_pipelines_from_text(f.read()):
                 found.append((doc, cand))

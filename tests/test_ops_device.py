@@ -1,0 +1,641 @@
+"""On-device pre/post-processing (docs/on-device-ops.md): Pallas kernel
+parity, device-path decoders, fused composite plumbing with the
+zero-host-transfer pin, and the int8 fused-dequant epilogue.
+
+Pallas kernels run in interpret mode on the CPU mesh (the
+ops/pallas/_compat.py discipline) against their jnp references; the
+pipeline tests mirror PR-8's adjacent-segments test: lightweight jax
+stages in the exact detect→crop→landmark shape, with the real face-model
+cascade (heavier compiles) marked slow.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops import detection as det
+from nnstreamer_tpu.ops.image import crop_and_resize as jnp_crop
+from nnstreamer_tpu.ops.image import resize_bilinear as jnp_resize
+from nnstreamer_tpu.ops.pallas.image_kernels import (
+    crop_and_resize as pallas_crop,
+    resize_bilinear as pallas_resize,
+)
+from nnstreamer_tpu.ops.pallas.nms import nms as pallas_nms
+from nnstreamer_tpu.pipeline import transfer
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+from nnstreamer_tpu.tensors.frame import Frame
+from nnstreamer_tpu.tensors.spec import DType, TensorSpec, TensorsSpec
+
+
+# ------------------------------------------------- Pallas image kernels
+class TestPallasImageParity:
+    def test_crop_matches_jnp_reference(self):
+        rng = np.random.default_rng(0)
+        img = jnp.asarray(rng.standard_normal((16, 12, 3)), jnp.float32)
+        boxes = jnp.asarray(
+            [
+                [0.0, 0.0, 12.0, 16.0],     # full image
+                [2.5, 3.5, 9.5, 12.5],      # subpixel interior
+                [-4.0, -2.0, 30.0, 40.0],   # clamps to edges
+                [5.0, 5.0, 5.0, 5.0],       # degenerate box
+            ],
+            jnp.float32,
+        )
+        want = np.asarray(jnp_crop(img, boxes, 8, 6, impl="jnp"))
+        got = np.asarray(pallas_crop(img, boxes, 8, 6, interpret=True))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_crop_normalize_epilogue(self):
+        """The fused ``·scale + offset`` epilogue equals normalizing the
+        jnp reference's output — one kernel, zero extra passes."""
+        rng = np.random.default_rng(1)
+        img = jnp.asarray(rng.integers(0, 255, (16, 12, 3), np.uint8))
+        boxes = jnp.asarray([[1.0, 2.0, 11.0, 14.0]], jnp.float32)
+        got = np.asarray(pallas_crop(
+            img, boxes, 8, 6, scale=1 / 127.5, offset=-1.0, interpret=True
+        ))
+        want = (
+            np.asarray(jnp_crop(
+                img.astype(jnp.float32), boxes, 8, 6, impl="jnp"
+            )) / 127.5 - 1.0
+        )
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_integer_output_rounds_and_clips(self):
+        rng = np.random.default_rng(2)
+        img = jnp.asarray(rng.integers(0, 255, (10, 10, 1), np.uint8))
+        boxes = jnp.asarray([[0.25, 0.25, 9.75, 9.75]], jnp.float32)
+        got = np.asarray(pallas_crop(
+            img, boxes, 5, 5, out_dtype=jnp.uint8, interpret=True
+        ))
+        assert got.dtype == np.uint8
+        ref = np.asarray(jnp_crop(
+            img.astype(jnp.float32), boxes, 5, 5, impl="jnp"
+        ))
+        want = np.clip(np.round(ref), 0, 255).astype(np.uint8)
+        # float-associativity differences between the matmul and gather
+        # forms can flip a sample sitting exactly on a .5 boundary
+        assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+    def test_resize_matches_jnp(self):
+        rng = np.random.default_rng(3)
+        batch = jnp.asarray(
+            rng.standard_normal((2, 9, 7, 2)), jnp.float32
+        )
+        want = np.asarray(jnp_resize(batch, 5, 4, impl="jnp"))
+        got = np.asarray(pallas_resize(batch, 5, 4, interpret=True))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestPallasNms:
+    @pytest.mark.parametrize("n", [40, 200])  # under/over one lane pad
+    def test_bit_parity_with_jnp(self, n):
+        rng = np.random.default_rng(n)
+        boxes = rng.random((n, 4)).astype(np.float32)
+        boxes[:, 2:] = boxes[:, :2] + rng.random((n, 2)).astype(np.float32)
+        scores = rng.random(n).astype(np.float32)
+        scores[scores < 0.3] = 0.0
+        ji, js = det.nms(
+            jnp.asarray(boxes), jnp.asarray(scores), 0.5, 20, impl="jnp"
+        )
+        pi, ps = pallas_nms(
+            jnp.asarray(boxes), jnp.asarray(scores), 0.5, 20,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(ji), np.asarray(pi))
+        np.testing.assert_array_equal(np.asarray(js), np.asarray(ps))
+
+    def test_detection_dispatch_impl_pallas(self):
+        """ops/detection.nms impl=pallas routes through the kernel (the
+        interpreter off-TPU) and stays bit-identical. Same static
+        params as the parity case above, so the jitted kernel entry is
+        reused rather than recompiled."""
+        rng = np.random.default_rng(40)
+        boxes = rng.random((40, 4)).astype(np.float32)
+        boxes[:, 2:] += boxes[:, :2]
+        scores = rng.random(40).astype(np.float32)
+        a = det.nms(jnp.asarray(boxes), jnp.asarray(scores), 0.5, 20)
+        b = det.nms(
+            jnp.asarray(boxes), jnp.asarray(scores), 0.5, 20,
+            impl="pallas",
+        )
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+# --------------------------------------------------- device-path decoders
+def _decoder(mode, postproc="auto", **props):
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+    return TensorDecoder(mode=mode, postproc=postproc, **props)
+
+
+class TestDeviceDecoders:
+    def test_yolov5_bitwise_parity_with_host_path(self):
+        spec = TensorsSpec.of(TensorSpec((25, 10), DType.FLOAT32))
+        pred = np.random.default_rng(0).random((25, 10)).astype(np.float32)
+        dev = _decoder("bounding_boxes", "device", option1="yolov5")
+        (out_spec,) = dev.fix_negotiation([spec])
+        assert out_spec[0].shape == (100, 6)
+        assert dev.is_traceable()
+        got = np.asarray(dev.make_fn()((jnp.asarray(pred),))[0])
+        host = _decoder("bounding_boxes", option1="yolov5")
+        host.fix_negotiation([spec])
+        want = host._sub._detections(Frame((pred,)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_mobilenet_ssd_bitwise_parity(self, tmp_path):
+        n = 16
+        rng = np.random.default_rng(1)
+        priors = tmp_path / "priors.txt"
+        rows = rng.random((4, n)).astype(np.float32) * 0.5 + 0.25
+        priors.write_text(
+            "\n".join(" ".join(f"{v:.6f}" for v in r) for r in rows)
+        )
+        spec = TensorsSpec(
+            (TensorSpec((n, 4), DType.FLOAT32),
+             TensorSpec((n, 5), DType.FLOAT32))
+        )
+        loc = rng.standard_normal((n, 4)).astype(np.float32)
+        sco = rng.standard_normal((n, 5)).astype(np.float32)
+        dev = _decoder("bounding_boxes", "device",
+                       option1="mobilenet-ssd", option3=str(priors))
+        dev.fix_negotiation([spec])
+        got = np.asarray(
+            dev.make_fn()((jnp.asarray(loc), jnp.asarray(sco)))[0]
+        )
+        host = _decoder("bounding_boxes", option1="mobilenet-ssd",
+                        option3=str(priors))
+        host.fix_negotiation([spec])
+        want = host._sub._detections(Frame((loc, sco)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_image_segment_matches_host_label_map(self):
+        spec = TensorsSpec.of(TensorSpec((1, 6, 5, 21), DType.FLOAT32))
+        scores = np.random.default_rng(2).random((1, 6, 5, 21)).astype(
+            np.float32
+        )
+        dev = _decoder("image_segment", "device", option1="tflite-deeplab")
+        (out_spec,) = dev.fix_negotiation([spec])
+        assert out_spec[0].dtype is DType.UINT8
+        got = np.asarray(dev.make_fn()((jnp.asarray(scores),))[0])
+        host = _decoder("image_segment", option1="tflite-deeplab")
+        host.fix_negotiation([spec])
+        decoded = host._sub.decode(Frame((scores,)), host.options)
+        np.testing.assert_array_equal(got, decoded.meta["label_map"])
+
+    def test_pose_matches_host_keypoints_meta(self):
+        spec = TensorsSpec.of(TensorSpec((1, 9, 9, 14), DType.FLOAT32))
+        heat = np.random.default_rng(3).standard_normal(
+            (1, 9, 9, 14)
+        ).astype(np.float32)
+        dev = _decoder("pose_estimation", "device")
+        dev.fix_negotiation([spec])
+        got = np.asarray(dev.make_fn()((jnp.asarray(heat),))[0])
+        host = _decoder("pose_estimation")
+        host.fix_negotiation([spec])
+        decoded = host._sub.decode(Frame((heat,)), host.options)
+        np.testing.assert_allclose(
+            got, decoded.meta["keypoints"], atol=1e-5
+        )
+
+    def test_postproc_host_forces_host_node(self):
+        spec = TensorsSpec.of(TensorSpec((1, 10), DType.FLOAT32))
+        host = _decoder("image_labeling", "host")
+        host.fix_negotiation([spec])
+        assert not host.is_traceable()
+        auto = _decoder("image_labeling")
+        auto.fix_negotiation([spec])
+        assert auto.is_traceable()
+
+    def test_postproc_device_without_device_path_raises(self, tmp_path):
+        from nnstreamer_tpu.elements.base import NegotiationError
+
+        labels = tmp_path / "labels.txt"
+        labels.write_text("a\nb\n")
+        spec = TensorsSpec.of(TensorSpec((1, 2), DType.FLOAT32))
+        dec = _decoder("image_labeling", "device", option1=str(labels))
+        with pytest.raises(NegotiationError, match="no device decode"):
+            dec.fix_negotiation([spec])
+
+    def test_custom_code_postproc_device_raises(self):
+        from nnstreamer_tpu.elements.base import NegotiationError
+        from nnstreamer_tpu.elements.decoder import (
+            register_custom_decoder,
+            unregister_custom_decoder,
+        )
+
+        register_custom_decoder("t_ops_dev", lambda f, o: f)
+        try:
+            dec = _decoder("custom-code", "device", option1="t_ops_dev")
+            with pytest.raises(NegotiationError, match="host callback"):
+                dec.fix_negotiation(
+                    [TensorsSpec.of(TensorSpec((2,), DType.FLOAT32))]
+                )
+        finally:
+            unregister_custom_decoder("t_ops_dev")
+
+
+# ------------------------------------------------ image transform/converter
+class TestImageTransforms:
+    def test_crop_resize_matches_tensor_crop_semantics(self):
+        """int32 (x,y,w,h) regions: zero-size rows zero their crops and
+        uint8 output rounds+clips — the tensor_crop out-size=
+        conventions, now as a 1→1 fusable op."""
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, (1, 16, 12, 3), np.uint8)
+        regions = np.asarray(
+            [[0, 0, 12, 16], [2, 3, 6, 8], [0, 0, 0, 0]], np.int32
+        )
+        t = TensorTransform(mode="crop-resize", option="8:6")
+        (out,) = t.fix_negotiation([TensorsSpec((
+            TensorSpec((1, 16, 12, 3), DType.UINT8),
+            TensorSpec((3, 4), DType.INT32),
+        ))])
+        assert out[0].shape == (3, 8, 6, 3) and out[0].dtype is DType.UINT8
+        crops = np.asarray(
+            t.make_fn()((jnp.asarray(img), jnp.asarray(regions)))[0]
+        )
+        assert (crops[2] == 0).all()
+        b = regions.astype(np.float32)
+        xyxy = np.concatenate([b[:, :2], b[:, :2] + b[:, 2:4]], axis=-1)
+        ref = np.asarray(jnp_crop(
+            jnp.asarray(img[0], jnp.float32), jnp.asarray(xyxy), 8, 6,
+            impl="jnp",
+        )).copy()
+        ref[2] = 0.0
+        np.testing.assert_array_equal(
+            crops, np.clip(np.round(ref), 0, 255).astype(np.uint8)
+        )
+
+    def test_crop_resize_rejects_bad_boxes(self):
+        from nnstreamer_tpu.elements.base import NegotiationError
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        t = TensorTransform(mode="crop-resize", option="8:6")
+        with pytest.raises(NegotiationError, match="boxes"):
+            t.fix_negotiation([TensorsSpec((
+                TensorSpec((1, 16, 12, 3), DType.UINT8),
+                TensorSpec((3, 5), DType.INT32),
+            ))])
+
+    def test_resize_spec_and_rank_guard(self):
+        from nnstreamer_tpu.elements.base import NegotiationError
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        t = TensorTransform(mode="resize", option="4:4")
+        (out,) = t.fix_negotiation(
+            [TensorsSpec.of(TensorSpec((1, 8, 8, 3), DType.UINT8))]
+        )
+        assert out[0].shape == (1, 4, 4, 3)
+        t2 = TensorTransform(mode="resize", option="4:4")
+        with pytest.raises(NegotiationError, match="resize"):
+            t2.fix_negotiation(
+                [TensorsSpec.of(TensorSpec((8, 8), DType.FLOAT32))]
+            )
+
+    def test_converter_input_norm_rejects_non_video(self):
+        from nnstreamer_tpu.elements.base import NegotiationError
+        from nnstreamer_tpu.elements.converter import TensorConverter
+
+        cv = TensorConverter(**{"input-norm": "127.5:127.5"})
+        with pytest.raises(NegotiationError, match="input-norm"):
+            cv.fix_negotiation(
+                [TensorsSpec.of(TensorSpec((4,), DType.FLOAT32))]
+            )
+
+    def test_crop_impl_pallas_dispatch_off_tpu_interprets(self):
+        """Explicit impl=pallas off-TPU routes through the interpreter
+        (same contract as ops/detection.nms) instead of crashing on
+        Mosaic lowering; integer results match the jnp path's
+        round+clip within the .5-boundary tolerance."""
+        rng = np.random.default_rng(9)
+        img = jnp.asarray(rng.integers(0, 255, (10, 8, 2), np.uint8))
+        boxes = jnp.asarray([[1.0, 1.0, 7.0, 9.0]], jnp.float32)
+        a = np.asarray(jnp_crop(img, boxes, 5, 4, impl="jnp"))
+        b = np.asarray(jnp_crop(img, boxes, 5, 4, impl="pallas"))
+        assert a.dtype == b.dtype == np.uint8
+        assert np.abs(a.astype(int) - b.astype(int)).max() <= 1
+
+    def test_converter_input_norm_fuses_float_spec(self):
+        from nnstreamer_tpu.elements.base import MediaSpec
+        from nnstreamer_tpu.elements.converter import TensorConverter
+
+        cv = TensorConverter(**{"input-norm": "127.5:127.5"})
+        (out,) = cv.fix_negotiation(
+            [MediaSpec("video", width=6, height=4, format="RGB")]
+        )
+        assert out[0].dtype is DType.FLOAT32
+        assert cv.is_traceable()
+        img = np.random.default_rng(1).integers(
+            0, 255, (4, 6, 3), np.uint8
+        )
+        got = np.asarray(cv.make_fn()((jnp.asarray(img),))[0])
+        assert got.shape == (1, 4, 6, 3)
+        np.testing.assert_allclose(
+            got[0], (img.astype(np.float32) - 127.5) / 127.5, atol=1e-6
+        )
+
+
+# -------------------------------------------- fused pipeline + transfer pins
+def _detector_script(tmp_path, h=32, w=32):
+    """Tiny detect-shaped jax stage: image → (image, regions) — the
+    2-tensor output the crop-resize transform fuses with."""
+    path = tmp_path / "det.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "_REG = jnp.asarray(np.array([[0, 0, %d, %d], [4, 4, 8, 8],"
+        " [0, 0, 0, 0], [2, 2, 6, 6]], np.int32))\n"
+        "def get_model(options):\n"
+        "    return (lambda img: (img, _REG)), None\n" % (w, h)
+    )
+    return str(path)
+
+
+def _landmark_script(tmp_path):
+    """Tiny landmark-shaped jax stage: crop batch → [N, 8] features."""
+    path = tmp_path / "lmk.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "def get_model(options):\n"
+        "    def fn(crops):\n"
+        "        x = crops.astype(jnp.float32)\n"
+        "        pooled = jnp.mean(x, axis=(1, 2))  # [N, C]\n"
+        "        return jnp.concatenate([pooled, -pooled], axis=-1)\n"
+        "    return fn, None\n"
+    )
+    return str(path)
+
+
+class TestFusedPostprocPipeline:
+    def test_device_decoder_fuses_and_counts(self):
+        """A postproc=device decoder joins the upstream filter's fused
+        segment; the plan counts it as a postproc op, stats() exposes
+        it, and nns_fused_postproc_total counts the frames."""
+        from nnstreamer_tpu import obs as obs_metrics
+        from nnstreamer_tpu.pipeline.executor import FusedNode
+
+        obs_metrics.enable()
+        p = parse_pipeline(
+            "tensorsrc dimensions=16 types=float32 pattern=random "
+            "num-frames=12 ! tensor_filter framework=scaler ! "
+            "tensor_decoder mode=image_labeling postproc=device ! "
+            "tensor_sink name=out"
+        )
+        ex = p.run(timeout=60)
+        fused = [n for n in ex.nodes if isinstance(n, FusedNode)]
+        assert len(fused) == 1
+        assert "tensor_decoder" in fused[0].name  # decoder IS the segment
+        assert fused[0].seg.postproc_ops == 1
+        row = ex.stats()[fused[0].name]
+        assert row["fused_postproc"] == 1
+        total = sum(
+            m["value"] for m in obs_metrics.get().to_dict()["metrics"]
+            if m["name"] == "nns_fused_postproc_total"
+        )
+        assert total >= 12
+        # and the decode math is right: argmax of the scaled row
+        out = [np.asarray(f.tensors[0]) for f in p["out"].frames]
+        assert all(o.dtype == np.uint32 and o.shape == (1,) for o in out)
+
+    def test_packed_fetch_drops_with_device_decode(self):
+        """The satellite pin: with a HOST decoder after a fused filter,
+        the coalesced D2H prefetch carries the decoder's (large)
+        inputs; with the decode fused on device only the small decoded
+        tensor is ever fetched — the per-run D2H byte count collapses."""
+        desc = (
+            "tensorsrc dimensions=4096 types=float32 pattern=random "
+            "num-frames=16 ! tensor_filter framework=scaler ! "
+            "tensor_decoder mode=image_labeling postproc={pp} ! "
+            "tensor_sink name=out"
+        )
+        p1 = parse_pipeline(desc.format(pp="host"))
+        host_d2h = p1.run(timeout=60).transfer_totals()["d2h"]
+        p2 = parse_pipeline(desc.format(pp="device"))
+        dev_d2h = p2.run(timeout=60).transfer_totals()["d2h"]
+        # host mode fetches 16 KiB of logits per frame; device mode
+        # fetches the 4-byte label index (the sink's only read)
+        assert dev_d2h == 16 * 4  # uint32 per frame, nothing else
+        assert host_d2h >= 16 * 4096 * 4
+        # decoded values identical either way
+        a = [np.asarray(f.tensors[0]) for f in p1["out"].frames]
+        b = [np.asarray(f.tensors[0]) for f in p2["out"].frames]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_detect_crop_landmark_zero_host_transfer(self, tmp_path):
+        """The PR-8 adjacent-segments mirror in the composite shape:
+        detect → crop-resize → (queue) → landmark as two fused device
+        segments, device source, discarding sink — ZERO bytes cross the
+        host boundary in either direction."""
+        desc = (
+            "videotestsrc pattern=gradient num-frames=8 device=true "
+            "width=32 height=32 ! tensor_converter ! "
+            f"tensor_filter framework=jax model={_detector_script(tmp_path)} ! "
+            "tensor_transform mode=crop-resize option=8:8 ! queue ! "
+            f"tensor_filter framework=jax model={_landmark_script(tmp_path)} ! "
+            "fakesink"
+        )
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=120)
+        assert not ex.errors
+        totals = ex.transfer_totals()
+        assert totals == {"h2d": 0, "d2h": 0}
+
+    def test_detect_crop_landmark_sink_fetches_only_landmarks(
+        self, tmp_path
+    ):
+        """With a reading sink, the coalesced fetch packs ONLY the
+        post-decode tensor: D2H is exactly n_frames × the landmark
+        tensor's bytes — the image and the crop batch never leave the
+        device."""
+        desc = (
+            "videotestsrc pattern=gradient num-frames=8 device=true "
+            "width=32 height=32 ! tensor_converter ! "
+            f"tensor_filter framework=jax model={_detector_script(tmp_path)} ! "
+            "tensor_transform mode=crop-resize option=8:8 ! queue ! "
+            f"tensor_filter framework=jax model={_landmark_script(tmp_path)} ! "
+            "tensor_sink name=out"
+        )
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=120)
+        lm = [np.asarray(f.tensors[0]) for f in p["out"].frames]
+        assert len(lm) == 8 and lm[0].shape == (4, 6)
+        assert ex.transfer_totals()["d2h"] == 8 * lm[0].nbytes
+        # crop semantics carried through: the zero region's features
+        # pool to zero in the first half
+        assert np.allclose(lm[0][2][:3], 0.0)
+
+
+# ----------------------------------------------- int8 dequant epilogue
+class TestInt8DequantParity:
+    def test_wo_conv1x1_matches_host_dequant(self):
+        """The fused dequant epilogue (models/quantize._wo_conv1x1)
+        against the host dequant reference (dequantize_w + plain
+        matmul): same math, same numbers."""
+        from nnstreamer_tpu.models import quantize as qz
+
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.standard_normal((1, 1, 12, 8)), jnp.float32)
+        x = jnp.asarray(rng.standard_normal((2, 3, 12)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+        w8, scale = qz._quantize_w(w)
+        got = np.asarray(qz._wo_conv1x1(
+            x, {"w8": w8, "wscale": scale, "b": b}
+        ))
+        host_w = np.asarray(qz.dequantize_w(w8, scale))[0, 0]
+        want = np.asarray(x) @ host_w + np.asarray(b)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # the int8 form really is int8 (¼ the weight bytes resident)
+        assert np.asarray(w8).dtype == np.int8
+
+    @pytest.mark.slow
+    def test_apply_int8w_full_model_matches_host_dequant(self):
+        """End-to-end: apply_int8w over the whole quantized MobileNet
+        equals the fp32 forward over host-dequantized weights, exactly
+        (same float structure, dequant folded at the operand)."""
+        from nnstreamer_tpu.models import mobilenet_v2 as mv2
+        from nnstreamer_tpu.models import nn
+        from nnstreamer_tpu.models import quantize as qz
+
+        params = mv2.init_params(
+            jax.random.PRNGKey(0), num_classes=10, width=0.25
+        )
+        folded = qz.fold_mobilenet(params)
+        q = qz.quantize_mobilenet_weights(folded)
+        x = jnp.asarray(np.random.default_rng(0).integers(
+            0, 255, (1, 64, 64, 3), np.uint8
+        ))
+        got = np.asarray(qz.apply_int8w(q, x))
+        deq = {
+            "stem": folded["stem"],
+            "classifier": folded["classifier"],
+            "blocks": [],
+        }
+        for blk, qb in zip(folded["blocks"], q["blocks"]):
+            b = {"dw": blk["dw"]}
+            for part in ("expand", "project"):
+                if part in qb:
+                    b[part] = {
+                        "w": qz.dequantize_w(
+                            qb[part]["w8"], qb[part]["wscale"]
+                        ),
+                        "b": qb[part]["b"],
+                    }
+            deq["blocks"].append(b)
+        deq["head"] = {
+            "w": qz.dequantize_w(q["head"]["w8"], q["head"]["wscale"]),
+            "b": folded["head"]["b"],
+        }
+        y = qz._folded_forward(deq, qz.normalize_uint8(x), [])
+        want = np.asarray(nn.dense(
+            jnp.mean(y.astype(jnp.float32), axis=(1, 2)),
+            folded["classifier"],
+        ))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+# ------------------------------------------------------------ NNS-W116
+class TestW116HostPostproc:
+    DESC = (
+        "tensorsrc dimensions=25:10 types=float32 num-frames=4 ! "
+        "tensor_filter framework=scaler ! "
+        "tensor_decoder mode=bounding_boxes option1=yolov5{pp} ! "
+        "{tail}"
+    )
+
+    def _codes(self, pp="", tail="tensor_filter framework=scaler ! fakesink"):
+        from nnstreamer_tpu.analysis.lint import lint
+
+        r = lint(self.DESC.format(pp=pp, tail=tail))
+        return [d.code for d in r.diagnostics]
+
+    def test_fires_for_host_decoder_between_device_filters(self):
+        assert "NNS-W116" in self._codes()
+
+    def test_silent_with_postproc_device(self):
+        codes = self._codes(pp=" postproc=device")
+        assert "NNS-W116" not in codes
+        assert "NNS-W113" not in codes
+
+    def test_silent_at_chain_tail(self):
+        assert "NNS-W116" not in self._codes(tail="fakesink")
+
+    def test_postproc_device_with_error_pad_serves_host_path(self):
+        """A linked error pad is a fusion barrier, so a postproc=device
+        decoder lands on the host loop — it must serve the SAME traced
+        decode (structured tensor out), never the video tail."""
+        desc = (
+            "tensorsrc dimensions=25:10 types=float32 pattern=random "
+            "num-frames=4 ! tensor_filter framework=scaler ! "
+            "tensor_decoder name=dec mode=bounding_boxes option1=yolov5 "
+            "postproc=device on-error=route ! tensor_sink name=out "
+            "dec.src_1 ! fakesink"
+        )
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=60)
+        assert not ex.errors
+        outs = [np.asarray(f.tensors[0]) for f in p["out"].frames]
+        assert len(outs) == 4
+        assert all(o.shape == (100, 6) and o.dtype == np.float32
+                   for o in outs)
+
+    def test_postproc_device_pipeline_lints_and_runs_clean(self):
+        from nnstreamer_tpu.analysis.lint import lint
+
+        desc = self.DESC.format(
+            pp=" postproc=device",
+            tail="tensor_filter framework=scaler ! tensor_sink name=out",
+        )
+        assert lint(desc).exit_code == 0
+        p = parse_pipeline(desc)
+        ex = p.run(timeout=60)
+        assert not ex.errors
+        assert len(p["out"].frames) == 4
+
+
+# ----------------------------------------------- real face cascade (slow)
+@pytest.mark.slow
+class TestRealFaceCascade:
+    FUSED = (
+        "videotestsrc pattern=gradient num-frames={n} device=true "
+        "width=128 height=128 ! tensor_converter ! "
+        "tensor_filter framework=jax model=zoo:face_detect "
+        'custom="output:regions+image,threshold:0.0,frame_size:128:128" ! '
+        "tensor_transform mode=crop-resize option=112:112 ! queue ! "
+        "tensor_filter framework=jax model=zoo:face_landmark "
+        'custom="batch:16" ! {sink}'
+    )
+
+    def test_zero_host_transfer_and_parity_with_tensor_crop(self):
+        # zero-transfer pin on the real models
+        p = parse_pipeline(self.FUSED.format(n=3, sink="fakesink"))
+        ex = p.run(timeout=300)
+        assert ex.transfer_totals() == {"h2d": 0, "d2h": 0}
+        # numeric parity vs the tensor_crop element cascade
+        p2 = parse_pipeline(self.FUSED.format(n=2, sink="tensor_sink name=out"))
+        p2.run(timeout=300)
+        fused_lm = [np.asarray(f.tensors[0]) for f in p2["out"].frames]
+        crop_desc = (
+            "videotestsrc pattern=gradient num-frames=2 width=128 "
+            "height=128 ! tensor_converter ! tee name=t "
+            "t. ! queue ! tensor_filter framework=jax "
+            "model=zoo:face_detect "
+            'custom="output:regions,threshold:0.0,frame_size:128:128" ! '
+            "crop.sink_1 t. ! queue ! crop.sink_0 "
+            "tensor_crop name=crop out-size=112:112 max-crops=16 ! "
+            "tensor_filter framework=jax model=zoo:face_landmark "
+            'custom="batch:16" ! tensor_sink name=out'
+        )
+        p3 = parse_pipeline(crop_desc)
+        p3.run(timeout=300)
+        crop_lm = [np.asarray(f.tensors[0]) for f in p3["out"].frames]
+        assert len(fused_lm) == len(crop_lm) == 2
+        for a, b in zip(fused_lm, crop_lm):
+            np.testing.assert_allclose(a, b, atol=1e-4)
