@@ -1407,11 +1407,91 @@ def _int8_mb8_cell() -> float | None:
     return iters * mb / (time.perf_counter() - t0)
 
 
+def _paged_tok_frac_cell() -> float | None:
+    """Fresh paged_tok_frac measurement for --gate: paged (block-native
+    default) decode tok/s over slot-layout tok/s at EQUAL occupancy —
+    the `--pipeline llm` parity cell's ratio, measured lean (no
+    capacity sweep). A ratio, so host speed largely cancels; a drop
+    means the block-native decode path itself regressed vs the slot
+    step (e.g. a reintroduced gather/scatter or view carry)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nnstreamer_tpu.models import transformer as tfm
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rng = np.random.default_rng(0)
+    if on_tpu:
+        model_kw = dict(vocab=32000, d_model=512, n_heads=8, n_layers=4)
+        dtype = jnp.bfloat16
+    else:
+        model_kw = dict(vocab=512, d_model=64, n_heads=4, n_layers=2)
+        dtype = jnp.float32
+    params = tfm.init_params(jax.random.PRNGKey(7), **model_kw)
+    max_len, prompt_len, block_size = 192, 32, 16
+    slots, tok_budget = 6, 64
+    prompts = [
+        rng.integers(1, model_kw["vocab"], (48,)).astype(np.int32)
+        for _ in range(slots)
+    ]
+
+    def _mk(layout):
+        kw = dict(compute_dtype=dtype)
+        if layout == "paged":
+            kw.update(kv_layout="paged", block_size=block_size,
+                      kv_blocks=slots * max_len // block_size)
+        return ContinuousBatcher(
+            params, model_kw["n_heads"], n_slots=slots, max_len=max_len,
+            prompt_len=prompt_len, **kw,
+        )
+
+    slot_tok_s = _llm_equal_occupancy_tok_s(_mk("slot"), prompts, tok_budget)
+    paged_tok_s = _llm_equal_occupancy_tok_s(
+        _mk("paged"), prompts, tok_budget
+    )
+    if not slot_tok_s:
+        return None
+    return round(paged_tok_s / slot_tok_s, 3)
+
+
+def _llm_equal_occupancy_tok_s(cb, prompts, budget: int) -> float:
+    """Decode tok/s at EQUAL occupancy — the one methodology behind
+    ``paged_tok_frac`` (`--pipeline llm` and `--gate`).
+
+    A warm submit→drain round compiles every program the measured
+    round will touch (including the paged prefix-hit admission path,
+    which only engages on a resubmitted prompt); the measured round
+    then pumps until every request is ADMITTED before the clock
+    starts — occupancy is only equal once it is full on both layouts
+    (the slot layout admits synchronously in submit(); paged trickles
+    chunked prefill through the pumps, an admission-latency policy the
+    capacity/TTFT cells already account). Tokens are counted from the
+    pump returns, so partial decoding during admission cancels out."""
+    for _ in range(2):  # second round warms the prefix-hit admission
+        rids = [cb.submit(p, budget) for p in prompts]
+        while any(cb.result(r) is None for r in rids):
+            cb.step_pump(8)
+    rids = [cb.submit(p, budget) for p in prompts]
+    while cb.stats().get("kv_prefill_queue", 0) > 0:
+        cb.step_pump(1)
+    cb.step_pump(1)  # apply the last pending activation
+    n = 0
+    t0 = time.perf_counter()
+    while any(cb.result(r) is None for r in rids):
+        out = cb.step_pump(8)
+        n += sum(len(v) for v in out.values())
+    dt = time.perf_counter() - t0
+    return n / dt if dt > 0 else 0.0
+
+
 # --gate compares these keys; the executor ceilings + overlap are
 # measurable on a CPU-pinned host so the gate needs no relay window;
-# the composite/int8 cells measure on whatever backend attaches (the
-# reference environment) and are gated only when the reference record
-# carries them — pre-PR-12 references skip them until next capture.
+# the composite/int8/paged cells measure on whatever backend attaches
+# (the reference environment) and are gated only when the reference
+# record carries them — older references skip them until next capture
+# (`bench.py --capture-measured` writes one with every gated cell).
 # Thresholds are per-key fractions of allowed drop vs the reference.
 GATE_KEYS = {
     "executor_chain_fps": 0.25,
@@ -1421,7 +1501,23 @@ GATE_KEYS = {
     # loaded host wobbles it more than the paced ceilings
     "composite_face_fps": 0.3,
     "int8_mb8_fps": 0.25,
+    # paged/slot decode tok/s ratio at equal occupancy: host speed
+    # cancels in the ratio (measured ~1.5-1.7 on the CPU smoke — the
+    # block-native pump beats the slot layout's) — a breach means the
+    # block-native decode path itself regressed, e.g. a reintroduced
+    # gather/scatter or view carry
+    "paged_tok_frac": 0.2,
 }
+
+# fresh in-process measurements for the backend-dependent cells —
+# _gate and --capture-measured iterate this SAME tuple, so a new cell
+# cannot land in one and silently vanish from the other (the gate
+# skips keys the reference lacks without erroring)
+GATED_CELLS = (
+    ("composite_face_fps", _composite_face_cell),
+    ("int8_mb8_fps", _int8_mb8_cell),
+    ("paged_tok_frac", _paged_tok_frac_cell),
+)
 
 
 def _gate_reference(argv) -> tuple[str, dict] | tuple[None, None]:
@@ -1511,10 +1607,7 @@ def _gate() -> int:
         "executor_branched_fps": branched,
         "overlap_efficiency": overlap,
     }
-    for key, cell in (
-        ("composite_face_fps", _composite_face_cell),
-        ("int8_mb8_fps", _int8_mb8_cell),
-    ):
+    for key, cell in GATED_CELLS:
         # composite_face_fps predates this gate key with UNCHANGED
         # methodology (the shared _composite_face_cell), so pre-PR-12
         # references gate it meaningfully; int8_mb8_fps changed
@@ -1598,6 +1691,54 @@ def _gate() -> int:
         "skipped": skipped,
     }, indent=1))
     return (1 if same_host else 2) if failures else 0
+
+
+def _capture_measured() -> int:
+    """``--capture-measured <path>``: measure every gated cell fresh on
+    THIS host and write a BENCH_MEASURED-style reference record, so the
+    gate keys added since the last full relay capture
+    (overlap_efficiency, composite_face_fps, int8_mb8_fps,
+    paged_tok_frac) stop being skipped for lack of a reference. The
+    record stamps ``host`` (the gate's same-host rule) and
+    ``int8_impl`` (the int8 cell's configuration guard). Never run
+    concurrently with a tier-1 measurement."""
+    import jax
+
+    tail = sys.argv[sys.argv.index("--capture-measured") + 1:][:1]
+    if not tail or tail[0].startswith("-"):
+        print("usage: bench.py --capture-measured <out.json>",
+              file=sys.stderr)
+        return 2
+    path = os.path.abspath(tail[0])
+    rec = {
+        "metric": "bench_gate_reference_capture",
+        "host": _platform.node(),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "int8_impl": "int8w",
+    }
+    _mark("capture start")
+    chain, branched, spreads = _executor_ceilings()
+    rec["executor_chain_fps"] = _round(chain)
+    rec["executor_branched_fps"] = _round(branched)
+    for key, spread in spreads.items():
+        rec[f"{key}_spread_pct"] = spread
+    _mark("executor ceilings")
+    for key, cell in (
+        ("overlap_efficiency", _overlap_efficiency),
+    ) + GATED_CELLS:
+        try:
+            rec[key] = _round(cell(), 4)
+        except Exception as exc:  # noqa: BLE001 — capture what measures;
+            # the gate skips keys absent from the reference
+            print(f"[capture] {key} failed: {exc!r}", file=sys.stderr)
+            rec[key] = None
+        _mark(key)
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+    return 0
 
 
 def _pipeline_batched(smoke: bool) -> None:
@@ -1979,27 +2120,23 @@ def _pipeline_llm(smoke: bool) -> None:
     paged_cap, paged_st = _capacity(_mk("paged", 64), 64)
     _mark("paged capacity measured")
 
-    def _tok_s(cb, n_req):
-        prompts = [_prompt(100 + i) for i in range(n_req)]
-        rids = [cb.submit(p, decode_budget) for p in prompts]
-        while any(cb.result(r) is None for r in rids):
-            cb.step_pump(8)  # warm compile drain
-        t0 = time.perf_counter()
-        rids = [cb.submit(p, decode_budget) for p in prompts]
-        while any(cb.result(r) is None for r in rids):
-            cb.step_pump(8)
-        return n_req * decode_budget / (time.perf_counter() - t0)
-
-    slot_tok_s = _tok_s(_mk("slot", slot_slots), slot_slots)
+    tok_budget = 64  # decode window of the tok/s cells (not capacity's)
+    tok_prompts = [_prompt(100 + i) for i in range(slot_slots)]
+    slot_tok_s = _llm_equal_occupancy_tok_s(
+        _mk("slot", slot_slots), tok_prompts, tok_budget
+    )
     _mark("slot tok/s measured")
-    paged_tok_s = _tok_s(_mk("paged", slot_slots), slot_slots)
+    paged_tok_s = _llm_equal_occupancy_tok_s(
+        _mk("paged", slot_slots), tok_prompts, tok_budget
+    )
     _mark("paged tok/s measured")
     rec = {
         "metric": "llm_paged_vs_slot_capacity_at_fixed_kv_hbm",
         "kv_budget_tokens": budget_tokens,
         "block_size": block_size,
         "max_len": max_len,
-        "decode_budget": decode_budget,
+        "decode_budget": decode_budget,  # the capacity cells' budget
+        "tok_s_budget": tok_budget,      # the equal-occupancy tok/s cells'
         "slot_capacity": slot_cap,
         "paged_capacity": paged_cap,
         "capacity_ratio": (
@@ -2010,6 +2147,14 @@ def _pipeline_llm(smoke: bool) -> None:
         "tok_s_ratio": (
             round(paged_tok_s / slot_tok_s, 3) if slot_tok_s else None
         ),
+        # the gate key (GATE_KEYS): paged/slot decode tok/s at equal
+        # occupancy — ≥ 0.95 is the block-native acceptance bar, a
+        # regression fails `bench.py --gate` against a fresh reference
+        "paged_tok_frac": (
+            round(paged_tok_s / slot_tok_s, 3) if slot_tok_s else None
+        ),
+        "kv_attn": paged_st.get("kv_attn"),
+        "kv_gather_dispatches": paged_st.get("kv_gather_dispatches", 0),
         "nns_kv_prefix_hits_total": paged_st.get("kv_prefix_hits", 0),
         "kv_prefix_hit_tokens": paged_st.get("kv_prefix_hit_tokens", 0),
         "kv_preemptions": paged_st.get("kv_preemptions", 0),
@@ -2029,6 +2174,8 @@ def main() -> None:
         return _watch()
     if "--gate" in sys.argv:
         return _gate()
+    if "--capture-measured" in sys.argv:
+        return _capture_measured()
     if "--pipeline" in sys.argv:
         mode = sys.argv[sys.argv.index("--pipeline") + 1 :][:1]
         if mode == ["batched"]:

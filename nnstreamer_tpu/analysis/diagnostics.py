@@ -162,6 +162,15 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "into the adjacent fused segment and only the small decoded "
         "tensor ever leaves the device",
     ),
+    "NNS-W117": (
+        Severity.WARNING, "paged-gather-materializes-cache",
+        "a paged LLM serving element is pinned to kv-attn=gather, whose "
+        "step programs materialize the full contiguous per-slot view "
+        "beside the block arena (a transient HBM doubling) and the "
+        "combined footprint exceeds the declared memory bound; the "
+        "block-native default (kv-attn=auto/block) attends the arena "
+        "directly through the block tables with no gathered view",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -799,13 +799,21 @@ def _model_sharing_pass(pipeline: Pipeline, report: LintReport) -> None:
 
 
 def _kv_cache_pass(pipeline: Pipeline, report: LintReport) -> None:
-    """NNS-W115: oversized static KV cache — a tensor_llm_serversink
-    whose slot-layout cache (2 · L · n-slots · max-len · KV · Dh,
-    every slot sized for the worst case) exceeds the declared memory
-    bound (``kv-memory-bound`` prop, or ``[llm] memory_bound``) while
-    ``kv-layout=paged`` is available. Static estimate from the element's
-    props and custom model options — no model is loaded (the sink is
-    LINT_SKIP_NEGOTIATE for exactly that reason)."""
+    """NNS-W115 + NNS-W117: KV caches that cannot fit their declared
+    memory bound (``kv-memory-bound`` prop, or ``[llm] memory_bound``).
+
+    - W115: a slot-layout cache (2 · L · n-slots · max-len · KV · Dh,
+      every slot sized for the worst case) exceeds the bound while
+      ``kv-layout=paged`` is available.
+    - W117: a PAGED element pinned to ``kv-attn=gather``, whose step
+      programs materialize the full contiguous per-slot view (slot-
+      cache-sized) BESIDE the block arena — the transient footprint
+      arena + view exceeds the bound. The block-native default has no
+      gathered view, so the fix is simply dropping the pin.
+
+    Static estimates from the element's props and custom model options
+    — no model is loaded (the sink is LINT_SKIP_NEGOTIATE for exactly
+    that reason)."""
     from nnstreamer_tpu.backends.base import FilterProps
     from nnstreamer_tpu.config import conf
     from nnstreamer_tpu.elements.llm_serve import LlmServerSink
@@ -817,8 +825,6 @@ def _kv_cache_pass(pipeline: Pipeline, report: LintReport) -> None:
         layout = str(e.get_property("kv-layout") or "").strip() or (
             conf().get("llm", "kv_layout", "slot")
         )
-        if layout == "paged":
-            continue
         bound_raw = str(e.get_property("kv-memory-bound") or "").strip()
         if not bound_raw:
             bound_raw = conf().get("llm", "memory_bound", "").strip()
@@ -845,14 +851,46 @@ def _kv_cache_pass(pipeline: Pipeline, report: LintReport) -> None:
             per_elem = 2.0 if dt == "bfloat16" else 4.0
         n_slots = int(e.get_property("n-slots") or 4)
         max_len = int(e.get_property("max-len") or 256)
-        est = int(
-            2 * n_layers * n_slots * max_len * n_kv * hd * per_elem
-        )
-        if est <= bound:
+        # the slot cache — which is ALSO the gathered view's shape
+        view = int(2 * n_layers * n_slots * max_len * n_kv * hd * per_elem)
+        if layout == "paged":
+            attn = str(e.get_property("kv-attn") or "").strip() or (
+                conf().get("llm", "kv_attn", "auto")
+            )
+            if attn != "gather":
+                continue  # block-native: no gathered view to flag
+            bs = int(e.get_property("block-size") or 0) or (
+                conf().get_int("llm", "block_size", 16)
+            ) or 16
+            kv_blocks = int(e.get_property("kv-blocks") or 0) or (
+                conf().get_int("llm", "kv_blocks", 0)
+            )
+            if kv_blocks <= 0:  # no-saving auto default (serving.py)
+                kv_blocks = n_slots * (-(-max_len // bs))
+            arena = int(
+                2 * n_layers * (kv_blocks + 1) * bs * n_kv * hd * per_elem
+            )
+            est = arena + view
+            if est <= bound:
+                continue
+            report.add(
+                "NNS-W117", e.name,
+                f"kv-attn=gather materializes the contiguous view ≈ "
+                f"{view / (1 << 20):.0f} MiB beside the "
+                f"{arena / (1 << 20):.0f} MiB block arena every step — "
+                f"transient ≈ {est / (1 << 20):.0f} MiB exceeds the "
+                f"declared bound {bound_raw}",
+                "drop kv-attn=gather (the block-native default attends "
+                "the arena directly through the block tables, no "
+                "gathered view — docs/llm-serving.md); keep the gather "
+                "oracle for parity debugging only",
+            )
+            continue
+        if view <= bound:
             continue
         report.add(
             "NNS-W115", e.name,
-            f"slot-layout KV cache ≈ {est / (1 << 20):.0f} MiB "
+            f"slot-layout KV cache ≈ {view / (1 << 20):.0f} MiB "
             f"(2·L{n_layers}·slots{n_slots}·len{max_len}·kv{n_kv}·"
             f"hd{hd}) exceeds the declared bound {bound_raw} — every "
             "slot is sized for the worst-case request",

@@ -91,7 +91,7 @@ class _LlmServer:
                  speculate_model: str = "", pump_tokens: int = 1,
                  kv_layout: str = "slot", block_size: int = 16,
                  kv_blocks: int = 0, cache_dtype: str = "auto",
-                 prefill_chunks: int = 1):
+                 prefill_chunks: int = 1, kv_attn: str = "auto"):
         from nnstreamer_tpu.models import zoo
         from nnstreamer_tpu.models.serving import ContinuousBatcher
 
@@ -140,6 +140,7 @@ class _LlmServer:
                 kv_layout=kv_layout, block_size=block_size,
                 kv_blocks=kv_blocks or None,
                 prefill_chunks=prefill_chunks,
+                kv_attn=kv_attn or "auto",
             )
         self.cb = ContinuousBatcher(
             m.params, n_heads, n_slots=n_slots, max_len=max_len,
@@ -342,9 +343,12 @@ class LlmServerSink(Sink):
     kv-layout/block-size/kv-blocks/prefill-chunks (paged KV cache:
     block-table arena with prefix sharing, chunked prefill and
     preemption-by-eviction — docs/llm-serving.md; defaults from the
-    [llm] config section), cache-dtype (int8 stores the KV cache
-    quantized), kv-memory-bound (declared HBM budget consumed by
-    nns-lint NNS-W115)."""
+    [llm] config section), kv-attn (paged decode formulation:
+    auto/block attend the arena directly through the block tables;
+    gather keeps the materialized-view debug/parity oracle — flagged
+    by nns-lint NNS-W117 when it would breach the memory bound),
+    cache-dtype (int8 stores the KV cache quantized), kv-memory-bound
+    (declared HBM budget consumed by nns-lint NNS-W115/W117)."""
 
     FACTORY_NAME = "tensor_llm_serversink"
 
@@ -368,6 +372,10 @@ class LlmServerSink(Sink):
         # paged KV cache (nnstreamer_tpu/kv/, docs/llm-serving.md);
         # empty strings defer to the [llm] config section
         "kv-layout": PropSpec("str", "", desc="slot | paged ([llm] default)"),
+        "kv-attn": PropSpec(
+            "str", "",
+            desc="paged decode path: auto | block | gather ([llm] default)",
+        ),
         "block-size": PropSpec("int", 0, desc="tokens per KV block (paged)"),
         "kv-blocks": PropSpec("int", 0, desc="arena blocks (paged; 0=auto)"),
         "cache-dtype": PropSpec("str", "auto", desc="auto | int8"),
@@ -397,6 +405,9 @@ class LlmServerSink(Sink):
         kv_layout = str(self.get_property("kv-layout", "")).strip() or (
             cfg.get("llm", "kv_layout", "slot")
         )
+        kv_attn = str(self.get_property("kv-attn", "")).strip() or (
+            cfg.get("llm", "kv_attn", "auto")
+        )
         block_size = int(self.get_property("block-size", 0)) or (
             cfg.get_int("llm", "block_size", 16)
         )
@@ -425,6 +436,7 @@ class LlmServerSink(Sink):
             kv_blocks=kv_blocks,
             cache_dtype=str(self.get_property("cache-dtype", "auto")),
             prefill_chunks=prefill_chunks,
+            kv_attn=kv_attn,
         )
         self._server: Optional[_LlmServer] = None
 

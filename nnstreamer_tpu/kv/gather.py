@@ -1,8 +1,20 @@
-"""Jitted block-table gather/scatter: the paged cache's device read/write.
+"""Jitted block-table gather/scatter: the paged cache's admission ops
+and the gather-formulation decode oracle.
 
-The paged step/pump/spec programs run the SAME attention math as the
-contiguous slot layout (models/serving.batched_decode_step and friends)
-— the only difference is where the cache bytes live:
+Since the block-native path landed (:mod:`nnstreamer_tpu.kv.block_attn`,
+``ContinuousBatcher(kv_attn="auto"|"block")`` — the default), the
+gather/scatter pair below serves the DECODE plane only as the
+debug/parity oracle behind ``kv_attn="gather"``: bitwise identical
+streams, but every step materializes the full contiguous view beside
+the arena (a transient HBM doubling — forcing it on a bounded chip is
+what nns-lint NNS-W117 warns about) and pays a whole-arena scatter.
+The admission-path helpers at the bottom (block write/read/copy,
+arena init) are shared by BOTH formulations.
+
+Under ``kv_attn="gather"`` the step/pump/spec programs run the SAME
+attention math as the contiguous slot layout
+(models/serving.batched_decode_step and friends) — the only difference
+is where the cache bytes live:
 
 - :func:`gather_cache` materializes, inside the program, a per-slot
   contiguous view ``[L, B, max_len, ...]`` from the block arena
@@ -145,6 +157,91 @@ def make_paged_ops(quantized: bool, compute_dtype):
         jax.jit(write_block, donate_argnums=0),
         jax.jit(read_block),
         jax.jit(copy_block, donate_argnums=0),
+    )
+
+
+def make_staging_ops(quantized: bool, compute_dtype):
+    """Coalesced admission staging: ONE program per direction instead
+    of one :func:`make_paged_ops` call per block.
+
+    Returns ``(seed_stage, land_stage)`` over a chunked-prefill stage;
+    the stage's block count rides in ``ids.shape[0]`` (the caller
+    passes one id slot per stage block — a bucket-wide fast-path stage
+    and the full chunked stage each compile once):
+
+    - ``seed_stage(arena, stage, ids, n_seed)`` — read arena blocks
+      ``ids[:n_seed]`` (dequantized when int8) into the stage's leading
+      columns in one launch: the prefix-seeded prefill source
+      (replaces a ``read_block`` + two dynamic-update launches per
+      matched block);
+    - ``land_stage(arena, stage, ids, valid)`` — write every stage
+      block ``i`` with ``valid[i]`` to arena block ``ids[i]``
+      (quantizing when int8 — per token per head, so slicing per block
+      first would change nothing) in one launch; invalid lanes route
+      to scratch block 0 carrying its init values (zero payload, unit
+      scales), so scratch stays pristine. Replaces a ``write_block``
+      launch per landed block.
+
+    Values are bitwise the per-block ops' — only the dispatch count
+    changes (the paged admission path used to cost ~2 launches per
+    block of prompt, a real tax on the `bench.py --pipeline llm`
+    equal-occupancy cell)."""
+
+    def seed_stage(arena, stage, ids, n_seed):
+        S = ids.shape[0]
+        if quantized:
+            (ka, ksc), (va, vsc) = arena
+
+            def taken(pay, sc):
+                t = jnp.take(pay, ids, axis=1)   # [L, S, bs, KV, Dh]
+                s = jnp.take(sc, ids, axis=1)    # [L, S, bs, KV]
+                return dequantize_kv(t, s)
+            tk, tv = taken(ka, ksc), taken(va, vsc)
+        else:
+            ka, va = arena
+            tk = jnp.take(ka, ids, axis=1)
+            tv = jnp.take(va, ids, axis=1)
+        bs = tk.shape[2]
+
+        def place(t, sleaf):
+            flat = t.reshape(
+                (t.shape[0], 1, S * bs) + t.shape[3:]
+            ).astype(sleaf.dtype)
+            cols = jnp.arange(S * bs, dtype=jnp.int32)
+            keep = (cols < n_seed * bs).reshape(
+                (1, 1, S * bs) + (1,) * (sleaf.ndim - 3)
+            )
+            return jnp.where(keep, flat, sleaf)
+
+        return place(tk, stage[0]), place(tv, stage[1])
+
+    def land_stage(arena, stage, ids, valid):
+        S = ids.shape[0]
+        ks, vs = stage  # [L, 1, S*bs, KV, Dh] compute dtype
+
+        def rows_of(s):
+            return s.reshape((s.shape[0], S, -1) + s.shape[3:])
+
+        def put(a, rows, fill=0):
+            keep = valid.reshape((1, S) + (1,) * (rows.ndim - 2))
+            rows = jnp.where(keep, rows.astype(a.dtype),
+                             jnp.asarray(fill, a.dtype))
+            return a.at[:, ids].set(rows)
+
+        if quantized:
+            (ka, ksc), (va, vsc) = arena
+            k8, ksn = quantize_kv(ks)
+            v8, vsn = quantize_kv(vs)
+            return (
+                (put(ka, rows_of(k8)), put(ksc, rows_of(ksn), 1.0)),
+                (put(va, rows_of(v8)), put(vsc, rows_of(vsn), 1.0)),
+            )
+        ka, va = arena
+        return (put(ka, rows_of(ks)), put(va, rows_of(vs)))
+
+    return (
+        jax.jit(seed_stage, donate_argnums=1),
+        jax.jit(land_stage, donate_argnums=0),
     )
 
 

@@ -1007,6 +1007,7 @@ class ContinuousBatcher:
         block_size: int = 16,
         kv_blocks: Optional[int] = None,
         prefill_chunks: int = 1,
+        kv_attn: str = "auto",
     ):
         """``windowed=True`` makes max_len a sliding attention window
         over a ring-buffer cache: generations AND prompts of any length
@@ -1028,7 +1029,18 @@ class ContinuousBatcher:
         The draft must share the target's vocabulary. Composes with
         windowed rings: the draft proposes against its pre-write ring
         and commits only accepted columns — the same verify-then-commit
-        discipline the target uses (see _DraftEngine)."""
+        discipline the target uses (see _DraftEngine).
+
+        ``kv_attn`` selects the PAGED decode formulation
+        (docs/llm-serving.md): ``"auto"``/``"block"`` attend the block
+        arena directly through the block tables and write each decoded
+        token in place into its owning block (kv/block_attn.py — no
+        gathered view, the default); ``"gather"`` keeps the
+        gather→contiguous-view→scatter oracle (kv/gather.py) for
+        debugging/parity at the cost of a transient HBM doubling.
+        Both are bitwise identical to the slot layout. Paged composes
+        with ``attn_impl="pallas"`` via the block-table kernel
+        (ops/pallas/paged_attention.py) — block-native only."""
         if prompt_len > max_len:
             raise ValueError("prompt_len must be ≤ max_len")
         if cache_dtype not in ("auto", "int8"):
@@ -1036,19 +1048,33 @@ class ContinuousBatcher:
         quantized_cache = cache_dtype == "int8"
         if kv_layout not in ("slot", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        if kv_attn not in ("auto", "block", "gather"):
+            raise ValueError(f"unknown kv_attn {kv_attn!r}")
         self._paged = kv_layout == "paged"
+        self._kv_attn = ""
         if self._paged:
             # paged KV (nnstreamer_tpu/kv/, docs/llm-serving.md): the
-            # cache is a block arena behind per-slot block tables; the
-            # decode math is the SAME batched step on a gathered view
-            # (bitwise parity pinned by tests/test_kv_paged.py). The
-            # windowed ring, slot-sharded meshes, draft models and the
-            # Pallas kernel keep the slot layout for now.
+            # cache is a block arena behind per-slot block tables.
+            # kv_attn selects the decode formulation: "block" (the
+            # "auto" default) attends DIRECTLY against the arena
+            # through the block table and writes the decoded token in
+            # place into its single owning block (kv/block_attn.py —
+            # no contiguous view in either direction); "gather" keeps
+            # the gather→slot-step→scatter oracle (kv/gather.py) for
+            # debugging/parity. Both are bitwise identical to the slot
+            # layout (tests/test_kv_paged.py, tests/test_kv_block_attn
+            # .py). The windowed ring, slot-sharded meshes and draft
+            # models keep the slot layout for now.
+            self._kv_attn = "block" if kv_attn == "auto" else kv_attn
             for flag, why in (
                 (windowed, "windowed (ring) caches"),
                 (mesh is not None, "mesh-sharded slots"),
                 (draft_params is not None, "draft models"),
-                (attn_impl != "xla", f"attn_impl={attn_impl!r}"),
+                (attn_impl not in ("xla", "pallas"),
+                 f"attn_impl={attn_impl!r}"),
+                (attn_impl == "pallas" and self._kv_attn == "gather",
+                 "attn_impl='pallas' with kv_attn='gather' (the paged "
+                 "kernel is block-native — drop kv_attn='gather')"),
             ):
                 if flag:
                     raise ValueError(
@@ -1067,12 +1093,31 @@ class ContinuousBatcher:
                     f"prompt_len({prompt_len}) so staged prefill chunks "
                     "land on block boundaries"
                 )
-        if attn_impl == "pallas":
-            from nnstreamer_tpu.ops.pallas.decode_attention import (
-                make_decode_attention,
+        elif kv_attn != "auto":
+            raise ValueError(
+                "kv_attn selects the paged decode formulation; the slot "
+                "layout has no block table to attend through"
             )
+        paged_attn_fn = None
+        if attn_impl == "pallas":
+            if self._paged:
+                # the block-table kernel: attends the arena through the
+                # prefetched tables, one block per grid step, no
+                # gathered view (ops/pallas/paged_attention.py); the
+                # spec verify keeps inline XLA attention exactly like
+                # the slot layout's Pallas batchers
+                from nnstreamer_tpu.ops.pallas.paged_attention import (
+                    make_paged_attention,
+                )
 
-            attn_fn = make_decode_attention()
+                paged_attn_fn = make_paged_attention()
+                attn_fn = None
+            else:
+                from nnstreamer_tpu.ops.pallas.decode_attention import (
+                    make_decode_attention,
+                )
+
+                attn_fn = make_decode_attention()
         elif attn_impl == "xla":
             attn_fn = None
         else:
@@ -1112,10 +1157,12 @@ class ContinuousBatcher:
         kv = tfm.n_kv_heads_of(params["blocks"]["wqkv"], d, n_heads)
         shape = (L, n_slots, max_len, kv, hd)
         if self._paged:
+            from nnstreamer_tpu.kv import block_attn as _kvb
             from nnstreamer_tpu.kv import gather as _kvg
             from nnstreamer_tpu.kv.blocks import BlockPool
 
             self._kvg = _kvg
+            self._kvb = _kvb
             self.block_size = block_size
             self._blocks_per_slot = max_len // block_size
             if kv_blocks is None:
@@ -1236,6 +1283,14 @@ class ContinuousBatcher:
         # caching path) still fit their full-width writes
         self._stage_len = (-(-max_len // prompt_len) + 1) * prompt_len
         self._stage_shape = (L, 1, self._stage_len, kv, hd)
+        if self._paged:
+            # coalesced admission staging (kv/gather.make_staging_ops):
+            # prefix seeding and block landing as ONE program each —
+            # the per-block read/write launches used to dominate paged
+            # admission latency on short decode budgets
+            self._seed_stage, self._land_stage = (
+                self._kvg.make_staging_ops(quantized_cache, compute_dtype)
+            )
         self._prefill_chunk = jax.jit(
             lambda toks, cpos, cache: dec.verify_chunk(
                 params, toks, cpos, cache, n_heads,
@@ -1298,11 +1353,14 @@ class ContinuousBatcher:
         # place, and on any TPU donation halves the cache's HBM
         # footprint — the carried state never has two live copies
         _don = dict(donate_argnums=(3, 4))
-        if self._paged:
-            # paged step: gather the block arena into the SAME
-            # contiguous per-slot view the slot layout carries, run the
-            # IDENTICAL step body on it, then scatter only the written
-            # token's block back (inactive lanes route to scratch).
+        if self._paged and self._kv_attn == "gather":
+            # gather oracle (kv_attn="gather"): gather the block arena
+            # into the SAME contiguous per-slot view the slot layout
+            # carries, run the IDENTICAL step body on it, then scatter
+            # only the written token's block back (inactive lanes route
+            # to scratch). Pays a transient [L,B,max_len,...] view
+            # beside the arena plus the scatter — kept as the
+            # debug/parity reference for the block-native default.
             # tables (arg 4) is NOT donated — it is the cached device
             # copy reused across pumps; arena (3) and hist (5) are.
             _kvg = self._kvg
@@ -1327,6 +1385,40 @@ class ContinuousBatcher:
             _pgdon = dict(donate_argnums=(3, 5))
             self._step_greedy = jax.jit(paged_step(False), **_pgdon)
             self._step_sampling = jax.jit(paged_step(True), **_pgdon)
+        elif self._paged:
+            # block-native (kv_attn="block", the "auto" default): the
+            # step attends DIRECTLY against the arena through the block
+            # table and lands the decoded token's K/V with one width-1
+            # in-place block write under donation — zero gather_cache /
+            # scatter_window programs on the decode path (pinned by
+            # tests/test_kv_block_attn.py), bitwise identical to the
+            # gather oracle and hence the slot layout.
+            _kvb = self._kvb
+            _pg_attn = paged_attn_fn
+
+            def block_step(sampling):
+                def impl(tok, pos, active, arena, tables, hist, temp,
+                         topk, topp, keys):
+                    logits, arena, pos2 = _kvb.batched_decode_step_block(
+                        params, tok, pos, active, arena, tables,
+                        n_heads, compute_dtype, attn_fn=_pg_attn,
+                    )
+                    if sampling:
+                        sub = jax.vmap(jax.random.fold_in)(keys, pos2)
+                        new = sample_tokens(logits, temp, topk, topp, sub)
+                    else:
+                        new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    new = jnp.where(active, new, tok)
+                    hist = hist_write_row(
+                        hist, new[:, None], pos2, active.astype(jnp.int32)
+                    )
+                    return new, arena, pos2, hist
+
+                return impl
+
+            _pgdon = dict(donate_argnums=(3, 5))
+            self._step_greedy = jax.jit(block_step(False), **_pgdon)
+            self._step_sampling = jax.jit(block_step(True), **_pgdon)
         elif mesh is not None and attn_impl == "pallas":
             # GSPMD cannot partition the kernel's custom call over the
             # slot-sharded cache — but the step is slot-parallel by
@@ -1414,21 +1506,37 @@ class ContinuousBatcher:
         )
         _wd = draft_params is not None
         if self._paged:
-            # paged pump: the scan gathers/scatters per step through the
-            # (static-within-a-pump) block table; budget/stop/active are
-            # the device-carried pump state like everywhere else
+            # paged pump: the scan steps through the (static-within-a-
+            # pump) block table; budget/stop/active are the device-
+            # carried pump state like everywhere else. kv_attn="gather"
+            # gathers/scatters per step (the oracle); the block-native
+            # default reads the arena through the table and writes the
+            # token's block in place — a steady pump dispatches ZERO
+            # gather/scatter programs.
             _kvg = self._kvg
+            _kvb = self._kvb
+            _pg_attn = paged_attn_fn
+            _gather_pump = self._kv_attn == "gather"
 
             def paged_pump_impl(sampling):
                 def impl(tok, pos, active, arena, tables, hist, budget,
                          stop, temp, topk, topp, keys, n_steps):
                     def body(carry, _):
                         tok, pos, active, arena, hist, budget = carry
-                        view = _kvg.gather_cache(arena, tables)
-                        logits, view, pos2 = batched_decode_step(
-                            params, tok, pos, active, view, n_heads,
-                            compute_dtype, attn_fn=attn_fn,
-                        )
+                        if _gather_pump:
+                            view = _kvg.gather_cache(arena, tables)
+                            logits, view, pos2 = batched_decode_step(
+                                params, tok, pos, active, view, n_heads,
+                                compute_dtype, attn_fn=attn_fn,
+                            )
+                        else:
+                            logits, arena, pos2 = (
+                                _kvb.batched_decode_step_block(
+                                    params, tok, pos, active, arena,
+                                    tables, n_heads, compute_dtype,
+                                    attn_fn=_pg_attn,
+                                )
+                            )
                         if sampling:
                             sub = jax.vmap(jax.random.fold_in)(keys, pos2)
                             new = sample_tokens(
@@ -1438,9 +1546,10 @@ class ContinuousBatcher:
                             new = jnp.argmax(logits, -1).astype(jnp.int32)
                         new = jnp.where(active, new, tok)
                         emit = jnp.where(active, new, -1)
-                        arena = _kvg.scatter_window(
-                            arena, tables, view, pos, 1, active
-                        )
+                        if _gather_pump:
+                            arena = _kvg.scatter_window(
+                                arena, tables, view, pos, 1, active
+                            )
                         hist = hist_write_row(
                             hist, new[:, None], pos2,
                             active.astype(jnp.int32),
@@ -1630,27 +1739,38 @@ class ContinuousBatcher:
         _use_draft = draft_params is not None and not windowed
         if self._paged:
             # paged speculative machinery: one verify round (spec_step)
-            # and the R-round device pump, both running the slot
-            # layout's verify/accept math on the gathered view and
-            # scattering the k-wide write window back per round
-            _kvg = self._kvg
+            # and the R-round device pump. The verify chunks ride the
+            # SAME formulation as the decode path: block-native reads
+            # straight off the arena by default (the k-wide window
+            # lands with one in-place multi-column block write), or the
+            # gathered-view oracle under kv_attn="gather" — so
+            # speculative and prefill-interleaved pumps drop the gather
+            # with everything else.
 
             def paged_spec_round(spec_sampling):
                 def impl(toks, pos_, active, arena, tables, hist, temp,
                          topk, topp, keys):
-                    view = _kvg.gather_cache(arena, tables)
-                    logits, view = batched_verify_step(
-                        params, toks, pos_, active, view, n_heads,
-                        compute_dtype,
-                    )
+                    if _gather_pump:
+                        view = _kvg.gather_cache(arena, tables)
+                        logits, view = batched_verify_step(
+                            params, toks, pos_, active, view, n_heads,
+                            compute_dtype,
+                        )
+                    else:
+                        logits, arena = _kvb.batched_verify_step_block(
+                            params, toks, pos_, active, arena, tables,
+                            n_heads, compute_dtype,
+                        )
                     m, final = spec_accept(
                         logits, toks, temp, topk, topp, keys, pos_,
                         spec_sampling,
                     )
                     m = jnp.where(active, m, 0)
-                    arena = _kvg.scatter_window(
-                        arena, tables, view, pos_, toks.shape[1], active
-                    )
+                    if _gather_pump:
+                        arena = _kvg.scatter_window(
+                            arena, tables, view, pos_, toks.shape[1],
+                            active,
+                        )
                     _, hist = spec_emit_hist(
                         toks, m, final, active, hist, pos_, False
                     )
@@ -1679,19 +1799,28 @@ class ContinuousBatcher:
                         toks = jnp.concatenate(
                             [tok[:, None], props], axis=1
                         )
-                        view = _kvg.gather_cache(arena, tables)
-                        logits, view = batched_verify_step(
-                            params, toks, pos, active, view, n_heads,
-                            compute_dtype,
-                        )
+                        if _gather_pump:
+                            view = _kvg.gather_cache(arena, tables)
+                            logits, view = batched_verify_step(
+                                params, toks, pos, active, view,
+                                n_heads, compute_dtype,
+                            )
+                        else:
+                            logits, arena = (
+                                _kvb.batched_verify_step_block(
+                                    params, toks, pos, active, arena,
+                                    tables, n_heads, compute_dtype,
+                                )
+                            )
                         m, final = spec_accept(
                             logits, toks, temp, topk, topp, keys, pos,
                             spec_sampling,
                         )
                         m = jnp.where(active, m, 0)
-                        arena = _kvg.scatter_window(
-                            arena, tables, view, pos, k, active
-                        )
+                        if _gather_pump:
+                            arena = _kvg.scatter_window(
+                                arena, tables, view, pos, k, active
+                            )
                         emit, hist = spec_emit_hist(
                             toks, m, final, active, hist, pos, False
                         )
@@ -1767,6 +1896,12 @@ class ContinuousBatcher:
         self._n_spec_rounds = 0
         self._n_spec_accepted = 0
         self._n_spec_columns = 0  # proposal columns offered (normalizer)
+        # step/pump/spec launches that ran the gather/scatter oracle
+        # (kv_attn="gather") instead of the block-native formulation —
+        # 0 forever on a block-native batcher (the zero-gather pin in
+        # tests/test_kv_block_attn.py); mirrored to the
+        # nns_kv_gather_dispatch_total obs counter
+        self._n_gather_dispatch = 0
         self._step_time_s = 0.0
         # bounded per-request latency windows (newest 1024): TTFT and
         # full request wall time — stats() reports their p50s
@@ -1898,12 +2033,14 @@ class ContinuousBatcher:
                 n_blocks = -(-plen // bs)
                 with self._lock:
                     blocks = self._pool.alloc(n_blocks)
-                for i, b in enumerate(blocks):
-                    ks = stage[0][:, :, i * bs: (i + 1) * bs]
-                    vs = stage[1][:, :, i * bs: (i + 1) * bs]
-                    self._cache = self._write_block(
-                        self._cache, jnp.asarray(b, jnp.int32), ks, vs
-                    )
+                ids = np.zeros((self._stage_len // bs,), np.int32)
+                valid = np.zeros((self._stage_len // bs,), bool)
+                ids[: n_blocks] = blocks
+                valid[: n_blocks] = True
+                self._cache = self._land_stage(
+                    self._cache, stage, jnp.asarray(ids),
+                    jnp.asarray(valid),
+                )
                 with self._lock:
                     self._pool.register(tokens, blocks)
                     pid = self._next_prefix
@@ -2326,15 +2463,25 @@ class ContinuousBatcher:
         and activate it when staged + block-affordable — the chunked-
         prefill interleave: a decoding slot waits at most this many
         chunk programs per pump, whatever someone else's prompt length.
+
+        The throttle exists ONLY to bound decode stalls — while nothing
+        is decoding (no active slot, no activation pending), it would
+        merely serialize admissions one bucket per pump, so an idle
+        decode plane keeps advancing until a job activates or the queue
+        drains (the cold-start admission latency fix; the interleave
+        bound is unchanged the moment anything is live).
         Caller holds _step_lock; _lock is taken only for bookkeeping."""
-        for _ in range(self._prefill_chunks):
+        budget = self._prefill_chunks
+        while True:
             with self._lock:
                 job = self._prefill_q[0] if self._prefill_q else None
-            if job is None:
+                idle = not self._active.any() and not self._pending
+            if job is None or (budget <= 0 and not idle):
                 return
             self._slo.prefilling(job.req.rid)
             if not job.done_staging():
                 self._prefill_chunk_one(job)
+                budget -= 1
             if job.done_staging():
                 if self._prefill_finalize(job):
                     with self._lock:
@@ -2378,22 +2525,18 @@ class ContinuousBatcher:
                 return
             stage = self._empty_stage()
             # seed matched prefix K/V into the stage so continuation
-            # chunks attend it (fp: bitwise the originally staged values)
+            # chunks attend it (fp: bitwise the originally staged
+            # values) — all matched blocks in ONE seed_stage launch
             bs = self.block_size
             seeds = list(job.matched_full)
             if job.matched_partial is not None:
                 seeds.append(job.matched_partial)
-            for i, b in enumerate(seeds):
-                ks, vs = self._read_block(self._cache, b)
-                stage = (
-                    jax.lax.dynamic_update_slice(
-                        stage[0], ks.astype(stage[0].dtype),
-                        (0, 0, i * bs, 0, 0),
-                    ),
-                    jax.lax.dynamic_update_slice(
-                        stage[1], vs.astype(stage[1].dtype),
-                        (0, 0, i * bs, 0, 0),
-                    ),
+            if seeds:
+                ids = np.zeros((self._stage_len // bs,), np.int32)
+                ids[: len(seeds)] = seeds
+                stage = self._seed_stage(
+                    self._cache, stage, jnp.asarray(ids),
+                    jnp.asarray(len(seeds), jnp.int32),
                 )
             job.stage = stage
         if job.done_staging():
@@ -2455,13 +2598,21 @@ class ContinuousBatcher:
         blocks = list(job.matched_full) + fresh
         # land staged K/V into the fresh blocks (adopted full blocks
         # already hold theirs; the CoW block's copied prefix rides the
-        # seeded stage, so one write covers copy + continuation)
+        # seeded stage, so one write covers copy + continuation) — the
+        # whole span in ONE land_stage launch
         if job.stage is not None:
-            for i in range(n_full, n_blocks):
-                ks = job.stage[0][:, :, i * bs: (i + 1) * bs]
-                vs = job.stage[1][:, :, i * bs: (i + 1) * bs]
-                self._cache = self._write_block(
-                    self._cache, jnp.asarray(blocks[i], jnp.int32), ks, vs
+            if n_blocks > n_full:
+                # one id slot per stage block — the bucket-wide fast
+                # path stage and the full chunked stage each size it
+                stage_blocks = job.stage[0].shape[2] // bs
+                ids = np.zeros((stage_blocks,), np.int32)
+                valid = np.zeros((stage_blocks,), bool)
+                for i in range(n_full, n_blocks):
+                    ids[i] = blocks[i]
+                    valid[i] = True
+                self._cache = self._land_stage(
+                    self._cache, job.stage, jnp.asarray(ids),
+                    jnp.asarray(valid),
                 )
         elif job.matched_partial is not None and fresh:
             # fully-matched resume ending in a partial block: pure
@@ -2668,6 +2819,20 @@ class ContinuousBatcher:
             self._tables_dirty = False
         return self._tables_dev
 
+    def _note_gather_dispatch_locked(self) -> None:
+        """Count a paged step/pump/spec launch that ran the
+        gather→contiguous-view→scatter oracle (``kv_attn="gather"``)
+        instead of the block-native formulation. An operator watching
+        ``nns_kv_gather_dispatch_total`` (or ``kv_gather_dispatches``
+        in stats()) sees exactly when the decode plane is paying the
+        materialized-view round trip; a block-native batcher never
+        increments it — the zero-gather steady-state regression pin."""
+        if self._kv_attn != "gather":
+            return
+        self._n_gather_dispatch += 1
+        if self._obs_reg is not None:
+            self._obs_reg.counter("nns_kv_gather_dispatch_total").inc()
+
     def step_pump(self, n: int = 8) -> Dict[int, List[int]]:
         """Advance every active slot by up to ``n`` tokens in ONE
         compiled program (lax.scan over the batched step) with ONE
@@ -2701,6 +2866,7 @@ class ContinuousBatcher:
                 )
                 budget_dev, stop_dev, active_dev = self._pump_state_locked()
                 if self._paged:
+                    self._note_gather_dispatch_locked()
                     args = (
                         self._tok, self._pos, active_dev, self._cache,
                         self._tables_device_locked(), self._hist,
@@ -2823,6 +2989,7 @@ class ContinuousBatcher:
                         self._pump_state_locked()
                     )
                     if self._paged:
+                        self._note_gather_dispatch_locked()
                         args = (
                             self._tok, self._pos, active_dev,
                             self._cache, self._tables_device_locked(),
@@ -2969,6 +3136,7 @@ class ContinuousBatcher:
                 for s, req in enumerate(self._slots)
             )
             if self._paged:
+                self._note_gather_dispatch_locked()
                 args = (
                     self._tok, self._pos, jnp.asarray(active_np),
                     self._cache, self._tables_device_locked(),
@@ -3141,6 +3309,7 @@ class ContinuousBatcher:
                 )
             if self._paged:
                 with self._lock:
+                    self._note_gather_dispatch_locked()
                     tables_dev = self._tables_device_locked()
                 args = (
                     jnp.asarray(toks_host), self._pos,
@@ -3251,6 +3420,12 @@ class ContinuousBatcher:
                 st["kv_block_size"] = self.block_size
                 st["kv_prefill_queue"] = len(self._prefill_q)
                 st["kv_preemptions"] = self._slo.preemptions_total
+                # which decode formulation this batcher runs (block =
+                # arena attended through the tables, gather = the
+                # materialized-view oracle) and how many launches paid
+                # the gather round trip — 0 forever under kv_attn=block
+                st["kv_attn"] = self._kv_attn
+                st["kv_gather_dispatches"] = self._n_gather_dispatch
             return st
 
     def _lat_p50s_locked(self):

@@ -120,6 +120,13 @@ METRIC_CATALOG: Dict[str, str] = {
         "of re-prefilled — shared system prompts count once, not per "
         "request (counter; docs/llm-serving.md)"
     ),
+    "nns_kv_gather_dispatch_total": (
+        "paged step/pump/spec launches that ran the gather→contiguous-"
+        "view→scatter oracle (kv_attn=gather) instead of the "
+        "block-native arena read — a nonzero rate means the decode "
+        "plane is paying the materialized-view round trip (counter; "
+        "docs/llm-serving.md)"
+    ),
     "nns_request_ttft_ms": (
         "per-request time to first token, submit → first token "
         "materialized, milliseconds (histogram; the admission SLO — "

@@ -1,0 +1,260 @@
+"""Paged (block-table) decode attention as a Pallas TPU kernel.
+
+The flash-style single-pass decode kernel of
+:mod:`~nnstreamer_tpu.ops.pallas.decode_attention` generalized to the
+nns-kv paged layout (docs/llm-serving.md): instead of one contiguous
+``[B, S, KV, D]`` cache row per slot, the K/V live in a shared block
+arena ``[N, bs, KV, D]`` behind per-slot block tables ``[B, nb]`` —
+and the whole point of this kernel is that the arena is attended
+**through the table**, one block per grid step, with NO gathered
+contiguous view ever materialized in HBM (the gather → attend →
+scatter round trip the jnp gather formulation pays).
+
+Mechanics (grid ``(B, H, nb)``, k innermost with "arbitrary"
+semantics):
+
+- the block table and per-slot fill levels ride as SCALAR-PREFETCH
+  operands, so each grid step's BlockSpec index map picks the physical
+  arena block to DMA (``tables[b, kb]``) before the body runs — each
+  live arena block is read from HBM exactly once per (slot, head);
+- blocks at or beyond a slot's fill level — including the
+  scratch-mapped unallocated table tail — are predicated off with
+  ``@pl.when``; partially-filled blocks mask their dead columns to
+  softmax weight exactly zero and zero the matching V rows, so
+  arbitrary scratch content can never leak into the output;
+- the online-softmax scratch (m, l, acc) carries across blocks, and
+  the pending token's OWN K/V (``fresh_k``/``fresh_v``, not yet in the
+  arena — the batcher lands it after the layer scan with one in-place
+  block write) folds in the final grid step: it is position ``pos``,
+  the highest live column, so the reduction order equals position
+  order;
+- int8 arenas pass ``k_scale``/``v_scale`` ``[N, bs, KV]`` (the
+  per-token-per-head symmetric scales of models/serving.quantize_kv)
+  and dequantize per block in VMEM — HBM traffic stays at the int8
+  byte count.
+
+Off-TPU the kernel runs in interpret mode (``_compat`` discipline);
+``kv.block_attn.block_attention(impl="auto")`` dispatches between this
+kernel (TPU) and the jnp online-softmax reference it is pinned against
+in tests/test_kv_block_attn.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
+NEG_INF = -1e30
+
+
+def _kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, fk_ref, fv_ref, *rest,
+            scale: float, block_k: int, n_b: int, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # history length: positions 0..pos-1 live in arena blocks (the
+    # pending token's column is the separate fresh operand); clamped to
+    # the table's reach so a stale lane can never walk past the arena
+    hist = jnp.minimum(pos_ref[b], n_b * block_k)
+    k_start = kb * block_k
+
+    @pl.when(k_start < hist)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)        # [1, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            # per-row dequant in VMEM: int8 payload × f32 scale [bs]
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                   # [1, bs]
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < hist, s, NEG_INF)
+        # dead rows get weight exp(NEG_INF - m) = 0, but a scratch-mapped
+        # or partially-filled block may hold arbitrary V bytes, and
+        # 0 * NaN = NaN — zero those rows so the weighted sum stays clean
+        v = jnp.where(cols.reshape(-1, 1) < hist, v, 0.0)
+
+        m_prev = m_ref[:]                           # [1]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(
+            m_new[:, None] <= NEG_INF, 0.0, jnp.exp(s - m_new[:, None])
+        )
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == n_b - 1)
+    def _final():
+        # fold the pending token's own column (position pos — the
+        # highest live position, so folding it LAST keeps the reduction
+        # in position order), then normalize
+        q = q_ref[0, 0].astype(jnp.float32)         # [1, d]
+        fk = fk_ref[0, 0, 0].astype(jnp.float32)    # [d]
+        fv = fv_ref[0, 0, 0].astype(jnp.float32)
+        s1 = jax.lax.dot_general(
+            q, fk[None, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [1, 1]
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, s1[:, 0])
+        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p1 = jnp.exp(s1 - m_new[:, None])           # always live
+        l = l_ref[:] * alpha + jnp.sum(p1, axis=1)
+        acc = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p1, fv[None, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        l2 = l[:, None]
+        o_ref[0, 0] = jnp.where(
+            l2 > 0, acc / jnp.maximum(l2, 1e-30), 0.0
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(
+    q,
+    arena_k,
+    arena_v,
+    tables,
+    pos,
+    fresh_k,
+    fresh_v,
+    k_scale=None,
+    v_scale=None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    """q [B,1,H,D]; arena_k/v [N, bs, KV, D] (the kv.gather arena leaves
+    of ONE layer, consumed in place; KV ≤ H under grouped-query
+    attention — query head hi reads kv head hi//(H/KV) straight from
+    the BlockSpec index map); tables [B, nb] int32 block tables; pos
+    [B] int32 HISTORY lengths (positions 0..pos-1 attendable from
+    blocks); fresh_k/v [B,1,KV,D] the pending token's K/V (column pos)
+    → o [B,1,H,D] float32. With ``k_scale``/``v_scale`` [N, bs, KV]
+    the arena payloads are int8 and dequantized blockwise in VMEM."""
+    b, _, h, d = q.shape
+    n_kv = arena_k.shape[2]
+    bs = arena_k.shape[1]
+    nb = tables.shape[1]
+    if h % n_kv:
+        raise ValueError(f"query heads {h} not divisible by kv heads {n_kv}")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    group = h // n_kv
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    kernel = functools.partial(
+        _kernel, scale=scale, block_k=bs, n_b=nb, quantized=quantized,
+    )
+
+    from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets
+
+    # the physical arena block each grid step streams is picked by the
+    # PREFETCHED table — this index map is where the gather disappears
+    kv_spec = pl.BlockSpec(
+        (1, bs, 1, d),
+        lambda bi, hi, kb, tab_ref, pos_ref: (tab_ref[bi, kb], 0,
+                                              hi // group, 0),
+    )
+    fresh_spec = pl.BlockSpec(
+        (1, 1, 1, d),
+        lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi // group, 0),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, 1, d),
+            lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi, 0),
+        ),
+        kv_spec,
+        kv_spec,
+        fresh_spec,
+        fresh_spec,
+    ]
+    operands = [
+        tables.astype(jnp.int32), pos.astype(jnp.int32),
+        q, arena_k, arena_v, fresh_k, fresh_v,
+    ]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, bs, 1),
+            lambda bi, hi, kb, tab_ref, pos_ref: (tab_ref[bi, kb], 0,
+                                                  hi // group),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, d),
+            lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), jnp.float32),
+        grid_spec=grid_spec,
+        compiler_params=_compiler_params(
+            pltpu,
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out
+
+
+def make_paged_attention(interpret: Optional[bool] = None, **kwargs):
+    """attn factory for the block-native serving step: real kernel on
+    TPU, interpreter elsewhere.
+
+    The returned ``attn(q, k_entry, v_entry, tables, pos, (fk, fv))``
+    accepts either float arena leaves or the int8 entries
+    ``(payload, scales)`` exactly as kv.block_attn's step bodies hold
+    them; ``fk``/``fv`` are the pending token's (already dequantized)
+    K/V, folded as the final online-softmax column."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def attn(q, cache_k, cache_v, tables, pos, fresh_kv):
+        fk, fv = fresh_kv
+        if isinstance(cache_k, tuple):
+            (k8, ks), (v8, vs) = cache_k, cache_v
+            return paged_decode_attention(
+                q, k8, v8, tables, pos, fk, fv, k_scale=ks, v_scale=vs,
+                interpret=interpret, **kwargs,
+            )
+        return paged_decode_attention(
+            q, cache_k, cache_v, tables, pos, fk, fv,
+            interpret=interpret, **kwargs,
+        )
+
+    return attn

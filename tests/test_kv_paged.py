@@ -13,7 +13,12 @@ never depends on batch composition — the continuous-batching invariant
 test_serving pins), so ONE module-scoped slot reference and ONE paged
 batcher serve most tests here; per-test batchers exist only where the
 configuration itself differs (int8, tight pool, restore target). Keeps
-the compile count — the file's real cost — low.
+the compile count — the file's real cost — low. The widest
+parity-matrix cells (long-prompt chunked, eviction, sharing
+degradation, snapshot/restore) are marked `slow` under the tier-1
+DOTS budget; the fp greedy+sampling and int8 bitwise cells stay
+tier-1, and tests/test_kv_block_attn.py pins the block-native
+formulation these now run by default against the gather oracle.
 """
 
 import jax
@@ -165,6 +170,7 @@ def test_paged_parity_greedy_and_sampling(slot_ref, paged_cb):
     assert _ref_streams(slot_ref, subs) == _drain(paged_cb, rb, pump=4)
 
 
+@pytest.mark.slow
 def test_paged_long_prompt_chunked_prefill_parity(slot_ref, paged_cb):
     """A prompt spanning several prefill buckets admits chunk by chunk
     and still yields the slot layout's exact stream."""
@@ -260,6 +266,7 @@ def test_chunked_prefill_interleaves_decode(paged_cb):
 
 # -- preemption / eviction → re-prefill ------------------------------------
 
+@pytest.mark.slow
 def test_eviction_reprefill_parity(params, slot_ref):
     """A pool too small for three full streams preempts and re-prefills
     — and every stream still equals the slot reference byte for byte."""
@@ -272,6 +279,7 @@ def test_eviction_reprefill_parity(params, slot_ref):
     assert tight.stats()["kv_blocks_in_use"] == 0  # all freed at finish
 
 
+@pytest.mark.slow
 def test_sharing_degradation_unblocks_queue(params, slot_ref):
     """A prefix hit whose copy-on-write block makes the job UNaffordable
     (adopting the partial pulls a block from the pool AND still needs a
@@ -293,6 +301,7 @@ def test_sharing_degradation_unblocks_queue(params, slot_ref):
 
 # -- snapshot / restore -----------------------------------------------------
 
+@pytest.mark.slow
 def test_snapshot_restore_block_tables(params, paged_cb):
     """Mid-decode snapshot → fresh batcher → restore: identical
     continuation, pool accounting included (PR-7 warm-restart
@@ -364,9 +373,13 @@ def test_requests_view_and_nns_top_render(paged_cb):
         "serving_kv_blocks_in_use": 0,
         "serving_kv_blocks": 24,
         "serving_kv_prefix_hits": 3,
+        "serving_kv_attn": "block",
     }}}
     out = render_requests(snap)
     assert str(rid) in out and "done" in out and "prefix-hits=3" in out
+    # the footer names the active decode formulation (block-native by
+    # default; gather would additionally show its dispatch count)
+    assert "kv-attn=block" in out
     assert "TTFT" in out.splitlines()[0]
     assert "LLM serving" in render_requests({"nodes": {}})
 
