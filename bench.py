@@ -1456,6 +1456,32 @@ def _paged_tok_frac_cell() -> float | None:
     return round(paged_tok_s / slot_tok_s, 3)
 
 
+def _plane_async_frac_cell() -> float | None:
+    """Fresh plane_async_frac measurement for --gate: async
+    (ring-depth=3 ticket rings) over blocking aggregate fps, 8
+    latency-shaped streams (max-batch=2 local windows) through one
+    shared plane on the weight-bound MLP — the `--pipeline plane`
+    async cell pair, measured lean. A ratio, so host speed cancels; a
+    drop means the async submit path itself regressed (a reintroduced
+    block on the stream service thread, a ring that stopped engaging,
+    or a scheduler change that re-convoys the dispatches)."""
+    model = _plane_mlp_model()
+    n_streams, n_frames = 8, 240
+    async_fps, _, _ = _plane_run_streams(
+        model, n_streams, n_frames,
+        "plane=gate_async plane-max-batch=32 plane-timeout-ms=2 "
+        "max-batch=2 ring-depth=3",
+    )
+    sync_fps, _, _ = _plane_run_streams(
+        model, n_streams, n_frames,
+        "plane=gate_sync plane-max-batch=32 plane-timeout-ms=2 "
+        "max-batch=2",
+    )
+    if not sync_fps:
+        return None
+    return round(async_fps / sync_fps, 3)
+
+
 def _llm_equal_occupancy_tok_s(cb, prompts, budget: int) -> float:
     """Decode tok/s at EQUAL occupancy — the one methodology behind
     ``paged_tok_frac`` (`--pipeline llm` and `--gate`).
@@ -1507,6 +1533,12 @@ GATE_KEYS = {
     # block-native decode path itself regressed, e.g. a reintroduced
     # gather/scatter or view carry
     "paged_tok_frac": 0.2,
+    # async/blocking plane submit fps ratio at 8 latency-shaped
+    # streams: host speed cancels in the ratio (~1.6 on the CPU smoke
+    # vs the 1.3 acceptance bar) — a breach means blocking crept back
+    # into the stream-side submit path or the in-flight ring stopped
+    # filling dispatches
+    "plane_async_frac": 0.2,
 }
 
 # fresh in-process measurements for the backend-dependent cells —
@@ -1517,6 +1549,7 @@ GATED_CELLS = (
     ("composite_face_fps", _composite_face_cell),
     ("int8_mb8_fps", _int8_mb8_cell),
     ("paged_tok_frac", _paged_tok_frac_cell),
+    ("plane_async_frac", _plane_async_frac_cell),
 )
 
 
@@ -1810,35 +1843,14 @@ def _pipeline_batched(smoke: bool) -> None:
     print(json.dumps(rec))
 
 
-def _pipeline_plane(smoke: bool) -> None:
-    """``--pipeline plane``: N concurrent client streams through ONE
-    shared serving plane (serving_plane/, docs/serving-plane.md) vs the
-    same N streams through isolated per-stream executors at equal
-    device budget, ONE JSON line. The isolated baseline opens N
-    backends (N weight copies) and dispatches N per-frame programs; the
-    plane opens ONE and continuously batches across streams — the
-    acceptance bar is aggregate plane throughput ≥ 1.5× isolated.
-
-    The model is a weight-bound MLP (512→4096→512, ~16 MB of weights):
-    the serving-shaped regime where per-frame cost is dominated by
-    streaming the weights, so batching K frames amortizes the weight
-    traffic K× and N per-stream copies thrash the cache/HBM that one
-    shared copy keeps resident — the same shape continuous-batched LLM
-    decode lives in. ``--smoke`` pins CPU and shrinks the run."""
+def _plane_mlp_model(d_in: int = 512, d_hid: int = 4096) -> str:
+    """Write the weight-bound MLP (512→4096→512, ~16 MB of weights) the
+    plane cells share: the serving-shaped regime where per-frame cost is
+    dominated by streaming the weights, so batching K frames amortizes
+    the weight traffic K× — the same shape continuous-batched LLM
+    decode lives in."""
     import tempfile
-    import threading
 
-    import jax
-
-    if smoke:
-        jax.config.update("jax_platforms", "cpu")
-    from nnstreamer_tpu.pipeline.parse import parse_pipeline
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    n_streams = 8
-    n_frames = 300 if smoke else (1500 if on_tpu else 600)
-    d_in, d_hid = 512, 4096
     model_dir = tempfile.mkdtemp(prefix="nns_plane_bench_")
     model = os.path.join(model_dir, "mlp.py")
     with open(model, "w") as f:
@@ -1854,58 +1866,117 @@ def _pipeline_plane(smoke: bool) -> None:
             "    return (lambda x: jnp.tanh(jnp.tanh(x @ _W1) @ _W2)),"
             " None\n"
         )
+    return model
 
-    def run_streams(plane_props: str):
-        """All N pipelines concurrently; returns (sum of per-stream
-        steady fps, per-stream list, one executor's plane stats)."""
-        descs = [
-            (
-                f"tensorsrc dimensions={d_in} types=float32 "
-                f"pattern=random num-frames={n_frames} ! "
-                f"tensor_filter framework=jax model={model} "
-                f"input={d_in} inputtype=float32 {plane_props} ! "
-                "tensor_sink sync-window=8 queue-size=128"
-            )
-            for _ in range(n_streams)
-        ]
-        pipelines = [parse_pipeline(d) for d in descs]
-        execs = [None] * n_streams
-        errors = []
 
-        def drive(i: int) -> None:
-            try:
-                execs[i] = pipelines[i].run(timeout=900)
-            except Exception as exc:  # noqa: BLE001 — surfaced below
-                errors.append((i, exc))
+def _plane_run_streams(
+    model: str, n_streams: int, n_frames: int, plane_props: str,
+    d_in: int = 512,
+):
+    """All N pipelines concurrently; returns (sum of per-stream steady
+    fps, per-stream list, one executor's plane stats) — shared by
+    ``--pipeline plane`` and the ``plane_async_frac`` gate cell."""
+    import threading
 
-        threads = [
-            threading.Thread(target=drive, args=(i,))
-            for i in range(n_streams)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise RuntimeError(f"stream failures: {errors!r}")
-        per_stream = [_steady_fps(ex) or 0.0 for ex in execs]
-        plane_row = {}
-        for ex in execs:
-            for row in ex.stats().values():
-                if "plane_name" in row:
-                    plane_row = {
-                        k: v for k, v in row.items()
-                        if k.startswith("plane_")
-                        and k != "plane_per_stream"
-                    }
-                    break
-            if plane_row:
+    from nnstreamer_tpu.pipeline.parse import parse_pipeline
+
+    descs = [
+        (
+            f"tensorsrc dimensions={d_in} types=float32 "
+            f"pattern=random num-frames={n_frames} ! "
+            f"tensor_filter framework=jax model={model} "
+            f"input={d_in} inputtype=float32 {plane_props} ! "
+            "tensor_sink sync-window=8 queue-size=128"
+        )
+        for _ in range(n_streams)
+    ]
+    pipelines = [parse_pipeline(d) for d in descs]
+    execs = [None] * n_streams
+    errors = []
+
+    def drive(i: int) -> None:
+        try:
+            execs[i] = pipelines[i].run(timeout=900)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append((i, exc))
+
+    threads = [
+        threading.Thread(target=drive, args=(i,))
+        for i in range(n_streams)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"stream failures: {errors!r}")
+    per_stream = [_steady_fps(ex) or 0.0 for ex in execs]
+    plane_row = {}
+    for ex in execs:
+        for row in ex.stats().values():
+            if "plane_name" in row:
+                plane_row = {
+                    k: v for k, v in row.items()
+                    if k.startswith("plane_")
+                    and k != "plane_per_stream"
+                }
                 break
-        return sum(per_stream), per_stream, plane_row
+        if plane_row:
+            break
+    return sum(per_stream), per_stream, plane_row
 
-    iso_fps, iso_each, _ = run_streams("")
+
+def _pipeline_plane(smoke: bool) -> None:
+    """``--pipeline plane``: N concurrent client streams through ONE
+    shared serving plane (serving_plane/, docs/serving-plane.md) vs the
+    same N streams through isolated per-stream executors at equal
+    device budget, ONE JSON line. The isolated baseline opens N
+    backends (N weight copies) and dispatches N per-frame programs; the
+    plane opens ONE and continuously batches across streams — the
+    acceptance bar is aggregate plane throughput ≥ 1.5× isolated.
+
+    A second cell pair measures ASYNC submits (ring-depth=3 ticket
+    rings, docs/serving-plane.md) against blocking submits at equal
+    config: LATENCY-SHAPED streams — small local windows
+    (``max-batch=2``), so no client's frame parks in a deep local
+    collector. Blocking submits then convoy: all 8 streams wait on one
+    dispatch, the plane's queue empties every cycle, and each dispatch
+    pays the straggler wait at partial occupancy (~11/32 measured).
+    The async rings keep ~3 windows per stream in flight, so dispatches
+    stay full (~31/32) with no straggler stalls — ``plane_async_frac``
+    (async/blocking aggregate fps, the ``--gate`` key; bar ≥ 1.3×,
+    ~1.6× measured on the CPU smoke). ``--smoke`` pins CPU and shrinks
+    the run."""
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n_streams = 8
+    n_frames = 300 if smoke else (1500 if on_tpu else 600)
+    model = _plane_mlp_model()
+
+    iso_fps, iso_each, _ = _plane_run_streams(
+        model, n_streams, n_frames, ""
+    )
     _mark("isolated streams measured")
-    plane_fps, plane_each, plane_row = run_streams(
+    # async measured BEFORE its blocking comparator so any second-run
+    # jit/cache warmth favors the baseline, never the claimed win
+    async_fps, async_each, async_row = _plane_run_streams(
+        model, n_streams, n_frames,
+        "plane=bench_async plane-max-batch=32 plane-timeout-ms=2 "
+        "max-batch=2 ring-depth=3",
+    )
+    _mark("async plane streams measured")
+    sync_fps, _sync_each, sync_row = _plane_run_streams(
+        model, n_streams, n_frames,
+        "plane=bench_sync plane-max-batch=32 plane-timeout-ms=2 "
+        "max-batch=2",
+    )
+    _mark("blocking comparator measured")
+    plane_fps, plane_each, plane_row = _plane_run_streams(
+        model, n_streams, n_frames,
         "plane=bench plane-max-batch=32 plane-timeout-ms=2"
     )
     _mark("plane streams measured")
@@ -1922,6 +1993,21 @@ def _pipeline_plane(smoke: bool) -> None:
         "speedup": speedup,
         "plane_stream_min_fps": _round(min(plane_each) if plane_each else None),
         "isolated_stream_min_fps": _round(min(iso_each) if iso_each else None),
+        # async-vs-blocking cell pair (max-batch=2 latency-shaped
+        # windows, ring-depth=3): the gate key is the ratio so host
+        # speed cancels
+        "plane_async_aggregate_fps": _round(async_fps),
+        "plane_blocking_aggregate_fps": _round(sync_fps),
+        "plane_async_frac": (
+            round(async_fps / sync_fps, 3)
+            if async_fps and sync_fps else None
+        ),
+        "plane_async_stream_min_fps": _round(
+            min(async_each) if async_each else None
+        ),
+        "plane_async_inflight_ring": 3,
+        "plane_async_avg_batch": async_row.get("plane_avg_batch"),
+        "plane_blocking_avg_batch": sync_row.get("plane_avg_batch"),
         "platform": dev.platform,
         "device": str(dev.device_kind),
         "host": _platform.node(),
@@ -2130,6 +2216,8 @@ def _pipeline_llm(smoke: bool) -> None:
         _mk("paged", slot_slots), tok_prompts, tok_budget
     )
     _mark("paged tok/s measured")
+    plane_cell = _llm_through_plane_cell(model_kw, rng) or {}
+    _mark("through-plane measured")
     rec = {
         "metric": "llm_paged_vs_slot_capacity_at_fixed_kv_hbm",
         "kv_budget_tokens": budget_tokens,
@@ -2162,7 +2250,113 @@ def _pipeline_llm(smoke: bool) -> None:
         "device": str(dev.device_kind),
         "host": _platform.node(),
     }
+    rec.update(plane_cell)
     print(json.dumps(rec))
+
+
+def _llm_through_plane_cell(model_kw: dict, rng) -> dict | None:
+    """LLM pumps batched THROUGH a serving plane (serving_plane/llm.py,
+    docs/llm-serving.md): two serversink/serversrc pipeline pairs share
+    ONE plane-managed paged ContinuousBatcher (``plane=`` on the
+    serversink) — cross-stream admission rides the deficit-round-robin
+    scheduler, SLO ledgers stay per stream, and the block-native decode
+    path must stay gather-free (``llm_plane_gather_dispatches`` pinned
+    0 in the record)."""
+    import threading
+
+    import numpy as np
+
+    from nnstreamer_tpu.elements.llm_serve import (
+        LlmServerSink,
+        LlmServerSrc,
+    )
+    from nnstreamer_tpu.elements.sink import AppSink
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+    from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
+
+    opts = ",".join(
+        f"{k}:{v}" for k, v in model_kw.items()
+    ) + ",seed:7"
+    n_streams, n_reqs, budget = 2, 4, 24
+    pipes, ends = [], []
+    for k in range(n_streams):
+        src = AppSrc(spec=TensorsSpec(format=TensorFormat.FLEXIBLE))
+        sink = LlmServerSink(**{
+            "id": f"bench_pl{k}", "model": "zoo:transformer_lm",
+            "custom": opts, "n-slots": 8, "max-len": 96,
+            "prompt-len": 32, "max-new-tokens": budget, "pump": 4,
+            "plane": "llm_bench", "block-size": 16, "kv-blocks": 48,
+        })
+        osrc = LlmServerSrc(**{"id": f"bench_pl{k}"})
+        osink = AppSink()
+        p = Pipeline().chain(src, sink)
+        p.chain(osrc, osink)
+        p.start()
+        pipes.append(p)
+        ends.append((src, osink, osrc))
+    try:
+        t0 = time.perf_counter()
+        for k, (src, _, _) in enumerate(ends):
+            for i in range(n_reqs):
+                prompt = rng.integers(
+                    1, model_kw["vocab"], (16 + 4 * i,)
+                ).astype(np.int32)
+                src.push(Frame((prompt,), meta={"req": f"s{k}r{i}"}))
+            src.end_of_stream()
+        stream_toks = [0] * n_streams
+        errors = []
+        per_stream_reqs = []
+
+        def drain(k):
+            try:
+                _, osink, _ = ends[k]
+                for _ in range(n_reqs):
+                    f = osink.pop(timeout=300)
+                    if f is None:
+                        raise RuntimeError(
+                            "llm plane cell drained early"
+                        )
+                    stream_toks[k] += int(np.asarray(f.tensors[0]).size)
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append((k, exc))
+
+        threads = [
+            threading.Thread(target=drain, args=(k,))
+            for k in range(n_streams)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            # a partial drain must fail the cell, not publish a tok/s
+            # computed from whatever happened to arrive
+            raise RuntimeError(f"llm plane cell failures: {errors!r}")
+        toks = sum(stream_toks)
+        dt = time.perf_counter() - t0
+        st = None
+        for _, _, osrc in ends:
+            got = osrc.serving_stats()
+            if got:
+                per_stream_reqs.append(len(got.get("requests", {})))
+                if st is None:
+                    st = got
+    finally:
+        for p in pipes:
+            p.stop()
+    if st is None:
+        return None
+    return {
+        "llm_plane_streams": n_streams,
+        "llm_plane_requests_per_stream": n_reqs,
+        "llm_plane_tok_s": _round(toks / dt if dt > 0 else 0.0, 1),
+        "llm_plane_gather_dispatches": st.get("kv_gather_dispatches", 0),
+        "llm_plane_kv_attn": st.get("kv_attn"),
+        # per-stream SLO ledgers: each src reports ONLY its own rows
+        "llm_plane_stream_request_rows": per_stream_reqs,
+    }
 
 
 def main() -> None:

@@ -162,6 +162,16 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "into the adjacent fused segment and only the small decoded "
         "tensor ever leaves the device",
     ),
+    "NNS-W118": (
+        Severity.WARNING, "blocking-plane-submit-under-ring",
+        "a serving-plane stream that cannot overlap its submits: either "
+        "a plane filter sets ring-depth>1 but disables the local window "
+        "collector (batching=false forces per-frame blocking submits, "
+        "so the in-flight ring never engages), or several streams share "
+        "one plane with every in-flight depth left at 1 — each stream "
+        "then blocks a full plane round trip per window while the "
+        "async ticket ring would overlap submit/compute/delivery",
+    ),
     "NNS-W117": (
         Severity.WARNING, "paged-gather-materializes-cache",
         "a paged LLM serving element is pinned to kv-attn=gather, whose "

@@ -798,6 +798,79 @@ def _model_sharing_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _plane_async_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W118: blocking plane submits under a ring
+    (docs/serving-plane.md). Two shapes, both static property reads:
+
+    - a plane filter with ``ring-depth>1`` but ``batching=false``: the
+      async ticket ring rides the host WINDOW loop, so disabling the
+      local collector forces per-frame blocking submits and the ring
+      never engages;
+    - two or more streams of the same plane in this pipeline with every
+      in-flight depth left at 1 (no ``ring-depth`` and ``[plane]
+      inflight = 1``): each stream blocks a full plane round trip per
+      window — exactly the multi-stream shape async submits exist for.
+    """
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    def _depth(e) -> Optional[int]:
+        raw = e.get_property("ring-depth")
+        if raw is None:
+            return None
+        try:
+            return max(1, int(raw))
+        except (TypeError, ValueError):
+            return None  # NNS-W101/E005 already covers the bad value
+
+    cfg_inflight = 1
+    try:
+        from nnstreamer_tpu.serving_plane.plane import _plane_defaults
+
+        cfg_inflight = max(1, int(_plane_defaults()["inflight"]))
+    except Exception:  # noqa: BLE001 — a broken ini has its own warning
+        pass
+    groups: Dict[str, List] = {}
+    for e in pipeline.elements:
+        if not isinstance(e, TensorFilter):
+            continue
+        if not str(e.get_property("plane") or "").strip():
+            continue
+        groups.setdefault(str(e.get_property("plane")).strip(), []).append(e)
+        depth = _depth(e)
+        raw_batching = e.get_property("batching")
+        batching_off = (
+            raw_batching is not None
+            and str(raw_batching).strip().lower() in ("false", "0", "no")
+        )
+        if depth is not None and depth > 1 and batching_off:
+            report.add(
+                "NNS-W118", e.name,
+                f"ring-depth={depth} with batching=false: the async "
+                "in-flight ring rides the window collector, so this "
+                "stream still submits per frame, blocking a full plane "
+                "round trip each time",
+                "drop batching=false (plane filters default the "
+                "collector on, window-matched to the plane) — "
+                "docs/serving-plane.md",
+            )
+    for pname, elems in groups.items():
+        if len(elems) < 2:
+            continue
+        depths = [(_depth(e) or cfg_inflight) for e in elems]
+        if any(d > 1 for d in depths):
+            continue
+        names = ", ".join(e.name for e in elems)
+        report.add(
+            "NNS-W118", elems[0].name,
+            f"{len(elems)} streams share plane {pname!r} with every "
+            f"in-flight depth at 1 ({names}): each blocks a full plane "
+            "round trip per window instead of overlapping submits",
+            "set ring-depth=2..3 on the plane filters (or [plane] "
+            "inflight = 2) to pipeline submit/compute/delivery — "
+            "docs/serving-plane.md",
+        )
+
+
 def _kv_cache_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W115 + NNS-W117: KV caches that cannot fit their declared
     memory bound (``kv-memory-bound`` prop, or ``[llm] memory_bound``).
@@ -1219,6 +1292,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _replica_failover_pass(pipeline, report)
     _resident_handoff_pass(pipeline, report)
     _model_sharing_pass(pipeline, report)
+    _plane_async_pass(pipeline, report)
     _kv_cache_pass(pipeline, report)
     specs: Dict[str, List[Any]] = {}
     if not cyclic:

@@ -144,7 +144,10 @@ STREAM_PROPS: Dict[str, PropSpec] = {
         desc="in-flight frames per device node: H2D of frame N+1 and "
         "D2H of frame N-1 overlap compute of frame N (default "
         "[executor] ring_depth = 2; 1 = synchronous dispatch-and-"
-        "deliver; docs/streaming.md)",
+        "deliver; docs/streaming.md). On a plane= filter this is the "
+        "stream's async in-flight WINDOW ring instead (default "
+        "[plane] inflight = 1 — blocking submits; "
+        "docs/serving-plane.md)",
     ),
 }
 

@@ -365,6 +365,7 @@ class TensorFilter(TensorOp):
         self._plane_stream = None   # this filter's PlaneStream
         self._plane_cfg = None      # resolved PlaneConfig
         self._plane_last_stats: Dict[str, Any] = {}
+        self.plane_inflight = 1     # async ring depth (1 = blocking)
         if self.plane:
             # cross-stream batching rides the host batched loop: the
             # LOCAL collector drains a window per round-trip (one
@@ -377,6 +378,21 @@ class TensorFilter(TensorOp):
             )
 
             self._plane_cfg = resolve_plane_config([self])
+            # async in-flight ring depth for THIS stream
+            # (docs/serving-plane.md): the PR-8 ring-depth property
+            # outranks the [plane] inflight config default; 1 keeps
+            # blocking submits. Resolved here (not plan time) because
+            # the plane path rides the host batched loop, which only
+            # arms a ring when the element asks.
+            raw_rd = self.get_property("ring-depth")
+            if raw_rd is not None:
+                from nnstreamer_tpu.pipeline.transfer import (
+                    resolve_ring_depth,
+                )
+
+                self.plane_inflight = resolve_ring_depth([self])
+            else:
+                self.plane_inflight = self._plane_cfg.inflight
             if self.get_property("batching") is None:
                 self.set_property("batching", "true")
             if self.get_property("max-batch") is None:
@@ -1034,6 +1050,65 @@ class TensorFilter(TensorOp):
             return True
         return bool(getattr(self._ensure_open(), "batchable", False))
 
+    def _plane_window_inputs(self, frames: List[Frame]) -> List[tuple]:
+        """Per-frame model input tuples for one plane window
+        (input-combination applied) — shared by the blocking and async
+        submit paths."""
+        in_comb = self.in_combination
+        return [
+            f.tensors if in_comb is None
+            else tuple(f.tensors[i] for _, i in in_comb)
+            for f in frames
+        ]
+
+    def _finish_plane_window(
+        self, frames: List[Frame], model_outs, per: int
+    ) -> List[Frame]:
+        """Rebuild output frames from one served plane window
+        (output-combination applied, ``per``-ns stat per frame) — ONE
+        implementation so the blocking and async paths cannot drift."""
+        out_comb = self.out_combination
+        outs: List[Frame] = []
+        for f, model_out in zip(frames, model_outs):
+            self._elem_stats.record(per)
+            if out_comb is None:
+                tensors = tuple(model_out)
+            else:
+                tensors = tuple(
+                    f.tensors[i] if kind == "i" else model_out[i]
+                    for kind, i in out_comb
+                )
+            outs.append(f.with_tensors(tensors))
+        return outs
+
+    def host_submit_window_async(self, frames: List[Frame]):
+        """Non-blocking plane submit of one collected window
+        (docs/serving-plane.md): returns an opaque ticket for
+        :meth:`host_collect_window`. The executor's plane ring parks up
+        to ``plane_inflight`` tickets so window N+1 submits while N
+        computes on the plane and N−1 delivers downstream."""
+        plane = self._acquire_plane()
+        req = plane.submit_window_async(
+            self._plane_stream, self._plane_window_inputs(frames)
+        )
+        return (req, frames)
+
+    def host_collect_window(self, ticket) -> List[Frame]:
+        """Redeem one async plane ticket (strictly in submission order
+        — the executor ring is FIFO, so per-stream order is
+        structural). Raises the window's invoke error whole; the
+        executor then splits it per frame through this node's error
+        policy via :meth:`host_process`, the blocking re-invoke unit.
+        Plane outputs pass through UNTOUCHED — device arrays stay
+        device-resident for downstream consumers (the PR-8 handoff)."""
+        req, frames = ticket
+        t0 = time.perf_counter_ns()
+        model_outs = self._plane.wait_window(self._plane_stream, req)
+        # per-frame share of the RESIDUAL wait (overlap ate the rest) —
+        # the honest async latency, matching nns_plane_submit_wait_ms
+        per = (time.perf_counter_ns() - t0) // max(1, len(frames))
+        return self._finish_plane_window(frames, model_outs, per)
+
     def host_process_batch(self, frames: List[Frame]) -> List[Frame]:
         """One invoke_batched() call for the window: combinations applied
         per frame, ONE timed section (and one shared-lock acquisition)
@@ -1045,29 +1120,12 @@ class TensorFilter(TensorOp):
             # raises whole — the executor's ladder then splits per
             # frame through host_process, per-stream accounting intact.
             plane = self._acquire_plane()
-            in_comb, out_comb = self.in_combination, self.out_combination
-            model_ins = [
-                f.tensors if in_comb is None
-                else tuple(f.tensors[i] for _, i in in_comb)
-                for f in frames
-            ]
             t0 = time.perf_counter_ns()
             model_outs = plane.submit_window(
-                self._plane_stream, model_ins
+                self._plane_stream, self._plane_window_inputs(frames)
             )
             per = (time.perf_counter_ns() - t0) // max(1, len(frames))
-            outs: List[Frame] = []
-            for f, model_out in zip(frames, model_outs):
-                self._elem_stats.record(per)
-                if out_comb is None:
-                    tensors = tuple(model_out)
-                else:
-                    tensors = tuple(
-                        f.tensors[i] if kind == "i" else model_out[i]
-                        for kind, i in out_comb
-                    )
-                outs.append(f.with_tensors(tensors))
-            return outs
+            return self._finish_plane_window(frames, model_outs, per)
         sig0 = tuple((t.shape, t.dtype) for t in frames[0].tensors)
         if any(
             tuple((t.shape, t.dtype) for t in f.tensors) != sig0

@@ -60,7 +60,11 @@ def _get_server(srv_id: str, create_kw: Optional[dict] = None):
         if srv is not None and create_kw is not None and srv.eos:
             # stale server from a previous (stopped/drained) pipeline
             # run reusing this id: replace rather than resurrect — its
-            # props may differ and its eos flag would end the new stream
+            # props may differ and its eos flag would end the new
+            # stream. Its plane ref (if any) is NOT released here: the
+            # stale server's own src may still be draining pending
+            # generations through it — release rides that src's
+            # _drop_server, which always releases the server it held.
             srv = None
         if srv is None:
             if create_kw is None:
@@ -76,10 +80,79 @@ def _drop_server(srv_id: str, srv) -> None:
     """Remove the table entry — but only if it is still ``srv``: another
     pipeline may have reused the id with a fresh server, and a src that
     stopped before ever acquiring its server (srv None) must not evict a
-    live entry another pipeline registered under the same id."""
+    live entry another pipeline registered under the same id. A
+    plane-attached server also drops its plane ref (last sharer out
+    closes the shared batcher) — unconditionally on ``srv``, not just
+    when the table entry still matched: a stale server replaced by a
+    fresh one under the same id would otherwise leak its ref forever.
+    release_plane is idempotent, so the drained-then-stopped src's two
+    calls release once."""
     with _table_lock:
         if srv is not None and _table.get(srv_id) is srv:
             _table.pop(srv_id, None)
+    if srv is not None:
+        srv.release_plane()
+
+
+def _build_batcher(model: str, options: Dict[str, str], n_slots: int,
+                   max_len: int, prompt_len: int, speculate: int,
+                   speculate_model: str, kv_layout: str, block_size: int,
+                   kv_blocks: int, cache_dtype: str, prefill_chunks: int,
+                   kv_attn: str):
+    """Open the zoo model (+ optional draft) and build the
+    ContinuousBatcher — shared by the private-server path and the
+    LlmPlane opener (serving_plane/llm.py), so through-plane serving
+    runs the EXACT construction a solo serversink would."""
+    from nnstreamer_tpu.models import zoo
+    from nnstreamer_tpu.models.serving import ContinuousBatcher
+
+    if not model.startswith("zoo:"):
+        raise ElementError(
+            f"tensor_llm_serversink: model must be zoo:<name>, got "
+            f"{model!r}"
+        )
+    m = zoo.get(model[len("zoo:"):], **options)
+    n_heads = int(options.get("n_heads", 8))
+    draft_kw = {}
+    if speculate_model:
+        # speculate-model=zoo:<name>: a draft model proposes the
+        # speculate=k chunks instead of prompt-lookup. Its config
+        # rides in the same custom dict under draft_-prefixed keys
+        # (draft_d_model, draft_n_layers, draft_n_heads, ...); the
+        # vocab must match the target's.
+        if not speculate_model.startswith("zoo:"):
+            raise ElementError(
+                f"tensor_llm_serversink: speculate-model must be "
+                f"zoo:<name>, got {speculate_model!r}"
+            )
+        d_opts = {
+            k[len("draft_"):]: v for k, v in options.items()
+            if k.startswith("draft_")
+        }
+        if "vocab" in options and "vocab" not in d_opts:
+            d_opts["vocab"] = options["vocab"]
+        dm = zoo.get(speculate_model[len("zoo:"):], **d_opts)
+        draft_kw = dict(
+            draft_params=dm.params,
+            draft_n_heads=int(d_opts.get("n_heads", 8)),
+        )
+    kv_kw = {}
+    if kv_layout != "slot":
+        # paged KV (nnstreamer_tpu/kv/, docs/llm-serving.md):
+        # block-table cache with prefix sharing, chunked prefill
+        # and preemption-by-eviction; incompatible with a draft
+        # model for now (ContinuousBatcher validates)
+        kv_kw = dict(
+            kv_layout=kv_layout, block_size=block_size,
+            kv_blocks=kv_blocks or None,
+            prefill_chunks=prefill_chunks,
+            kv_attn=kv_attn or "auto",
+        )
+    return ContinuousBatcher(
+        m.params, n_heads, n_slots=n_slots, max_len=max_len,
+        prompt_len=prompt_len, cache_dtype=cache_dtype,
+        **kv_kw, **draft_kw,
+    )
 
 
 class _LlmServer:
@@ -91,62 +164,73 @@ class _LlmServer:
                  speculate_model: str = "", pump_tokens: int = 1,
                  kv_layout: str = "slot", block_size: int = 16,
                  kv_blocks: int = 0, cache_dtype: str = "auto",
-                 prefill_chunks: int = 1, kv_attn: str = "auto"):
-        from nnstreamer_tpu.models import zoo
-        from nnstreamer_tpu.models.serving import ContinuousBatcher
-
-        if not model.startswith("zoo:"):
-            raise ElementError(
-                f"tensor_llm_serversink: model must be zoo:<name>, got "
-                f"{model!r}"
-            )
-        m = zoo.get(model[len("zoo:"):], **options)
-        n_heads = int(options.get("n_heads", 8))
-        draft_kw = {}
+                 prefill_chunks: int = 1, kv_attn: str = "auto",
+                 plane: str = "", plane_weight: float = 1.0,
+                 srv_id: str = "0"):
         if speculate_model and speculate != -1 and speculate < 2:
             # a draft model exists ONLY to propose speculate=k chunks;
             # without this, every request would pay the draft prefill
             # for a proposer the plain-step pump never consults
             speculate = 4
-        if speculate_model:
-            # speculate-model=zoo:<name>: a draft model proposes the
-            # speculate=k chunks instead of prompt-lookup. Its config
-            # rides in the same custom dict under draft_-prefixed keys
-            # (draft_d_model, draft_n_layers, draft_n_heads, ...); the
-            # vocab must match the target's.
-            if not speculate_model.startswith("zoo:"):
+        self.plane_name = plane
+        self._plane = None   # LlmPlane once acquired
+        self._stream = None  # this server's LlmStream
+        if plane:
+            # plane=<name> (docs/llm-serving.md): this serversink is one
+            # client stream of a SHARED paged batcher — the tensor
+            # plane's discipline at token granularity. The features that
+            # assume a private batcher are rejected with the reason:
+            if kv_layout != "paged":
                 raise ElementError(
-                    f"tensor_llm_serversink: speculate-model must be "
-                    f"zoo:<name>, got {speculate_model!r}"
+                    f"tensor_llm_serversink: plane={plane!r} needs "
+                    "kv-layout=paged (the shared batcher is the paged "
+                    "arena; slot caches are per-server by construction)"
                 )
-            d_opts = {
-                k[len("draft_"):]: v for k, v in options.items()
-                if k.startswith("draft_")
-            }
-            if "vocab" in options and "vocab" not in d_opts:
-                d_opts["vocab"] = options["vocab"]
-            dm = zoo.get(speculate_model[len("zoo:"):], **d_opts)
-            draft_kw = dict(
-                draft_params=dm.params,
-                draft_n_heads=int(d_opts.get("n_heads", 8)),
+            if speculate or speculate_model:
+                raise ElementError(
+                    f"tensor_llm_serversink: plane={plane!r} cannot "
+                    "combine with speculate/speculate-model (the "
+                    "speculation controller state is per-server)"
+                )
+            if stream:
+                raise ElementError(
+                    f"tensor_llm_serversink: plane={plane!r} cannot "
+                    "combine with stream=true (per-token routing "
+                    "through a shared plane is not wired yet)"
+                )
+            from nnstreamer_tpu.serving_plane import llm as llm_plane
+
+            sig = (
+                model, tuple(sorted(options.items())), n_slots, max_len,
+                prompt_len, kv_layout, block_size, kv_blocks,
+                cache_dtype, prefill_chunks, kv_attn or "auto",
+                max(1, int(pump_tokens)),
             )
-        kv_kw = {}
-        if kv_layout != "slot":
-            # paged KV (nnstreamer_tpu/kv/, docs/llm-serving.md):
-            # block-table cache with prefix sharing, chunked prefill
-            # and preemption-by-eviction; incompatible with a draft
-            # model for now (ContinuousBatcher validates)
-            kv_kw = dict(
-                kv_layout=kv_layout, block_size=block_size,
-                kv_blocks=kv_blocks or None,
-                prefill_chunks=prefill_chunks,
-                kv_attn=kv_attn or "auto",
+            self._plane = llm_plane.acquire(
+                plane, sig,
+                opener=lambda: _build_batcher(
+                    model, options, n_slots, max_len, prompt_len,
+                    speculate, speculate_model, kv_layout, block_size,
+                    kv_blocks, cache_dtype, prefill_chunks, kv_attn,
+                ),
+                pump_tokens=pump_tokens,
             )
-        self.cb = ContinuousBatcher(
-            m.params, n_heads, n_slots=n_slots, max_len=max_len,
-            prompt_len=prompt_len, cache_dtype=cache_dtype,
-            **kv_kw, **draft_kw,
-        )
+            try:
+                self._stream = self._plane.attach(srv_id, plane_weight)
+            except ValueError:
+                # same id string attached elsewhere in this process:
+                # disambiguate rather than refuse (ids are only unique
+                # per pairing)
+                self._stream = self._plane.attach(
+                    f"{srv_id}@{id(self) & 0xffff:04x}", plane_weight
+                )
+            self.cb = self._plane.cb
+        else:
+            self.cb = _build_batcher(
+                model, options, n_slots, max_len, prompt_len, speculate,
+                speculate_model, kv_layout, block_size, kv_blocks,
+                cache_dtype, prefill_chunks, kv_attn,
+            )
         self.default_new = default_new
         self._lock = threading.Lock()
         self._pending: Dict[int, dict] = {}  # rid -> request meta
@@ -197,6 +281,16 @@ class _LlmServer:
             # SLO accounting (nns-top --requests); the edge layer's
             # deadline shedding is upstream of this element
             kw["deadline_s"] = float(frame.meta["deadline_ms"]) / 1000.0
+        if self._plane is not None:
+            # through-plane serving: the prompt queues for weighted-fair
+            # admission into the SHARED batcher (serving_plane/llm.py);
+            # backpressure past the fair backlog pumps inside submit
+            if self.stopped:
+                raise ElementError("tensor_llm_serversink: stopped")
+            self._plane.submit(
+                self._stream, prompt, budget, kw, dict(frame.meta)
+            )
+            return
         while True:
             if self.stopped:
                 raise ElementError("tensor_llm_serversink: stopped")
@@ -215,6 +309,11 @@ class _LlmServer:
     def pump(self) -> bool:
         """One decode step; harvest finished requests (and, in streaming
         mode, every new token). True if anything advanced."""
+        if self._plane is not None:
+            # the SHARED batcher advances every stream's requests; this
+            # server's finished generations land on its own plane
+            # stream deque (pop reads them there)
+            return self._plane.pump()
         N = self.pump_tokens
         if self.speculate == -1:
             if N > 1:
@@ -293,6 +392,11 @@ class _LlmServer:
         (VERDICT r4 #5: a silent proposer regression shows up here as a
         sagging acceptance rate / k pinned at 2 — visible in --stats,
         not only in wall time)."""
+        if self._plane is not None:
+            # shared-batcher counters + ONLY this stream's request rows
+            # (per-stream SLO ledgers: sharers never report each
+            # other's — serving_plane/llm.py)
+            return self._plane.stats_for(self._stream)
         st = self.cb.stats()
         # per-request SLO rows for nns-top --requests (serving_requests
         # once the executor prefixes the row)
@@ -311,13 +415,33 @@ class _LlmServer:
         return st
 
     def pop(self):
+        if self._plane is not None:
+            return self._plane.pop(self._stream)
         with self._lock:
             return self._out.popleft() if self._out else None
 
     @property
     def drained(self) -> bool:
+        if self._plane is not None:
+            return self.eos and self._plane.idle_for(self._stream)
         with self._lock:
             return self.eos and not self._pending and not self._out
+
+    def release_plane(self) -> None:
+        """Detach from (and drop one ref of) the shared LLM plane —
+        called when this server leaves the pairing table. Idempotent
+        (the src calls it at drain AND at stop) and race-guarded under
+        ``_lock``; no-op for private-batcher servers."""
+        with self._lock:
+            plane, self._plane = self._plane, None
+        if plane is None:
+            return
+        from nnstreamer_tpu.serving_plane import llm as llm_plane
+
+        if self._stream is not None:
+            plane.detach(self._stream)
+        llm_plane.release(self.plane_name, plane)
+        self.cb = None
 
 
 @registry.element("tensor_llm_serversink")
@@ -385,6 +509,20 @@ class LlmServerSink(Sink):
         "kv-memory-bound": PropSpec(
             "str", "", desc="declared KV HBM bound (lint NNS-W115)"
         ),
+        # through-plane serving (serving_plane/llm.py,
+        # docs/llm-serving.md): serversinks naming one plane share ONE
+        # paged ContinuousBatcher — cross-stream admission rides the
+        # deficit-round-robin scheduler, SLO ledgers stay per stream
+        "plane": PropSpec(
+            "str", "",
+            desc="attach to the named process-wide LLM serving plane "
+            "(shared paged batcher; requires kv-layout=paged)",
+        ),
+        "plane-weight": PropSpec(
+            "float", 1.0,
+            desc="this stream's weighted-fair admission share on the "
+            "LLM plane (default 1.0)",
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -405,6 +543,14 @@ class LlmServerSink(Sink):
         kv_layout = str(self.get_property("kv-layout", "")).strip() or (
             cfg.get("llm", "kv_layout", "slot")
         )
+        if (
+            str(self.get_property("plane", "") or "")
+            and not str(self.get_property("kv-layout", "")).strip()
+            and kv_layout == "slot"
+        ):
+            # plane= means "the shared paged batcher" — an unset
+            # kv-layout follows the plane rather than the slot default
+            kv_layout = "paged"
         kv_attn = str(self.get_property("kv-attn", "")).strip() or (
             cfg.get("llm", "kv_attn", "auto")
         )
@@ -437,6 +583,9 @@ class LlmServerSink(Sink):
             cache_dtype=str(self.get_property("cache-dtype", "auto")),
             prefill_chunks=prefill_chunks,
             kv_attn=kv_attn,
+            plane=str(self.get_property("plane", "") or ""),
+            plane_weight=float(self.get_property("plane-weight", 1.0)),
+            srv_id=self.srv_id,
         )
         self._server: Optional[_LlmServer] = None
 

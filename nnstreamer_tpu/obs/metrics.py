@@ -110,6 +110,18 @@ METRIC_CATALOG: Dict[str, str] = {
         "by plane and stream label (counter; admitted minus served is "
         "the stream's in-flight/errored tail — docs/serving-plane.md)"
     ),
+    "nns_plane_inflight_windows": (
+        "windows submitted to a serving plane but not yet collected by "
+        "their stream's async ticket wait, by plane label (gauge; ~0 "
+        "under blocking submits, up to streams × ring-depth when the "
+        "async in-flight rings are full — docs/serving-plane.md)"
+    ),
+    "nns_plane_submit_wait_ms": (
+        "time a stream spent BLOCKED per plane window — the full round "
+        "trip for blocking submits, the residual ticket wait for async "
+        "ones (overlap eats the rest), milliseconds, by plane label "
+        "(histogram; docs/serving-plane.md)"
+    ),
     "nns_kv_blocks_in_use": (
         "KV-cache blocks currently referenced by live requests in a "
         "paged continuous batcher (gauge; capacity vs kv_blocks is the "

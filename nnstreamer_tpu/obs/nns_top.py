@@ -193,7 +193,8 @@ def render_clients(snap: dict) -> str:
 
 _MODEL_COLUMNS = (
     ("PLANE", 16), ("MODE", 10), ("DEV", 5), ("STREAMS", 9),
-    ("Q", 5), ("DISP", 8), ("BATCH", 7), ("OCC%", 7), ("FRAMES", 0),
+    ("Q", 5), ("INFL", 6), ("DISP", 8), ("BATCH", 7), ("OCC%", 7),
+    ("FRAMES", 0),
 )
 
 
@@ -223,6 +224,9 @@ def render_models(snap: dict) -> str:
             str(row.get("plane_devices", "-")),
             str(row.get("plane_streams", "-")),
             str(row.get("plane_queue_depth", "-")),
+            # async in-flight windows parked across the plane's stream
+            # rings (docs/serving-plane.md); 0/- under blocking submits
+            str(row.get("plane_inflight", "-")),
             str(row.get("plane_dispatches", "-")),
             _num(row, "plane_avg_batch"),
             _num(row, "plane_occupancy_pct"),
@@ -239,6 +243,7 @@ def render_models(snap: dict) -> str:
                     f"  {str(sid)[:20]}: admitted={s.get('admitted', 0)} "
                     f"served={s.get('served', 0)} "
                     f"queued={s.get('queued', 0)} "
+                    f"inflight={s.get('inflight', 0)} "
                     f"errors={s.get('errors', 0)} "
                     f"weight={s.get('weight', 1.0)}"
                 )

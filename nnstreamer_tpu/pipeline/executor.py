@@ -1098,6 +1098,36 @@ class FusedNode(Node):
         self.broadcast_eos()
 
 
+class _PlaneWindowRing:
+    """In-flight PLANE-WINDOW FIFO for the async submit loop: entries
+    are (frames, ticket, wait_s) tuples parked between
+    submit_window_async and the ordered host_collect_window. Exposed as
+    the node's ``_ring`` so ``Node.inflight()`` — the drain/watchdog
+    surface — counts the parked FRAMES (a ticket holds a whole
+    window)."""
+
+    __slots__ = ("_q",)
+
+    def __init__(self) -> None:
+        self._q: deque = deque()
+
+    def __len__(self) -> int:
+        return sum(len(e[0]) for e in self._q)
+
+    def __bool__(self) -> bool:
+        return bool(self._q)
+
+    @property
+    def windows(self) -> int:
+        return len(self._q)
+
+    def append(self, entry) -> None:
+        self._q.append(entry)
+
+    def popleft(self):
+        return self._q.popleft()
+
+
 class TensorOpHostNode(Node):
     """Host-path adapter for non-traceable TensorOps (e.g. tensor_filter
     with a torch/tflite backend) — a fusion barrier."""
@@ -1187,6 +1217,18 @@ class TensorOpHostNode(Node):
             # host elements sit outside fused segments, so plan time did
             # not hand them a shared stats object
             elem.batch_stats = BatchStats()
+        depth = int(getattr(elem, "plane_inflight", 1) or 1)
+        if (
+            depth > 1
+            and getattr(elem, "plane", "")
+            and hasattr(elem, "host_submit_window_async")
+        ):
+            # async serving-plane submits (docs/serving-plane.md):
+            # collected windows ride tickets through a per-stream
+            # in-flight ring instead of blocking the service thread
+            # for the full plane round trip
+            self._run_plane_async(cfg, gate, depth)
+            return
         pol = getattr(elem, "device_policy", None)
         if pol is not None and pol.get("oom-policy") == "degrade":
             self.bucket_governor = BucketGovernor(
@@ -1259,6 +1301,101 @@ class TensorOpHostNode(Node):
             if gov is not None and gov.on_ok(len(chunk)):
                 self._update_degraded_gauge()
         return outs
+
+    def _run_plane_async(self, cfg, gate, depth: int) -> None:
+        """Async plane submits (docs/serving-plane.md): each collected
+        window SUBMITS as a non-blocking ticket; the oldest ticket is
+        redeemed — and its frames delivered — only once ``depth``
+        tickets are in flight (or input idles / EOS flushes), so window
+        N+1 submits while the plane computes window N and window N−1
+        delivers downstream. Delivery is strictly FIFO at every depth
+        (per-stream order is structural), and a failed in-flight window
+        splits per frame through THIS node's own error-policy gate via
+        the blocking single-frame submit — the PR-3/6/7 fault/NACK/
+        deadline accounting stays per stream, identical to the sync
+        path. Plane outputs deliver untouched: device arrays stay
+        resident for device-capable consumers (the PR-8 handoff)."""
+        elem = self.elem
+        pol = getattr(elem, "device_policy", None)
+        if pol is not None and pol.get("oom-policy") == "degrade":
+            # async keeps the sync path's OOM degradation ladder:
+            # failed windows re-run through _invoke_host_window below,
+            # and the collector caps at the governor's live ceiling
+            self.bucket_governor = BucketGovernor(
+                cfg.buckets, cooldown_s=pol["oom-reprobe-ms"] / 1000.0
+            )
+        gov = self.bucket_governor
+        self._apply_pending_restore()
+        collector = self.make_batch_collector(
+            cfg, elem, cap=(gov.cap if gov is not None else None)
+        )
+        stats = elem.batch_stats
+        ring = _PlaneWindowRing()
+        self._ring = ring
+        chan = self.in_queues[0]
+
+        def deliver_one() -> None:
+            frames, ticket, wait_s = ring.popleft()
+            # timed from delivery start, not submit: a parked window
+            # intentionally waits depth-1 dispatches in the ring, and
+            # folding that into the node's batch latency would read as
+            # a depth× slowdown — the residual redeem wait is the
+            # honest async number (matching nns_plane_submit_wait_ms)
+            t0 = time.perf_counter()
+            if ticket is None:
+                # submit itself failed: blocking re-invoke through the
+                # sync ladder (OOM governor shrink, then per-frame
+                # gate split — the exact _run_batched semantics)
+                outs = self._invoke_host_window(frames, gate)
+            else:
+                try:
+                    outs = elem.host_collect_window(ticket)
+                except _Stop:
+                    raise
+                except Exception:
+                    # failed in-flight window: re-run it through the
+                    # SAME degradation ladder the sync path uses — an
+                    # OOM shrinks the governor ceiling and retries,
+                    # anything else splits per frame through this
+                    # node's gate (or re-raises with no gate), so
+                    # enabling async never changes fault semantics
+                    outs = self._invoke_host_window(frames, gate)
+            stats.record(len(frames), len(frames), wait_s)
+            self.stat_batch(t0, len(frames), len(frames), wait_s)
+            for f in outs:
+                self.push_out(0, f)
+
+        while True:
+            while ring and len(chan) == 0:
+                # idle input: drain the in-flight windows in order so
+                # latency stays bounded (the _FrameRing idle-flush
+                # discipline) instead of parking them until the next
+                # arrival
+                deliver_one()
+            frames, eos, wait_s = collector.collect()
+            if frames:
+                frames = [
+                    f for f in frames if not self.shed_if_expired(f)
+                ]
+            if frames:
+                ticket = None
+                try:
+                    ticket = elem.host_submit_window_async(frames)
+                except _Stop:
+                    raise
+                except Exception:
+                    if gate is None:
+                        raise
+                ring.append((frames, ticket, wait_s))
+                while ring.windows >= depth:
+                    deliver_one()
+            if eos:
+                while ring:
+                    deliver_one()
+                for f in elem.flush():
+                    self.push_out(0, f)
+                break
+        self.broadcast_eos()
 
 
 class HostNode(Node):

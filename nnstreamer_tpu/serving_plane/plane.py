@@ -9,8 +9,9 @@ dispatches ONE device program for all of them (Hermes/StreamTensor's
 shared-accelerator multiplexing, PAPERS.md). What each stream keeps:
 
 - **FIFO order** — requests complete in per-stream submission order
-  (the scheduler pops each stream's queue left-to-right and a stream's
-  executor thread submits one frame at a time).
+  (the scheduler pops each stream's queue left-to-right, and a stream's
+  executor thread submits — and, under async tickets, collects — in
+  order).
 - **Fault accounting** — a failed batch splits per frame, so only the
   failing frame's stream sees the error; it surfaces in THAT stream's
   executor as an ordinary invoke error, where the PR-3 FaultGate
@@ -69,6 +70,11 @@ class PlaneConfig:
     unhealthy_after: int = 3
     probe_every: int = 64
     submit_timeout_s: float = 30.0
+    # default per-stream in-flight ring depth for ASYNC submits
+    # (docs/serving-plane.md): 1 keeps the blocking submit discipline;
+    # an element-level ring-depth= outranks it per stream, so it stays
+    # out of signature() — sharers may legitimately differ
+    inflight: int = 1
 
     def signature(self) -> tuple:
         return (
@@ -106,6 +112,7 @@ def _plane_defaults() -> Dict[str, Any]:
         "unhealthy_after": _num("unhealthy_after", int, 3),
         "probe_every": _num("probe_every", int, 64),
         "submit_timeout_s": _num("submit_timeout_s", float, 30.0),
+        "inflight": _num("inflight", int, 1),
     }
 
 
@@ -154,6 +161,7 @@ def resolve_plane_config(elements) -> PlaneConfig:
         unhealthy_after=max(1, int(d["unhealthy_after"])),
         probe_every=max(1, int(d["probe_every"])),
         submit_timeout_s=max(0.1, float(d["submit_timeout_s"])),
+        inflight=max(1, min(32, int(d["inflight"]))),
     )
 
 
@@ -165,7 +173,7 @@ class _Req:
     blocking submits would gate every stream on two thread wakes per
     frame."""
 
-    __slots__ = ("frames", "out", "exc", "done", "abandoned")
+    __slots__ = ("frames", "out", "exc", "done", "abandoned", "ahead")
 
     def __init__(self, frames) -> None:
         self.frames = frames
@@ -176,6 +184,11 @@ class _Req:
         # window: a recovering service thread must not credit `served`
         # for frames nobody waits on
         self.abandoned = False
+        # windows of the SAME stream already in flight when this one was
+        # submitted: the wait-side stall grant scales by it (a deep ring
+        # legitimately waits several dispatches, but only while the
+        # plane keeps making progress)
+        self.ahead = 0
 
 
 class ModelPlane:
@@ -209,9 +222,15 @@ class ModelPlane:
         self.dispatches = 0
         self.frames = 0
         self.split_dispatches = 0
+        # total windows submitted-but-not-yet-collected across streams
+        # (inc under the plane lock at submit, dec at wait-side
+        # resolution — the async ring's live depth)
+        self._inflight_total = 0
         self._metrics = obs_metrics.get()
         self._occ_hist = None
         self._depth_gauge = None
+        self._inflight_gauge = None
+        self._wait_hist = None
         if self._metrics is not None:
             self._occ_hist = self._metrics.histogram(
                 "nns_plane_batch_occupancy", lo=1.0, growth=2.0 ** 0.5,
@@ -219,6 +238,12 @@ class ModelPlane:
             )
             self._depth_gauge = self._metrics.gauge(
                 "nns_plane_queue_depth", plane=name
+            )
+            self._inflight_gauge = self._metrics.gauge(
+                "nns_plane_inflight_windows", plane=name
+            )
+            self._wait_hist = self._metrics.histogram(
+                "nns_plane_submit_wait_ms", plane=name
             )
         self._thread = threading.Thread(
             target=self._serve, name=f"nns-plane-{name}", daemon=True
@@ -252,6 +277,17 @@ class ModelPlane:
     def detach(self, stream: PlaneStream) -> None:
         with self._cond:
             pending = self._sched.remove(stream)
+            # tickets the stream never redeemed (executor torn down
+            # with windows parked in its ring) would inflate the
+            # plane-wide in-flight counter forever — reconcile them
+            # out with the leaving stream
+            if stream.inflight > 0:
+                self._inflight_total = max(
+                    0, self._inflight_total - stream.inflight
+                )
+                stream.inflight = 0
+                if self._inflight_gauge is not None:
+                    self._inflight_gauge.set(self._inflight_total)
         for req in pending:
             # a detaching stream's queued frames get a terminal outcome,
             # never a silent hang (the PR-6 disposal discipline)
@@ -262,59 +298,120 @@ class ModelPlane:
             req.done.set()
 
     # -- submission (executor node threads) --------------------------------
-    def submit_window(
+    def submit_window_async(
         self, stream: PlaneStream, windows: List[Tuple[Any, ...]]
-    ) -> List[Tuple[Any, ...]]:
-        """Enqueue one window of tensor tuples and block until the
-        plane serves it (the stream's executor thread is the caller, so
-        per-stream FIFO is structural). Returns per-frame output
-        tuples; raises the underlying invoke error for THIS window only
-        — batchmates from other streams are unaffected."""
+    ) -> _Req:
+        """Enqueue one window of tensor tuples WITHOUT waiting: returns
+        a ticket the submitter redeems with :meth:`wait_window` —
+        strictly in submission order, which keeps per-stream FIFO
+        structural exactly like the blocking path (the stream's
+        executor thread is the only submitter AND the only collector).
+        The stream's in-flight ring (docs/serving-plane.md) is the
+        caller's: it holds up to ``ring-depth``/``[plane] inflight``
+        tickets so window N+1 submits while N computes and N−1
+        delivers."""
         req = _Req(windows)
         with self._cond:
             if self._closed:
                 raise PlaneClosedError(f"plane {self.name!r} is closed")
+            req.ahead = stream.inflight
             stream.q.append(req)
             stream.admitted += len(windows)
+            stream.inflight += 1
+            self._inflight_total += 1
             if stream._admit_ctr is not None:
                 stream._admit_ctr.inc(len(windows))
+            if self._inflight_gauge is not None:
+                self._inflight_gauge.set(self._inflight_total)
             self._cond.notify_all()
-        deadline = time.monotonic() + self.cfg.submit_timeout_s
-        extended = False
-        while not req.done.wait(0.05):
-            if time.monotonic() < deadline:
-                continue
-            # retract the request if it is still queued, so a timed-out
-            # (and possibly retried) window is never ALSO served later
-            # by a recovering service thread — double-invoking the
-            # frames and crediting `served` nobody waits on
-            with self._cond:
-                try:
-                    stream.q.remove(req)
-                    retracted = True
-                except ValueError:
-                    retracted = False  # already collected: in flight
-            if retracted:
+        return req
+
+    def wait_window(
+        self, stream: PlaneStream, req: _Req
+    ) -> List[Tuple[Any, ...]]:
+        """Redeem a ticket: block until the plane serves (or fails) the
+        window. Returns per-frame output tuples; raises the underlying
+        invoke error for THIS window only — batchmates from other
+        streams are unaffected.
+
+        Stall discipline: while the window is still QUEUED the wait is
+        one ``submit_timeout_s`` (then the request retracts, so a
+        timed-out-and-retried window is never ALSO served later by a
+        recovering service thread). Once IN FLIGHT the grant scales
+        with the windows ahead of it at submit time — a depth-k ring
+        legitimately waits k dispatches — but every grant past the
+        first requires the plane to have DISPATCHED something since the
+        last check: a wedged service thread surfaces after at most
+        2×``submit_timeout_s`` regardless of ring depth, instead of the
+        depth masking it."""
+        t_wait0 = time.perf_counter()
+        try:
+            deadline = time.monotonic() + self.cfg.submit_timeout_s
+            max_ext = 1 + max(0, req.ahead)
+            extensions = 0
+            last_dispatches = self.dispatches
+            while not req.done.wait(0.05):
+                if time.monotonic() < deadline:
+                    continue
+                with self._cond:
+                    try:
+                        stream.q.remove(req)
+                        retracted = True
+                    except ValueError:
+                        retracted = False  # already collected: in flight
+                if retracted:
+                    raise PlaneClosedError(
+                        f"plane {self.name!r}: no service within "
+                        f"{self.cfg.submit_timeout_s}s (service thread "
+                        "dead or program wedged)"
+                    )
+                progressed = self.dispatches != last_dispatches
+                last_dispatches = self.dispatches
+                if extensions == 0 or (
+                    progressed and extensions < max_ext
+                ):
+                    # in flight: the dispatch may legitimately be slow
+                    # (a cold compile, or windows ahead in the ring) —
+                    # grant another full window, but past the first
+                    # only while dispatches keep landing
+                    extensions += 1
+                    deadline = time.monotonic() + self.cfg.submit_timeout_s
+                    continue
+                req.abandoned = True
                 raise PlaneClosedError(
-                    f"plane {self.name!r}: no service within "
-                    f"{self.cfg.submit_timeout_s}s (service thread "
-                    "dead or program wedged)"
+                    f"plane {self.name!r}: in-flight window unserved "
+                    f"after {(1 + extensions) * self.cfg.submit_timeout_s}"
+                    "s without dispatch progress (program wedged)"
                 )
-            if not extended:
-                # in flight: the dispatch may legitimately be slow (a
-                # cold compile); grant one more full window before
-                # declaring the plane wedged
-                extended = True
-                deadline = time.monotonic() + self.cfg.submit_timeout_s
-                continue
-            req.abandoned = True
-            raise PlaneClosedError(
-                f"plane {self.name!r}: in-flight window unserved after "
-                f"{2 * self.cfg.submit_timeout_s}s (program wedged)"
-            )
+        finally:
+            with self._lock:
+                # conditional: detach() may have already reconciled
+                # this stream's tickets out of the totals — a late
+                # waiter must not debit another stream's contribution
+                if stream.inflight > 0:
+                    stream.inflight -= 1
+                    self._inflight_total = max(
+                        0, self._inflight_total - 1
+                    )
+                    if self._inflight_gauge is not None:
+                        self._inflight_gauge.set(self._inflight_total)
+            if self._wait_hist is not None:
+                self._wait_hist.observe(
+                    (time.perf_counter() - t_wait0) * 1000.0
+                )
         if req.exc is not None:
             raise req.exc
         return req.out
+
+    def submit_window(
+        self, stream: PlaneStream, windows: List[Tuple[Any, ...]]
+    ) -> List[Tuple[Any, ...]]:
+        """Blocking submit: one async ticket redeemed immediately (the
+        ``inflight=1`` discipline; also the error-policy split's
+        re-invoke unit)."""
+        return self.wait_window(
+            stream, self.submit_window_async(stream, windows)
+        )
 
     def submit(self, stream: PlaneStream, frame):
         """Single-frame convenience over :meth:`submit_window` (the
@@ -459,6 +556,7 @@ class ModelPlane:
             "max_batch": self.cfg.max_batch,
             "streams": len(self._sched),
             "queue_depth": self._sched.backlog,
+            "inflight": self._inflight_total,
             "dispatches": self.dispatches,
             "frames": self.frames,
             "split_dispatches": self.split_dispatches,

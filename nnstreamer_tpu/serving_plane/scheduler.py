@@ -43,7 +43,7 @@ class PlaneStream:
     """
 
     __slots__ = ("sid", "weight", "deficit", "q", "admitted", "served",
-                 "errors", "_admit_ctr", "_serve_ctr")
+                 "errors", "inflight", "_admit_ctr", "_serve_ctr")
 
     def __init__(self, sid: str, weight: float = 1.0) -> None:
         self.sid = sid
@@ -53,6 +53,11 @@ class PlaneStream:
         self.admitted = 0
         self.served = 0
         self.errors = 0
+        # async tickets outstanding (submitted, not yet collected by the
+        # stream's wait_window) — inc under the plane lock at submit,
+        # dec at wait-side resolution; 0 under blocking submits between
+        # round trips
+        self.inflight = 0
         # nns-obs counter handles, wired by the plane when metrics are on
         self._admit_ctr = None
         self._serve_ctr = None
@@ -70,6 +75,7 @@ class PlaneStream:
             "admitted": self.admitted,
             "served": self.served,
             "errors": self.errors,
+            "inflight": self.inflight,
         }
 
 
