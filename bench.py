@@ -2110,6 +2110,221 @@ def _pipeline_composite(smoke: bool) -> None:
     }))
 
 
+def _pipeline_edge(smoke: bool) -> None:
+    """``--pipeline edge``: the fleet/fanout benchmark (ROADMAP item 5,
+    docs/edge-serving.md "Running a fleet"), ONE JSON line. Cells:
+
+    - ``one_endpoint_fps`` / ``three_endpoint_fps`` — aggregate
+      request/reply throughput of N concurrent ``tensor_query_client``
+      fleets against 1 vs 3 admission-bounded echo servers (loopback
+      TCP; the fanout win is server-side parallelism + per-endpoint
+      queues), and their ratio ``fanout_speedup``;
+    - ``kill_failover_gap_ms`` — during the 3-endpoint run one server
+      is HARD-killed mid-stream; the gap is the worst per-request
+      latency the fleet observed around the kill (the failover cost);
+    - ``kill_duplicate_replies`` / ``kill_failovers`` — at-most-once
+      bookkeeping under the kill (duplicates must stay 0 delivered —
+      the counter counts *dropped* late replies);
+    - ``shm_rtt_fps`` / ``grpc_push_fps`` — optional same-host cells
+      where the toolchain/grpcio are available (the zero-socket shm
+      query pair and the gRPC bridge push path).
+
+    ``--smoke`` shrinks counts; never run concurrently with a tier-1
+    measurement."""
+    import threading
+
+    import numpy as np
+
+    from nnstreamer_tpu.edge.query import TensorQueryClient
+    from nnstreamer_tpu.pipeline.parse import parse_pipeline
+    from nnstreamer_tpu.tensors.frame import Frame
+
+    n_clients = 3 if smoke else 6
+    # even --smoke keeps enough requests that the mid-run kill lands
+    # INSIDE the traffic window (the gap cell nulls when it misses)
+    n_requests = 120 if smoke else 200
+
+    def start_server(tag: str):
+        p = parse_pipeline(
+            f"tensor_query_serversrc name={tag}-src port=0 id={tag} "
+            "max-inflight=8 retry-after-ms=10 ! "
+            "tensor_filter framework=passthrough input=64 "
+            "inputtype=float32 ! "
+            f"tensor_query_serversink id={tag}"
+        )
+        p.start()
+        return p, p[f"{tag}-src"].bound_port
+
+    def run_fleet(hosts: str, kill_fn=None):
+        """N concurrent clients; returns (aggregate_fps, per-request
+        (done_t, latency) list, summed fleet stats)."""
+        lat = []
+        stats = []
+        mu = threading.Lock()
+
+        def drive(i: int) -> None:
+            c = TensorQueryClient(
+                f"bench-edge-c{i}",
+                **{"hosts": hosts, "timeout": 10, "retry-max": 8,
+                   "retry-backoff-ms": 10},
+            )
+            c.start()
+            try:
+                for j in range(n_requests):
+                    t0 = time.perf_counter()
+                    c.process(Frame((np.full(64, float(j), np.float32),)))
+                    done = time.perf_counter()
+                    with mu:
+                        lat.append((done, done - t0))
+            finally:
+                with mu:
+                    stats.append(c.fleet_stats())
+                c.stop()
+
+        threads = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if kill_fn is not None:
+            kill_fn()
+        for t in threads:
+            t.join(timeout=300)
+        wall = time.perf_counter() - t0
+        fps = len(lat) / wall if wall > 0 else None
+        agg = {
+            "failovers": sum(s.get("failovers", 0) for s in stats),
+            "duplicate_replies": sum(
+                s.get("duplicate_replies", 0) for s in stats
+            ),
+        }
+        return fps, lat, agg
+
+    # cell 1: one endpoint
+    p1, port1 = start_server("bedge1")
+    one_fps, _lat1, _ = run_fleet(f"127.0.0.1:{port1}")
+    p1.stop()
+    _mark("edge 1-endpoint measured")
+
+    # cell 2: three endpoints, then the mid-run kill
+    servers = [start_server(f"bedge3{i}") for i in range(3)]
+    hosts3 = ",".join(f"127.0.0.1:{port}" for _p, port in servers)
+    three_fps, _lat3, _ = run_fleet(hosts3)
+    _mark("edge 3-endpoint measured")
+
+    kill_at_s = max(0.05, 0.3 * len(_lat3) / (three_fps or 1000.0))
+    killed = {}
+
+    def kill_one():
+        def _later():
+            time.sleep(kill_at_s)
+            servers[0][0].stop()
+            killed["t"] = time.perf_counter()
+        threading.Thread(target=_later, daemon=True).start()
+
+    kill_fps, kill_lat, kill_agg = run_fleet(hosts3, kill_fn=kill_one)
+    for p, _port in servers[1:]:
+        p.stop()
+    _mark("edge kill cell measured")
+
+    # optional same-host transport cells
+    shm_fps = grpc_fps = None
+    try:
+        from nnstreamer_tpu.edge.query_transports import (
+            ShmClientTransport,
+            ShmServerTransport,
+        )
+
+        srv = ShmServerTransport()
+        port = srv.listen("", 0)
+        cli = ShmClientTransport()
+        cli.connect("", port)
+        blob = b"x" * 4096
+        stop = threading.Event()
+
+        def echo():
+            while not stop.is_set():
+                got = srv.recv(timeout=0.1)
+                if got is not None:
+                    srv.send(got[0], got[1])
+
+        t = threading.Thread(target=echo, daemon=True)
+        t.start()
+        n = 200 if smoke else 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            cli.send(0, blob)
+            cli.recv(timeout=5)
+        shm_fps = n / (time.perf_counter() - t0)
+        stop.set()
+        t.join(timeout=2)
+        cli.close()
+        srv.close()
+    except Exception:  # noqa: BLE001 — toolchain-gated optional cell
+        pass
+    try:
+        import grpc  # noqa: F401
+
+        from nnstreamer_tpu.edge.grpc_bridge import (
+            GrpcTensorSink,
+            GrpcTensorSrc,
+        )
+
+        gsrc = GrpcTensorSrc("bench-gsrc", server="true", port=0)
+        gsrc.start()
+        gsink = GrpcTensorSink(
+            "bench-gsink", server="false", port=gsrc.bound_port
+        )
+        gsink.start()
+        frame = Frame((np.zeros(64, np.float32),))
+        n = 200 if smoke else 2000
+        got = 0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            gsink.render(frame)
+        while got < n and time.perf_counter() - t0 < 60:
+            if gsrc.generate() is not None:
+                got += 1
+        grpc_fps = got / (time.perf_counter() - t0)
+        gsink.stop()
+        gsrc.stop()
+    except Exception:  # noqa: BLE001 — grpcio-gated optional cell
+        pass
+
+    # failover gap: the worst request latency among requests completing
+    # AFTER the kill landed (pre-kill cold-start spikes must not read
+    # as failover cost); null when the kill missed the traffic window.
+    # Duplicates counted are DROPPED late replies — delivered
+    # duplicates are impossible by the frame_id dedup, which the fleet
+    # tests pin
+    gap_ms = None
+    kill_t = killed.get("t")
+    if kill_t is not None:
+        post = [l for (done, l) in kill_lat if done >= kill_t]
+        if post:
+            gap_ms = max(post) * 1000.0
+    rec = {
+        "metric": "edge_fleet_fanout",
+        "unit": "fps",
+        "one_endpoint_fps": _round(one_fps),
+        "three_endpoint_fps": _round(three_fps),
+        "fanout_speedup": (
+            round(three_fps / one_fps, 3) if one_fps and three_fps else None
+        ),
+        "kill_fps": _round(kill_fps),
+        "kill_failover_gap_ms": _round(gap_ms),
+        "kill_failovers": kill_agg["failovers"],
+        "kill_duplicate_replies": kill_agg["duplicate_replies"],
+        "shm_rtt_fps": _round(shm_fps) if shm_fps else None,
+        "grpc_push_fps": _round(grpc_fps) if grpc_fps else None,
+        "n_clients": n_clients,
+        "n_requests": n_requests,
+    }
+    print(json.dumps(rec))
+
+
 def _pipeline_llm(smoke: bool) -> None:
     """``--pipeline llm``: paged-vs-slot KV capacity at ONE fixed KV
     HBM budget (models/serving.py kv_layout, docs/llm-serving.md), ONE
@@ -2380,6 +2595,8 @@ def main() -> None:
             return _pipeline_llm("--smoke" in sys.argv)
         if mode == ["composite"]:
             return _pipeline_composite("--smoke" in sys.argv)
+        if mode == ["edge"]:
+            return _pipeline_edge("--smoke" in sys.argv)
         print(f"unknown --pipeline mode {mode}", file=sys.stderr)
         return 2
 

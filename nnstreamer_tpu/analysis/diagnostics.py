@@ -172,6 +172,14 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "then blocks a full plane round trip per window while the "
         "async ticket ring would overlap submit/compute/delivery",
     ),
+    "NNS-W119": (
+        Severity.WARNING, "single-endpoint-no-failover",
+        "a tensor_query_client stamps a per-request SLO (deadline-ms) "
+        "but binds exactly one endpoint with retry-max=0: any endpoint "
+        "hiccup is a terminal error with no reconnect, no failover, and "
+        "no hedge — bind a fleet (hosts=h1:p1,h2:p2) or grant a "
+        "retry-max budget",
+    ),
     "NNS-W117": (
         Severity.WARNING, "paged-gather-materializes-cache",
         "a paged LLM serving element is pinned to kv-attn=gather, whose "

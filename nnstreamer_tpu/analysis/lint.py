@@ -721,6 +721,42 @@ def _admission_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _fleet_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W119: single-endpoint-no-failover — a tensor_query_client
+    that stamps a per-request SLO (``deadline-ms``) cares about
+    tail latency, yet binds exactly ONE endpoint with ``retry-max=0``:
+    a dead or draining server is then a terminal error per frame, with
+    no reconnect, no failover target, and no hedge
+    (docs/edge-serving.md "Running a fleet")."""
+    from nnstreamer_tpu.edge.fleet import parse_hosts
+    from nnstreamer_tpu.edge.query import TensorQueryClient
+
+    for e in pipeline.elements:
+        if not isinstance(e, TensorQueryClient):
+            continue
+        hosts = e.get_property("hosts")
+        if hosts:
+            try:
+                if len(parse_hosts(hosts)) > 1:
+                    continue  # a real fleet: failover targets exist
+            except ValueError:
+                continue  # NNS-E011 already covers the bad value
+        try:
+            deadline = float(e.get_property("deadline-ms") or 0.0)
+            retry_max = int(e.get_property("retry-max") or 0)
+        except (TypeError, ValueError):
+            continue  # NNS-E005 already covers the bad value
+        if deadline > 0 and retry_max <= 0:
+            report.add(
+                "NNS-W119", e.name,
+                f"deadline-ms={deadline:.0f} with one endpoint and "
+                "retry-max=0: an endpoint hiccup is a terminal error "
+                "with no failover",
+                "bind a fleet (hosts=h1:p1,h2:p2,...) or set retry-max "
+                "(docs/edge-serving.md)",
+            )
+
+
 def _replica_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W112: replicas=N promises the stream survives a dying
     replica, but with the default on-error=stop the day EVERY replica is
@@ -1289,6 +1325,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _fanout_join_pass(pipeline, report)
     _skewed_join_pass(pipeline, report)
     _admission_pass(pipeline, report)
+    _fleet_failover_pass(pipeline, report)
     _replica_failover_pass(pipeline, report)
     _resident_handoff_pass(pipeline, report)
     _model_sharing_pass(pipeline, report)

@@ -55,6 +55,7 @@ REASON_RATE = "rate"                    # token bucket empty
 REASON_MALFORMED = "malformed"          # undecodable request
 REASON_DEADLINE = "deadline"            # shed: SLO already missed
 REASON_FAILED = "failed"                # admitted, then dropped by a fault policy
+REASON_DRAINING = "draining"            # graceful drain: retry ANOTHER endpoint
 
 
 class Decision(NamedTuple):
@@ -296,6 +297,28 @@ class AdmissionController:
                 return None
         self._gauge_depth(c, depth)
         return frame
+
+    def flush_ready(self):
+        """Graceful-drain flush (docs/edge-serving.md "Running a
+        fleet"): pop every queued-but-unserved admitted frame so the
+        caller can NACK it ``draining`` — queued requests re-route to
+        another endpoint instead of waiting out a dying server. The
+        frames stay counted in-flight until the caller's
+        ``release(cid)`` (the PR-6 budget-release path), so the
+        accounting ledger never skips a state."""
+        with self._mu:
+            out = []
+            for c in self._clients.values():
+                for q in c.queues.values():
+                    while q:
+                        out.append(q.popleft())
+            self._ready = 0
+        for frame in out:
+            cid = getattr(frame, "meta", {}).get("client_id")
+            c = self._clients.get(cid)
+            if c is not None:
+                self._gauge_depth(c, 0)
+        return out
 
     def _evict_idle(self, now: float) -> None:
         """Reclaim clients with nothing queued or in flight that have

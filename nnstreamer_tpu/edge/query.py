@@ -32,15 +32,25 @@ from nnstreamer_tpu import registry, trace
 from nnstreamer_tpu.obs import metrics as obs_metrics
 from nnstreamer_tpu.edge.admission import (
     REASON_DEADLINE,
+    REASON_DRAINING,
     REASON_FAILED,
     REASON_MALFORMED,
     REASON_MAX_CLIENTS,
     AdmissionConfig,
     AdmissionController,
 )
+from nnstreamer_tpu.edge.fleet import (
+    FleetEndpoints,
+    HedgeTimer,
+    ReplyDeduper,
+    RttWindow,
+    parse_hosts,
+)
 from nnstreamer_tpu.edge.serialize import (
+    Ctrl,
     Nack,
     decode_message,
+    encode_ctrl,
     encode_message,
     encode_nack,
 )
@@ -48,7 +58,9 @@ from nnstreamer_tpu.edge.transport import (
     ChaosCounter,
     ChaosTransport,
     TransportError,
+    UnresolvableError,
     make_transport,
+    resolve_target,
 )
 from nnstreamer_tpu.elements.base import (
     ElementError,
@@ -65,17 +77,27 @@ from nnstreamer_tpu.tensors.spec import TensorFormat, TensorsSpec
 # reference QUERY_DEFAULT_TIMEOUT_SEC (tensor_query_common.h:28) is 10 s
 DEFAULT_TIMEOUT = 10.0
 
+# serversrc readiness flags (docs/edge-serving.md "Running a fleet"):
+# ready → serving; draining → graceful drain in progress (new submits
+# NACK `draining`); dead → stopped/not started
+SRV_READY = "ready"
+SRV_DRAINING = "draining"
+SRV_DEAD = "dead"
+
 # serversrc/serversink pairing: id → shared server transport (+ the
 # admission controller when one is configured, keyed separately so the
-# transport-only consumers stay untouched)
+# transport-only consumers stay untouched; + the readiness flag so the
+# fault-disposal paths can pick drain-aware NACK reasons)
 _server_table: Dict[str, object] = {}
 _controller_table: Dict[str, AdmissionController] = {}
+_state_table: Dict[str, str] = {}
 _server_lock = threading.Lock()
 
 
 def _register_server(srv_id: str, transport, controller=None) -> None:
     with _server_lock:
         _server_table[srv_id] = transport
+        _state_table[srv_id] = SRV_READY
         if controller is not None:
             _controller_table[srv_id] = controller
         else:
@@ -92,6 +114,18 @@ def _get_controller(srv_id: str) -> Optional[AdmissionController]:
         return _controller_table.get(srv_id)
 
 
+def server_state(srv_id: str) -> str:
+    """The serversrc's readiness flag (ready / draining / dead)."""
+    with _server_lock:
+        return _state_table.get(srv_id, SRV_DEAD)
+
+
+def _set_server_state(srv_id: str, state: str) -> None:
+    with _server_lock:
+        if srv_id in _state_table:
+            _state_table[srv_id] = state
+
+
 def _unregister_server(srv_id: str, transport=None) -> None:
     """Remove the pairing entry — but only if it still belongs to the
     caller (a restarted serversrc may have re-registered the id)."""
@@ -99,6 +133,7 @@ def _unregister_server(srv_id: str, transport=None) -> None:
         if transport is None or _server_table.get(srv_id) is transport:
             _server_table.pop(srv_id, None)
             _controller_table.pop(srv_id, None)
+            _state_table.pop(srv_id, None)
 
 
 def nack_for_shed(srv_id: str, cid, frame_id=None) -> None:
@@ -125,8 +160,11 @@ def discard_admitted(srv_id: str, cid, action: str, frame_id=None) -> None:
     notify_discard): return its admission budget — the in-flight slot
     must not stay pinned forever — and, unless the frame was delivered
     to a dead-letter consumer (``action == "route"``), NACK the client
-    (``failed``, terminal) so the request does not end as a silent
-    client-side timeout."""
+    so the request does not end as a silent client-side timeout. The
+    reason is ``failed`` (terminal) normally, but ``draining`` while
+    the server is in a graceful drain — the disposal is then a
+    restart artifact, not a verdict on the request, and a fleet client
+    re-routes it to another endpoint instead of giving up."""
     ctrl = _get_controller(srv_id)
     if ctrl is not None and cid is not None:
         ctrl.release(cid)
@@ -134,12 +172,64 @@ def discard_admitted(srv_id: str, cid, action: str, frame_id=None) -> None:
         return  # the dead-letter consumer owns the request's fate now
     transport = _get_server(srv_id)
     if transport is not None and cid is not None:
+        if server_state(srv_id) == SRV_DRAINING:
+            reason, hint = REASON_DRAINING, (
+                ctrl.cfg.retry_after_ms if ctrl is not None else 50.0
+            )
+        else:
+            reason, hint = REASON_FAILED, 0.0
         try:
             transport.send(
-                cid, encode_nack(REASON_FAILED, 0.0, frame_id=frame_id)
+                cid, encode_nack(reason, hint, frame_id=frame_id)
             )
         except (TransportError, OSError):
             pass
+
+
+def drain_flushed(srv_id: str, cid, frame_id=None) -> None:
+    """A draining server flushed a queued admitted request before it
+    consumed device time (pipeline/faults.py notify_drain_flush): NACK
+    the client ``draining`` — a fleet client re-routes the request to
+    another endpoint, so a rolling restart loses nothing — and return
+    the admission budget (the PR-6 release path)."""
+    ctrl = _get_controller(srv_id)
+    transport = _get_server(srv_id)
+    if transport is not None and cid is not None:
+        hint = ctrl.cfg.retry_after_ms if ctrl is not None else 50.0
+        try:
+            transport.send(
+                cid, encode_nack(REASON_DRAINING, hint, frame_id=frame_id)
+            )
+        except (TransportError, OSError):
+            pass
+    if ctrl is not None and cid is not None:
+        ctrl.release(cid)
+
+
+def request_drain(host: str, port: int, connect_type: str = "TCP",
+                  topic: str = "nns-query", attempts: int = 3) -> None:
+    """Operator helper: ask the query server at ``host:port`` to drain
+    gracefully (the ``drain`` control message — rolling restarts without
+    dropping admitted work). Fire-and-forget once delivered: the server
+    NACKs new submits ``draining`` from the moment the message lands.
+    A couple of connect retries absorb transient accept races on a busy
+    server; a server that stays unreachable raises."""
+    last: Optional[Exception] = None
+    for attempt in range(max(1, int(attempts))):
+        if attempt:
+            time.sleep(0.05 * attempt)
+        t = _make_client_transport(str(connect_type).upper(), topic)
+        try:
+            t.connect(host, port)
+            t.send(0, encode_ctrl("drain"))
+            return
+        except (TransportError, OSError) as exc:
+            last = exc
+        finally:
+            t.close()
+    raise TransportError(
+        f"cannot deliver drain to {host}:{port}: {last}"
+    )
 
 
 CONNECT_TYPES = ("TCP", "MQTT", "HYBRID", "SHM")
@@ -229,13 +319,42 @@ class TensorQueryClient(HostElement):
     did NOT process the request — the client honors the NACK's
     retry-after hint on its existing ``retry-max`` budget. The
     ``chaos-*`` properties inject deterministic network faults
-    (docs/fault-tolerance.md) for testing those paths."""
+    (docs/fault-tolerance.md) for testing those paths.
+
+    Fleet mode (docs/edge-serving.md "Running a fleet"): ``hosts=
+    h1:p1,h2:p2,...`` replaces the single ``dest-host``/``dest-port``
+    binding with a health-scored endpoint fleet (edge/fleet.py):
+    consecutive-failure ejection with jittered-backoff re-probes, a
+    ``draining`` NACK benches an endpoint for exactly its retry-after
+    hint (rolling restarts), and an in-flight request whose endpoint
+    dies FAILS OVER to the next healthy endpoint — delivery stays
+    at-most-once because replies are deduped by ``frame_id`` (a late
+    duplicate from the first server is dropped, never pushed
+    downstream). ``hedge-after-ms`` > 0 arms hedged requests: a
+    straggling request is re-sent to a second endpoint after the delay,
+    first reply wins, the loser's reply is deduped (< 0 adapts the
+    threshold to the observed reply p99). Note the failover/hedge
+    semantics differ from the single-endpoint path on purpose: a
+    re-send may double-*process* on two servers, but never
+    double-*delivers* — opt in only when requests are idempotent or the
+    duplicate compute is acceptable."""
 
     FACTORY_NAME = "tensor_query_client"
 
     PROPERTIES = {
         "dest-host": PropSpec("str", "127.0.0.1"),
-        "dest-port": PropSpec("int", 0, desc="required"),
+        "dest-port": PropSpec("int", 0, desc="required unless hosts= set"),
+        "hosts": PropSpec(
+            "str", None,
+            desc="fleet endpoints h1:p1,h2:p2,... — overrides dest-host/"
+            "dest-port and enables health-scored failover/hedging",
+        ),
+        "hedge-after-ms": PropSpec(
+            "float", 0.0,
+            desc="fleet hedging: re-send a straggling request to a "
+            "second endpoint after this delay, first reply wins "
+            "(0 = off, <0 = adaptive from the observed reply p99)",
+        ),
         "timeout": PropSpec("float", 10.0, desc="per-request (s)"),
         "connect-type": PropSpec("enum", "TCP", CONNECT_TYPES),
         "topic": PropSpec("str", "nns-query"),
@@ -292,6 +411,34 @@ class TensorQueryClient(HostElement):
         )
         self._rng = random.Random(0xED6E)  # deterministic jitter stream
         self._transport = None
+        # fleet mode (docs/edge-serving.md "Running a fleet"): hosts=
+        # binds a health-scored endpoint selector instead of one socket
+        self.hedge_after_ms = float(self.get_property("hedge-after-ms", 0.0))
+        hosts_raw = self.get_property("hosts")
+        self._fleet: Optional[FleetEndpoints] = None
+        self._ep_transports: Dict[object, object] = {}
+        self._dedup: Optional[ReplyDeduper] = None
+        self._rtts: Optional[RttWindow] = None
+        self.fleet_failovers = 0   # requests re-sent off a failed endpoint
+        self.fleet_hedges = 0      # hedge sends fired
+        self.stale_replies = 0     # late replies to already-terminal requests
+        self._failover_ctr = None
+        self._hedge_ctr = None
+        if hosts_raw:
+            try:
+                targets = parse_hosts(hosts_raw)
+            except ValueError as exc:
+                raise ElementError(f"{self.name}: {exc}") from exc
+            self._fleet = FleetEndpoints(
+                targets,
+                probe_backoff_ms=max(
+                    1.0, float(self.get_property("retry-backoff-ms", 50.0))
+                ),
+                rng=random.Random(0xF1EE7),
+                name=self.name,
+            )
+            self._dedup = ReplyDeduper()
+            self._rtts = RttWindow()
         # distributed correlation (docs/observability.md): every request
         # carries a frame_id that survives the hop via the wire meta
         # blob, so client and server traces merge into one timeline
@@ -307,28 +454,52 @@ class TensorQueryClient(HostElement):
 
     def negotiate(self, in_specs: List[Spec]) -> List[Spec]:
         self.connect_type = _check_connect_type(self)
-        if self.port <= 0:
-            raise NegotiationError(f"{self.name}: dest-port required")
+        if self.port <= 0 and self._fleet is None:
+            raise NegotiationError(
+                f"{self.name}: dest-port (or hosts=) required"
+            )
         # the reply's spec is the remote pipeline's business — flexible
         # (caps compatibility is the user's responsibility, reference
         # tensor_query/README.md)
         return [TensorsSpec(format=TensorFormat.FLEXIBLE)]
+
+    def _build_transport(self, connect_timeout: Optional[float] = None):
+        t = _make_client_transport(self.connect_type, self.topic)
+        if connect_timeout is not None:
+            if not hasattr(t, "connect_timeout") \
+                    and self.connect_type == "TCP":
+                # the native transport has no bounded connect(): a
+                # SYN-blackholed fleet endpoint would stall the request
+                # for the OS default (~minutes) and block failover, so
+                # fleet connections ride the python transport (same
+                # framing, cross-checked in tests) where the clamp works
+                t.close()
+                t = make_transport(prefer_native=False)
+            if hasattr(t, "connect_timeout"):
+                t.connect_timeout = connect_timeout
+        if self._chaos_drop_n or self._chaos_trunc_n:
+            # the counter survives reconnects so the injection schedule
+            # stays deterministic across the faults it causes (and, in
+            # fleet mode, across endpoints)
+            t = ChaosTransport(
+                t, self._chaos_counter,
+                drop_every_n=self._chaos_drop_n,
+                truncate_every_n=self._chaos_trunc_n,
+            )
+        return t
 
     def _connect_once(self) -> None:
         # resolve (and validate) connect-type here, not only in start():
         # standalone callers may hit process() without start(), and the
         # property must be honored on that path too
         self.connect_type = _check_connect_type(self)
-        t = _make_client_transport(self.connect_type, self.topic)
-        if self._chaos_drop_n or self._chaos_trunc_n:
-            # the counter survives reconnects so the injection schedule
-            # stays deterministic across the faults it causes
-            t = ChaosTransport(
-                t, self._chaos_counter,
-                drop_every_n=self._chaos_drop_n,
-                truncate_every_n=self._chaos_trunc_n,
-            )
-        self._transport = t
+        if self.connect_type == "TCP":
+            # re-resolve on EVERY reconnect attempt: a failed-over DNS
+            # record points somewhere new, and an unresolvable name is a
+            # DISTINCT terminal failure (UnresolvableError) instead of a
+            # retry-max budget burned on a gone host
+            resolve_target(self.host, self.port)
+        self._transport = self._build_transport()
         try:
             self._transport.connect(self.host, self.port)
         except (TransportError, OSError):
@@ -344,11 +515,21 @@ class TensorQueryClient(HostElement):
         from nnstreamer_tpu.pipeline.faults import backoff_s
 
         self._obs_reg = obs_metrics.get()
+        if self._fleet is not None:
+            self._start_fleet()
+            return
         attempt = 0
         while True:
             try:
                 self._connect_once()
                 return
+            except UnresolvableError as exc:
+                # terminal, distinct: retrying a name that does not
+                # resolve burns the whole budget for nothing
+                raise ElementError(
+                    f"{self.name}: query server host {self.host!r} is "
+                    f"unresolvable: {exc}"
+                ) from exc
             except (TransportError, OSError) as exc:
                 if attempt >= self.retry_max:
                     raise ElementError(
@@ -360,12 +541,74 @@ class TensorQueryClient(HostElement):
                 time.sleep(backoff_s(attempt, self._retry_policy, self._rng))
                 attempt += 1
 
-    def stop(self) -> None:
-        self._drop_connection()
-
-    def process(self, frame: Frame) -> Optional[Frame]:
+    def _start_fleet(self) -> None:
+        """Fleet start: at least ONE endpoint must be reachable (the
+        rest connect lazily on first dispatch/failover)."""
         from nnstreamer_tpu.pipeline.faults import backoff_s
 
+        self.connect_type = _check_connect_type(self)
+        attempt = 0
+        while True:
+            last_exc = None
+            for ep in self._fleet.plan():
+                try:
+                    self._ep_transport(ep)
+                    return
+                except UnresolvableError as exc:
+                    self._fleet.record_fail(ep, unresolvable=True)
+                    last_exc = exc
+                except (TransportError, OSError) as exc:
+                    self._fleet.record_fail(ep)
+                    last_exc = exc
+            if attempt >= self.retry_max:
+                addrs = ",".join(
+                    e.addr for e in self._fleet.endpoints
+                )
+                raise ElementError(
+                    f"{self.name}: no reachable endpoint in fleet "
+                    f"[{addrs}]"
+                    + (f": {last_exc}" if last_exc is not None else "")
+                )
+            time.sleep(max(
+                backoff_s(attempt, self._retry_policy, self._rng),
+                self._fleet.next_retry_in(),
+            ))
+            attempt += 1
+
+    def _ep_transport(self, ep):
+        """Get-or-connect the transport for one fleet endpoint. The
+        connect timeout is clamped well under the request timeout so a
+        blackholed endpoint cannot eat the whole deadline inside one
+        connect; the hostname re-resolves on every (re)connect."""
+        t = self._ep_transports.get(ep)
+        if t is not None:
+            return t
+        if self.connect_type == "TCP":
+            resolve_target(ep.host, ep.port)
+        t = self._build_transport(
+            connect_timeout=max(0.2, min(2.0, self.timeout / 2.0))
+        )
+        try:
+            t.connect(ep.host, ep.port)
+        except BaseException:
+            t.close()
+            raise
+        self._ep_transports[ep] = t
+        return t
+
+    def _close_ep(self, ep) -> None:
+        t = self._ep_transports.pop(ep, None)
+        if t is not None:
+            t.close()
+
+    def stop(self) -> None:
+        self._drop_connection()
+        for ep in list(self._ep_transports):
+            self._close_ep(ep)
+
+    def _stamp_request(self, frame: Frame):
+        """Correlation + SLO meta shared by the single-endpoint and
+        fleet request paths."""
         fid = frame.meta.get("frame_id")
         if fid is None:
             fid = f"{self._fid_prefix}.{next(self._fid_seq)}"
@@ -374,6 +617,44 @@ class TensorQueryClient(HostElement):
             frame = frame.with_meta(deadline_ms=self.deadline_ms)
         if self.priority is not None and "priority" not in frame.meta:
             frame = frame.with_meta(priority=self.priority)
+        return frame, fid
+
+    def _finish_reply(self, msg, frame: Frame, fid, t_req: float):
+        """Trace + metrics + reply normalization shared by both request
+        paths."""
+        rtt_s = time.perf_counter() - t_req
+        if self._rtts is not None:
+            self._rtts.record(rtt_s)  # feeds the adaptive hedge p99
+        tracer = trace.get()
+        if tracer is not None:
+            # the client half of the cross-process pair: merge() lines
+            # this span up with the server's frame_id-tagged spans
+            tracer.complete(
+                self.name, "edge", t_req, rtt_s, {"frame_id": fid}
+            )
+        reg = self._obs_reg
+        if reg is not None:
+            if self._rtt_hist is None:
+                self._rtt_hist = reg.histogram(
+                    "nns_edge_rtt_us", element=self.name
+                )
+            self._rtt_hist.observe(rtt_s * 1e6)
+            reg.counter(
+                "nns_edge_requests_total", element=self.name
+            ).inc()
+        reply = msg
+        if isinstance(reply, EOS):
+            return None
+        if reply.meta.get("frame_id") is None:
+            reply = reply.with_meta(frame_id=fid)
+        return reply.with_pts(frame.pts, frame.duration)
+
+    def process(self, frame: Frame) -> Optional[Frame]:
+        from nnstreamer_tpu.pipeline.faults import backoff_s
+
+        if self._fleet is not None:
+            return self._process_fleet(frame)
+        frame, fid = self._stamp_request(frame)
         data = encode_message(frame)
         t_req = time.perf_counter()
         attempt = 0
@@ -449,6 +730,15 @@ class TensorQueryClient(HostElement):
                     time.sleep(delay)
                     continue
                 break
+            except UnresolvableError as exc:
+                # the satellite bugfix: a reconnect whose target no
+                # longer RESOLVES is terminal with a distinct reason —
+                # not retry-max spins against a gone name
+                self._drop_connection()
+                raise ElementError(
+                    f"{self.name}: query server host {self.host!r} is "
+                    f"unresolvable: {exc}"
+                ) from exc
             except (TransportError, OSError) as exc:
                 self._drop_connection()
                 # the retry loop covers CONNECT/SEND failures only: once
@@ -464,30 +754,245 @@ class TensorQueryClient(HostElement):
                     ) from exc
                 time.sleep(backoff_s(attempt, self._retry_policy, self._rng))
                 attempt += 1
-        rtt_s = time.perf_counter() - t_req
-        tracer = trace.get()
-        if tracer is not None:
-            # the client half of the cross-process pair: merge() lines
-            # this span up with the server's frame_id-tagged spans
-            tracer.complete(
-                self.name, "edge", t_req, rtt_s, {"frame_id": fid}
-            )
-        reg = self._obs_reg
-        if reg is not None:
-            if self._rtt_hist is None:
-                self._rtt_hist = reg.histogram(
-                    "nns_edge_rtt_us", element=self.name
+        return self._finish_reply(msg, frame, fid, t_req)
+
+    # -- fleet request path (docs/edge-serving.md "Running a fleet") -------
+    def _process_fleet(self, frame: Frame) -> Optional[Frame]:
+        from nnstreamer_tpu.pipeline.faults import backoff_s
+
+        frame, fid = self._stamp_request(frame)
+        data = encode_message(frame)
+        t_req = time.perf_counter()
+        deadline = time.monotonic() + self.timeout
+        hedger = HedgeTimer(self.hedge_after_ms, rtts=self._rtts)
+        inflight: List = []   # [(endpoint, transport)] holding this request
+        tried = set()         # endpoint idx already failed/NACKed this round
+        sends = 0
+        nack_attempt = 0      # retry budget for whole-fleet rejection rounds
+        pending_hint_s = 0.0  # retry-after carried into the next round
+
+        failed_eps = 0        # endpoints that failed/NACKed this request
+
+        def _send_next(is_hedge: bool = False):
+            """Send this request to the next endpoint the plan allows;
+            returns (sent, last_exc). Counts a failover whenever the
+            request lands on an endpoint after another one failed it —
+            whether the first failure happened at send time (dead
+            socket, unresolvable) or after the request was in flight."""
+            nonlocal sends, failed_eps
+            last_exc = None
+            for ep in self._fleet.plan():
+                if ep.idx in tried or any(e is ep for e, _t in inflight):
+                    continue
+                try:
+                    tr = self._ep_transport(ep)
+                    tr.send(0, data)
+                except UnresolvableError as exc:
+                    self._fleet.record_fail(ep, unresolvable=True)
+                    self._close_ep(ep)
+                    tried.add(ep.idx)
+                    ep.failovers += 1
+                    failed_eps += 1
+                    last_exc = exc
+                    continue
+                except (TransportError, OSError) as exc:
+                    self._fleet.record_fail(ep)
+                    self._close_ep(ep)
+                    tried.add(ep.idx)
+                    ep.failovers += 1
+                    failed_eps += 1
+                    last_exc = exc
+                    continue
+                ep.inflight += 1
+                inflight.append((ep, tr))
+                sends += 1
+                if is_hedge:
+                    self._count_hedge()
+                elif failed_eps:
+                    self._count_failover()
+                return True, None
+            return False, last_exc
+
+        def _drop_inflight(i: int, failed: bool) -> None:
+            nonlocal failed_eps
+            ep, _tr = inflight.pop(i)
+            ep.inflight = max(0, ep.inflight - 1)
+            if failed:
+                ep.failovers += 1
+                failed_eps += 1
+                tried.add(ep.idx)
+
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                # straggler timeout: every endpoint still holding the
+                # request takes a health hit, but the connections stay —
+                # the frame_id dedup drops their late replies, so the
+                # NEXT request cannot be answered off-by-one
+                for ep, _tr in inflight:
+                    ep.inflight = max(0, ep.inflight - 1)
+                    self._fleet.record_fail(ep)
+                self._dedup.claim(fid)  # a late reply must never deliver
+                raise ElementError(
+                    f"{self.name}: query timeout after {self.timeout}s"
                 )
-            self._rtt_hist.observe(rtt_s * 1e6)
-            reg.counter(
-                "nns_edge_requests_total", element=self.name
-            ).inc()
-        reply = msg
-        if isinstance(reply, EOS):
-            return None
-        if reply.meta.get("frame_id") is None:
-            reply = reply.with_meta(frame_id=fid)
-        return reply.with_pts(frame.pts, frame.duration)
+            if not inflight:
+                sent, last_exc = _send_next()
+                if not sent:
+                    if nack_attempt >= self.retry_max:
+                        raise ElementError(
+                            f"{self.name}: no fleet endpoint accepted the "
+                            f"request after {nack_attempt + 1} round(s)"
+                            + (f": {last_exc}" if last_exc else "")
+                        )
+                    delay = max(
+                        pending_hint_s,
+                        backoff_s(nack_attempt, self._retry_policy,
+                                  self._rng),
+                        self._fleet.next_retry_in(),
+                    )
+                    nack_attempt += 1
+                    pending_hint_s = 0.0
+                    time.sleep(min(delay, max(0.001, deadline - now)))
+                    tried.clear()  # a fresh round may retry everyone —
+                    failed_eps = 0  # and a same-endpoint resend after a
+                    #                 whole-fleet-refused round is a
+                    #                 RETRY, not a failover
+                    continue
+                if sends == 1:
+                    hedger.arm()
+            # wait for a reply on the in-flight transports; with a
+            # hedge outstanding, round-robin short polls keep both live
+            got = None
+            src = 0
+            if len(inflight) == 1:
+                ep, tr = inflight[0]
+                slice_s = min(0.02, max(0.001, deadline - now))
+                try:
+                    got = tr.recv(timeout=slice_s)
+                except (TransportError, OSError):
+                    got = (0, b"")
+            else:
+                for i, (ep, tr) in enumerate(inflight):
+                    try:
+                        got = tr.recv(timeout=0.005)
+                    except (TransportError, OSError):
+                        got = (0, b"")
+                    if got is not None:
+                        src = i
+                        break
+            if got is None:
+                if hedger.due():
+                    hedger.fire()  # one hedge per request, sent or not
+                    _send_next(is_hedge=True)
+                continue
+            ep, tr = inflight[src]
+            _cid, payload = got
+            if not payload:
+                # connection died under the request: fail over
+                self._fleet.record_fail(ep)
+                self._close_ep(ep)
+                _drop_inflight(src, failed=True)
+                continue
+            try:
+                msg = decode_message(payload)
+            except ValueError:
+                continue  # garbage on the reply path: ignore, keep waiting
+            if isinstance(msg, Ctrl):
+                continue  # control messages are client→server only
+            if isinstance(msg, Nack):
+                nfid = msg.frame_id
+                if nfid is not None and nfid != fid:
+                    self.stale_replies += 1
+                    continue  # a NACK for an already-terminal request
+                self._count_nack(msg.reason)
+                if msg.reason == REASON_DRAINING:
+                    # rolling restart: bench for exactly the hint and
+                    # re-route — the request was NOT processed
+                    self._fleet.mark_draining(ep, msg.retry_after_ms)
+                    pending_hint_s = max(
+                        pending_hint_s, msg.retry_after_ms / 1000.0
+                    )
+                    _drop_inflight(src, failed=True)
+                    continue
+                if msg.reason in (REASON_DEADLINE, REASON_FAILED):
+                    # terminal verdicts — but a hedge may still win
+                    _drop_inflight(src, failed=True)
+                    if inflight:
+                        continue
+                    if msg.reason == REASON_DEADLINE:
+                        raise ElementError(
+                            f"{self.name}: server shed the request "
+                            f"(deadline {self.deadline_ms:.0f} ms missed)"
+                        )
+                    raise ElementError(
+                        f"{self.name}: server failed the request "
+                        "(dropped by its error policy)"
+                    )
+                # retryable admission NACK (overload / rate / max-clients
+                # / client-backpressure / malformed): the natural fleet
+                # response is failover; the conn-level reject path also
+                # CLOSES, so drop the transport before moving on
+                pending_hint_s = max(
+                    pending_hint_s, msg.retry_after_ms / 1000.0
+                )
+                self._close_ep(ep)
+                _drop_inflight(src, failed=True)
+                continue
+            # DATA (or EOS) reply
+            rfid = getattr(msg, "meta", {}).get(
+                "frame_id"
+            ) if not isinstance(msg, EOS) else None
+            if rfid is not None and rfid != fid:
+                # a late reply to an ALREADY-terminal request (timeout/
+                # failover winner already delivered): at-most-once means
+                # it is dropped here, never pushed downstream
+                if self._dedup.seen(rfid):
+                    self._dedup.claim(rfid)  # count the duplicate
+                else:
+                    self.stale_replies += 1
+                continue
+            if not self._dedup.claim(fid):
+                continue  # hedge loser: the first reply already won
+            for e, _t in inflight:
+                e.inflight = max(0, e.inflight - 1)
+            self._fleet.record_ok(ep)
+            return self._finish_reply(msg, frame, fid, t_req)
+
+    def _count_failover(self) -> None:
+        self.fleet_failovers += 1
+        reg = self._obs_reg
+        if reg is None:
+            return
+        if self._failover_ctr is None:
+            self._failover_ctr = reg.counter(
+                "nns_fleet_failovers_total", element=self.name
+            )
+        self._failover_ctr.inc()
+
+    def _count_hedge(self) -> None:
+        self.fleet_hedges += 1
+        reg = self._obs_reg
+        if reg is None:
+            return
+        if self._hedge_ctr is None:
+            self._hedge_ctr = reg.counter(
+                "nns_fleet_hedges_total", element=self.name
+            )
+        self._hedge_ctr.inc()
+
+    def fleet_stats(self) -> Dict[str, object]:
+        """Executor.stats() hook (``fleet_*`` keys; nns-top --fleet)."""
+        if self._fleet is None:
+            return {}
+        return {
+            "endpoints": self._fleet.snapshot(),
+            "healthy": self._fleet.healthy_count(),
+            "failovers": self.fleet_failovers,
+            "hedges": self.fleet_hedges,
+            "duplicate_replies": self._dedup.duplicates,
+            "stale_replies": self.stale_replies,
+        }
 
     def _count_nack(self, reason: str) -> None:
         reg = self._obs_reg
@@ -574,6 +1079,11 @@ class TensorQueryServerSrc(Source):
         self._adm_cfg = AdmissionConfig.from_element(self)
         self._controller: Optional[AdmissionController] = None
         self.malformed_total = 0  # undecodable requests NACKed
+        # readiness flag (docs/edge-serving.md "Running a fleet"):
+        # ready / draining / dead — exposed via admission_stats() on the
+        # obs endpoint; fleet clients learn "draining" from the NACKs
+        self.state = SRV_DEAD
+        self.drain_nacked = 0  # new submits NACKed while draining
 
     def output_spec(self) -> Spec:
         self.connect_type = _check_connect_type(self)
@@ -591,13 +1101,55 @@ class TensorQueryServerSrc(Source):
             retry_after_ms=self._adm_cfg.retry_after_ms,
         )
         self.bound_port = self._transport.listen(self.host, self.port)
+        self.state = SRV_READY
         _register_server(self.srv_id, self._transport, self._controller)
 
     def stop(self) -> None:
+        self.state = SRV_DEAD
         _unregister_server(self.srv_id, self._transport)
         if self._transport is not None:
             self._transport.close()
             self._transport = None
+
+    # -- graceful drain (docs/edge-serving.md "Running a fleet") -----------
+    def drain(self, flush_queued: bool = False) -> None:
+        """Stop accepting new work: from now on new submits are NACKed
+        with the terminal-after-retry reason ``draining`` (+ the
+        ``retry-after-ms`` hint), while already-admitted requests keep
+        flowing to their replies (or dead-letter) through the normal
+        PR-6 budget-release path. ``flush_queued=True`` additionally
+        NACKs the queued-but-unserved admitted backlog so those requests
+        re-route NOW instead of waiting out this server. The rolling-
+        restart recipe: ``drain()`` → wait for :meth:`drained` →
+        ``Executor.drain()`` (quiesce the graph) → stop/restart — zero
+        accepted requests lost. Also reachable over the wire via the
+        ``drain`` control message (:func:`request_drain`)."""
+        self.state = SRV_DRAINING
+        _set_server_state(self.srv_id, SRV_DRAINING)
+        if flush_queued and self._controller is not None:
+            from nnstreamer_tpu.pipeline.faults import notify_drain_flush
+
+            for frame in self._controller.flush_ready():
+                notify_drain_flush(frame, self.name)
+
+    def drained(self) -> bool:
+        """True once drain() was called and no admitted request remains
+        in flight (every accepted request reached its terminal
+        outcome)."""
+        if self.state != SRV_DRAINING:
+            return False
+        if self._controller is None:
+            return True
+        return self._controller.snapshot()["inflight"] == 0
+
+    def _nack_draining(self, cid, frame_id=None) -> None:
+        self.drain_nacked += 1
+        if self._controller is not None:
+            self._controller.count_reject(REASON_DRAINING)
+        self._send_nack(
+            cid, REASON_DRAINING, self._adm_cfg.retry_after_ms,
+            frame_id=frame_id,
+        )
 
     def _trace_in(self, frame, cid) -> None:
         tracer = trace.get()
@@ -638,8 +1190,17 @@ class TensorQueryServerSrc(Source):
             ctrl.count_reject(REASON_MALFORMED)
             self._send_nack(cid, REASON_MALFORMED, 0.0)
             return
+        if isinstance(msg, Ctrl):
+            if msg.op == "drain":
+                self.drain()
+            return
         if isinstance(msg, (EOS, Nack)):
             return  # one client's EOS must not stop the server
+        if self.state == SRV_DRAINING:
+            # graceful drain: new work is refused with an explicit
+            # reason + hint so fleet clients re-route immediately
+            self._nack_draining(cid, frame_id=msg.meta.get("frame_id"))
+            return
         frame = self._stamp(msg, cid)
         decision = ctrl.offer(cid, frame)
         if not decision.ok:
@@ -667,10 +1228,19 @@ class TensorQueryServerSrc(Source):
                 self.malformed_total += 1
                 self._send_nack(cid, REASON_MALFORMED, 0.0)
                 return None
+            if isinstance(frame, Ctrl):
+                if frame.op == "drain":
+                    self.drain()
+                return None
             if isinstance(frame, EOS):
                 return None
             if isinstance(frame, Nack):
                 return None  # NACKs are server→client only; ignore
+            if self.state == SRV_DRAINING:
+                self._nack_draining(
+                    cid, frame_id=frame.meta.get("frame_id")
+                )
+                return None
             self._trace_in(frame, cid)
             return self._stamp(frame, cid)
         # drain everything that arrived (admitting or NACKing each),
@@ -688,11 +1258,15 @@ class TensorQueryServerSrc(Source):
         return frame
 
     def admission_stats(self) -> Dict[str, object]:
-        """Executor.stats() hook (``adm_*`` keys; nns-top --clients)."""
+        """Executor.stats() hook (``adm_*`` keys; nns-top --clients).
+        ``readiness`` is the drain/rolling-restart flag the obs endpoint
+        exposes (ready / draining / dead)."""
         ctrl = self._controller
-        out: Dict[str, object] = {}
+        out: Dict[str, object] = {"readiness": self.state}
         if ctrl is not None:
             out.update(ctrl.snapshot())
+        if self.drain_nacked:
+            out["drain_nacked"] = self.drain_nacked
         if self.malformed_total:
             out["malformed"] = self.malformed_total
         t = self._transport

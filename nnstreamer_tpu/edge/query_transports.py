@@ -20,6 +20,12 @@ connect-type TCP / MQTT / HYBRID (/ AITT, vendor-gated). Semantics:
 All adapters expose the same surface as the native TCP transport
 (connect/listen/send/recv/close/peer_count) so the query elements stay
 transport-agnostic, like the reference elements over nns_edge handles.
+Fleet mode (``tensor_query_client hosts=...``, docs/edge-serving.md
+"Running a fleet") builds one adapter per endpoint through the same
+factory: for MQTT each ``host:port`` names a broker, for SHM the port
+keys the ring pair (host ignored) — so failover/hedging compose with
+every connect-type, though the health scorer's re-resolve fast-path
+(``UnresolvableError``) only applies to the TCP family.
 """
 
 from __future__ import annotations

@@ -8,7 +8,7 @@ SURVEY.md §5.8).
 Layout (little-endian):
 
     uint8  version (2; v1 — no meta blob, flags always 0 — still decodes)
-    uint8  kind    (0 = DATA, 1 = EOS, 2 = NACK)
+    uint8  kind    (0 = DATA, 1 = EOS, 2 = NACK, 3 = CTRL)
     int64  pts     (ns; -1 = unknown)
     int64  duration(ns; -1 = unknown)
     uint32 flags   (bit 0: a meta blob follows the header)
@@ -52,6 +52,12 @@ _DECODABLE_VERSIONS = (1, 2)
 KIND_DATA = 0
 KIND_EOS = 1
 KIND_NACK = 2
+# control channel (docs/edge-serving.md "Running a fleet"): an operator
+# message to the serving plane rather than a request — today only
+# ``drain`` (graceful drain for rolling restarts). Same framing as a
+# NACK: no tensors, just the meta blob (``ctrl_op``). Both ends of this
+# protocol live in-tree, so no version bump is needed.
+KIND_CTRL = 3
 FLAG_META = 1
 
 # meta keys that must NOT cross a hop: local to the process that set them
@@ -79,6 +85,31 @@ class Nack:
             f"Nack(reason={self.reason!r}, "
             f"retry_after_ms={self.retry_after_ms})"
         )
+
+
+class Ctrl:
+    """A control message to the serving plane (``KIND_CTRL``): today
+    only ``op == "drain"`` — stop accepting new work, NACK new submits
+    ``draining``, finish the admitted in-flight, then quiesce."""
+
+    __slots__ = ("op", "meta")
+
+    def __init__(self, op: str, meta=None) -> None:
+        self.op = op
+        self.meta = meta or {}
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Ctrl(op={self.op!r})"
+
+
+def encode_ctrl(op: str, **extra) -> bytes:
+    meta = {"ctrl_op": str(op)}
+    meta.update(extra)
+    enc = json.dumps(meta, separators=(",", ":")).encode()
+    return (
+        _HDR.pack(VERSION, KIND_CTRL, -1, -1, FLAG_META)
+        + _META_LEN.pack(len(enc)) + enc
+    )
 
 
 def encode_nack(reason: str, retry_after_ms: float = 0.0,
@@ -124,8 +155,8 @@ def encode_message(frame) -> bytes:
 
 
 def decode_message(data: bytes):
-    """→ Frame, EOS_FRAME, or :class:`Nack`. Raises ValueError on
-    malformed input."""
+    """→ Frame, EOS_FRAME, :class:`Nack`, or :class:`Ctrl`. Raises
+    ValueError on malformed input."""
     if len(data) < _HDR.size:
         raise ValueError(f"edge message too short: {len(data)}")
     version, kind, pts, dur, flags = _HDR.unpack_from(data)
@@ -157,6 +188,8 @@ def decode_message(data: bytes):
             float(meta.get("retry_after_ms", 0.0) or 0.0),
             meta.get("frame_id"),
         )
+    if kind == KIND_CTRL:
+        return Ctrl(str(meta.get("ctrl_op", "")), meta)
     tensors = decode_frame_tensors(data[off:])
     return Frame(
         tensors,

@@ -34,6 +34,32 @@ class TransportError(RuntimeError):
     pass
 
 
+class UnresolvableError(TransportError):
+    """The target hostname no longer resolves (NXDOMAIN/EAI_*): a
+    DISTINCT failure class — reconnect-with-backoff against a gone name
+    burns the whole retry budget for nothing, so callers fail fast (or,
+    in a fleet, eject the endpoint immediately) instead of retrying."""
+
+
+def resolve_target(host: str, port: int) -> Tuple[str, int]:
+    """Resolve ``host`` freshly (EVERY reconnect attempt must re-resolve
+    — a failed-over DNS record points somewhere new, and the old A
+    record may be the dead box). Returns the first (address, port);
+    raises :class:`UnresolvableError` when the name does not resolve."""
+    try:
+        infos = socket.getaddrinfo(
+            host, port, type=socket.SOCK_STREAM
+        )
+    except socket.gaierror as exc:
+        raise UnresolvableError(
+            f"cannot resolve {host!r}: {exc}"
+        ) from exc
+    if not infos:
+        raise UnresolvableError(f"cannot resolve {host!r}: empty answer")
+    addr = infos[0][4]
+    return str(addr[0]), int(addr[1])
+
+
 # --------------------------------------------------------------------- native
 class _NativeLib:
     _instance = None
@@ -138,6 +164,9 @@ class PyTransport:
 
     max_conns = 0            # 0 = unbounded (instance attr overrides)
     reject_payload: Optional[bytes] = None
+    connect_timeout = 10.0   # fleet clients shrink this: a blackholed
+    #                          endpoint must not stall a whole request
+    #                          deadline inside one connect()
 
     def __init__(self) -> None:
         self._is_server = False
@@ -260,7 +289,16 @@ class PyTransport:
         return self._listen_sock.getsockname()[1]
 
     def connect(self, host: str, port: int) -> None:
-        sock = socket.create_connection((host, port), timeout=10)
+        # create_connection re-resolves `host` on every call by design:
+        # a reconnect after failover must chase the CURRENT record
+        try:
+            sock = socket.create_connection(
+                (host, port), timeout=self.connect_timeout
+            )
+        except socket.gaierror as exc:
+            raise UnresolvableError(
+                f"cannot resolve {host!r}: {exc}"
+            ) from exc
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
         self._running = True

@@ -81,6 +81,21 @@ METRIC_CATALOG: Dict[str, str] = {
         "structured NACKs a tensor_query_client received, by reason "
         "label (counter)"
     ),
+    "nns_fleet_failovers_total": (
+        "fleet-client requests re-sent to another endpoint after their "
+        "first endpoint failed, NACKed draining, or rejected them "
+        "(counter; docs/edge-serving.md)"
+    ),
+    "nns_fleet_hedges_total": (
+        "hedged sends a fleet client fired at a second endpoint for "
+        "straggling requests (hedge-after-ms; first reply wins, the "
+        "loser is deduped by frame_id) (counter; docs/edge-serving.md)"
+    ),
+    "nns_endpoint_healthy": (
+        "1 while a fleet endpoint is in the dispatch rotation, 0 while "
+        "ejected (consecutive failures) or draining (rolling restart), "
+        "by endpoint label (gauge; docs/edge-serving.md)"
+    ),
     "nns_device_faults_total": (
         "device-plane faults classified per element, by kind label: "
         "oom / compile / device_lost / transient (counter; "

@@ -23,6 +23,11 @@ cumulative ``fps`` field (which includes compile/warmup).
 query-server client: queued/inflight/admitted/rejected, plus reject
 reasons — docs/edge-serving.md).
 
+``--fleet`` switches to the per-endpoint fleet view (one row per
+fleet-client endpoint: state/score/inflight/failovers from the health
+scorer, plus each query server's drain readiness flag —
+docs/edge-serving.md "Running a fleet").
+
 ``--models`` switches to the per-plane serving view (one row per
 serving plane: mode/devices, attached streams, cross-stream queue
 depth, dispatches, batch occupancy — plus a per-stream admit/serve
@@ -188,6 +193,70 @@ def render_clients(snap: dict) -> str:
             lines.append(f"  {name}: " + " ".join(footer))
     if not lines:
         return "(no admission-controlled query server in this snapshot)"
+    return "\n".join(lines)
+
+
+_FLEET_COLUMNS = (
+    ("CLIENT", 20), ("ENDPOINT", 22), ("STATE", 10), ("SCORE", 7),
+    ("INFL", 6), ("SERVED", 8), ("FAILS", 7), ("FAILOVER", 0),
+)
+
+
+def render_fleet(snap: dict) -> str:
+    """The ``--fleet`` view: one row per (fleet client, endpoint) from
+    the client's health scorer (``fleet_endpoints`` in its stats row —
+    docs/edge-serving.md "Running a fleet"), plus a per-client footer
+    with the failover/hedge/duplicate totals — and a row per query
+    SERVER advertising its drain readiness flag. Empty when nothing in
+    the snapshot serves a fleet."""
+    nodes: Dict[str, dict] = snap.get("nodes", {})
+    lines = []
+    head = "".join(
+        name.ljust(w) if w else name for name, w in _FLEET_COLUMNS
+    )
+    for name, row in nodes.items():
+        eps = row.get("fleet_endpoints")
+        if not isinstance(eps, dict):
+            continue
+        if not lines:
+            lines.append(head)
+            lines.append("-" * max(len(head), 72))
+        for addr, e in sorted(eps.items()):
+            cells = [
+                name[:19], str(addr)[:21],
+                str(e.get("state", "-"))[:9],
+                _num(e, "score", 2),
+                str(e.get("inflight", 0)),
+                str(e.get("served", 0)),
+                str(e.get("fails", 0)),
+                str(e.get("failovers", 0))
+                + (" unresolvable" if e.get("unresolvable") else ""),
+            ]
+            lines.append("".join(
+                c.ljust(w) if w else c
+                for c, (_, w) in zip(cells, _FLEET_COLUMNS)
+            ))
+        footer = [
+            f"healthy={row.get('fleet_healthy', '-')}",
+            f"failovers={row.get('fleet_failovers', 0)}",
+            f"hedges={row.get('fleet_hedges', 0)}",
+            f"dup-replies={row.get('fleet_duplicate_replies', 0)}",
+        ]
+        if row.get("fleet_stale_replies"):
+            footer.append(f"stale={row['fleet_stale_replies']}")
+        lines.append(f"  {name}: " + " ".join(footer))
+    # server half: the drain/rolling-restart readiness flags
+    for name, row in nodes.items():
+        readiness = row.get("adm_readiness")
+        if readiness is None:
+            continue
+        extra = (
+            f" drain-nacked={row['adm_drain_nacked']}"
+            if row.get("adm_drain_nacked") else ""
+        )
+        lines.append(f"  server {name}: {readiness}{extra}")
+    if not lines:
+        return "(no fleet client in this snapshot)"
     return "\n".join(lines)
 
 
@@ -385,6 +454,9 @@ def main(argv=None) -> int:
                     help="render one frame and exit (scripting)")
     ap.add_argument("--clients", action="store_true",
                     help="per-client admission view (query servers)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="per-endpoint fleet view (query clients + "
+                    "server readiness)")
     ap.add_argument("--models", action="store_true",
                     help="per-plane serving view (shared model planes)")
     ap.add_argument("--requests", action="store_true",
@@ -405,6 +477,8 @@ def main(argv=None) -> int:
             sys.stdout.write("\x1b[2J\x1b[H")
         if args.clients:
             print(render_clients(snap))
+        elif args.fleet:
+            print(render_fleet(snap))
         elif args.models:
             print(render_models(snap))
         elif args.requests:

@@ -2273,6 +2273,14 @@ class Executor:
                 got = astats()
                 if got:
                     s.update({f"adm_{k}": v for k, v in got.items()})
+            # fleet client (edge/fleet.py): per-endpoint health/served/
+            # failover rows plus hedge/duplicate counters when the
+            # element dispatches over a hosts= endpoint fleet
+            flstats = getattr(elem, "fleet_stats", None)
+            if callable(flstats):
+                got = flstats()
+                if got:
+                    s.update({f"fleet_{k}": v for k, v in got.items()})
             # circuit-breaker fallback (tensor_filter fallback-framework/
             # fallback-model): primary failures, opens, fallback serves
             cstats = getattr(elem, "circuit_stats", None)

@@ -237,15 +237,45 @@ def notify_shed(frame, node_name: str) -> None:
         )
 
 
+def notify_drain_flush(frame, node_name: str) -> None:
+    """A draining query server flushed ``frame`` from its admitted queue
+    before it consumed device time (``drain(flush_queued=True)`` —
+    docs/edge-serving.md "Running a fleet"): record the trace event and
+    NACK the client with the terminal-after-retry reason ``draining`` —
+    a fleet client re-routes the request to another endpoint, so a
+    rolling restart loses zero accepted requests. The admission budget
+    releases through the same PR-6 path as every other disposal. Lazy
+    edge import, same discipline as notify_shed."""
+    from nnstreamer_tpu import trace
+
+    meta = getattr(frame, "meta", None) or {}
+    tracer = trace.get()
+    if tracer is not None:
+        tracer.fault(
+            node_name, "drain-flush", None,
+            frame_id=meta.get("frame_id"),
+        )
+    srv = meta.get("_nns_srv")
+    if srv is not None:
+        from nnstreamer_tpu.edge.query import drain_flushed
+
+        drain_flushed(
+            srv, meta.get("client_id"), frame_id=meta.get("frame_id")
+        )
+
+
 def notify_discard(frame, node_name: str, action: str) -> None:
     """A fault policy disposed of ``frame`` (``drop``: consumed outright;
     ``route``: delivered to a dead-letter consumer). When the frame is an
     admitted edge request (``_nns_srv`` meta), return its admission
-    budget — and for drops, NACK the client (reason ``failed``) so the
-    request still reaches a terminal outcome instead of a silent
-    client-side timeout. Routed frames get no NACK: the dead-letter
-    consumer now owns the request's fate (it may even reply through the
-    serversink). Lazy edge import, same discipline as notify_shed."""
+    budget — and for drops, NACK the client (reason ``failed``; reason
+    ``draining`` while the origin server is in a graceful drain, so the
+    disposal reads as a restart artifact a fleet client re-routes, not a
+    verdict) so the request still reaches a terminal outcome instead of
+    a silent client-side timeout. Routed frames get no NACK: the
+    dead-letter consumer now owns the request's fate (it may even reply
+    through the serversink). Lazy edge import, same discipline as
+    notify_shed."""
     meta = getattr(frame, "meta", None)
     if not meta:
         return
