@@ -27,3 +27,8 @@ from nnstreamer_tpu.analysis.lint import (  # noqa: F401
     lint,
 )
 from nnstreamer_tpu.analysis.racecheck import run_race_lint  # noqa: F401
+from nnstreamer_tpu.analysis.xray import (  # noqa: F401
+    XrayResult,
+    dispatch_table,
+    xray,
+)

@@ -1,0 +1,438 @@
+"""Shared static byte/cost estimation for placement and nns-xray.
+
+One home for every "how many bytes" question the static tooling asks
+(docs/chain-analysis.md), so the Hermes-style placement planner
+(serving_plane/placement.py) and the chain analyzer (analysis/xray.py)
+cannot drift apart:
+
+- :func:`parse_bytes` / :func:`params_bytes` / :func:`spec_bytes` /
+  :func:`estimate_backend_bytes` / :func:`estimate_stage_bytes` — the
+  per-stage resident-memory estimators (moved here from placement.py,
+  which re-exports them for compatibility).
+- :func:`plan_transfer_boundaries` / :func:`predict_frame_transfers` —
+  the static mirror of the executor's host<->device negotiation
+  (``Node._out_wants_host``, SinkNode ``READS_HOST`` fetches, staged
+  H2D): every link where frame bytes will cross the host boundary,
+  with the per-frame byte count, so ``TransferTally`` measurements
+  have a prediction to be checked against
+  (``Executor.transfer_crosscheck``).
+- :func:`chain_cost` — per-chain params / activation / transient-HBM
+  bytes over :meth:`ExecPlan.chains` compile units.
+
+Everything here is abstract arithmetic over negotiated specs and
+params pytrees — ``eval_shape``-style, nothing is allocated on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.log import get_logger
+
+_log = get_logger("analysis.costmodel")
+
+
+def parse_bytes(raw: str) -> int:
+    """``"256M"`` → 268435456 (K/M/G binary suffixes; plain ints pass
+    through)."""
+    s = str(raw).strip()
+    if not s:
+        raise ValueError("empty byte size")
+    mult = 1
+    suffix = s[-1].upper()
+    if suffix in ("K", "M", "G"):
+        mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}[suffix]
+        s = s[:-1]
+    return int(float(s) * mult)
+
+
+def params_bytes(tree: Any) -> int:
+    """Total bytes of a params pytree (weights resident on device)."""
+    if tree is None:
+        return 0
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return total
+
+
+_VIDEO_CHANNELS = {"RGB": 3, "BGR": 3, "RGBA": 4, "BGRA": 4, "GRAY8": 1}
+
+
+def spec_bytes(spec: Any) -> int:
+    """Activation bytes of a TensorsSpec (0 for flexible/None specs).
+    Video MediaSpecs (a source feeding tensor_converter — the bytes a
+    staged H2D upload would move) estimate width x height x channels."""
+    if spec is None:
+        return 0
+    if getattr(spec, "media_type", None) == "video":
+        w = getattr(spec, "width", None)
+        h = getattr(spec, "height", None)
+        if not w or not h:
+            return 0
+        ch = _VIDEO_CHANNELS.get(getattr(spec, "format", "RGB"), 3)
+        return int(w) * int(h) * ch
+    if not getattr(spec, "is_static", False):
+        return 0
+    total = 0
+    for t in spec:
+        total += int(
+            np.prod(t.shape, dtype=np.int64)
+        ) * np.dtype(t.dtype.np_dtype).itemsize
+    return total
+
+
+def estimate_backend_bytes(backend: Any) -> int:
+    """Resident bytes an opened backend will hold on its device:
+    params (the dominant term for real models) + one in-flight set of
+    input/output activations. Abstract arithmetic over specs — nothing
+    is allocated."""
+    total = params_bytes(getattr(backend, "_params", None))
+    try:
+        in_spec, out_spec = backend.get_model_info()
+    except Exception:  # noqa: BLE001 — shape-polymorphic: activations unknown
+        return total
+    return total + spec_bytes(in_spec) + spec_bytes(out_spec)
+
+
+def estimate_stage_bytes(elem: Any) -> int:
+    """Per-stage estimate for a tensor_filter element (opens the
+    backend it will serve with anyway — no throwaway copy)."""
+    backend = elem._ensure_open()
+    return estimate_backend_bytes(backend)
+
+
+# -- static transfer prediction ---------------------------------------------
+#
+# The executor decides per link whether frame bytes cross the host
+# boundary (pipeline/executor.py Node._out_wants_host, SinkNode
+# READS_HOST, FusedNode staging; docs/streaming.md). The functions
+# below re-derive those decisions STATICALLY from the compiled plan so
+# the per-frame transfer bytes are a prediction, not only a runtime
+# tally.
+
+@dataclass(frozen=True)
+class TransferBoundary:
+    """One link where frame bytes cross the host<->device boundary."""
+
+    producer: str        # element whose output crosses
+    consumer: str        # element that triggers the crossing
+    direction: str       # "h2d" | "d2h"
+    bytes_per_frame: int
+    reason: str          # producer-fetch | host-node-fetch | sink-fetch
+    #                    # | stage
+
+
+def _is_transparent(e: Any) -> bool:
+    """Elements the executor wires AROUND for handoff purposes: queue
+    and capsfilter declare DEVICE_PASSTHROUGH (device arrays ride
+    through untouched); tee is eliminated at build, so a producer sees
+    the tee's consumers directly."""
+    from nnstreamer_tpu.elements.flow import Tee
+
+    return bool(getattr(type(e), "DEVICE_PASSTHROUGH", False)) or isinstance(
+        e, Tee
+    )
+
+
+def _effective_consumers(pipeline, e: Any) -> List[Any]:
+    """Downstream elements of ``e`` with transparent plumbing resolved
+    away (the post-elimination consumer set the executor negotiates
+    with)."""
+    out: List[Any] = []
+    seen = set()
+    frontier = [l.dst for l in pipeline.out_links(e)]
+    while frontier:
+        n = frontier.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if _is_transparent(n):
+            frontier.extend(l.dst for l in pipeline.out_links(n))
+        else:
+            out.append(n)
+    return out
+
+
+def _consumer_reads_host(plan, e: Any) -> bool:
+    """Static mirror of the consumer side of ``Node._out_wants_host``:
+    True when delivering a device array to ``e`` costs a D2H fetch
+    (at the producer or at the consumer's own node — tallied bytes are
+    the same either way)."""
+    from nnstreamer_tpu.elements.base import Routing, Sink, TensorOp
+
+    if getattr(type(e), "WANTS_HOST", False):
+        return True
+    if isinstance(e, Sink):
+        return bool(getattr(e, "READS_HOST", True))
+    if isinstance(e, Routing):
+        return False  # regroups frames without touching bytes
+    if isinstance(e, TensorOp):
+        if e in plan.seg_of:
+            return False  # fused: the segment chains on device
+        probe = getattr(e, "wants_host_input", None)
+        if callable(probe):
+            try:
+                return bool(probe())
+            except Exception:  # noqa: BLE001 — unopened backend: host path
+                return True
+        return True  # host-path TensorOp node reads host bytes
+    return True  # HostElement and anything unknown: assume host reader
+
+
+def _out_is_device(plan, e: Any, memo: Dict[int, bool]) -> bool:
+    """Static device-residency of an element's output frames."""
+    from nnstreamer_tpu.elements.base import Routing, Source, TensorOp
+
+    key = id(e)
+    if key in memo:
+        return memo[key]
+    memo[key] = False  # cycle guard (lint runs on arbitrary graphs)
+    pipeline = plan.pipeline
+
+    def inputs_device() -> bool:
+        return any(
+            _out_is_device(plan, l.src, memo) for l in pipeline.in_links(e)
+        )
+
+    if isinstance(e, Source):
+        dev = bool(getattr(e, "device", False))
+    elif e in plan.seg_of:
+        seg = plan.seg_of[e]
+        # identity segments (passthrough backends) forward frames
+        # untouched, so residency propagates; real programs emit device
+        # arrays (jax outputs count for the D2H tally even on the CPU
+        # backend — pipeline/transfer.py FrameFetch)
+        dev = True
+        try:
+            if seg.is_identity():
+                dev = any(
+                    _out_is_device(plan, l.src, memo)
+                    for l in pipeline.in_links(seg.first)
+                )
+        except Exception:  # noqa: BLE001 — unopened backend: not identity
+            dev = True
+    elif _is_transparent(e) or isinstance(e, Routing):
+        dev = inputs_device()
+    elif isinstance(e, TensorOp):
+        # host-path node: device-pinned filters (wants_host_input False)
+        # run a placed program and emit device arrays; plain host ops
+        # emit numpy
+        probe = getattr(e, "wants_host_input", None)
+        if callable(probe):
+            try:
+                dev = not probe()
+            except Exception:  # noqa: BLE001
+                dev = False
+        else:
+            dev = False
+    else:
+        dev = False  # HostElement / sinks produce nothing device
+    memo[key] = dev
+    return dev
+
+
+def plan_transfer_boundaries(
+    plan, assume_tpu: Optional[bool] = None
+) -> List[TransferBoundary]:
+    """Every host-boundary crossing the executor will pay per frame.
+
+    ``assume_tpu`` overrides the platform default: on a process-local
+    CPU backend staged H2D is a pass-through (pipeline/transfer.py
+    ``stage_frame``), so predicted h2d is 0 there; D2H fetches tally on
+    every backend. Pass ``assume_tpu=True`` for the what-would-TPU-pay
+    view nns-xray reports."""
+    from nnstreamer_tpu.elements.base import Sink, TensorOp
+    from nnstreamer_tpu.pipeline.transfer import default_backend_is_cpu
+
+    if assume_tpu is None:
+        assume_tpu = not default_backend_is_cpu()
+    pipeline = plan.pipeline
+    memo: Dict[int, bool] = {}
+    out: List[TransferBoundary] = []
+    for e in pipeline.elements:
+        if isinstance(e, Sink) or _is_transparent(e):
+            continue
+        if not pipeline.out_links(e):
+            continue
+        consumers = _effective_consumers(pipeline, e)
+        if not consumers:
+            continue
+        out_bytes = spec_bytes(e.out_specs[0]) if e.out_specs else 0
+        if _out_is_device(plan, e, memo):
+            readers = [
+                c for c in consumers if _consumer_reads_host(plan, c)
+            ]
+            if not readers:
+                continue
+            if len(readers) == len(consumers) and not any(
+                isinstance(c, Sink) for c in consumers
+            ):
+                # Node._out_wants_host: every consumer reads host and
+                # none is a sink — ONE coalesced producer-side fetch
+                out.append(TransferBoundary(
+                    e.name, ",".join(c.name for c in readers), "d2h",
+                    out_bytes, "producer-fetch",
+                ))
+                continue
+            for c in readers:
+                reason = (
+                    "sink-fetch" if isinstance(c, Sink)
+                    else "host-node-fetch"
+                )
+                out.append(TransferBoundary(
+                    e.name, c.name, "d2h", out_bytes, reason,
+                ))
+        elif assume_tpu:
+            # host-resident output: each fused-segment consumer stages
+            # its input to device (FusedNode H2D; free on local CPU)
+            for c in consumers:
+                if isinstance(c, TensorOp) and c in plan.seg_of:
+                    out.append(TransferBoundary(
+                        e.name, c.name, "h2d", out_bytes, "stage",
+                    ))
+    return out
+
+
+def predict_frame_transfers(
+    plan, assume_tpu: Optional[bool] = None
+) -> Dict[str, int]:
+    """Predicted host<->device bytes PER FRAME for a 1:1 pipeline —
+    the static counterpart of ``Executor.transfer_totals()`` divided
+    by frames produced. Cardinality-changing elements (rate limiters,
+    aggregation windows) make the per-frame view approximate; the
+    executor's cross-check weighs each boundary by its producer node's
+    own frame count instead."""
+    totals = {"h2d": 0, "d2h": 0}
+    for b in plan_transfer_boundaries(plan, assume_tpu=assume_tpu):
+        totals[b.direction] += b.bytes_per_frame
+    return totals
+
+
+# -- per-chain cost model ---------------------------------------------------
+
+@dataclass
+class ChainCost:
+    """Static memory/transfer cost of one compile-unit chain
+    (docs/chain-analysis.md "Cost model"):
+
+    - ``params_bytes``: member backends' weights, resident for the
+      chain's lifetime.
+    - ``activation_bytes``: one in-flight frame's negotiated inputs +
+      outputs summed over the chain's segments.
+    - ``transient_bytes``: peak per-segment working set — the widest
+      segment's input + output + jaxpr intermediate values, scaled by
+      the max micro-batch bucket (the arena XLA needs while that
+      program runs; upper bound, no buffer-reuse modeling).
+    - ``boundary_in_bytes`` / ``boundary_out_bytes``: per-frame bytes
+      entering/leaving the chain at its edges (what the chain would pay
+      at a host boundary if one appears there).
+    """
+
+    params_bytes: int = 0
+    activation_bytes: int = 0
+    transient_bytes: int = 0
+    boundary_in_bytes: int = 0
+    boundary_out_bytes: int = 0
+    segments: List[str] = field(default_factory=list)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self.params_bytes + self.transient_bytes
+
+
+def _segment_intermediate_bytes(seg) -> int:
+    """Sum of jaxpr intermediate-value bytes for one segment's composed
+    program at the negotiated per-frame signature (eval_shape-style —
+    abstract tracing only). 0 when the segment cannot be traced here
+    (unopened/host backend): the in+out activations still count."""
+    import jax
+
+    sig = seg._negotiated_sig()
+    if sig is None:
+        return 0
+    try:
+        composed = seg._compose()
+        shapes = [
+            jax.ShapeDtypeStruct(shape, dtype) for shape, dtype in sig
+        ]
+        jaxpr = jax.make_jaxpr(composed)(*shapes)
+    except Exception:  # noqa: BLE001 — cost model degrades, never raises
+        return 0
+    total = 0
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", None)
+            if shape is None:
+                continue
+            total += int(
+                np.prod(shape, dtype=np.int64)
+            ) * np.dtype(aval.dtype).itemsize
+    return total
+
+
+def chain_cost(chain, open_backends: bool = True) -> ChainCost:
+    """Static cost of one :class:`~nnstreamer_tpu.pipeline.graph.Chain`.
+    ``open_backends=False`` skips params estimation (no model load) —
+    activation/transient arithmetic still runs."""
+    cost = ChainCost(segments=[seg.name for seg in chain.segments])
+    for seg in chain.segments:
+        in_b = spec_bytes(seg.first.in_specs[0] if seg.first.in_specs else None)
+        out_b = spec_bytes(
+            seg.last.out_specs[0] if seg.last.out_specs else None
+        )
+        cost.activation_bytes += in_b + out_b
+        bucket = 1
+        cfg = seg.batch_config
+        if cfg is not None and getattr(cfg, "active", False) and cfg.buckets:
+            bucket = int(cfg.buckets[-1])
+        transient = (in_b + out_b + _segment_intermediate_bytes(seg)) * bucket
+        cost.transient_bytes = max(cost.transient_bytes, transient)
+        if open_backends:
+            for op in seg.ops:
+                ensure = getattr(op, "_ensure_open", None)
+                if not callable(ensure):
+                    continue
+                try:
+                    cost.params_bytes += params_bytes(
+                        getattr(ensure(), "_params", None)
+                    )
+                except Exception:  # noqa: BLE001 — unopenable: skip params
+                    pass
+    first, last = chain.segments[0], chain.segments[-1]
+    cost.boundary_in_bytes = spec_bytes(
+        first.first.in_specs[0] if first.first.in_specs else None
+    )
+    cost.boundary_out_bytes = spec_bytes(
+        last.last.out_specs[0] if last.last.out_specs else None
+    )
+    return cost
+
+
+def configured_device_bound() -> Optional[int]:
+    """The per-device HBM bound the placement planner and the W124
+    chain lint share: ``[plane] memory_per_device`` (bytes, K/M/G
+    suffixes). None = no bound declared, W124 stays silent."""
+    from nnstreamer_tpu.config import conf
+
+    raw = conf().get("plane", "memory_per_device", "")
+    if not raw:
+        return None
+    try:
+        return parse_bytes(raw)
+    except ValueError:
+        _log.warning(
+            "[plane] memory_per_device=%r is not a byte size; no bound",
+            raw,
+        )
+        return None

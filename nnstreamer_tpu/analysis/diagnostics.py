@@ -189,6 +189,45 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "block-native default (kv-attn=auto/block) attends the arena "
         "directly through the block tables with no gathered view",
     ),
+    # -- nns-xray chain analysis (analysis/xray.py, docs/chain-analysis.md) -
+    "NNS-W120": (
+        Severity.WARNING, "chain-split-by-host-node",
+        "a host-path tensor op severs an otherwise compileable chain "
+        "of fused segments: frames materialize to host and re-stage to "
+        "device at the split, and the span can never become one "
+        "resident program; a device-capable framework (or "
+        "postproc=device for decoders, which W116 pinpoints) rejoins "
+        "the chain",
+    ),
+    "NNS-W121": (
+        Severity.WARNING, "recompile-hazard-cache-keys",
+        "a fused segment's jit-cache key space is unbounded or "
+        "explodes: a flexible (per-frame shape) input spec under "
+        "micro-batching, or arity x buckets x donation variants over "
+        "the retrace bound — each new key is a fresh XLA compile on "
+        "the hot path",
+    ),
+    "NNS-W122": (
+        Severity.WARNING, "dtype-promotion-in-device-segment",
+        "a device segment's traced program silently promotes to f64/"
+        "complex128 (or drifts from its negotiated output dtype) with "
+        "no 64-bit input: on TPU that is an emulated-precision slowdown "
+        "and a doubled activation footprint the specs never declared",
+    ),
+    "NNS-W123": (
+        Severity.WARNING, "donation-defeating-output",
+        "a segment streams with donated input buffers (donate under "
+        "ring-depth>1) but no output matches any input's shape/dtype, "
+        "so XLA can reuse nothing: every frame pays a fresh output "
+        "allocation while the donated arena is discarded",
+    ),
+    "NNS-W124": (
+        Severity.WARNING, "chain-transient-hbm-over-bound",
+        "a chain's static cost (resident params + peak per-program "
+        "transient working set at the max micro-batch bucket) exceeds "
+        "the declared [plane] memory_per_device bound: the chain OOMs "
+        "on a real chip even though each stage fits alone",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -1010,130 +1010,32 @@ def _kv_cache_pass(pipeline: Pipeline, report: LintReport) -> None:
 
 
 def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
-    """NNS-W113: a host-bound element between two device-capable
-    (traceable) filters forces every frame through host memory and back
-    mid-stream — the resident device-to-device segment handoff
-    (docs/streaming.md) only works across contiguous device segments
-    and pure plumbing (queue/capsfilter/tee carry device arrays
-    untouched). Device capability is read STATICALLY from the
-    framework's backend class (no backend open, no model load): the
-    class overrides ``traceable_fn``."""
-    from nnstreamer_tpu import registry
-    from nnstreamer_tpu.backends.base import Backend
+    """NNS-W113/W116/W120: a host-bound element between two
+    device-capable (traceable) filters forces every frame through host
+    memory and back mid-stream — the resident device-to-device segment
+    handoff (docs/streaming.md) only works across contiguous device
+    segments and pure plumbing (queue/capsfilter/tee carry device
+    arrays untouched). The predicates live in analysis/xray.py (shared
+    with the chain analyzer so the two can never disagree about what
+    splits a chain); capability is read STATICALLY from the backend
+    class — no backend open, no model load. ONE code per boundary:
+    W116 when the split is a decoder with an unused device path (a
+    one-property fix), W120 when a host-path tensor op severs a
+    compileable chain (docs/chain-analysis.md), W113 for host elements
+    outside the tensor-op surface (a structural restructure)."""
+    from nnstreamer_tpu.analysis.xray import (
+        decoder_will_fuse,
+        host_bound,
+        host_postproc_with_device_path,
+        reaches_capable,
+    )
     from nnstreamer_tpu.elements.base import TensorOp
-    from nnstreamer_tpu.elements.decoder import TensorDecoder
-    from nnstreamer_tpu.elements.filter import TensorFilter
-    from nnstreamer_tpu.elements.flow import CapsFilter, Queue, Tee
-    from nnstreamer_tpu.elements.routing import Routing
-
-    def device_capable(e) -> bool:
-        if not isinstance(e, TensorFilter):
-            return False
-        fw = e.get_property("framework")
-        if not fw or str(fw) == "auto":
-            return False
-        if e.get_property("fallback-framework"):
-            return False  # deliberate per-frame fusion barrier
-        try:
-            if int(e.get_property("replicas") or 0) > 1:
-                return False  # idem
-        except (TypeError, ValueError):
-            pass
-        try:
-            cls = registry.get(registry.KIND_FILTER, str(fw))
-        except KeyError:
-            return False  # unknown framework has its own diagnostic
-        return cls.traceable_fn is not Backend.traceable_fn
-
-    def transparent(e) -> bool:
-        # plumbing a device array rides through untouched: thread/
-        # buffer boundaries and fan-out that never read tensor bytes
-        return isinstance(e, (Queue, CapsFilter, Tee))
-
-    def reaches_capable(e, links) -> bool:
-        seen = {e}
-        frontier = [n for n in links(e) if n not in seen]
-        while frontier:
-            n = frontier.pop()
-            if n in seen:
-                continue
-            seen.add(n)
-            if device_capable(n):
-                return True
-            if transparent(n):
-                frontier.extend(links(n))
-        return False
 
     def ups(e):
         return [ln.src for ln in pipeline.in_links(e)]
 
     def downs(e):
         return [ln.dst for ln in pipeline.out_links(e)]
-
-    def host_bound(e) -> bool:
-        # elements that read/produce tensor bytes on host. Routing
-        # (mux/demux/split/join) regroups frames without touching
-        # bytes, so it passes device arrays through; traceable
-        # TensorOps (tensor_transform, device filters) FUSE into the
-        # chain — no split to warn about.
-        if transparent(e) or isinstance(e, Routing):
-            return False
-        if isinstance(e, TensorFilter):
-            fw = e.get_property("framework")
-            if not fw or str(fw) == "auto":
-                return False  # can't tell statically; never open here
-            try:
-                cls = registry.get(registry.KIND_FILTER, str(fw))
-            except KeyError:
-                return False
-            return cls.traceable_fn is Backend.traceable_fn
-        if isinstance(e, TensorOp):
-            try:
-                return not e.is_traceable()
-            except Exception:  # noqa: BLE001 — can't tell without opening
-                return False
-        return hasattr(e, "host_process")
-
-    def host_postproc_with_device_path(e) -> bool:
-        """NNS-W116 static capability read (mirrors W113's backend-class
-        read — no negotiation, no model/labels load): a tensor_decoder
-        that will RUN host (postproc=host, or postproc=auto with a
-        subplugin that offers no auto-fuse make_fn) while its subplugin
-        declares a device decode path for these options."""
-        if not isinstance(e, TensorDecoder):
-            return False
-        if e.postproc == "device" or e.mode == "custom-code":
-            return False
-        try:
-            cls = registry.get(registry.KIND_DECODER, e.mode)
-        except KeyError:
-            return False  # unknown mode has its own diagnostic
-        probe = getattr(cls, "device_capable", None)
-        if probe is None or not probe(e.options):
-            return False
-        if e.postproc == "auto" and getattr(cls, "make_fn", None) is not None:
-            return False  # auto already fuses this subplugin
-        return True
-
-    def decoder_will_fuse(e) -> bool:
-        """Decoders whose is_traceable() is False only because lint
-        never negotiates: postproc=device always fuses (or fails
-        negotiation loudly), and auto fuses subplugins that offer a
-        make_fn for these options (image_labeling without labels)."""
-        if not isinstance(e, TensorDecoder) or e.mode == "custom-code":
-            return False
-        if e.postproc == "device":
-            return True
-        if e.postproc != "auto":
-            return False
-        try:
-            cls = registry.get(registry.KIND_DECODER, e.mode)
-        except KeyError:
-            return False
-        if getattr(cls, "make_fn", None) is None:
-            return False
-        probe = getattr(cls, "device_capable", None)
-        return probe is None or bool(probe(e.options))
 
     for e in pipeline.elements:
         if not host_bound(e) or decoder_will_fuse(e):
@@ -1151,6 +1053,21 @@ def _resident_handoff_pass(pipeline: Pipeline, report: LintReport) -> None:
                 "path",
                 "set postproc=device to fold the decode into the "
                 "adjacent fused segment (docs/on-device-ops.md)",
+            )
+            continue
+        if isinstance(e, TensorOp):
+            # host-path tensor op (host-backend filter, non-traceable
+            # op, device-path-less decoder) severing a chain: the
+            # chain-granular diagnostic (nns-xray reports the same
+            # boundary with the chains it severs)
+            report.add(
+                "NNS-W120", e.name,
+                "host-path op severs an otherwise compileable chain "
+                "of fused segments: frames materialize to host and "
+                "re-stage to device here every frame",
+                "give this op a device-capable framework/traceable "
+                "path, or move it outside the device span "
+                "(docs/chain-analysis.md)",
             )
             continue
         report.add(

@@ -82,6 +82,7 @@ def _emitted_codes() -> Set[str]:
         # `lint` to the function, and the as-import would grab that
         "nnstreamer_tpu.analysis.lint",
         "nnstreamer_tpu.analysis.racecheck",
+        "nnstreamer_tpu.analysis.xray",
         "nnstreamer_tpu.pipeline.sanitize",
     ):
         mod = importlib.import_module(name)
@@ -190,6 +191,53 @@ def obs_self_check() -> List[str]:
                     f"metric {name} is not documented in "
                     "docs/observability.md"
                 )
+    return problems
+
+
+# -- nns-xray self-check: chain codes wired emitters<->catalog<->docs -------
+
+_XRAY_CODES = ("NNS-W120", "NNS-W121", "NNS-W122", "NNS-W123", "NNS-W124")
+
+
+def xray_self_check() -> List[str]:
+    """Validate the chain-analysis diagnostics both ways: every
+    W120-W124 code is in the catalog, has an emitter in
+    analysis/xray.py, and is documented in docs/chain-analysis.md AND
+    docs/linting.md; conversely every NNS code docs/chain-analysis.md
+    mentions exists in the catalog (no doc drift either direction)."""
+    import importlib
+    import os
+
+    from nnstreamer_tpu.analysis.diagnostics import CATALOG
+
+    problems: List[str] = []
+    mod = importlib.import_module("nnstreamer_tpu.analysis.xray")
+    emitted = set(_CODE_REF.findall(inspect.getsource(mod)))
+    for code in _XRAY_CODES:
+        if code not in CATALOG:
+            problems.append(f"chain code {code} missing from the catalog")
+        if code not in emitted:
+            problems.append(
+                f"chain code {code} has no emitter in analysis/xray.py"
+            )
+    for doc_name in ("chain-analysis.md", "linting.md"):
+        doc = os.path.join(_repo_root(), "docs", doc_name)
+        if not os.path.isfile(doc):  # repo checkouts only
+            continue
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        for code in _XRAY_CODES:
+            if code not in text:
+                problems.append(
+                    f"{code} is not documented in docs/{doc_name}"
+                )
+        if doc_name == "chain-analysis.md":
+            for code in sorted(set(_CODE_REF.findall(text))):
+                if code not in CATALOG:
+                    problems.append(
+                        f"docs/chain-analysis.md mentions unknown code "
+                        f"{code}"
+                    )
     return problems
 
 

@@ -383,9 +383,13 @@ def block_attention(q, cache_k, cache_v, tables, pos, fresh_kv,
     float, or ``(int8 payload, [N, bs, KV] scales)`` tuples."""
     if impl not in ("auto", "jnp", "pallas"):
         raise ValueError(f"block_attention impl {impl!r} not auto/jnp/pallas")
-    if impl == "pallas" or (
+    from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+
+    use_pallas = impl == "pallas" or (
         impl == "auto" and jax.default_backend() == "tpu"
-    ):
+    )
+    _record_dispatch("block_attention", "pallas" if use_pallas else "jnp")
+    if use_pallas:
         from nnstreamer_tpu.ops.pallas.paged_attention import (
             make_paged_attention,
         )

@@ -1099,6 +1099,12 @@ class ContinuousBatcher:
                 "layout has no block table to attend through"
             )
         paged_attn_fn = None
+        from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+
+        _record_dispatch(
+            "serving_attention",
+            "pallas" if attn_impl == "pallas" else "xla",
+        )
         if attn_impl == "pallas":
             if self._paged:
                 # the block-table kernel: attends the arena through the

@@ -95,7 +95,13 @@ def nms(
     """
     if impl not in ("auto", "jnp", "pallas"):
         raise ValueError(f"nms impl {impl!r} not auto/jnp/pallas")
-    if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
+    from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+
+    use_pallas = impl == "pallas" or (
+        impl == "auto" and jax.default_backend() == "tpu"
+    )
+    _record_dispatch("nms", "pallas" if use_pallas else "jnp")
+    if use_pallas:
         from nnstreamer_tpu.ops.pallas.nms import nms as pallas_nms
 
         # explicit impl=pallas off-TPU runs the interpreter (parity

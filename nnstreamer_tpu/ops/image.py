@@ -14,20 +14,28 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
 
-def _use_pallas(impl: str) -> bool:
+
+def _use_pallas(impl: str, op: str = "") -> bool:
     """Implementation pick for the image ops: ``auto`` takes the Pallas
     kernel on a real TPU backend (MXU-blocked resampling,
     ops/pallas/image_kernels.py) and the jnp expression elsewhere (the
     interpreter would be a pessimization on the CPU hot path; interpret
-    mode stays a parity-test tool)."""
+    mode stays a parity-test tool). A non-empty ``op`` records the
+    resolved choice in the dispatch tally (ops/dispatch.py) so
+    ``nns-xray --dispatch`` can prove which kernel engaged."""
     if impl == "pallas":
-        return True
-    if impl == "jnp":
-        return False
-    if impl != "auto":
+        use = True
+    elif impl == "jnp":
+        use = False
+    elif impl != "auto":
         raise ValueError(f"image op impl {impl!r} not auto/jnp/pallas")
-    return jax.default_backend() == "tpu"
+    else:
+        use = jax.default_backend() == "tpu"
+    if op:
+        _record_dispatch(op, "pallas" if use else "jnp")
+    return use
 
 
 def crop_and_resize(image, boxes, out_h: int, out_w: int, impl: str = "auto"):
@@ -37,7 +45,7 @@ def crop_and_resize(image, boxes, out_h: int, out_w: int, impl: str = "auto"):
     coordinates (any float dtype; degenerate boxes clamp to edge pixels)
     → [N, out_h, out_w, C], image dtype.
     """
-    if _use_pallas(impl):
+    if _use_pallas(impl, op="crop_and_resize"):
         from nnstreamer_tpu.ops.pallas.image_kernels import (
             crop_and_resize as pallas_crop,
         )
@@ -111,7 +119,7 @@ def resize_bilinear(image, out_h: int, out_w: int, impl: str = "auto"):
     drift apart numerically."""
     squeeze = image.ndim == 3
     img = image[None] if squeeze else image
-    if _use_pallas(impl):
+    if _use_pallas(impl, op="resize_bilinear"):
         from nnstreamer_tpu.ops.pallas.image_kernels import (
             resize_bilinear as pallas_resize,
         )

@@ -1647,6 +1647,11 @@ class Executor:
         # keeps the hot path at one attribute check per frame.
         self.metrics = obs_metrics.get()
         self._metrics_server = None
+        # nns-xray cost-model cross-check (NNS_XRAY_CROSSCHECK env /
+        # [executor] xray_crosscheck): stop() then compares the static
+        # transfer prediction against TransferTally measured bytes and
+        # logs the verdict (docs/chain-analysis.md)
+        self.xray_crosscheck = transfer.xray_crosscheck_enabled()
         self._t_run0: Optional[float] = None
         # transfer-tally baseline, re-snapshotted at start()
         self._transfer_t0: Dict[str, int] = transfer.tally.snapshot()
@@ -2121,6 +2126,20 @@ class Executor:
         if self.metrics is not None:
             # after the join so late in-flight fetches are counted
             transfer.mirror_into(self.metrics)
+        if self.xray_crosscheck:
+            # after the join for the same reason: the tally must hold
+            # every fetch this run will ever make before it is compared
+            try:
+                cc = self.transfer_crosscheck()
+                level = (
+                    _log.warning if any(cc["delta"].values()) else _log.info
+                )
+                level(
+                    "xray cross-check: predicted=%s measured=%s delta=%s",
+                    cc["predicted"], cc["measured"], cc["delta"],
+                )
+            except Exception as exc:  # noqa: BLE001 — advisory, never fatal
+                _log.warning("xray cross-check failed: %s", exc)
         for e in self.plan.pipeline.elements:
             e.stop()
         leaked = [t.name for t in threads if t.is_alive()]
@@ -2376,4 +2395,36 @@ class Executor:
         return {
             "h2d": now["h2d_bytes"] - base["h2d_bytes"],
             "d2h": now["d2h_bytes"] - base["d2h_bytes"],
+        }
+
+    def transfer_crosscheck(self) -> Dict[str, Dict[str, int]]:
+        """Verify the static cost model against this run: the predicted
+        host-boundary bytes (analysis/costmodel.py
+        ``plan_transfer_boundaries`` — the same plan this executor
+        built from) weighed by each boundary's OWN producer frame count,
+        against the ``TransferTally`` measured totals. Rate limiters and
+        aggregation windows change per-node cardinality, which is why
+        each boundary multiplies by its producer node's
+        ``frames_processed`` rather than a single pipeline frame count.
+        Returns ``{"predicted": .., "measured": .., "delta": ..}``; a
+        zero delta on a serial run is the model's proof
+        (docs/chain-analysis.md "Runtime cross-check")."""
+        from nnstreamer_tpu.analysis.costmodel import (
+            plan_transfer_boundaries,
+        )
+
+        elems = {e.name: e for e in self.plan.pipeline.elements}
+        predicted = {"h2d": 0, "d2h": 0}
+        for b in plan_transfer_boundaries(self.plan):
+            node = self._node_of.get(elems.get(b.producer))
+            if node is None:
+                continue
+            predicted[b.direction] += b.bytes_per_frame * node.frames_processed
+        measured = self.transfer_totals()
+        return {
+            "predicted": predicted,
+            "measured": measured,
+            "delta": {
+                k: measured[k] - predicted[k] for k in ("h2d", "d2h")
+            },
         }

@@ -765,7 +765,90 @@ class FusedSegment:
 
 
 @dataclass
+class Chain:
+    """One compile unit (docs/chain-analysis.md): a maximal run of
+    fused segments joined by device-resident handoffs. Today the
+    executor runs each segment as its own XLA program and the handoff
+    is a device-array pass between nodes; a chain is exactly the span
+    ROADMAP item 1 would compile into ONE resident program, so
+    ``nns-xray`` reports and lints at this granularity."""
+
+    segments: List[FusedSegment]
+
+    @property
+    def first(self) -> TensorOp:
+        return self.segments[0].first
+
+    @property
+    def last(self) -> TensorOp:
+        return self.segments[-1].last
+
+    @property
+    def name(self) -> str:
+        return " => ".join(s.name for s in self.segments)
+
+    @property
+    def ops(self) -> List[TensorOp]:
+        return [op for s in self.segments for op in s.ops]
+
+
+@dataclass
 class ExecPlan:
     pipeline: Pipeline
     segments: List[FusedSegment]
     seg_of: Dict[Element, FusedSegment]
+
+    def _device_successor(
+        self, seg: FusedSegment
+    ) -> Optional[FusedSegment]:
+        """The unique fused segment ``seg`` hands frames to on device:
+        reachable from ``seg.last`` across only ``DEVICE_PASSTHROUGH``
+        plumbing (queue, capsfilter — the executor's resident handoff
+        rides through those untouched, the same transparency
+        ``Node._out_wants_host`` negotiates). Anything else on the path
+        — a host-path op, routing, tee fan-out, a ``WANTS_HOST``
+        consumer — severs the chain, as does reaching two different
+        segments (no single linear program covers a fork)."""
+        frontier = [l.dst for l in self.pipeline.out_links(seg.last)]
+        seen: set = set()
+        hit: Optional[FusedSegment] = None
+        while frontier:
+            e = frontier.pop()
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            s2 = self.seg_of.get(e)
+            if s2 is not None and s2 is not seg:
+                if hit is not None and hit is not s2:
+                    return None
+                hit = s2
+            elif getattr(type(e), "DEVICE_PASSTHROUGH", False):
+                frontier.extend(
+                    l.dst for l in self.pipeline.out_links(e)
+                )
+        return hit
+
+    def chains(self) -> List[Chain]:
+        """Compile units: maximal runs of fused segments joined by
+        device handoffs (:class:`Chain`), in plan (topological) order.
+        Every segment lands in exactly one chain; a pipeline with no
+        host hop between its filters is a single chain end to end."""
+        next_of: Dict[int, FusedSegment] = {}
+        has_prev: set = set()
+        for seg in self.segments:
+            succ = self._device_successor(seg)
+            if succ is not None:
+                next_of[id(seg)] = succ
+                has_prev.add(id(succ))
+        out: List[Chain] = []
+        for seg in self.segments:
+            if id(seg) in has_prev:
+                continue
+            run = [seg]
+            while id(run[-1]) in next_of:
+                nxt = next_of[id(run[-1])]
+                if any(s is nxt for s in run):  # cycle guard
+                    break
+                run.append(nxt)
+            out.append(Chain(segments=run))
+        return out

@@ -30,6 +30,7 @@ for the resident streaming executor's double-buffered frame ring:
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
@@ -442,6 +443,20 @@ def resolve_ring_depth(elems) -> int:
         _log.warning("ring-depth=%r is not an int; using 2", raw)
         depth = 2
     return max(1, min(32, depth))
+
+
+def xray_crosscheck_enabled() -> bool:
+    """``NNS_XRAY_CROSSCHECK`` env first, then ``[executor]
+    xray_crosscheck`` (default off): the executor then compares the
+    nns-xray static transfer prediction against this tally at stop()
+    and logs the verdict — the cost model's verification loop
+    (docs/chain-analysis.md)."""
+    raw = os.environ.get("NNS_XRAY_CROSSCHECK")
+    if raw is not None:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    from nnstreamer_tpu.config import conf
+
+    return conf().get_bool("executor", "xray_crosscheck", False)
 
 
 def donation_enabled() -> bool:

@@ -30,8 +30,16 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence
 
-import numpy as np
-
+# byte estimators live in the shared cost model (analysis/costmodel.py)
+# so placement and nns-xray price stages identically; re-exported here
+# because this was their original home
+from nnstreamer_tpu.analysis.costmodel import (  # noqa: F401 — re-export
+    estimate_backend_bytes,
+    estimate_stage_bytes,
+    params_bytes,
+    parse_bytes,
+    spec_bytes,
+)
 from nnstreamer_tpu.log import get_logger
 
 _log = get_logger("serving_plane.placement")
@@ -40,68 +48,6 @@ _log = get_logger("serving_plane.placement")
 class PlacementError(RuntimeError):
     """No placement satisfies the memory bound (a stage exceeds one
     chip, or the chips are collectively full)."""
-
-
-def parse_bytes(raw: str) -> int:
-    """``"256M"`` → 268435456 (K/M/G binary suffixes; plain ints pass
-    through)."""
-    s = str(raw).strip()
-    if not s:
-        raise ValueError("empty byte size")
-    mult = 1
-    suffix = s[-1].upper()
-    if suffix in ("K", "M", "G"):
-        mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}[suffix]
-        s = s[:-1]
-    return int(float(s) * mult)
-
-
-def params_bytes(tree: Any) -> int:
-    """Total bytes of a params pytree (weights resident on device)."""
-    if tree is None:
-        return 0
-    import jax
-
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(tree):
-        shape = getattr(leaf, "shape", None)
-        if shape is None:
-            continue
-        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
-        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
-    return total
-
-
-def spec_bytes(spec: Any) -> int:
-    """Activation bytes of a TensorsSpec (0 for flexible/None specs)."""
-    if spec is None or not getattr(spec, "is_static", False):
-        return 0
-    total = 0
-    for t in spec:
-        total += int(
-            np.prod(t.shape, dtype=np.int64)
-        ) * np.dtype(t.dtype.np_dtype).itemsize
-    return total
-
-
-def estimate_backend_bytes(backend: Any) -> int:
-    """Resident bytes an opened backend will hold on its device:
-    params (the dominant term for real models) + one in-flight set of
-    input/output activations. Abstract arithmetic over specs — nothing
-    is allocated."""
-    total = params_bytes(getattr(backend, "_params", None))
-    try:
-        in_spec, out_spec = backend.get_model_info()
-    except Exception:  # noqa: BLE001 — shape-polymorphic: activations unknown
-        return total
-    return total + spec_bytes(in_spec) + spec_bytes(out_spec)
-
-
-def estimate_stage_bytes(elem: Any) -> int:
-    """Per-stage estimate for a tensor_filter element (opens the
-    backend it will serve with anyway — no throwaway copy)."""
-    backend = elem._ensure_open()
-    return estimate_backend_bytes(backend)
 
 
 def plan_placement(
@@ -165,19 +111,9 @@ def plan_placement(
 
 
 def _configured_bound() -> Optional[int]:
-    from nnstreamer_tpu.config import conf
+    from nnstreamer_tpu.analysis.costmodel import configured_device_bound
 
-    raw = conf().get("plane", "memory_per_device", "")
-    if not raw:
-        return None
-    try:
-        return parse_bytes(raw)
-    except ValueError:
-        _log.warning(
-            "[plane] memory_per_device=%r is not a byte size; placement "
-            "stays manual", raw,
-        )
-        return None
+    return configured_device_bound()
 
 
 def place_pipeline(

@@ -464,6 +464,64 @@ class TestSatelliteFixes:
             registry.unregister(registry.KIND_ELEMENT, "strictsrc")
 
 
+# -- W113/W116/W120: one code per severed device chain -----------------------
+
+class TestChainSplitCodeDeferral:
+    """The resident-handoff pass emits exactly ONE code per boundary:
+    W116 for fusable decoders (one-property fix), W120 for host-path
+    tensor ops (the chain-granular diagnostic nns-xray shares), W113
+    only for host elements outside the tensor-op surface — pinned both
+    ways so the three can never double-report."""
+
+    HOST_SPLIT = (
+        "videotestsrc device=true num-frames=4 width=16 height=16 ! "
+        "tensor_converter ! tensor_filter framework=scaler ! "
+        "tensor_filter name=hostop framework=hostscaler ! "
+        "tensor_filter framework=scaler ! fakesink"
+    )
+
+    def test_host_tensor_op_fires_w120_not_w113_or_w116(self):
+        codes = [d.code for d in lint(self.HOST_SPLIT).diagnostics]
+        assert "NNS-W120" in codes
+        assert "NNS-W113" not in codes
+        assert "NNS-W116" not in codes
+
+    def test_fusable_decoder_keeps_w116_not_w120(self):
+        r = lint(
+            "tensorsrc dimensions=25:10 types=float32 num-frames=4 ! "
+            "tensor_filter framework=scaler ! "
+            "tensor_decoder mode=bounding_boxes option1=yolov5 ! "
+            "tensor_filter framework=scaler ! fakesink"
+        )
+        codes = [d.code for d in r.diagnostics]
+        assert "NNS-W116" in codes
+        assert "NNS-W120" not in codes
+
+    def test_non_tensor_op_host_element_keeps_w113(self):
+        from nnstreamer_tpu import registry
+        from nnstreamer_tpu.elements.base import Element
+
+        class HostPassthru(Element):
+            def negotiate(self, in_specs):
+                return list(in_specs)
+
+            def host_process(self, frame):
+                return frame
+
+        registry.register(registry.KIND_ELEMENT, "hostpassthru", HostPassthru)
+        try:
+            r = lint(
+                "videotestsrc device=true width=16 height=16 ! "
+                "tensor_converter ! tensor_filter framework=scaler ! "
+                "hostpassthru ! tensor_filter framework=scaler ! fakesink"
+            )
+            codes = [d.code for d in r.diagnostics]
+            assert "NNS-W113" in codes
+            assert "NNS-W120" not in codes
+        finally:
+            registry.unregister(registry.KIND_ELEMENT, "hostpassthru")
+
+
 # -- the docs/examples sweep -------------------------------------------------
 
 def _is_pipelineish(text):
@@ -516,7 +574,8 @@ def _embedded_pipeline_strings():
     for doc in ("elements.md", "linting.md", "batching.md",
                 "fault-tolerance.md", "sanitizer.md", "observability.md",
                 "edge-serving.md", "resilience.md", "streaming.md",
-                "serving-plane.md", "llm-serving.md", "on-device-ops.md"):
+                "serving-plane.md", "llm-serving.md", "on-device-ops.md",
+                "chain-analysis.md"):
         with open(os.path.join(REPO, "docs", doc)) as f:
             for cand in _candidate_pipelines_from_text(f.read()):
                 found.append((doc, cand))

@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -59,4 +61,36 @@ def test_san_diagnostic_catalog_covers_code():
     from nnstreamer_tpu.analysis.selfcheck import san_self_check
 
     problems = san_self_check()
+    assert not problems, "\n".join(problems)
+
+
+def test_xray_chain_codes_wired_both_ways():
+    """nns-xray --self-check: every chain diagnostic (NNS-W120..W124)
+    is cataloged, has an emitter in analysis/xray.py, and is documented
+    in docs/chain-analysis.md AND docs/linting.md; conversely the chain
+    doc mentions no unknown codes (tools/check_style.py runs the same
+    gate on whole-tree runs)."""
+    from nnstreamer_tpu.analysis.selfcheck import xray_self_check
+
+    problems = xray_self_check()
+    assert not problems, "\n".join(problems)
+
+
+@pytest.mark.slow
+def test_documented_pipelines_xray_clean():
+    """Every pipeline string embedded in examples/ and docs/ must xray
+    clean of the chain diagnostics W120-W124 — a shipped snippet firing
+    one is either a bad example or a false positive
+    (tools/check_style.py runs the same gate on whole-tree runs; slow:
+    it compiles ~20 documented pipelines, and tier-1 seconds displace
+    passing dots at the truncated tail of the 870 s budget)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_style", os.path.join(REPO, "tools", "check_style.py")
+    )
+    check_style = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(check_style)
+    assert check_style.documented_pipeline_strings(), "sweep found nothing"
+    problems = check_style.run_xray_docs_gate()
     assert not problems, "\n".join(problems)

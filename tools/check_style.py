@@ -13,6 +13,10 @@ Rules for tracked .py files (and the C++ under native/):
   forces it off entirely)
 - `nns-san --race nnstreamer_tpu/` is clean: the package source obeys
   its own concurrency idioms (same whole-tree-only gating)
+- `nns-xray --self-check` passes (chain diagnostics W120-W124 wired
+  emitters<->catalog<->docs both ways) and every pipeline string in
+  examples/ and docs/ xrays clean of the chain diagnostics (same
+  whole-tree-only gating)
 
 Usage: python tools/check_style.py [paths...]   (default: repo tree)
 Exit 0 clean, 1 with findings listed one per line.
@@ -113,6 +117,107 @@ def run_race_lint_gate() -> list:
     return [f"race: {d}" for d in report.diagnostics]
 
 
+def run_xray_self_check() -> list:
+    """Run nns-xray --self-check in-process: a chain diagnostic
+    (NNS-W120..W124) missing from the catalog, without an emitter, or
+    undocumented in docs/chain-analysis.md + docs/linting.md is a style
+    problem — as is a doc mentioning a code that doesn't exist."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.selfcheck import xray_self_check
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"nns-xray --self-check could not run: {exc}"]
+    return [f"xray: {p}" for p in xray_self_check()]
+
+
+def documented_pipeline_strings() -> list:
+    """(source, description) for every pipeline launch string embedded
+    in examples/*.py and docs/*.md — double-quoted launch strings plus
+    paragraph-joined blocks, validated by the real tokenizer (the same
+    heuristic as the tests' lint-clean sweep)."""
+    import ast
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from nnstreamer_tpu.pipeline.parse import ParseError, scan_description
+
+    def pipelineish(text):
+        if " ! " not in text:
+            return False
+        try:
+            items = scan_description(text)
+        except (ParseError, ValueError):
+            return False
+        n_elems = sum(1 for it in items if it[0] in ("element", "caps"))
+        return n_elems >= 2 and any(it[0] == "bang" for it in items)
+
+    def candidates(text):
+        seen = set()
+        flat = " ".join(ln.strip().rstrip("\\").strip()
+                        for ln in text.splitlines())
+        for m in re.finditer(r'"([^"]+ ! [^"]+)"', flat):
+            cand = m.group(1).strip()
+            if cand not in seen and pipelineish(cand):
+                seen.add(cand)
+                yield cand
+        for para in re.split(r"\n\s*\n", text):
+            joined = " ".join(ln.strip().rstrip("\\").strip()
+                              for ln in para.strip().splitlines())
+            joined = joined.strip().strip('"').replace('\\"', '"')
+            if joined not in seen and pipelineish(joined):
+                seen.add(joined)
+                yield joined
+
+    found = []
+    ex_dir = os.path.join(repo, "examples")
+    for fn in sorted(os.listdir(ex_dir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(ex_dir, fn)) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for cand in candidates(node.value):
+                    found.append((fn, cand))
+    doc_dir = os.path.join(repo, "docs")
+    for fn in sorted(os.listdir(doc_dir)):
+        if not fn.endswith(".md"):
+            continue
+        with open(os.path.join(doc_dir, fn)) as f:
+            for cand in candidates(f.read()):
+                found.append((fn, cand))
+    return found
+
+
+def run_xray_docs_gate() -> list:
+    """Every pipeline a doc or example shows must xray CLEAN of the
+    chain diagnostics: a documented launch string firing W120-W124
+    is either a bad example or a false positive — both are gate
+    failures (acceptance: zero false chain findings on shipped
+    snippets). Unanalyzable pipelines degrade to notes and pass."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.xray import xray
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"nns-xray docs gate could not run: {exc}"]
+    chain_codes = {f"NNS-W12{i}" for i in range(5)}
+    problems = []
+    for src, desc in documented_pipeline_strings():
+        result = xray(desc)
+        for d in result.diagnostics:
+            if d.code in chain_codes:
+                problems.append(
+                    f"xray-docs: {src}: {desc[:60]!r}: {d.code} "
+                    f"[{d.element}]"
+                )
+    return problems
+
+
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     no_self_check = "--no-self-check" in args
@@ -130,6 +235,8 @@ def main(argv=None) -> int:
         problems.extend(run_self_check())
         problems.extend(run_obs_self_check())
         problems.extend(run_race_lint_gate())
+        problems.extend(run_xray_self_check())
+        problems.extend(run_xray_docs_gate())
     for p in problems:
         print(p)
     if problems:
