@@ -858,11 +858,11 @@ def _run() -> None:
     # host-path executor ceilings (see _executor_ceilings):
     # median-of-3 short runs, spread recorded beside the value
     executor_chain_fps = executor_branched_fps = None
+    chain_program_fps = chain_program_pernode_fps = None
     ceiling_spreads = {}
     try:
-        executor_chain_fps, executor_branched_fps, ceiling_spreads = (
-            _executor_ceilings()
-        )
+        (executor_chain_fps, executor_branched_fps, chain_program_fps,
+         chain_program_pernode_fps, ceiling_spreads) = _executor_ceilings()
     except Exception as exc:  # noqa: BLE001
         print(f"[bench] executor ceilings failed: {exc!r}", file=sys.stderr)
     overlap_efficiency = None
@@ -926,11 +926,23 @@ def _run() -> None:
                 "pipeline_media_fps": _round(pipeline_media_fps),
                 "executor_chain_fps": _round(executor_chain_fps),
                 "executor_branched_fps": _round(executor_branched_fps),
+                "chain_program_fps": _round(chain_program_fps),
+                "chain_program_pernode_fps": _round(
+                    chain_program_pernode_fps
+                ),
+                "chain_program_frac": (
+                    round(chain_program_fps / chain_program_pernode_fps, 3)
+                    if chain_program_fps and chain_program_pernode_fps
+                    else None
+                ),
                 "executor_chain_fps_spread_pct": ceiling_spreads.get(
                     "executor_chain_fps"
                 ),
                 "executor_branched_fps_spread_pct": ceiling_spreads.get(
                     "executor_branched_fps"
+                ),
+                "chain_program_fps_spread_pct": ceiling_spreads.get(
+                    "chain_program_fps"
                 ),
                 "overlap_efficiency": (
                     round(overlap_efficiency, 4)
@@ -1225,14 +1237,24 @@ def _executor_ceilings(runs: int = 3):
     --gate threshold, so one unlucky scheduler beat could fail (or one
     lucky one pass) the gate on noise alone. The per-key relative
     spread ((max−min)/median) rides along so records show how
-    trustworthy each number is. Returns ``(chain, branched, spreads)``
+    trustworthy each number is.
+
+    The chain_program pair measures the SAME 3-stage chain (stages
+    split by queues so they plan as three fused segments) both ways:
+    compiled into one resident window program (chain_mode=auto, the
+    one-launch-per-window path, docs/chain-analysis.md "Compiled
+    chains") and per-node (chain_mode=off, one service thread per
+    stage). Their ratio is the whole-chain compilation win with host
+    speed cancelled — the acceptance bar is >= 1.5x.
+
+    Returns ``(chain, branched, chain_prog, chain_pernode, spreads)``
     with ``spreads`` mapping gate key → spread percent (None when
     unmeasurable)."""
     import statistics
     import subprocess
 
     code = r"""
-import time, jax
+import os, time, jax
 jax.config.update("jax_platforms", "cpu")
 from nnstreamer_tpu.pipeline.parse import parse_pipeline
 RUNS = %d
@@ -1244,9 +1266,21 @@ branched = (f"tensorsrc dimensions=4 num-frames={N // 2} ! tee name=t "
             "t. ! queue ! tensor_filter framework=passthrough ! m.sink_1 "
             "tensor_mux name=m sync-mode=slowest ! tensor_sink "
             "sync-window=64")
+prog = (f"tensorsrc dimensions=4 num-frames={N} ! "
+        "tensor_filter framework=passthrough ! queue ! "
+        "tensor_filter framework=passthrough ! queue ! "
+        "tensor_filter framework=passthrough ! tensor_sink sync-window=64")
 for _ in range(RUNS):
-    for label, desc, n in (("chain", chain, N),
-                           ("branched", branched, N // 2)):
+    for label, desc, n, mode in (("chain", chain, N, None),
+                                 ("branched", branched, N // 2, None),
+                                 ("chain_program", prog, N, "auto"),
+                                 ("chain_pernode", prog, N, "off")):
+        if mode is None:
+            os.environ.pop("NNS_TPU_EXECUTOR_CHAIN_MODE", None)
+            os.environ.pop("NNS_TPU_EXECUTOR_CHAIN_UNROLL", None)
+        else:
+            os.environ["NNS_TPU_EXECUTOR_CHAIN_MODE"] = mode
+            os.environ["NNS_TPU_EXECUTOR_CHAIN_UNROLL"] = "32"
         p = parse_pipeline(desc)
         t0 = time.perf_counter()
         p.run(timeout=600)
@@ -1257,7 +1291,8 @@ for _ in range(RUNS):
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=900, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
     )
-    vals = {"chain": [], "branched": []}
+    vals = {"chain": [], "branched": [], "chain_program": [],
+            "chain_pernode": []}
     for line in out.stdout.splitlines():
         bits = line.split()
         if len(bits) == 2 and bits[0] in vals:
@@ -1275,9 +1310,13 @@ for _ in range(RUNS):
 
     chain, chain_spread = _median_spread(vals["chain"])
     branched, branched_spread = _median_spread(vals["branched"])
-    return chain, branched, {
+    chain_prog, prog_spread = _median_spread(vals["chain_program"])
+    chain_pernode, pernode_spread = _median_spread(vals["chain_pernode"])
+    return chain, branched, chain_prog, chain_pernode, {
         "executor_chain_fps": chain_spread,
         "executor_branched_fps": branched_spread,
+        "chain_program_fps": prog_spread,
+        "chain_program_pernode_fps": pernode_spread,
     }
 
 
@@ -1539,6 +1578,15 @@ GATE_KEYS = {
     # into the stream-side submit path or the in-flight ring stopped
     # filling dispatches
     "plane_async_frac": 0.2,
+    # compiled whole-chain window program ceiling (one XLA launch per
+    # unrolled window — pipeline/chain_program.py); absolute fps rides
+    # the host like the other ceilings
+    "chain_program_fps": 0.25,
+    # compiled/per-node fps ratio on the SAME 3-stage chain: host speed
+    # cancels in the ratio (measured ~1.6-2x on the CPU smoke vs the
+    # 1.5 acceptance bar) — a breach means per-frame work crept back
+    # into the window path (meta hops, per-frame dispatch, ring stalls)
+    "chain_program_frac": 0.2,
 }
 
 # fresh in-process measurements for the backend-dependent cells —
@@ -1611,7 +1659,9 @@ def _gate() -> int:
         or os.environ.get("BENCH_GATE_FORCE") == "1"
     )
     try:
-        chain, branched, spreads = _executor_ceilings()
+        chain, branched, chain_prog, chain_pernode, spreads = (
+            _executor_ceilings()
+        )
     except Exception as exc:  # noqa: BLE001 — a gate that cannot
         # measure must not masquerade as a pass
         print(json.dumps({"gate": "error", "reason": repr(exc)}))
@@ -1638,6 +1688,11 @@ def _gate() -> int:
     fresh = {
         "executor_chain_fps": chain,
         "executor_branched_fps": branched,
+        "chain_program_fps": chain_prog,
+        "chain_program_frac": (
+            round(chain_prog / chain_pernode, 3)
+            if chain_prog and chain_pernode else None
+        ),
         "overlap_efficiency": overlap,
     }
     for key, cell in GATED_CELLS:
@@ -1751,9 +1806,17 @@ def _capture_measured() -> int:
         "int8_impl": "int8w",
     }
     _mark("capture start")
-    chain, branched, spreads = _executor_ceilings()
+    chain, branched, chain_prog, chain_pernode, spreads = (
+        _executor_ceilings()
+    )
     rec["executor_chain_fps"] = _round(chain)
     rec["executor_branched_fps"] = _round(branched)
+    rec["chain_program_fps"] = _round(chain_prog)
+    rec["chain_program_pernode_fps"] = _round(chain_pernode)
+    rec["chain_program_frac"] = (
+        round(chain_prog / chain_pernode, 3)
+        if chain_prog and chain_pernode else None
+    )
     for key, spread in spreads.items():
         rec[f"{key}_spread_pct"] = spread
     _mark("executor ceilings")

@@ -228,6 +228,14 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "the declared [plane] memory_per_device bound: the chain OOMs "
         "on a real chip even though each stage fits alone",
     ),
+    "NNS-W125": (
+        Severity.WARNING, "chain-eligible-not-compiled",
+        "a hazard-free multi-segment chain is running with chain_mode="
+        "off: every frame still crosses one service thread per node "
+        "where ONE resident whole-chain program (dispatched once per "
+        "unrolled window) would serve it — host-dispatch overhead the "
+        "compiled-chain path exists to remove",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

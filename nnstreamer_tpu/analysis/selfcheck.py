@@ -196,12 +196,15 @@ def obs_self_check() -> List[str]:
 
 # -- nns-xray self-check: chain codes wired emitters<->catalog<->docs -------
 
-_XRAY_CODES = ("NNS-W120", "NNS-W121", "NNS-W122", "NNS-W123", "NNS-W124")
+_XRAY_CODES = (
+    "NNS-W120", "NNS-W121", "NNS-W122", "NNS-W123", "NNS-W124",
+    "NNS-W125",
+)
 
 
 def xray_self_check() -> List[str]:
     """Validate the chain-analysis diagnostics both ways: every
-    W120-W124 code is in the catalog, has an emitter in
+    W120-W125 code is in the catalog, has an emitter in
     analysis/xray.py, and is documented in docs/chain-analysis.md AND
     docs/linting.md; conversely every NNS code docs/chain-analysis.md
     mentions exists in the catalog (no doc drift either direction)."""

@@ -206,13 +206,20 @@ def reaches_capable(e: Any, links: Callable[[Any], List[Any]]) -> bool:
 
 @dataclass
 class ChainReport:
-    """One compile unit's analysis row."""
+    """One compile unit's analysis row.
+
+    ``compiled`` is the executor's OWN verdict for the whole-chain
+    resident program (pipeline/chain_program.py ``decide_chain`` — the
+    same function ``Executor._build`` calls, so the report can never
+    disagree with what actually runs): ``yes (unroll K)``, or ``no:``
+    followed by the blocking hazard/config."""
 
     name: str
     segments: List[str]
     n_ops: int
     cost: ChainCost
     notes: List[str] = field(default_factory=list)
+    compiled: str = ""
 
 
 @dataclass
@@ -268,6 +275,8 @@ class XrayResult:
                 f"{_fmt_bytes(c.boundary_in_bytes)}/"
                 f"{_fmt_bytes(c.boundary_out_bytes)} per frame"
             )
+            if ch.compiled:
+                lines.append(f"    compiled: {ch.compiled}")
             for note in ch.notes:
                 lines.append(f"    note: {note}")
         for b in self.boundaries:
@@ -598,6 +607,42 @@ def _bound_pass(chain: Any, cost: ChainCost, report: LintReport) -> None:
     )
 
 
+def _compiled_pass(
+    plan: Any, chain: Any, cr: "ChainReport", report: LintReport
+) -> None:
+    """Fill the chain report's ``compiled`` column from the executor's
+    own verdict (pipeline/chain_program.py ``decide_chain``) and emit
+    NNS-W125 for the one configuration the lint exists for: a
+    hazard-free multi-segment chain someone switched OFF — leaving a
+    per-node-per-frame dispatch cost the compiled path would remove."""
+    from nnstreamer_tpu.pipeline.chain_program import decide_chain
+
+    try:
+        d = decide_chain(plan, chain)
+    except Exception as exc:  # noqa: BLE001 — verdict is best-effort here
+        cr.compiled = f"no: verdict unavailable ({exc})"
+        return
+    if d.compiles:
+        cr.compiled = f"yes (unroll {d.unroll})"
+        return
+    if d.eligible:  # and therefore mode == "off"
+        cr.compiled = "no: chain_mode=off"
+        report.add(
+            "NNS-W125", chain.first.name,
+            f"chain [{chain.name}]: {len(chain.segments)} hazard-free "
+            "segments are running with chain_mode=off — every frame "
+            "crosses one service thread per node where ONE resident "
+            "program (dispatched once per unrolled window) would serve "
+            "it",
+            "set [executor] chain_mode=auto (or drop the chain-mode=off "
+            "property) to compile this chain; keep off only while "
+            "debugging against the per-node parity oracle "
+            "(docs/chain-analysis.md)",
+        )
+        return
+    cr.compiled = f"no: {d.reason}"
+
+
 # -- entry point -------------------------------------------------------------
 
 def xray(
@@ -656,6 +701,7 @@ def xray(
         for seg in chain.segments:
             _segment_pass(seg, report, cr.notes)
         _bound_pass(chain, cost, report)
+        _compiled_pass(plan, chain, cr, report)
         res.chains.append(cr)
     return res
 

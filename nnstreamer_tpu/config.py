@@ -143,6 +143,16 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         # overrides. Env: NNS_TPU_EXECUTOR_RING_DEPTH etc.
         "ring_depth": "2",
         "donate": "true",
+        # whole-chain resident programs (pipeline/chain_program.py,
+        # docs/chain-analysis.md "Compiled chains"): chain_mode=auto
+        # compiles every eligible multi-segment chain into ONE jitted
+        # program dispatched once per unrolled window of chain_unroll
+        # frames (clamped by the OOM bucket governor rung and the W124
+        # transient-HBM bound); off keeps the per-node parity path.
+        # Per-element chain-mode property overrides. Env:
+        # NNS_TPU_EXECUTOR_CHAIN_MODE / NNS_TPU_EXECUTOR_CHAIN_UNROLL.
+        "chain_mode": "auto",
+        "chain_unroll": "4",
         # nns-san runtime sanitizer (pipeline/sanitize.py): instrumented
         # channels assert negotiated-spec conformance per frame, latch
         # offered == delivered + dropped + routed per node at EOS, watch

@@ -149,6 +149,14 @@ STREAM_PROPS: Dict[str, PropSpec] = {
         "[plane] inflight = 1 — blocking submits; "
         "docs/serving-plane.md)",
     ),
+    "chain-mode": PropSpec(
+        "enum", None, ("auto", "off"),
+        desc="whole-chain compilation for the chain this filter belongs "
+        "to: auto compiles an eligible multi-segment chain into ONE "
+        "resident program dispatched per unrolled window, off keeps "
+        "the per-node parity path (default [executor] chain_mode = "
+        "auto; docs/chain-analysis.md \"Compiled chains\")",
+    ),
 }
 
 

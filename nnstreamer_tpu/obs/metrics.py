@@ -175,6 +175,17 @@ METRIC_CATALOG: Dict[str, str] = {
         "normalize) ran fused inside a device segment instead of as a "
         "host node, per element (counter; docs/on-device-ops.md)"
     ),
+    "nns_chain_launches_total": (
+        "window dispatches of a compiled whole-chain resident program "
+        "— one per unrolled window, NOT one per node per frame, per "
+        "chain element (counter; docs/chain-analysis.md)"
+    ),
+    "nns_chain_fallback_total": (
+        "windows a compiled chain served through the per-node parity "
+        "path after its fallback latched (device fault, unshrinkable "
+        "OOM, or compile failure), per chain element (counter; "
+        "docs/chain-analysis.md)"
+    ),
 }
 
 # default ladder: quarter-octave buckets from 1 µs up past 100 s —

@@ -83,6 +83,16 @@ def _notes(row: dict) -> str:
         # pre/post-processing ops fused into this device segment
         # (docs/on-device-ops.md)
         notes.append("fused-post")
+    if row.get("chain_segments"):
+        # whole-chain resident program: segments collapsed into one
+        # node, one launch per unrolled window; `!` marks a chain
+        # serving from the per-node parity path after a fallback latch
+        # (docs/chain-analysis.md "Compiled chains")
+        mark = "!" if row.get("chain_fallback_windows") else ""
+        notes.append(
+            f"chain={row['chain_segments']}x{row.get('chain_unroll', 1)}"
+            f"{mark}"
+        )
     san = {k: v for k, v in row.items() if k.startswith("san_") and v}
     for k, v in sorted(san.items()):
         notes.append(f"{k}={v}")

@@ -79,6 +79,21 @@ class BatchConfig:
         return self.enabled and self.max_batch > 1
 
 
+def chain_window_config(unroll: int) -> BatchConfig:
+    """Window-collection config for a compiled chain's service loop
+    (pipeline/chain_program.py): drain up to ``unroll`` queued frames
+    per window and NEVER wait for one to fill (timeout 0) — a
+    trickle-fed chain keeps per-frame latency while a saturated one
+    amortizes its single XLA launch over full windows. The bucket
+    ladder is the standard 1,2,4,...,unroll so the resident program
+    traces O(log K) variants, exactly the micro-batching discipline."""
+    u = max(1, int(unroll))
+    return BatchConfig(
+        enabled=True, max_batch=u, timeout_ms=0.0,
+        buckets=default_buckets(u),
+    )
+
+
 def _executor_defaults() -> dict:
     """Executor-level batching defaults ([executor] config section; env
     ``NNS_TPU_EXECUTOR_*`` outranks ini, the standard config layering).

@@ -47,6 +47,7 @@ from nnstreamer_tpu.pipeline.device_faults import (
     BucketGovernor,
     DeviceCircuit,
     classify_device_fault,
+    resolve_device_policy,
 )
 from nnstreamer_tpu.pipeline.faults import (
     FaultGate,
@@ -1098,6 +1099,218 @@ class FusedNode(Node):
         self.broadcast_eos()
 
 
+class ChainNode(Node):
+    """ONE service thread — and in steady state ONE XLA dispatch per
+    unrolled window — for a whole compiled chain
+    (pipeline/chain_program.py, docs/chain-analysis.md "Compiled
+    chains"). Replaces the member segments' FusedNodes: ``_build`` maps
+    every member op here, so interior links never materialize channels
+    and the boundary bytes between member segments are structurally
+    zero (``transfer_crosscheck`` asserts exactly that). Any runtime
+    hazard — device fault, unshrinkable OOM, a compile failure at
+    build — latches the STICKY whole-chain fallback when the device
+    policy allows it (raises otherwise): every later frame serves
+    through the member segments' own per-node programs,
+    ``ChainProgram.process_frame_fallback``, the bitwise parity
+    oracle."""
+
+    def __init__(self, ex, chain, program) -> None:
+        super().__init__(ex, chain.name)
+        self.chain = chain
+        self.program = program
+        # sticky fallback latch + window counter: single-writer (this
+        # node's service thread); observers get GIL-atomic reads
+        self.fallback_latched = False
+        self.fallback_windows = 0
+        self._fallback_allowed = True
+        self._stage_on = False
+        # nns-obs handles, wired by _build when a registry is active
+        self._chain_launch_ctr = None
+        self._chain_fallback_ctr = None
+
+    def _update_degraded_gauge(self) -> None:
+        # the chain's degraded state is the fallback latch (there is no
+        # device circuit here — the latch IS the open circuit), plus the
+        # shared OOM-governor criterion
+        if self.ex.metrics is None:
+            return
+        if self._deg_gauge is None:
+            self._deg_gauge = self.ex.metrics.gauge(
+                "nns_degraded_segments", element=self.name
+            )
+        gov = self.bucket_governor
+        self._deg_gauge.set(
+            1 if (
+                self.fallback_latched
+                or (gov is not None and gov.degraded)
+            ) else 0
+        )
+
+    def _latch_fallback(self) -> None:
+        """Engage the sticky per-node fallback. Latched, not probed:
+        the hazard already proved the one-launch program wrong for this
+        run, and the per-node path is the semantics baseline — flapping
+        between the two mid-stream buys nothing."""
+        if not self.fallback_latched:
+            self.fallback_latched = True
+            _log.warning(
+                "chain %s: falling back to the per-node parity path",
+                self.name,
+            )
+            self._update_degraded_gauge()
+
+    def run(self) -> None:
+        from nnstreamer_tpu.pipeline.batching import chain_window_config
+
+        pol = resolve_device_policy(self.chain.ops)
+        self._fallback_allowed = bool(pol.get("device-fallback"))
+        if pol.get("oom-policy") == "degrade" and self.program.unroll > 1:
+            self.bucket_governor = BucketGovernor(
+                self.program.buckets,
+                cooldown_s=pol["oom-reprobe-ms"] / 1000.0,
+            )
+        try:
+            # compile the window program before the first frame
+            # (PAUSED-state parity, FusedNode discipline)
+            self.program.build()
+        except Exception as exc:
+            kind = self._device_fault(exc)
+            if kind is None or not self._fallback_allowed:
+                raise
+            self._latch_fallback()
+        self._apply_pending_restore()
+        self._stage_on = (
+            not transfer.default_backend_is_cpu()
+            and not self.program.is_identity()
+        )
+        gov = self.bucket_governor
+        cfg = chain_window_config(self.program.unroll)
+        collector = self.make_batch_collector(
+            cfg, self.chain.first,
+            cap=(gov.cap if gov is not None else None),
+        )
+        ring = _FrameRing(
+            self, transfer.resolve_ring_depth(self.chain.ops),
+            self._out_wants_host(),
+        )
+        self._ring = ring
+        while True:
+            if not self.in_queues[0]:
+                # idle input: deliver in-flight frames across the wait
+                ring.flush()
+            frames, eos, wait_s = collector.collect()
+            if frames:
+                frames = [
+                    f for f in frames if not self.shed_if_expired(f)
+                ]
+            if frames:
+                t0 = time.perf_counter()
+                outs, rows = self._invoke_chain_window(frames)
+                self.stat_batch(t0, len(frames), rows, wait_s)
+                for f in outs:
+                    ring.put(f)
+            if eos:
+                break
+        ring.flush()
+        self.broadcast_eos()
+
+    def _serve_fallback(self, chunk):
+        """Per-frame service through the member segments' OWN programs
+        (the parity oracle). A device fault inside drops that frame one
+        more rung to the segments' eager paths — a chain whose compiled
+        AND per-segment programs both fault still serves (device-
+        circuit semantics)."""
+        outs = []
+        for f in chunk:
+            try:
+                outs.append(self.program.process_frame_fallback(f))
+            except _Stop:
+                raise
+            except Exception as exc:
+                if self._device_fault(exc) is None:
+                    raise
+                outs.append(self.program.process_frame_eager(f))
+        return outs
+
+    def _invoke_chain_window(self, frames):
+        """One collected window through the chain's degradation ladder.
+        Returns (outs, rows_dispatched):
+
+        1. the window is chunked to the OOM governor's live ceiling;
+        2. a chunk that OOMs shrinks the ceiling one ladder rung and is
+           RETRIED (never dropped) — an unshrinkable OOM falls to (3);
+        3. any other device fault latches the sticky per-node fallback
+           (policy permitting; raises otherwise) and the chunk — and
+           the stream after it — serves per frame from the parity
+           oracle, eager rung underneath (docs/resilience.md)."""
+        gov = self.bucket_governor
+        outs: List = []
+        rows = 0
+        pending = deque([frames])
+        while pending:
+            chunk = pending.popleft()
+            cap = gov.cap() if gov is not None else None
+            if cap is not None and len(chunk) > cap:
+                # split to the live ceiling; remainder keeps its order
+                pending.appendleft(chunk[cap:])
+                chunk = chunk[:cap]
+            if self.fallback_latched:
+                outs.extend(self._serve_fallback(chunk))
+                rows += len(chunk)
+                self.fallback_windows += 1
+                if self._chain_fallback_ctr is not None:
+                    self._chain_fallback_ctr.inc()
+                continue
+            donate = False
+            chunk_in = chunk
+            if self._stage_on and self.program.donate and not any(
+                transfer.is_device_array(t)
+                for f in chunk for t in f.tensors
+            ):
+                # all-host window: stage PRIVATE device copies and
+                # donate THOSE, so every retry/fallback path re-reads
+                # the caller's intact host buffers, never a donated
+                # (deleted) array — _process_frame's replay discipline
+                try:
+                    chunk_in = [
+                        transfer.stage_frame(f, force=True)
+                        for f in chunk
+                    ]
+                    donate = True
+                except _Stop:
+                    raise
+                except Exception as exc:
+                    if self._device_fault(exc) is None:
+                        raise
+                    chunk_in, donate = chunk, False
+            try:
+                got, width, launched = self.program.process_window(
+                    chunk_in, donate
+                )
+            except _Stop:
+                raise
+            except Exception as exc:
+                kind = self._device_fault(exc)
+                if kind == "oom" and gov is not None:
+                    attempted = self.program.bucket_for(len(chunk))
+                    if gov.on_oom(attempted) is not None:
+                        self._update_degraded_gauge()
+                        pending.appendleft(chunk)  # retry, shrunk
+                        continue
+                if kind is None or not self._fallback_allowed:
+                    raise
+                self._latch_fallback()
+                pending.appendleft(chunk)  # re-served by the oracle
+                continue
+            if gov is not None and gov.on_ok(width):
+                self._update_degraded_gauge()
+            if launched and self._chain_launch_ctr is not None:
+                self._chain_launch_ctr.inc()
+            outs.extend(got)
+            rows += width
+        return outs, rows
+
+
 class _PlaneWindowRing:
     """In-flight PLANE-WINDOW FIFO for the async submit loop: entries
     are (frames, ticket, wait_s) tuples parked between
@@ -1722,11 +1935,40 @@ class Executor:
                 links.append([src, src_pad, o[2], o[3], merged])
             eliminated.add(e)
 
+        # ---- whole-chain compile units (pipeline/chain_program.py) ----
+        # decide once per chain (the SAME verdict nns-xray's `compiled`
+        # column and the NNS-W125 lint report): an eligible chain under
+        # chain_mode=auto gets ONE ChainNode absorbing every member op,
+        # so its interior links never materialize channels and steady
+        # state is one XLA dispatch per unrolled window. Everything else
+        # keeps the per-node path — the parity oracle.
+        from nnstreamer_tpu.pipeline.chain_program import (
+            ChainProgram,
+            decide_chain,
+        )
+
+        chain_of: Dict[Any, Tuple[Any, ChainProgram]] = {}
+        for chain in self.plan.chains():
+            decision = decide_chain(self.plan, chain)
+            if not decision.compiles:
+                continue
+            program = ChainProgram(chain, decision.unroll)
+            for op in chain.ops:
+                chain_of[op] = (chain, program)
+
         # create nodes
         for e in p.elements:
             if e in eliminated:
                 continue
             if isinstance(e, TensorOp):
+                cp = chain_of.get(e)
+                if cp is not None:
+                    chain, program = cp
+                    if chain.first is e:
+                        node = ChainNode(self, chain, program)
+                        for op in chain.ops:
+                            self._node_of[op] = node
+                    continue
                 seg = self.plan.seg_of.get(e)
                 if seg is None:  # non-traceable: host-path adapter
                     self._node_of[e] = TensorOpHostNode(self, e)
@@ -1758,9 +2000,10 @@ class Executor:
             dst_node = self._node_of[dst]
             if src_node is dst_node:
                 continue  # intra-segment link (fused away)
-            # node-level pad indices: fused nodes expose single in/out pad
-            sp = 0 if isinstance(src_node, FusedNode) else src_pad
-            dp = 0 if isinstance(dst_node, FusedNode) else dst_pad
+            # node-level pad indices: fused/chain nodes expose single
+            # in/out pad
+            sp = 0 if isinstance(src_node, (FusedNode, ChainNode)) else src_pad
+            dp = 0 if isinstance(dst_node, (FusedNode, ChainNode)) else dst_pad
             while len(dst_node.in_queues) <= dp:
                 dst_node.add_in_queue(dst.queue_size)
             if size is not None:  # an eliminated queue's depth override
@@ -1786,6 +2029,9 @@ class Executor:
                     self.sanitizer.register_pad(n.name, pad)
             for seg in self.plan.segments:
                 seg.sanitize_poison = True
+            for n in self.nodes:
+                if isinstance(n, ChainNode):
+                    n.program.sanitize_poison = True
         if self.metrics is not None:
             # per-node observability handles, created once here so the
             # per-frame path is attribute reads (no registry lookups)
@@ -1809,6 +2055,17 @@ class Executor:
                     # that carries decode/image/normalize ops
                     n._postproc_ctr = self.metrics.counter(
                         "nns_fused_postproc_total", element=n.name
+                    )
+                if isinstance(n, ChainNode):
+                    # compiled-chain telemetry (docs/observability.md):
+                    # launches counts window dispatches of the resident
+                    # program, fallback counts windows the per-node
+                    # parity path served after the latch
+                    n._chain_launch_ctr = self.metrics.counter(
+                        "nns_chain_launches_total", element=n.name
+                    )
+                    n._chain_fallback_ctr = self.metrics.counter(
+                        "nns_chain_fallback_total", element=n.name
                     )
 
     # -- lifecycle ---------------------------------------------------------
@@ -2174,6 +2431,11 @@ class Executor:
         whose thread never finished (counts still moving)."""
         if isinstance(n, FusedNode):
             elem = n.seg.first
+        elif isinstance(n, ChainNode):
+            # a compiled chain is 1:1 end to end (pure TensorOps, the
+            # same invariant per member segment) — the whole-chain node
+            # inherits the fused accounting contract
+            elem = n.chain.first
         else:
             elem = getattr(n, "elem", None)
             if elem is None \
@@ -2251,6 +2513,18 @@ class Executor:
             pp = getattr(getattr(n, "seg", None), "postproc_ops", 0)
             if pp:
                 s["fused_postproc"] = pp
+            # compiled chains (pipeline/chain_program.py): window width,
+            # resident-program dispatches, and the parity-path windows
+            # served after a fallback latch (nns-top renders the `chain`
+            # note from chain_segments)
+            if isinstance(n, ChainNode):
+                s["chain_segments"] = len(n.chain.segments)
+                s["chain_unroll"] = n.program.unroll
+                s["chain_launches"] = n.program.launches
+                if n.fallback_windows:
+                    s["chain_fallback_windows"] = n.fallback_windows
+                if n.fallback_latched:
+                    s["device_degraded"] = 1
             # micro-batching observability (fused segments and batchable
             # host filters): avg batch size, pad waste, straggler wait
             bstats = getattr(
@@ -2397,7 +2671,7 @@ class Executor:
             "d2h": now["d2h_bytes"] - base["d2h_bytes"],
         }
 
-    def transfer_crosscheck(self) -> Dict[str, Dict[str, int]]:
+    def transfer_crosscheck(self) -> Dict[str, Any]:
         """Verify the static cost model against this run: the predicted
         host-boundary bytes (analysis/costmodel.py
         ``plan_transfer_boundaries`` — the same plan this executor
@@ -2414,17 +2688,42 @@ class Executor:
         )
 
         elems = {e.name: e for e in self.plan.pipeline.elements}
+        boundaries = plan_transfer_boundaries(self.plan)
         predicted = {"h2d": 0, "d2h": 0}
-        for b in plan_transfer_boundaries(self.plan):
+        for b in boundaries:
             node = self._node_of.get(elems.get(b.producer))
             if node is None:
                 continue
             predicted[b.direction] += b.bytes_per_frame * node.frames_processed
         measured = self.transfer_totals()
+        # compiled chains (pipeline/chain_program.py): the model must
+        # predict ZERO interior boundary bytes for a chain one resident
+        # program serves, and the executor makes the measurement
+        # structural — member ops all map to ONE node, so interior
+        # links never materialize channels and nothing can cross there.
+        chains = []
+        for n in self.nodes:
+            if not isinstance(n, ChainNode):
+                continue
+            member = {op.name for op in n.chain.ops}
+            interior = 0
+            for b in boundaries:
+                if b.producer in member and b.consumer in member:
+                    node = self._node_of.get(elems.get(b.producer))
+                    frames = node.frames_processed if node else 0
+                    interior += b.bytes_per_frame * frames
+            chains.append({
+                "chain": n.name,
+                "unroll": n.program.unroll,
+                "launches": n.program.launches,
+                "predicted_interior": interior,
+                "measured_interior": 0,
+            })
         return {
             "predicted": predicted,
             "measured": measured,
             "delta": {
                 k: measured[k] - predicted[k] for k in ("h2d", "d2h")
             },
+            "chains": chains,
         }

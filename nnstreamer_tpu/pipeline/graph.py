@@ -767,11 +767,14 @@ class FusedSegment:
 @dataclass
 class Chain:
     """One compile unit (docs/chain-analysis.md): a maximal run of
-    fused segments joined by device-resident handoffs. Today the
-    executor runs each segment as its own XLA program and the handoff
-    is a device-array pass between nodes; a chain is exactly the span
-    ROADMAP item 1 would compile into ONE resident program, so
-    ``nns-xray`` reports and lints at this granularity."""
+    fused segments joined by device-resident handoffs. An eligible
+    multi-segment chain under ``[executor] chain_mode=auto`` compiles
+    into ONE resident program the executor dispatches once per
+    unrolled window (pipeline/chain_program.py ``decide_chain`` /
+    ``ChainProgram``); anything else runs each segment as its own XLA
+    program with a device-array pass between nodes — the parity
+    oracle the compiled path falls back to. ``nns-xray`` reports and
+    lints at this granularity either way."""
 
     segments: List[FusedSegment]
 

@@ -445,6 +445,48 @@ def resolve_ring_depth(elems) -> int:
     return max(1, min(32, depth))
 
 
+def resolve_chain_mode(elems) -> str:
+    """Resolve whole-chain compilation mode for one chain
+    (pipeline/chain_program.py): ``off`` from ANY member element's
+    ``chain-mode`` property outranks the ``[executor] chain_mode``
+    config default (NNS_TPU_EXECUTOR_CHAIN_MODE env over ini) — one
+    member opting out keeps the whole chain on the per-node parity
+    path, mirroring how one non-traceable op severs fusion. Unknown
+    values fall back to ``auto`` with a warning."""
+    from nnstreamer_tpu.config import conf
+
+    raw = None
+    for e in elems:
+        get = getattr(e, "get_property", None)
+        got = get("chain-mode") if get is not None else None
+        if got is not None:
+            raw = str(got).strip().lower()
+            if raw == "off":
+                return "off"
+    if raw is None:
+        raw = str(conf().get("executor", "chain_mode", "auto")).strip().lower()
+    if raw not in ("auto", "off"):
+        _log.warning("chain-mode=%r not one of auto/off; using auto", raw)
+        return "auto"
+    return raw
+
+
+def resolve_chain_unroll(elems) -> int:
+    """Frames per compiled-chain launch window (``[executor]
+    chain_unroll``, default 4, clamped to [1, 32]) — the STATIC ceiling;
+    pipeline/chain_program.py further clamps it by the W124
+    transient-HBM bound and the runtime OOM bucket governor rung."""
+    from nnstreamer_tpu.config import conf
+
+    raw = conf().get("executor", "chain_unroll", "4")
+    try:
+        unroll = int(raw)
+    except (TypeError, ValueError):
+        _log.warning("[executor] chain_unroll=%r is not an int; using 4", raw)
+        unroll = 4
+    return max(1, min(32, unroll))
+
+
 def xray_crosscheck_enabled() -> bool:
     """``NNS_XRAY_CROSSCHECK`` env first, then ``[executor]
     xray_crosscheck`` (default off): the executor then compares the

@@ -1,0 +1,303 @@
+"""Compiled whole-chain resident programs (pipeline/chain_program.py,
+docs/chain-analysis.md "Compiled chains").
+
+An eligible multi-segment chain compiles into ONE jitted program and the
+executor serves it from a single ChainNode — one XLA dispatch per
+unrolled window, not one per node per frame. The per-node path is the
+parity ORACLE: everything here compares the compiled stream bitwise
+against chain_mode=off (no ULP tolerance — the program is a literal
+unroll, not a vmap). Tier-1 keeps runs tiny (8x8 tensors, 11 frames);
+the chaos x unroll soak is marked `slow`.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline.chain_program import ChainProgram, decide_chain
+from nnstreamer_tpu.pipeline.device_faults import (
+    DeviceFaultError,
+    DeviceOOMError,
+)
+from nnstreamer_tpu.pipeline.executor import ChainNode
+from nnstreamer_tpu.pipeline.parse import parse_pipeline
+from nnstreamer_tpu.tensors.frame import Frame
+
+# 3 fused segments joined by device-passthrough queues = one chain; 11
+# frames with unroll 4 forces a partial (EOS-flushed) trailing window.
+# The constants are FMA-proof on purpose (x+1, *2, +0.5 stay exact in
+# float32 for counter data), so bitwise comparison is legitimate.
+DESC = (
+    "tensorsrc dimensions=8:8 pattern=counter num-frames=11 ! "
+    "tensor_transform mode=arithmetic option=add:1.0 ! queue ! "
+    "tensor_transform mode=arithmetic option=mul:2.0 ! queue ! "
+    "tensor_transform mode=arithmetic option=add:0.5 ! tensor_sink"
+)
+
+
+def _run(monkeypatch, desc, mode, sanitize=False, unroll=None):
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_MODE", mode)
+    if unroll is not None:
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_UNROLL", str(unroll))
+    monkeypatch.setenv("NNS_TPU_SANITIZE", "1" if sanitize else "0")
+    ex = parse_pipeline(desc).run(timeout=300)
+    sink = next(
+        n.elem for n in ex.nodes
+        if isinstance(getattr(n, "elem", None), TensorSink)
+    )
+    frames = [[np.asarray(t) for t in f.tensors] for f in sink.frames]
+    return frames, ex
+
+
+def _chain_nodes(ex):
+    return [n for n in ex.nodes if isinstance(n, ChainNode)]
+
+
+def _assert_bitwise(a, b):
+    assert len(a) == len(b)
+    for fa, fb in zip(a, b):
+        assert len(fa) == len(fb)
+        for ta, tb in zip(fa, fb):
+            assert ta.dtype == tb.dtype
+            np.testing.assert_array_equal(ta, tb)
+
+
+def _plan_and_chain(desc):
+    p = parse_pipeline(desc)
+    p.negotiate()
+    plan = p.compile_plan()
+    chains = plan.chains()
+    assert chains, "pipeline grew no chain"
+    return plan, chains[0]
+
+
+class TestCompiledParity:
+    def test_bitwise_parity_and_windowed_launches(self, monkeypatch):
+        """The flagship pin: compiled output is bitwise-identical to the
+        per-node oracle, all 11 frames arrive (EOS flushes the 3-frame
+        tail window), and the stream dispatched one launch per WINDOW —
+        3-4 launches for 11 frames at unroll 4, never one per frame."""
+        compiled, ex_on = _run(monkeypatch, DESC, "auto")
+        oracle, ex_off = _run(monkeypatch, DESC, "off")
+        nodes = _chain_nodes(ex_on)
+        assert len(nodes) == 1  # three segments, ONE service thread
+        assert not _chain_nodes(ex_off)  # the oracle keeps FusedNodes
+        assert len(compiled) == 11
+        _assert_bitwise(compiled, oracle)
+        n = nodes[0]
+        # ceil(11/4)=3 windows when the queue keeps up; one extra
+        # collect on a slow scheduler is tolerated, per-frame is not
+        assert 3 <= n.program.launches <= 4
+        assert not n.fallback_latched
+        assert n.fallback_windows == 0
+        s = ex_on.stats()[n.name]
+        assert s["chain_segments"] == 3
+        assert s["chain_unroll"] == 4
+        assert s["chain_launches"] == n.program.launches
+
+    def test_crosscheck_reports_zero_interior_bytes(self, monkeypatch):
+        """The resident-program invariant from both sides: the cost
+        model predicts zero bytes across interior member boundaries and
+        the executor's structural measurement agrees."""
+        _, ex = _run(monkeypatch, DESC, "auto")
+        rows = ex.transfer_crosscheck()["chains"]
+        assert len(rows) == 1
+        assert rows[0]["launches"] >= 1
+        assert rows[0]["predicted_interior"] == 0
+        assert rows[0]["measured_interior"] == 0
+
+    def test_sanitized_run_is_clean(self, monkeypatch):
+        """Window padding under the sanitizer uses poison rows; a clean
+        run must deliver every frame and latch zero findings (poison
+        can never leak into a delivered frame)."""
+        frames, ex = _run(monkeypatch, DESC, "auto", sanitize=True)
+        assert len(frames) == 11
+        assert _chain_nodes(ex)
+        assert ex.sanitizer.codes == [], [
+            str(d) for d in ex.sanitizer.findings()
+        ]
+
+
+class TestWindowProgram:
+    def test_one_dispatch_per_window(self, monkeypatch):
+        """The launch-count pin at program level: each process_window
+        call is exactly one XLA dispatch, padded windows report the
+        dispatched bucket width, and every row matches the oracle
+        bitwise."""
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_MODE", "auto")
+        plan, chain = _plan_and_chain(DESC)
+        d = decide_chain(plan, chain)
+        assert d.compiles, d.reason
+        assert d.unroll == 4
+        prog = ChainProgram(chain, d.unroll)
+        prog.build()
+        sig = chain.segments[0]._negotiated_sig()
+        frames = [
+            Frame(tuple(
+                np.full(shape, i, dtype) for shape, dtype in sig
+            ))
+            for i in range(7)
+        ]
+        outs, rows, launched = prog.process_window(frames[:4])
+        assert launched and rows == 4 and len(outs) == 4
+        assert prog.launches == 1
+        # EOS tail: 3 frames pad up to the 4-bucket, still ONE dispatch
+        outs2, rows2, launched2 = prog.process_window(frames[4:])
+        assert launched2 and rows2 == 4 and len(outs2) == 3
+        assert prog.launches == 2
+        for f, out in zip(frames, outs + outs2):
+            want = prog.process_frame_fallback(f)
+            for ta, tb in zip(out.tensors, want.tensors):
+                np.testing.assert_array_equal(
+                    np.asarray(ta), np.asarray(tb)
+                )
+
+    def test_trickle_window_uses_small_bucket(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_MODE", "auto")
+        plan, chain = _plan_and_chain(DESC)
+        prog = ChainProgram(chain, 4)
+        sig = chain.segments[0]._negotiated_sig()
+        frame = Frame(tuple(
+            np.full(shape, 5, dtype) for shape, dtype in sig
+        ))
+        outs, rows, launched = prog.process_window([frame])
+        assert launched and rows == 1 and len(outs) == 1
+        assert prog.launches == 1
+
+
+class TestFallbackLadder:
+    def test_oom_shrinks_window_and_recovers(self, monkeypatch):
+        """A window OOM shrinks one bucket rung and RETRIES (never
+        drops): output stays bitwise-identical, nothing latches."""
+        oracle, _ = _run(monkeypatch, DESC, "off")
+        state = {"calls": 0}
+        real = ChainProgram.process_window
+
+        def flaky(self, frames, donate=False):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                raise DeviceOOMError("injected window OOM")
+            return real(self, frames, donate)
+
+        monkeypatch.setattr(ChainProgram, "process_window", flaky)
+        compiled, ex = _run(monkeypatch, DESC, "auto")
+        _assert_bitwise(compiled, oracle)
+        n = _chain_nodes(ex)[0]
+        assert not n.fallback_latched
+        assert n.bucket_governor is not None
+        assert n.bucket_governor.ooms == 1
+        # shrunk windows mean MORE launches than the 3 healthy ones
+        assert n.program.launches > 3
+
+    def test_device_fault_latches_parity_fallback(self, monkeypatch):
+        """Any non-OOM device fault latches the sticky per-node
+        fallback: the whole stream still arrives, bitwise-identical,
+        and the sanitizer's frame accounting stays balanced."""
+        oracle, _ = _run(monkeypatch, DESC, "off")
+
+        def broken(self, frames, donate=False):
+            raise DeviceFaultError("injected chain fault")
+
+        monkeypatch.setattr(ChainProgram, "process_window", broken)
+        compiled, ex = _run(monkeypatch, DESC, "auto", sanitize=True)
+        _assert_bitwise(compiled, oracle)
+        n = _chain_nodes(ex)[0]
+        assert n.fallback_latched
+        assert n.fallback_windows >= 1
+        assert n.program.launches == 0
+        s = ex.stats()[n.name]
+        assert s["chain_fallback_windows"] == n.fallback_windows
+        assert s["device_degraded"] == 1
+        assert ex.sanitizer.codes == [], [
+            str(d) for d in ex.sanitizer.findings()
+        ]
+
+
+class TestDecision:
+    def test_single_segment_not_eligible(self):
+        plan, chain = _plan_and_chain(
+            "tensorsrc dimensions=4 num-frames=1 ! "
+            "tensor_transform mode=arithmetic option=add:1.0 ! "
+            "tensor_sink"
+        )
+        d = decide_chain(plan, chain)
+        assert not d.eligible
+        assert "single segment" in d.reason
+
+    def test_flexible_head_not_eligible(self):
+        plan, chain = _plan_and_chain(
+            "videotestsrc device=true num-frames=1 width=16 height=16 ! "
+            "tensor_converter ! queue ! "
+            "tensor_transform mode=typecast option=float32 ! fakesink"
+        )
+        d = decide_chain(plan, chain)
+        assert not d.eligible
+        assert "flexible input spec" in d.reason
+
+    def test_mode_off_is_eligible_but_not_compiled(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_MODE", "off")
+        plan, chain = _plan_and_chain(DESC)
+        d = decide_chain(plan, chain)
+        assert d.eligible and d.mode == "off" and not d.compiles
+
+    def test_no_fuse_oracle_disables_compilation(self, monkeypatch):
+        monkeypatch.setenv("NNS_NO_FUSE", "1")
+        plan, chain = _plan_and_chain(DESC)
+        d = decide_chain(plan, chain)
+        assert not d.eligible
+        assert "NNS_NO_FUSE" in d.reason
+
+
+class TestW125Lint:
+    def test_w125_fires_only_when_configured_off(self, monkeypatch):
+        """Both ways: chain_mode=off on an eligible chain fires
+        NNS-W125 and the compiled column says why; auto compiles and
+        stays silent."""
+        from nnstreamer_tpu.analysis.xray import xray
+
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_MODE", "off")
+        r_off = xray(DESC)
+        assert "NNS-W125" in r_off.codes
+        assert [c.compiled for c in r_off.chains] == [
+            "no: chain_mode=off"
+        ]
+        monkeypatch.setenv("NNS_TPU_EXECUTOR_CHAIN_MODE", "auto")
+        r_on = xray(DESC)
+        assert "NNS-W125" not in r_on.codes
+        assert [c.compiled for c in r_on.chains] == ["yes (unroll 4)"]
+
+
+@pytest.mark.slow
+def test_chaos_by_unroll_soak(monkeypatch):
+    """Chaos x unroll grid: inject an OOM or a transient fault into
+    every 3rd window across the bucket ladder — every configuration
+    must deliver the full bitwise-identical stream (shrunk, latched, or
+    healthy; never dropped)."""
+    oracle, _ = _run(monkeypatch, DESC, "off")
+    real = ChainProgram.process_window
+    for unroll in (1, 2, 4, 8):
+        for exc_cls in (DeviceOOMError, DeviceFaultError):
+            state = {"calls": 0}
+
+            def chaotic(self, frames, donate=False,
+                        _state=state, _exc=exc_cls):
+                _state["calls"] += 1
+                if _state["calls"] % 3 == 0:
+                    raise _exc("soak-injected")
+                return real(self, frames, donate)
+
+            monkeypatch.setattr(
+                ChainProgram, "process_window", chaotic
+            )
+            compiled, ex = _run(
+                monkeypatch, DESC, "auto",
+                sanitize=True, unroll=unroll,
+            )
+            _assert_bitwise(compiled, oracle)
+            assert ex.sanitizer.codes == [], (
+                unroll, exc_cls.__name__,
+                [str(d) for d in ex.sanitizer.findings()],
+            )

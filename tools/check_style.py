@@ -13,7 +13,7 @@ Rules for tracked .py files (and the C++ under native/):
   forces it off entirely)
 - `nns-san --race nnstreamer_tpu/` is clean: the package source obeys
   its own concurrency idioms (same whole-tree-only gating)
-- `nns-xray --self-check` passes (chain diagnostics W120-W124 wired
+- `nns-xray --self-check` passes (chain diagnostics W120-W125 wired
   emitters<->catalog<->docs both ways) and every pipeline string in
   examples/ and docs/ xrays clean of the chain diagnostics (same
   whole-tree-only gating)
@@ -119,7 +119,7 @@ def run_race_lint_gate() -> list:
 
 def run_xray_self_check() -> list:
     """Run nns-xray --self-check in-process: a chain diagnostic
-    (NNS-W120..W124) missing from the catalog, without an emitter, or
+    (NNS-W120..W125) missing from the catalog, without an emitter, or
     undocumented in docs/chain-analysis.md + docs/linting.md is a style
     problem — as is a doc mentioning a code that doesn't exist."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -194,7 +194,7 @@ def documented_pipeline_strings() -> list:
 
 def run_xray_docs_gate() -> list:
     """Every pipeline a doc or example shows must xray CLEAN of the
-    chain diagnostics: a documented launch string firing W120-W124
+    chain diagnostics: a documented launch string firing W120-W125
     is either a bad example or a false positive — both are gate
     failures (acceptance: zero false chain findings on shipped
     snippets). Unanalyzable pipelines degrade to notes and pass."""
@@ -205,7 +205,7 @@ def run_xray_docs_gate() -> list:
         from nnstreamer_tpu.analysis.xray import xray
     except Exception as exc:  # pragma: no cover - broken tree
         return [f"nns-xray docs gate could not run: {exc}"]
-    chain_codes = {f"NNS-W12{i}" for i in range(5)}
+    chain_codes = {f"NNS-W12{i}" for i in range(6)}
     problems = []
     for src, desc in documented_pipeline_strings():
         result = xray(desc)
