@@ -236,6 +236,16 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "unrolled window) would serve it — host-dispatch overhead the "
         "compiled-chain path exists to remove",
     ),
+    # -- fleet serving robustness (docs/llm-serving.md) ---------------------
+    "NNS-W126": (
+        Severity.WARNING, "llm-drain-loses-generations",
+        "a fleet-tuned query serversrc (explicit retry-after-ms — its "
+        "clients re-route on drain NACKs) feeds an LLM serversink with "
+        "no migrate-to peer and no checkpoint-dir: draining this "
+        "server abandons every in-flight generation's KV and decoded "
+        "tokens, so re-routed requests pay a full re-prefill from "
+        "token zero on the next endpoint",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -757,6 +757,46 @@ def _fleet_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
             )
 
 
+def _llm_drain_loss_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W126: llm-drain-loses-generations — an explicitly tuned
+    ``retry-after-ms`` on a query serversrc is the fleet-drain
+    contract's fingerprint: the operator expects clients to re-route
+    on ``draining`` NACKs during rolling restarts. An LLM serversink
+    behind such a serversrc with NO migrate-to peer and NO
+    checkpoint-dir turns every one of those drains into lost work —
+    the in-flight generations' KV and decoded tokens are abandoned and
+    the re-routed requests re-prefill from token zero
+    (docs/llm-serving.md "Migration & recovery"). The explicit-set
+    check matters: retry-after-ms DEFAULTS to 50, so only an operator
+    who wrote it down has promised drain semantics."""
+    from nnstreamer_tpu.edge.query import TensorQueryServerSrc
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink
+
+    if not any(
+        isinstance(e, TensorQueryServerSrc)
+        and e.get_property("retry-after-ms") is not None
+        for e in pipeline.elements
+    ):
+        return
+    for e in pipeline.elements:
+        if not isinstance(e, LlmServerSink):
+            continue
+        if e.get_property("plane"):
+            continue  # plane-shared batchers refuse migration by design
+        if e.get_property("migrate-to") or e.get_property("checkpoint-dir"):
+            continue
+        report.add(
+            "NNS-W126", e.name,
+            "fleet drain is tuned (serversrc retry-after-ms) but this "
+            "LLM server can neither migrate nor recover its in-flight "
+            "generations: a drain abandons their KV and decoded "
+            "tokens, and re-routed clients pay full re-prefill",
+            "set migrate-to=host:port (live KV-span migration) and/or "
+            "checkpoint-dir (crash recovery); both need "
+            "kv-layout=paged (docs/llm-serving.md)",
+        )
+
+
 def _replica_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W112: replicas=N promises the stream survives a dying
     replica, but with the default on-error=stop the day EVERY replica is
@@ -1243,6 +1283,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _skewed_join_pass(pipeline, report)
     _admission_pass(pipeline, report)
     _fleet_failover_pass(pipeline, report)
+    _llm_drain_loss_pass(pipeline, report)
     _replica_failover_pass(pipeline, report)
     _resident_handoff_pass(pipeline, report)
     _model_sharing_pass(pipeline, report)

@@ -155,7 +155,8 @@ def nack_for_shed(srv_id: str, cid, frame_id=None) -> None:
         ctrl.release(cid)
 
 
-def discard_admitted(srv_id: str, cid, action: str, frame_id=None) -> None:
+def discard_admitted(srv_id: str, cid, action: str, frame_id=None,
+                     draining: bool = False) -> None:
     """A fault policy disposed of an admitted request (pipeline/faults.py
     notify_discard): return its admission budget — the in-flight slot
     must not stay pinned forever — and, unless the frame was delivered
@@ -164,7 +165,10 @@ def discard_admitted(srv_id: str, cid, action: str, frame_id=None) -> None:
     reason is ``failed`` (terminal) normally, but ``draining`` while
     the server is in a graceful drain — the disposal is then a
     restart artifact, not a verdict on the request, and a fleet client
-    re-routes it to another endpoint instead of giving up."""
+    re-routes it to another endpoint instead of giving up.
+    ``draining=True`` forces that reading when the DOWNSTREAM consumer
+    is the one draining (an LLM serversink mid-drain behind a
+    still-ready serversrc — docs/llm-serving.md)."""
     ctrl = _get_controller(srv_id)
     if ctrl is not None and cid is not None:
         ctrl.release(cid)
@@ -172,7 +176,7 @@ def discard_admitted(srv_id: str, cid, action: str, frame_id=None) -> None:
         return  # the dead-letter consumer owns the request's fate now
     transport = _get_server(srv_id)
     if transport is not None and cid is not None:
-        if server_state(srv_id) == SRV_DRAINING:
+        if draining or server_state(srv_id) == SRV_DRAINING:
             reason, hint = REASON_DRAINING, (
                 ctrl.cfg.retry_after_ms if ctrl is not None else 50.0
             )
@@ -230,6 +234,121 @@ def request_drain(host: str, port: int, connect_type: str = "TCP",
     raise TransportError(
         f"cannot deliver drain to {host}:{port}: {last}"
     )
+
+
+# -- live KV-span migration handshake (docs/llm-serving.md) ----------------
+# A draining LLM server re-hosts in-flight generations by asking a peer
+# serversrc: ``migrate_probe`` (how many leading tokens does your prefix
+# index cover? → strip those payloads) then ``migrate_span`` (the
+# kv/migrate.py span bytes riding the CTRL payload). The serversrc
+# routes both to the LLM server registered for the requested ``llm_id``
+# — the pairing is process-local, like the serversrc/serversink tables.
+
+_migration_table: Dict[int, object] = {}
+_migration_lock = threading.Lock()
+
+
+class MigrationRefused(RuntimeError):
+    """The peer answered the migration handshake with ``migrate_nack``:
+    the span was NOT adopted (no handler, draining, capacity, corrupt
+    span...). The source keeps the request — fall back to local
+    re-prefill resume."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
+
+
+def register_migration_handler(llm_id: int, handler) -> None:
+    """Make an LLM server adoptable-at over this process's serversrcs.
+    ``handler`` implements ``migration_probe(tokens) -> int`` and
+    ``migration_adopt(span_bytes) -> new_rid`` (raising a
+    ``kv.migrate.SpanError`` subclass to refuse)."""
+    with _migration_lock:
+        _migration_table[int(llm_id)] = handler
+
+
+def unregister_migration_handler(llm_id: int, handler=None) -> None:
+    with _migration_lock:
+        if handler is None or _migration_table.get(int(llm_id)) is handler:
+            _migration_table.pop(int(llm_id), None)
+
+
+def _get_migration_handler(llm_id: int):
+    with _migration_lock:
+        h = _migration_table.get(int(llm_id))
+        if h is None and len(_migration_table) == 1:
+            # exactly one LLM server in this process — the common fleet
+            # layout — so migrate-to=host:port works without the sender
+            # guessing the peer's serversink id
+            h = next(iter(_migration_table.values()))
+        return h
+
+
+def _ctrl_roundtrip(host: str, port: int, msg: bytes, connect_type: str,
+                    topic: str, timeout: float):
+    """Send one CTRL message and wait for the CTRL reply (the data
+    protocol is fire-and-forget for CTRL; migration needs an answer)."""
+    t = _make_client_transport(str(connect_type).upper(), topic)
+    try:
+        t.connect(host, port)
+        t.send(0, msg)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = t.recv(timeout=0.1)
+            if got is None:
+                continue
+            _cid, payload = got
+            if not payload:
+                raise TransportError(
+                    "peer closed during migration handshake"
+                )
+            try:
+                reply = decode_message(payload)
+            except ValueError:
+                continue  # garbage on the reply path: keep waiting
+            if isinstance(reply, Ctrl):
+                return reply
+        raise TransportError(
+            f"migration handshake with {host}:{port} timed out"
+        )
+    finally:
+        t.close()
+
+
+def probe_migration(host: str, port: int, tokens, llm_id: int = 0,
+                    connect_type: str = "TCP", topic: str = "nns-query",
+                    timeout: float = 5.0) -> int:
+    """Ask the peer how many leading ``tokens`` its LLM server's prefix
+    index already covers (full blocks only) — the warm-migration diet.
+    Raises :class:`MigrationRefused` if the peer cannot host spans."""
+    reply = _ctrl_roundtrip(
+        host, port,
+        encode_ctrl("migrate_probe", llm_id=int(llm_id),
+                    tokens=[int(x) for x in tokens]),
+        connect_type, topic, timeout,
+    )
+    if reply.op != "migrate_probe_ack":
+        raise MigrationRefused(str(reply.meta.get("reason", reply.op)))
+    return int(reply.meta.get("shared_tokens", 0))
+
+
+def send_migration(host: str, port: int, span_bytes: bytes,
+                   llm_id: int = 0, connect_type: str = "TCP",
+                   topic: str = "nns-query", timeout: float = 10.0) -> int:
+    """Ship an encoded KV span to the peer; returns the rid the
+    adopting server continues the generation under. Raises
+    :class:`MigrationRefused` when the peer declines (the request is
+    still whole on the caller's side — resume it locally)."""
+    reply = _ctrl_roundtrip(
+        host, port,
+        encode_ctrl("migrate_span", payload=span_bytes,
+                    llm_id=int(llm_id)),
+        connect_type, topic, timeout,
+    )
+    if reply.op != "migrate_span_ack":
+        raise MigrationRefused(str(reply.meta.get("reason", reply.op)))
+    return int(reply.meta.get("rid", -1))
 
 
 CONNECT_TYPES = ("TCP", "MQTT", "HYBRID", "SHM")
@@ -1177,6 +1296,50 @@ class TensorQueryServerSrc(Source):
         except (TransportError, OSError):
             pass  # the client vanished; nothing to tell
 
+    def _handle_ctrl(self, cid, msg) -> None:
+        """Operator/fleet control ops: ``drain``, and the migration
+        handshake routed to the LLM server registered for the
+        requested ``llm_id`` (docs/llm-serving.md). Every migrate op
+        gets an explicit reply — the sender decides fallback on it."""
+        if msg.op == "drain":
+            self.drain()
+            return
+        if msg.op not in ("migrate_probe", "migrate_span"):
+            return  # unknown ctrl: ignore (both ends live in-tree)
+        if self.state == SRV_DRAINING:
+            reply = encode_ctrl("migrate_nack", reason="draining")
+        else:
+            handler = _get_migration_handler(
+                int(msg.meta.get("llm_id", 0) or 0)
+            )
+            if handler is None:
+                reply = encode_ctrl(
+                    "migrate_nack", reason="no-migration-handler"
+                )
+            else:
+                try:
+                    if msg.op == "migrate_probe":
+                        n = handler.migration_probe(
+                            msg.meta.get("tokens", [])
+                        )
+                        reply = encode_ctrl(
+                            "migrate_probe_ack", shared_tokens=int(n)
+                        )
+                    else:
+                        rid = handler.migration_adopt(msg.payload)
+                        reply = encode_ctrl(
+                            "migrate_span_ack", rid=int(rid)
+                        )
+                except Exception as exc:  # span taxonomy → wire reason
+                    reply = encode_ctrl(
+                        "migrate_nack",
+                        reason=f"{type(exc).__name__}: {exc}",
+                    )
+        try:
+            self._transport.send(cid, reply)
+        except (TransportError, OSError):
+            pass  # the migrating peer vanished; it will fall back
+
     def _handle_incoming(self, cid, payload) -> None:
         """Admission at arrival: decode, admit or NACK, queue."""
         ctrl = self._controller
@@ -1191,8 +1354,7 @@ class TensorQueryServerSrc(Source):
             self._send_nack(cid, REASON_MALFORMED, 0.0)
             return
         if isinstance(msg, Ctrl):
-            if msg.op == "drain":
-                self.drain()
+            self._handle_ctrl(cid, msg)
             return
         if isinstance(msg, (EOS, Nack)):
             return  # one client's EOS must not stop the server
@@ -1229,8 +1391,7 @@ class TensorQueryServerSrc(Source):
                 self._send_nack(cid, REASON_MALFORMED, 0.0)
                 return None
             if isinstance(frame, Ctrl):
-                if frame.op == "drain":
-                    self.drain()
+                self._handle_ctrl(cid, frame)
                 return None
             if isinstance(frame, EOS):
                 return None

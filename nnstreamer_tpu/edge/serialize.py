@@ -53,10 +53,12 @@ KIND_DATA = 0
 KIND_EOS = 1
 KIND_NACK = 2
 # control channel (docs/edge-serving.md "Running a fleet"): an operator
-# message to the serving plane rather than a request — today only
-# ``drain`` (graceful drain for rolling restarts). Same framing as a
-# NACK: no tensors, just the meta blob (``ctrl_op``). Both ends of this
-# protocol live in-tree, so no version bump is needed.
+# message to the serving plane rather than a request — ``drain``
+# (graceful drain for rolling restarts) and the ``migrate_*`` live-
+# migration handshake. Same framing as a NACK: the meta blob
+# (``ctrl_op``) instead of tensors, plus optional opaque payload bytes
+# after it (the KV span). Both ends of this protocol live in-tree, so
+# no version bump is needed.
 KIND_CTRL = 3
 FLAG_META = 1
 
@@ -88,27 +90,34 @@ class Nack:
 
 
 class Ctrl:
-    """A control message to the serving plane (``KIND_CTRL``): today
-    only ``op == "drain"`` — stop accepting new work, NACK new submits
-    ``draining``, finish the admitted in-flight, then quiesce."""
+    """A control message to the serving plane (``KIND_CTRL``):
+    ``op == "drain"`` (stop accepting new work, NACK new submits
+    ``draining``, finish the admitted in-flight, then quiesce) and the
+    live-migration handshake (docs/llm-serving.md "Migration &
+    recovery"): ``migrate_probe`` / ``migrate_probe_ack`` (prefix
+    coverage query before shipping), ``migrate_span`` /
+    ``migrate_span_ack`` (the KV span itself riding ``payload``).
+    ``payload`` is opaque trailing bytes after the meta blob — v1/v2
+    decoders ignored trailing CTRL bytes, so no version bump."""
 
-    __slots__ = ("op", "meta")
+    __slots__ = ("op", "meta", "payload")
 
-    def __init__(self, op: str, meta=None) -> None:
+    def __init__(self, op: str, meta=None, payload: bytes = b"") -> None:
         self.op = op
         self.meta = meta or {}
+        self.payload = payload
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"Ctrl(op={self.op!r})"
 
 
-def encode_ctrl(op: str, **extra) -> bytes:
+def encode_ctrl(op: str, payload: bytes = b"", **extra) -> bytes:
     meta = {"ctrl_op": str(op)}
     meta.update(extra)
     enc = json.dumps(meta, separators=(",", ":")).encode()
     return (
         _HDR.pack(VERSION, KIND_CTRL, -1, -1, FLAG_META)
-        + _META_LEN.pack(len(enc)) + enc
+        + _META_LEN.pack(len(enc)) + enc + payload
     )
 
 
@@ -189,7 +198,7 @@ def decode_message(data: bytes):
             meta.get("frame_id"),
         )
     if kind == KIND_CTRL:
-        return Ctrl(str(meta.get("ctrl_op", "")), meta)
+        return Ctrl(str(meta.get("ctrl_op", "")), meta, data[off:])
     tensors = decode_frame_tensors(data[off:])
     return Frame(
         tensors,

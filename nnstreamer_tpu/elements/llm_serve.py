@@ -76,6 +76,44 @@ def _get_server(srv_id: str, create_kw: Optional[dict] = None):
         return srv
 
 
+def drain_server(srv_id: str, migrate_to: Optional[str] = None) -> Dict:
+    """Operator surface (fleet tooling, tests): gracefully drain the
+    id-keyed LLM server — new submits NACK ``draining``, chunked
+    prefills settle, in-flight generations live-migrate to the peer (or
+    resume locally when the peer refuses). Returns the drain summary
+    (docs/llm-serving.md "Migration & recovery")."""
+    with _table_lock:
+        srv = _table.get(str(srv_id))
+    if srv is None:
+        raise ElementError(
+            f"tensor_llm_server id={srv_id}: no server registered"
+        )
+    return srv.drain(migrate_to)
+
+
+# meta keys that are meaningless outside the submitting process — the
+# same hop-local set edge/serialize.py strips at the wire (client_id is
+# the SOURCE server's transport pairing tag; the adopting server's own
+# edge layer re-tags replies)
+_SPAN_META_SKIP = frozenset({
+    "client_id", "wall_t0", "admit_t", "_nns_srv", "_nns_budget_released",
+})
+
+
+def _span_meta(meta: dict) -> dict:
+    """The JSON-scalar, cross-process-meaningful subset of a request's
+    frame meta — what rides ``RequestSpan.meta`` (and the span
+    checkpoint files) so the adopting or resuming server emits the
+    finished generation with its identity (``frame_id``!) intact."""
+    out = {}
+    for k, v in meta.items():
+        if k in _SPAN_META_SKIP:
+            continue
+        if v is None or isinstance(v, (str, int, float, bool)):
+            out[k] = v
+    return out
+
+
 def _drop_server(srv_id: str, srv) -> None:
     """Remove the table entry — but only if it is still ``srv``: another
     pipeline may have reused the id with a fresh server, and a src that
@@ -166,7 +204,30 @@ class _LlmServer:
                  kv_blocks: int = 0, cache_dtype: str = "auto",
                  prefill_chunks: int = 1, kv_attn: str = "auto",
                  plane: str = "", plane_weight: float = 1.0,
-                 srv_id: str = "0"):
+                 srv_id: str = "0", migrate_to: str = "",
+                 checkpoint_every_tokens: int = 0,
+                 checkpoint_dir: str = ""):
+        if (migrate_to or checkpoint_dir or checkpoint_every_tokens):
+            # migration + crash recovery (docs/llm-serving.md
+            # "Migration & recovery") move block-table KV spans — they
+            # have no meaning for the contiguous slot cache
+            if kv_layout != "paged":
+                raise ElementError(
+                    "tensor_llm_serversink: migrate-to / "
+                    "checkpoint-every-tokens / checkpoint-dir need "
+                    "kv-layout=paged (spans are block-table slices)"
+                )
+            if plane:
+                # typed plane refusal, raised BEFORE acquiring a plane
+                # ref (nothing to release on this failure path)
+                from nnstreamer_tpu.serving_plane.llm import LlmPlaneError
+
+                raise LlmPlaneError(
+                    f"llm plane {plane!r}: migrate-to/checkpoint-* "
+                    "refused — plane-shared batchers cannot migrate "
+                    "or checkpoint requests; serve with a private "
+                    "kv-layout=paged batcher instead"
+                )
         if speculate_model and speculate != -1 and speculate < 2:
             # a draft model exists ONLY to propose speculate=k chunks;
             # without this, every request would pay the draft prefill
@@ -263,10 +324,46 @@ class _LlmServer:
         self._acc_ema = 0.5
         self._spec_seen = (0, 0)  # (columns, accepted) at last adapt
         self._sent: Dict[int, int] = {}  # rid -> tokens already streamed
+        # -- live migration + crash recovery (docs/llm-serving.md
+        # "Migration & recovery") --------------------------------------
+        self.srv_id = str(srv_id)
+        self._paged = kv_layout == "paged" or plane != ""
+        self.migrate_to = str(migrate_to or "")
+        self.draining = False
+        self._edge_srv = None  # paired serversrc id, learned at submit
+        self._ckpt_every = max(0, int(checkpoint_every_tokens))
+        self._ckpt_dir = str(checkpoint_dir or "")
+        self._ckpt_seen: Dict[int, int] = {}  # rid -> tokens at last ckpt
+        from nnstreamer_tpu.obs import metrics as _obs_metrics
+
+        self._obs_reg = _obs_metrics.get()
+        # the llm_id the migration handshake routes by: the serversink
+        # id when numeric (the usual "id=0"), else 0 — the receiving
+        # process falls back to its only handler anyway when exactly
+        # one LLM server runs there
+        self._mig_id = int(self.srv_id) if self.srv_id.isdigit() else 0
+        self._mig_registered = False
+        if self._plane is None and self._paged:
+            # every private paged server is adoptable: being a
+            # migration DESTINATION needs no props — migrate-to only
+            # configures where THIS server ships its spans at drain
+            from nnstreamer_tpu.edge import query as _equery
+
+            _equery.register_migration_handler(self._mig_id, self)
+            self._mig_registered = True
+            if self._ckpt_dir:
+                self._restore_checkpoints()
 
     def submit(self, frame: Frame) -> None:
         import time as _time
 
+        if frame.meta.get("_nns_srv") is not None:
+            # remember which edge serversrc feeds this server, so
+            # drain() can flip its readiness flag and NACK at admission
+            self._edge_srv = frame.meta.get("_nns_srv")
+        if self.draining:
+            self._nack_draining(frame)
+            return
         prompt = np.asarray(frame.tensors[0]).reshape(-1).astype(np.int32)
         budget = int(frame.meta.get("max_new_tokens", self.default_new))
         # per-request sampling params ride in frame meta (greedy default)
@@ -349,6 +446,7 @@ class _LlmServer:
         else:
             emitted = self.cb.step()
         harvested = False
+        finished: List[int] = []
         with self._lock:
             if self.stream:
                 # count-based catch-up off cb.partials() (one batcher
@@ -373,7 +471,13 @@ class _LlmServer:
                         meta = {**meta, "stream": True, "done": True}
                     self._sent.pop(rid, None)
                     self._out.append((toks, meta))
+                    finished.append(rid)
                     harvested = True
+        if self._ckpt_dir:
+            for rid in finished:
+                self._ckpt_drop(rid)
+            if self._ckpt_every:
+                self._checkpoint_tick()
         return bool(emitted) or harvested
 
     def _stream_new_locked(self, rid: int, meta: dict, toks) -> bool:
@@ -386,6 +490,289 @@ class _LlmServer:
             ))
         self._sent[rid] = len(toks)
         return len(toks) > n0
+
+    # -- live migration + crash recovery (docs/llm-serving.md
+    # "Migration & recovery") ------------------------------------------
+
+    def _nack_draining(self, frame: Frame) -> None:
+        """A submit reaching a draining LLM server is NACKed
+        ``draining`` with the retry-after hint (the PR-15 edge-drain
+        contract, now honoured when the DOWNSTREAM consumer drains
+        behind a still-ready serversrc) — the fleet client re-routes
+        instead of timing out behind a server that will never finish
+        the request."""
+        srv = frame.meta.get("_nns_srv")
+        cid = frame.meta.get("client_id")
+        if srv is not None and cid is not None:
+            from nnstreamer_tpu.edge.query import discard_admitted
+
+            discard_admitted(
+                srv, cid, "nack", frame_id=frame.meta.get("frame_id"),
+                draining=True,
+            )
+            return
+        # no edge hop to answer through (direct pipeline submit): the
+        # typed refusal is the only channel left
+        raise ElementError(
+            "tensor_llm_serversink: draining — not accepting new "
+            "requests (resubmit to another endpoint)"
+        )
+
+    def migration_probe(self, tokens) -> int:
+        """How many leading ``tokens`` this server's prefix index
+        already covers (full blocks only) — the sender strips those
+        blocks' payloads and ships only the unshared suffix. Answers
+        ``migrate_probe`` CTRLs through the edge/query.py handler
+        registry."""
+        from nnstreamer_tpu.kv.migrate import SpanStateError
+
+        if self._plane is not None:
+            self._plane.refuse_migration("migrate_probe")
+        if self.draining or self.stopped:
+            raise SpanStateError(
+                f"tensor_llm_server id={self.srv_id}: draining"
+            )
+        return int(self.cb.probe_prefix([int(t) for t in tokens]))
+
+    def migration_adopt(self, span_bytes: bytes) -> int:
+        """Decode + adopt an incoming KV span: the generation continues
+        HERE under the returned rid — bitwise-identically for greedy
+        requests — and this server's serversrc emits it with the span's
+        surviving frame meta (``frame_id`` intact for reply dedup)."""
+        from nnstreamer_tpu.kv import migrate as _migrate
+
+        if self._plane is not None:
+            self._plane.refuse_migration("migrate_span")
+        if self.draining or self.stopped:
+            raise _migrate.SpanStateError(
+                f"tensor_llm_server id={self.srv_id}: draining"
+            )
+        span = _migrate.decode_span(span_bytes)
+        rid = self.cb.adopt_request(span)
+        with self._lock:
+            self._pending[rid] = dict(span.meta)
+        return rid
+
+    def drain(self, migrate_to: Optional[str] = None) -> Dict[str, int]:
+        """Graceful drain with live migration: stop admitting (new
+        submits NACK ``draining``, the paired edge serversrc flips to
+        SRV_DRAINING), settle every chunked prefill mid-flight (a span
+        is only extractable once its request is decoding — no job left
+        half-staged), then per in-flight request: extract the KV span,
+        probe the peer's prefix coverage, ship the slimmed span. A
+        refusing or unreachable peer falls back to local re-prefill
+        resume; with no peer configured the requests simply finish in
+        place. Returns ``{"migrated", "resumed", "completed", "kept"}``
+        counts."""
+        import time as _time
+
+        if self._plane is not None:
+            self._plane.refuse_migration("drain(migrate_to=...)")
+        self.draining = True
+        if self._edge_srv is not None:
+            from nnstreamer_tpu.edge import query as _equery
+
+            _equery._set_server_state(
+                self._edge_srv, _equery.SRV_DRAINING
+            )
+        summary = {"migrated": 0, "resumed": 0, "completed": 0, "kept": 0}
+        if self._paged:
+            # settle chunked prefills: every queued/half-staged prefill
+            # lands (its request becomes decoding — and extractable)
+            # before any span leaves; completed chunks are never re-run
+            while (self.cb.stats().get("kv_prefill_queue") or 0) > 0:
+                if self.stopped:
+                    break
+                if not self.pump():
+                    _time.sleep(0.002)
+        target = self.migrate_to if migrate_to is None else str(migrate_to)
+        with self._lock:
+            rids = list(self._pending)
+        if not target or not rids:
+            summary["kept"] = len(rids)
+            return summary
+        if not self._paged:
+            raise ElementError(
+                "tensor_llm_serversink: drain(migrate_to=...) needs "
+                "kv-layout=paged (spans are block-table slices)"
+            )
+        # host:port[/llm-id] — the peer's serversink id defaults to this
+        # server's own (symmetric fleet configs), and a peer hosting a
+        # single LLM server answers regardless (handler fallback)
+        peer_id = self._mig_id
+        base, sep, suffix = target.partition("/")
+        if sep:
+            target = base
+            peer_id = int(suffix) if suffix.isdigit() else 0
+        host, _, port_s = target.rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ElementError(
+                f"tensor_llm_serversink: migrate-to={target!r} must be "
+                "host:port[/llm-id]"
+            )
+        port = int(port_s)
+        from nnstreamer_tpu.edge import query as _equery
+        from nnstreamer_tpu.edge.transport import TransportError
+        from nnstreamer_tpu.kv import migrate as _migrate
+
+        for rid in rids:
+            try:
+                span = self.cb.extract_request(rid)
+            except _migrate.SpanError:
+                # finished between the settle loop and now — pump's
+                # harvest owns it (still a terminal outcome)
+                summary["completed"] += 1
+                continue
+            with self._lock:
+                meta = dict(self._pending.get(rid) or {})
+            span.meta.update(_span_meta(meta))
+            try:
+                shared = _equery.probe_migration(
+                    host, port, span.kv_tokens, llm_id=peer_id
+                )
+                wire = _migrate.encode_span(span.strip_shared(shared))
+                _equery.send_migration(
+                    host, port, wire, llm_id=peer_id
+                )
+            except (_equery.MigrationRefused, TransportError, OSError,
+                    ValueError, _migrate.SpanError):
+                # the request is still whole on this side — resume it
+                # locally via re-prefill of the surviving context (the
+                # cold fallback; generated tokens are NOT lost)
+                new_rid = self.cb.resume_from_span(span)
+                with self._lock:
+                    self._pending[new_rid] = self._pending.pop(rid, meta)
+                    n_sent = self._sent.pop(rid, None)
+                    if n_sent is not None:
+                        self._sent[new_rid] = n_sent
+                if self._ckpt_dir:
+                    self._ckpt_rename(rid, new_rid)
+                summary["resumed"] += 1
+            else:
+                with self._lock:
+                    self._pending.pop(rid, None)
+                    self._sent.pop(rid, None)
+                self._ckpt_drop(rid)
+                summary["migrated"] += 1
+        return summary
+
+    def _checkpoint_tick(self) -> None:
+        """Every checkpoint-every-tokens NEW tokens per request, write
+        an atomic span checkpoint — a hard-killed server process
+        resumes its in-flight generations from these files at next
+        construction, without re-running completed prefill chunks."""
+        with self._lock:
+            rids = list(self._pending)
+        if not rids or not self._paged:
+            return
+        parts = self.cb.partials(rids)
+        for rid in rids:
+            n = len(parts.get(rid) or ())
+            if n - self._ckpt_seen.get(rid, 0) < self._ckpt_every:
+                continue
+            if self._write_checkpoint(rid):
+                self._ckpt_seen[rid] = n
+
+    def _write_checkpoint(self, rid: int) -> bool:
+        import os
+
+        from nnstreamer_tpu.kv import migrate as _migrate
+
+        with self._lock:
+            meta = dict(self._pending.get(rid) or {})
+        try:
+            span = self.cb.extract_request(rid, remove=False)
+        except _migrate.SpanError:
+            return False  # finished or mid-prefill this instant — skip
+        span.meta.update(_span_meta(meta))
+        path = os.path.join(self._ckpt_dir, f"req-{rid}.span")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self._ckpt_dir, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(_migrate.encode_span(span))
+            # atomic replace: a reader (or the restore scan after a
+            # crash) sees the old complete checkpoint or the new one,
+            # never a torn file
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        return True
+
+    def _ckpt_drop(self, rid: int) -> None:
+        import os
+
+        self._ckpt_seen.pop(rid, None)
+        if not self._ckpt_dir:
+            return
+        try:
+            os.remove(os.path.join(self._ckpt_dir, f"req-{rid}.span"))
+        except OSError:
+            pass
+
+    def _ckpt_rename(self, old: int, new: int) -> None:
+        """A request changed rid (resume fallback, restore adoption):
+        move its checkpoint file along — the stale name would be
+        re-adopted as a GHOST duplicate at the next restart."""
+        import os
+
+        self._ckpt_seen[new] = self._ckpt_seen.pop(old, 0)
+        try:
+            os.replace(
+                os.path.join(self._ckpt_dir, f"req-{old}.span"),
+                os.path.join(self._ckpt_dir, f"req-{new}.span"),
+            )
+        except OSError:
+            pass
+
+    def _restore_checkpoints(self) -> None:
+        """Crash recovery: adopt every span checkpoint a previous
+        (hard-killed) server process left in checkpoint-dir — the
+        landed KV re-enters the arena directly, so completed prefill
+        chunks are NOT re-run. Corrupt or unadoptable files are set
+        aside (``.bad``) rather than retried forever."""
+        import os
+
+        from nnstreamer_tpu.kv import migrate as _migrate
+
+        try:
+            names = sorted(os.listdir(self._ckpt_dir))
+        except OSError:
+            return  # fresh dir: created lazily at the first checkpoint
+        for name in names:
+            if not name.endswith(".span"):
+                continue
+            path = os.path.join(self._ckpt_dir, name)
+            try:
+                with open(path, "rb") as f:
+                    span = _migrate.decode_span(f.read())
+                rid = self.cb.adopt_request(span)
+            except (OSError, _migrate.SpanError):
+                try:
+                    os.replace(path, path + ".bad")
+                except OSError:
+                    pass
+                continue
+            with self._lock:
+                self._pending[rid] = dict(span.meta)
+            # keep the file (under the adopted rid's name) until the
+            # request finishes or re-checkpoints: a crash right after
+            # restore must not lose the generation a second time
+            dest = os.path.join(self._ckpt_dir, f"req-{rid}.span")
+            if dest != path:
+                try:
+                    os.replace(path, dest)
+                except OSError:
+                    pass
+            self._ckpt_seen[rid] = len(span.tokens)
+            if self._obs_reg is not None:
+                self._obs_reg.counter(
+                    "nns_request_resumes_total", kind="checkpoint"
+                ).inc()
 
     def stats(self) -> Dict:
         """Batcher counters + the adaptive-speculation control state
@@ -431,7 +818,13 @@ class _LlmServer:
         """Detach from (and drop one ref of) the shared LLM plane —
         called when this server leaves the pairing table. Idempotent
         (the src calls it at drain AND at stop) and race-guarded under
-        ``_lock``; no-op for private-batcher servers."""
+        ``_lock``; private-batcher servers only unregister their
+        migration handler here."""
+        if self._mig_registered:
+            self._mig_registered = False
+            from nnstreamer_tpu.edge import query as _equery
+
+            _equery.unregister_migration_handler(self._mig_id, self)
         with self._lock:
             plane, self._plane = self._plane, None
         if plane is None:
@@ -472,7 +865,14 @@ class LlmServerSink(Sink):
     gather keeps the materialized-view debug/parity oracle — flagged
     by nns-lint NNS-W117 when it would breach the memory bound),
     cache-dtype (int8 stores the KV cache quantized), kv-memory-bound
-    (declared HBM budget consumed by nns-lint NNS-W115/W117)."""
+    (declared HBM budget consumed by nns-lint NNS-W115/W117),
+    migrate-to (peer host:port — drain-time live KV-span migration;
+    in-flight generations continue on the peer bitwise-identically for
+    greedy requests), checkpoint-every-tokens/checkpoint-dir (periodic
+    atomic span checkpoints; a restarted server adopts the files and
+    resumes without re-running completed prefill chunks — docs/
+    llm-serving.md "Migration & recovery"; all three require
+    kv-layout=paged and are refused on plane= with a typed error)."""
 
     FACTORY_NAME = "tensor_llm_serversink"
 
@@ -522,6 +922,24 @@ class LlmServerSink(Sink):
             "float", 1.0,
             desc="this stream's weighted-fair admission share on the "
             "LLM plane (default 1.0)",
+        ),
+        # live migration + crash recovery (docs/llm-serving.md
+        # "Migration & recovery"): paged private batchers only —
+        # plane-shared batchers refuse these with a typed error
+        "migrate-to": PropSpec(
+            "str", "",
+            desc="peer host:port[/llm-id] for drain-time live KV-span "
+            "migration (requires kv-layout=paged)",
+        ),
+        "checkpoint-every-tokens": PropSpec(
+            "int", 0,
+            desc="write an atomic span checkpoint every N generated "
+            "tokens per request (0 = off; requires kv-layout=paged)",
+        ),
+        "checkpoint-dir": PropSpec(
+            "str", "",
+            desc="span checkpoint directory — in-flight generations "
+            "found here resume at startup (crash recovery)",
         ),
     }
 
@@ -586,6 +1004,13 @@ class LlmServerSink(Sink):
             plane=str(self.get_property("plane", "") or ""),
             plane_weight=float(self.get_property("plane-weight", 1.0)),
             srv_id=self.srv_id,
+            migrate_to=str(self.get_property("migrate-to", "") or ""),
+            checkpoint_every_tokens=int(
+                self.get_property("checkpoint-every-tokens", 0)
+            ),
+            checkpoint_dir=str(
+                self.get_property("checkpoint-dir", "") or ""
+            ),
         )
         self._server: Optional[_LlmServer] = None
 

@@ -42,6 +42,21 @@ class NoBlocksError(RuntimeError):
     re-prefill it later from whatever prefix survived), never an OOM."""
 
 
+class PoolCapacityError(ValueError):
+    """A snapshot needs more blocks than this pool has (``kv_blocks``
+    shrank across a restart). Raised BEFORE any state mutates, so the
+    restoring batcher's arena stays intact; ``evictable`` names what the
+    snapshot could shed to fit — cached-tier prefix blocks (reclaimable
+    without touching a live request) and registered-prefix pins."""
+
+    def __init__(self, msg: str, needed: int, have: int,
+                 evictable=None) -> None:
+        super().__init__(msg)
+        self.needed = int(needed)
+        self.have = int(have)
+        self.evictable = list(evictable or [])
+
+
 def roll_hash(prev: int, tokens: np.ndarray) -> int:
     """Rolling block hash: CRC32 of the block's token bytes chained on
     the previous boundary's hash — one int per block boundary, cheap to

@@ -91,7 +91,10 @@ class SLORecord:
     t_done: Optional[float] = None
     n_tokens: int = 0
     preemptions: int = 0
-    state: str = "queued"  # queued | prefilling | decoding | done
+    # queued | prefilling | decoding | done | migrated (extracted and
+    # re-hosted on a peer batcher — terminal HERE; the adopting side
+    # opens a fresh record that finishes the request)
+    state: str = "queued"
 
     def view(self) -> Dict[str, Any]:
         ttft = tpot = None
@@ -161,6 +164,19 @@ class SLOLedger:
                 self._obs.histogram("nns_request_ttft_ms").observe(
                     max((rec.t_first - rec.t_submit) * 1000.0, 1e-6)
                 )
+
+    def record(self, rid: int) -> Optional[SLORecord]:
+        """The live record for ``rid`` (migration reads the deadline and
+        preemption count to ship with the span), or None if evicted."""
+        return self._get(rid)
+
+    def migrated(self, rid: int) -> None:
+        """The request was extracted and re-hosted elsewhere: terminal
+        for THIS ledger (the peer's record carries it to done)."""
+        rec = self._get(rid)
+        if rec is not None:
+            rec.t_done = time.perf_counter()
+            rec.state = "migrated"
 
     def preempted(self, rid: int) -> None:
         rec = self._get(rid)

@@ -1199,6 +1199,18 @@ class ContinuousBatcher:
             self._write_block, self._read_block, self._copy_block = (
                 _kvg.make_paged_ops(quantized_cache, compute_dtype)
             )
+            # live migration (kv/migrate.py): raw per-leaf block scatter
+            # — donated like every other arena mutator, and bypassing
+            # the quantize/dequantize in write_block/read_block so an
+            # int8 span lands the exact bytes the source held
+            self._adopt_scatter = jax.jit(
+                lambda leaf, ids, vals: leaf.at[:, ids].set(vals),
+                donate_argnums=0,
+            )
+            self._quantized = quantized_cache
+            self._n_migrations_out = 0
+            self._n_migrations_in = 0
+            self._n_resumes = 0
             self._prefill_q: deque = deque()
             self._prefill_chunks = max(1, int(prefill_chunks))
             self._prefixes_paged: Dict[int, Tuple[np.ndarray, List[int]]] = {}
@@ -2720,6 +2732,349 @@ class ContinuousBatcher:
             resumed=True,
         ))
 
+    # -- live migration (kv/migrate.py; docs/llm-serving.md) ---------------
+    def _span_leaf_template(self):
+        """(dtype, per-block shape) per arena leaf, jax leaf order —
+        the geometry a span must match to be adoptable here."""
+        return [
+            (str(np.dtype(leaf.dtype).name),
+             (leaf.shape[0],) + tuple(leaf.shape[2:]))
+            for leaf in jax.tree_util.tree_leaves(self._cache)
+        ]
+
+    def probe_prefix(self, tokens) -> int:
+        """Leading tokens of ``tokens`` whose K/V this pool already
+        holds in FULL indexed blocks — the migration warm probe.
+        Read-only (nothing is adopted); the answer feeds
+        ``RequestSpan.strip_shared`` on the sending side so a warm
+        migration ships only the unshared suffix."""
+        if not self._paged:
+            return 0
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        with self._lock:
+            m = self._pool.match(toks)
+        return len(m.full) * self.block_size
+
+    def extract_request(self, rid: int, remove: bool = True):
+        """Serialize request ``rid``'s live state into a
+        :class:`~nnstreamer_tpu.kv.migrate.RequestSpan`: the request
+        row, the rolling-CRC prefix hashes, and every KV block's RAW
+        arena bytes (int8 payloads ship quantized + scales verbatim —
+        the round trip through ``read_block`` would dequantize and
+        break the bitwise guarantee). ``remove=True`` (migration) frees
+        the slot and blocks — registered blocks park in the pool's
+        cached tier, adoptable by later prompts; ``remove=False`` is
+        the non-destructive checkpoint read. Under ``_step_lock`` like
+        ``register_prefix``: the arena reads must serialize with
+        donated step/pump launches."""
+        from nnstreamer_tpu.kv.blocks import roll_hash
+        from nnstreamer_tpu.kv.migrate import (
+            BlockRecord,
+            RequestSpan,
+            SpanStateError,
+            block_crc,
+        )
+
+        if not self._paged:
+            raise SpanStateError(
+                "request migration needs kv_layout='paged'"
+            )
+        self._check_failed()
+        with self._step_lock:
+            self._apply_pending()
+            with self._lock:
+                slot = None
+                for s, r in enumerate(self._slots):
+                    if r is not None and r.rid == rid:
+                        slot = s
+                        break
+                if slot is None or not self._active[slot]:
+                    raise SpanStateError(
+                        f"request {rid} is not extractable: only an "
+                        "actively decoding request has a KV span "
+                        "(settle the prefill queue first — queued/"
+                        "prefilling requests re-submit, they do not "
+                        "migrate)"
+                    )
+                req = self._slots[slot]
+                bs = self.block_size
+                n_kv = req.fill0 + len(req.tokens) - 1
+                n_blocks = -(-n_kv // bs)
+                blocks = self._tables[slot, :n_blocks].tolist()
+                stream = np.concatenate([
+                    np.asarray(req.prompt, np.int32),
+                    np.asarray(req.tokens, np.int32),
+                ])[:n_kv]
+                # one packed gather per arena leaf — the exact resident
+                # bytes, fetched through the same lock discipline as
+                # snapshot()
+                ids = jnp.asarray(np.asarray(blocks, np.int32))
+                raw = [
+                    np.asarray(leaf[:, ids])
+                    for leaf in jax.tree_util.tree_leaves(self._cache)
+                ]
+                records = []
+                hashes = []
+                h = 0
+                for i in range(n_blocks):
+                    n_tok = min(bs, n_kv - i * bs)
+                    payload = [
+                        np.ascontiguousarray(r[:, i]).tobytes()
+                        for r in raw
+                    ]
+                    records.append(
+                        BlockRecord(n_tok, block_crc(payload), payload)
+                    )
+                    if n_tok == bs:
+                        h = roll_hash(h, stream[i * bs: (i + 1) * bs])
+                        hashes.append(h)
+                rec = self._slo.record(rid)
+                deadline = None
+                if rec is not None and rec.deadline_s is not None:
+                    deadline = rec.deadline_s - (
+                        _time.perf_counter() - rec.t_submit
+                    )
+                span = RequestSpan(
+                    block_size=bs,
+                    leaves=self._span_leaf_template(),
+                    cache_dtype=(
+                        "int8" if self._quantized
+                        else str(np.dtype(self.compute_dtype).name)
+                    ),
+                    rid=rid,
+                    prompt=np.asarray(req.prompt, np.int32).copy(),
+                    tokens=list(req.tokens),
+                    fill0=int(req.fill0),
+                    budget=int(req.budget),
+                    temperature=float(req.temperature),
+                    top_k=int(req.top_k),
+                    top_p=float(req.top_p),
+                    stop_token=req.stop_token,
+                    key=np.asarray(req.key, np.uint32).copy(),
+                    deadline_s=deadline,
+                    preemptions=(
+                        rec.preemptions if rec is not None else 0
+                    ),
+                    prefix_hashes=hashes,
+                    blocks=records,
+                )
+                if remove:
+                    self._pool.free(blocks)
+                    self._tables[slot] = 0
+                    self._n_alloc[slot] = 0
+                    self._tables_dirty = True
+                    self._active[slot] = False
+                    self._pump_state_dirty = True
+                    self._slots[slot] = None
+                    self._slo.migrated(rid)
+                    self._n_migrations_out += 1
+                    if self._obs_reg is not None:
+                        self._obs_reg.counter(
+                            "nns_kv_migrations_total", direction="out"
+                        ).inc()
+        return span
+
+    def adopt_request(self, span) -> int:
+        """Land a peer's :class:`RequestSpan` into THIS batcher and
+        continue decoding it: full blocks the prefix index already
+        holds are shared by refcount (the warm path — stripped payloads
+        must be covered here or :class:`SpanPayloadMissingError`), the
+        rest land their raw payloads into freshly allocated blocks, and
+        the request re-enters the batch through the resumed-admission
+        path (``known_first`` = the pending token, so no re-sampling:
+        the continued stream is bitwise the source's). Returns the NEW
+        local rid. Raises :class:`SpanCapacityError` (no slot / no
+        blocks / budget would overflow ``max_len``) without mutating
+        anything."""
+        from nnstreamer_tpu.kv.blocks import NoBlocksError
+        from nnstreamer_tpu.kv.migrate import (
+            SpanCapacityError,
+            SpanFormatError,
+            SpanPayloadMissingError,
+        )
+
+        if not self._paged:
+            raise SpanFormatError(
+                "request migration needs kv_layout='paged'"
+            )
+        self._check_failed()
+        bs = self.block_size
+        if span.block_size != bs:
+            raise SpanFormatError(
+                f"KV span block_size {span.block_size} != this "
+                f"batcher's {bs}"
+            )
+        if list(span.leaves) != self._span_leaf_template():
+            raise SpanFormatError(
+                "KV span arena geometry mismatch (layers/heads/dims or "
+                "cache dtype differ — migrate between identically "
+                "configured batchers)"
+            )
+        if span.fill0 + span.budget > self.max_len:
+            raise SpanCapacityError(
+                f"span needs fill0+budget={span.fill0 + span.budget} "
+                f"positions but max_len={self.max_len}"
+            )
+        n_kv = span.n_kv
+        n_blocks = -(-n_kv // bs)
+        stream = span.kv_tokens
+        with self._step_lock:
+            self._apply_pending()
+            with self._lock:
+                try:
+                    slot = next(
+                        i for i, r in enumerate(self._slots) if r is None
+                    )
+                except StopIteration:
+                    raise SpanCapacityError(
+                        f"no free slot ({self.n_slots} occupied)"
+                    ) from None
+                m = self._pool.match(stream)
+                n_shared = min(len(m.full), n_blocks)
+                shared = list(m.full[:n_shared])
+                for i, rec in enumerate(span.blocks):
+                    if rec.payload is None and i >= n_shared:
+                        raise SpanPayloadMissingError(
+                            f"block {i} was stripped by the sender but "
+                            "this pool's prefix index does not cover it"
+                        )
+                for b in shared:
+                    self._pool.adopt(b)
+                if n_shared:
+                    self._pool.record_hit_tokens(n_shared * bs)
+                try:
+                    fresh = (
+                        self._pool.alloc(n_blocks - n_shared)
+                        if n_blocks > n_shared else []
+                    )
+                except NoBlocksError:
+                    self._pool.free(shared)
+                    raise SpanCapacityError(
+                        f"pool cannot host the span: needs "
+                        f"{n_blocks - n_shared} fresh blocks, "
+                        f"{self._pool.available()} available"
+                    ) from None
+                rid = self._next_rid
+                self._next_rid += 1
+                req = _Request(
+                    rid, span.budget, temperature=span.temperature,
+                    top_k=span.top_k, top_p=span.top_p,
+                    stop_token=span.stop_token,
+                    t_submit=_time.perf_counter(),
+                    key=np.asarray(span.key, np.uint32),
+                    prompt=np.asarray(span.prompt, np.int32),
+                )
+                req.tokens = list(span.tokens)
+                req.fill0 = int(span.fill0)
+                self._slots[slot] = req
+            blocks = shared + fresh
+            if fresh:
+                # decode every shipped payload on host BEFORE the first
+                # donated device write, so a malformed span can never
+                # half-mutate the arena
+                per_leaf = []
+                for j, (dt, shape) in enumerate(span.leaves):
+                    per_leaf.append(np.stack([
+                        np.frombuffer(
+                            span.blocks[i].payload[j], dtype=np.dtype(dt)
+                        ).reshape(shape)
+                        for i in range(n_shared, n_blocks)
+                    ], axis=1))
+                try:
+                    treedef = jax.tree_util.tree_structure(self._cache)
+                    leaves = jax.tree_util.tree_leaves(self._cache)
+                    ids = jnp.asarray(np.asarray(fresh, np.int32))
+                    self._cache = jax.tree_util.tree_unflatten(treedef, [
+                        self._adopt_scatter(leaf, ids, jnp.asarray(vals))
+                        for leaf, vals in zip(leaves, per_leaf)
+                    ])
+                except Exception as exc:  # donated mid-write: latch
+                    self._mark_failed(exc)
+                    raise
+            with self._lock:
+                self._pool.register(stream, blocks)
+                rec = self._slo.submit(rid, span.deadline_s)
+                rec.preemptions = int(span.preemptions)
+                hist_row = np.full((self.max_len,), -1, np.int32)
+                hist_row[:n_kv] = stream[: self.max_len]
+                self._pending.append(_PendingInsert(
+                    slot, None, None, int(span.tokens[-1]), n_kv, req,
+                    hist_row=hist_row, blocks=blocks, resumed=True,
+                ))
+                self._n_migrations_in += 1
+            self._apply_pending()
+        if self._obs_reg is not None:
+            self._obs_reg.counter(
+                "nns_kv_migrations_total", direction="in"
+            ).inc()
+        return rid
+
+    def resume_from_span(self, span) -> int:
+        """Deadline-aware re-prefill fallback (the PR-10 eviction-resume
+        path): when no peer accepts the span, re-admit the request from
+        its token stream — the prefix index supplies whatever KV
+        survived in the cached tier, chunked prefill recomputes the
+        rest, and ``known_first`` pins the pending token so the
+        continued stream is exactly the original. Returns the new rid;
+        the span's remaining deadline and preemption count carry over."""
+        from nnstreamer_tpu.kv.migrate import (
+            SpanCapacityError,
+            SpanFormatError,
+        )
+        from nnstreamer_tpu.kv.sched import PrefillJob
+
+        if not self._paged:
+            raise SpanFormatError(
+                "request migration needs kv_layout='paged'"
+            )
+        self._check_failed()
+        if span.fill0 + span.budget > self.max_len:
+            raise SpanCapacityError(
+                f"span needs fill0+budget={span.fill0 + span.budget} "
+                f"positions but max_len={self.max_len}"
+            )
+        with self._lock:
+            try:
+                slot = next(
+                    i for i, r in enumerate(self._slots) if r is None
+                )
+            except StopIteration:
+                raise SpanCapacityError(
+                    f"no free slot ({self.n_slots} occupied)"
+                ) from None
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _Request(
+                rid, span.budget, temperature=span.temperature,
+                top_k=span.top_k, top_p=span.top_p,
+                stop_token=span.stop_token,
+                t_submit=_time.perf_counter(),
+                key=np.asarray(span.key, np.uint32),
+                prompt=np.asarray(span.prompt, np.int32),
+            )
+            req.tokens = list(span.tokens)
+            req.fill0 = int(span.fill0)
+            self._slots[slot] = req
+            rec = self._slo.submit(rid, span.deadline_s)
+            rec.preemptions = int(span.preemptions)
+            if len(span.tokens) > 1:
+                context = np.concatenate([
+                    np.asarray(span.prompt, np.int32),
+                    np.asarray(span.tokens[:-1], np.int32),
+                ])
+            else:
+                context = np.asarray(span.prompt, np.int32)
+            self._prefill_q.append(PrefillJob(
+                slot, req, context,
+                known_first=int(span.tokens[-1]), resumed=True,
+            ))
+            self._n_resumes += 1
+        if self._obs_reg is not None:
+            self._obs_reg.counter(
+                "nns_request_resumes_total", kind="reprefill"
+            ).inc()
+        return rid
+
     # -- failure containment (donated-state launches) ----------------------
     def _mark_failed(self, exc: Exception) -> None:
         """A step/pump program raised after dispatch: the donated cache
@@ -3432,6 +3787,9 @@ class ContinuousBatcher:
                 # the gather round trip — 0 forever under kv_attn=block
                 st["kv_attn"] = self._kv_attn
                 st["kv_gather_dispatches"] = self._n_gather_dispatch
+                st["kv_migrations_out"] = self._n_migrations_out
+                st["kv_migrations_in"] = self._n_migrations_in
+                st["request_resumes"] = self._n_resumes
             return st
 
     def _lat_p50s_locked(self):
@@ -3636,6 +3994,37 @@ class ContinuousBatcher:
         if (snap.get("n_slots") != self.n_slots
                 or snap.get("max_len") != self.max_len):
             raise ValueError("snapshot geometry mismatch")
+        if self._paged:
+            # refuse a shrunk pool BEFORE any device state moves: the
+            # first mutation below donates the arena, so discovering the
+            # mismatch inside pool.restore() would leave a corrupt half-
+            # restored batcher. PoolCapacityError names what the
+            # snapshot could shed (cached prefix blocks, registered
+            # prefix pins) to fit a smaller kv_blocks on re-snapshot.
+            from nnstreamer_tpu.kv.blocks import PoolCapacityError
+            psnap = snap.get("pool", {})
+            snap_blocks = int(psnap.get("n_blocks", self._pool.n_blocks))
+            if snap_blocks > self._pool.n_blocks:
+                refcount = list(psnap.get("refcount", []))
+                live = sum(1 for rc in refcount[1:] if rc > 0)
+                evictable = [
+                    ("cached-block", int(b))
+                    for b in psnap.get("cached", [])
+                ] + [
+                    ("prefix", int(pid), len(blks))
+                    for pid, (_tok, blks)
+                    in snap.get("prefixes", {}).items()
+                ]
+                raise PoolCapacityError(
+                    f"snapshot was taken with kv_blocks={snap_blocks} "
+                    f"({live} in use) but this batcher has only "
+                    f"{self._pool.n_blocks}: restore refused before any "
+                    f"state moved; {len(evictable)} evictable "
+                    "candidates (cached prefix blocks / registered "
+                    "prefixes) could be shed at the source to fit",
+                    needed=snap_blocks, have=self._pool.n_blocks,
+                    evictable=evictable,
+                )
         with self._step_lock, self._lock:
             dev = snap["device"]
             self._cache = jax.tree_util.tree_map(

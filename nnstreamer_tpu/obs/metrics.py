@@ -154,6 +154,26 @@ METRIC_CATALOG: Dict[str, str] = {
         "plane is paying the materialized-view round trip (counter; "
         "docs/llm-serving.md)"
     ),
+    "nns_kv_migrations_total": (
+        "live request migrations through kv/migrate.py spans, by "
+        "direction label: out (extracted and shipped to a peer) / in "
+        "(adopted from a peer's span) (counter; docs/llm-serving.md "
+        "Migration & recovery)"
+    ),
+    "nns_kv_span_bytes_total": (
+        "encoded KV-span bytes, by direction label: out (spans "
+        "encoded) / in (spans decoded) — warm migrations strip "
+        "prefix-shared block payloads, so out bytes under-count the "
+        "resident KV the receiver reconstructs (counter; "
+        "docs/llm-serving.md)"
+    ),
+    "nns_request_resumes_total": (
+        "in-flight requests resumed after a disruption, by kind "
+        "label: reprefill (no peer accepted the span — deadline-aware "
+        "re-prefill from the surviving prefix) / checkpoint (adopted "
+        "from an on-disk span checkpoint after a restart) (counter; "
+        "docs/llm-serving.md)"
+    ),
     "nns_request_ttft_ms": (
         "per-request time to first token, submit → first token "
         "materialized, milliseconds (histogram; the admission SLO — "

@@ -404,6 +404,20 @@ def render_requests(snap: dict) -> str:
             footer.append(f"prefix-hits={row['serving_kv_prefix_hits']}")
         if pre:
             footer.append(f"preemptions={pre}")
+        # live migration + crash recovery (docs/llm-serving.md
+        # "Migration & recovery"): spans shipped out / adopted in, and
+        # requests resumed (re-prefill fallback or checkpoint restart);
+        # migrated requests also show as state=migrated in the rows
+        if row.get("serving_kv_migrations_out") or row.get(
+            "serving_kv_migrations_in"
+        ):
+            footer.append(
+                "migrations="
+                f"{row.get('serving_kv_migrations_out', 0)}out/"
+                f"{row.get('serving_kv_migrations_in', 0)}in"
+            )
+        if row.get("serving_request_resumes"):
+            footer.append(f"resumes={row['serving_request_resumes']}")
         if footer:
             lines.append(f"  {name}: " + " ".join(footer))
     if not lines:

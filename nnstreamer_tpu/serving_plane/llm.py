@@ -115,6 +115,19 @@ class LlmPlane:
             self._sched.add(s)
             return s
 
+    def refuse_migration(self, op: str) -> None:
+        """Plane-shared batchers refuse the live-migration surface with
+        a typed error (docs/llm-serving.md "Migration & recovery"): the
+        KV arena, slot table, and prefix index are shared across N
+        serversink streams, so extracting or adopting a span here would
+        move one stream's request through state every sharer co-owns.
+        Migration needs a PRIVATE kv-layout=paged batcher."""
+        raise LlmPlaneError(
+            f"llm plane {self.name!r}: {op} refused — plane-shared "
+            "batchers cannot migrate or checkpoint requests; serve "
+            "with a private kv-layout=paged batcher instead"
+        )
+
     def detach(self, stream: LlmStream) -> None:
         """Drop a stream: its queued prompts are discarded (the owning
         pipeline is stopping — nobody will pop their generations) and

@@ -531,6 +531,33 @@ def test_lint_w119_single_endpoint_no_failover_both_ways():
     assert "NNS-W119" not in plain.report.codes
 
 
+def test_lint_w126_llm_drain_loses_generations_both_ways():
+    from nnstreamer_tpu.analysis.lint import lint
+
+    base = (
+        "tensor_query_serversrc id=w6 port=5097 max-clients=4 "
+        "retry-after-ms=25 ! "
+        "tensor_llm_serversink id=w6l model=zoo:transformer_lm "
+        "kv-layout=paged block-size=16 kv-blocks=64{extra}"
+    )
+    risky = lint(base.format(extra=""))
+    assert "NNS-W126" in risky.report.codes
+    # any of the three remedies silences it: a migration peer, a
+    # checkpoint dir, or a plane (which refuses migration by design —
+    # the drain story is the plane's, not this server's)
+    for fix in (
+        " migrate-to=127.0.0.1:7001",
+        " checkpoint-dir=/var/nns/spans",
+        " plane=lp0",
+    ):
+        ok = lint(base.format(extra=fix))
+        assert "NNS-W126" not in ok.report.codes, fix
+    # retry-after-ms left at its default → no drain contract tuned →
+    # quiet (the docs' plain serving example must not warn)
+    plain = lint(base.format(extra="").replace("retry-after-ms=25 ", ""))
+    assert "NNS-W126" not in plain.report.codes
+
+
 # -------------------------------------------------------------- nns-top
 def test_nns_top_fleet_view_renders_endpoints_and_readiness():
     """`nns-top --fleet` renders the client's per-endpoint health rows

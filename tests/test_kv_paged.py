@@ -374,9 +374,14 @@ def test_requests_view_and_nns_top_render(paged_cb):
         "serving_kv_blocks": 24,
         "serving_kv_prefix_hits": 3,
         "serving_kv_attn": "block",
+        "serving_kv_migrations_out": 2,
+        "serving_kv_migrations_in": 1,
+        "serving_request_resumes": 1,
     }}}
     out = render_requests(snap)
     assert str(rid) in out and "done" in out and "prefix-hits=3" in out
+    # migration & recovery footer (docs/llm-serving.md)
+    assert "migrations=2out/1in" in out and "resumes=1" in out
     # the footer names the active decode formulation (block-native by
     # default; gather would additionally show its dispatch count)
     assert "kv-attn=block" in out

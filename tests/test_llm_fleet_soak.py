@@ -1,0 +1,243 @@
+"""Slow fleet soak: zero-loss LLM serving under failure
+(docs/llm-serving.md "Migration & recovery", docs/edge-serving.md).
+
+Two LLM servers in one "fleet" — A drains and live-migrates its
+in-flight generations over the real CTRL wire handshake to B; a
+refused late request re-routes; B is then hard-killed mid-decode and
+its successor B2 adopts the span checkpoints; a corrupted span and a
+draining destination exercise the refusal paths. The ledger at the
+end: every submitted request reached a terminal outcome, every
+finished stream is bitwise identical to its uninterrupted run, and
+no completed prefill chunk was ever re-run.
+
+Failure matrix pinned here: drain (A), kill (B), refuse (draining
+destination), corrupt (CRC-flipped span NACKed, bystanders unharmed).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import ElementError
+from nnstreamer_tpu.models import decode as dec
+from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.tensors.frame import Frame
+
+pytestmark = pytest.mark.slow
+
+OPTS = {
+    "vocab": "211", "d_model": "32", "n_heads": "2", "n_layers": "1",
+    "seed": "5",
+}
+N_HEADS = 2
+
+_PARAMS = None
+
+
+def _mk(**kw):
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+
+    base = dict(
+        model="zoo:transformer_lm", options=dict(OPTS), n_slots=4,
+        max_len=64, prompt_len=16, default_new=10, kv_layout="paged",
+        block_size=16, kv_blocks=0,
+    )
+    base.update(kw)
+    return _LlmServer(**base)
+
+
+def _alone(prompt, n_new=10):
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = tfm.init_params(
+            jax.random.PRNGKey(5), vocab=211, d_model=32, n_heads=2,
+            n_layers=1,
+        )
+    toks = dec.generate(
+        _PARAMS, np.asarray(prompt, np.int32)[None, :], N_HEADS, n_new
+    )
+    return [int(t) for t in np.asarray(toks)[0]]
+
+
+def _prompt(seed, n=6):
+    return np.random.default_rng(seed).integers(1, 211, (n,)).astype(
+        np.int32
+    )
+
+
+def _pump_until(srv, cond, timeout=180.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        srv.pump()
+
+
+def _pop_by_req(srv, n, timeout=180.0):
+    """Pump until n outputs landed; return {meta['req']: (tokens, meta)}."""
+    _pump_until(
+        srv, lambda: len(srv._out) >= n, timeout=timeout,
+        what=f"{n} finished generations",
+    )
+    out = {}
+    for _ in range(n):
+        toks, meta = srv.pop()
+        out[meta["req"]] = ([int(t) for t in toks], meta)
+    return out
+
+
+def test_fleet_migrate_kill_restart_soak(tmp_path):
+    from nnstreamer_tpu.edge import query as q
+    from nnstreamer_tpu.elements import llm_serve
+    from nnstreamer_tpu.kv.migrate import encode_span
+
+    ckpt = str(tmp_path / "spans")
+    prompts = {f"m{i}": _prompt(20 + i) for i in range(3)}
+    prompts["late"] = _prompt(30)
+    prompts["k0"] = _prompt(40)
+    prompts["k1"] = _prompt(41)
+    expect = {k: _alone(p) for k, p in prompts.items()}
+    done = {}
+
+    # B's edge endpoint: a real query serversrc answering the
+    # migrate_probe/migrate_span CTRL messages for whichever LLM
+    # server is registered under id 2 (B now, B2 after the restart)
+    src_b = q.TensorQueryServerSrc("soak-src-b", port=0, id="soak-b")
+    src_b.start()
+    stop = threading.Event()
+    pump_thread = threading.Thread(
+        target=lambda: [src_b.generate() for _ in iter(stop.is_set, True)],
+        daemon=True,
+    )
+    pump_thread.start()
+
+    srv_b = _mk(
+        srv_id="2", checkpoint_every_tokens=2, checkpoint_dir=ckpt,
+    )
+    srv_a = _mk(
+        srv_id="1",
+        migrate_to=f"127.0.0.1:{src_b.bound_port}/2",
+    )
+    srv_b2 = None
+    with llm_serve._table_lock:
+        llm_serve._table["soak-1"] = srv_a
+    try:
+        # -- phase 1: drain A, live-migrate 3 mid-decode requests ------
+        for k in ("m0", "m1", "m2"):
+            srv_a.submit(Frame((prompts[k],), meta={
+                "req": k, "frame_id": f"f-{k}", "client_id": 7,
+            }))
+        rids_a = list(srv_a._pending)
+        assert len(rids_a) == 3
+        _pump_until(
+            srv_a,
+            lambda: all(
+                len(srv_a.cb.partials(rids_a).get(r) or ()) >= 3
+                for r in rids_a
+            ),
+            what="3 decoded tokens on every A request",
+        )
+        summary = llm_serve.drain_server("soak-1")  # operator surface
+        assert summary["migrated"] == 3, summary
+        assert summary["resumed"] == 0 and summary["kept"] == 0
+        assert srv_a.draining
+        # A's ledger: migrated is a terminal state, nothing lingers
+        states = {r: srv_a.cb.requests()[r]["state"] for r in rids_a}
+        assert set(states.values()) == {"migrated"}, states
+        assert srv_a.cb.stats().get("kv_migrations_out") == 3
+        # B adopted straight into decode — zero prefill re-run
+        assert (srv_b.cb.stats().get("kv_prefill_queue") or 0) == 0
+        assert srv_b.cb.stats().get("kv_migrations_in") == 3
+
+        # a late request hits the draining server, is refused with the
+        # typed terminal error, and re-routes to the healthy peer (the
+        # edge path NACKs `draining` + retry-after — tests/test_fleet.py)
+        with pytest.raises(ElementError, match="draining"):
+            srv_a.submit(Frame((prompts["late"],), meta={"req": "late"}))
+        srv_b.submit(Frame((prompts["late"],), meta={
+            "req": "late", "frame_id": "f-late",
+        }))
+        done.update(_pop_by_req(srv_b, 4))
+        for k in ("m0", "m1", "m2"):
+            toks, meta = done[k]
+            assert toks == expect[k], f"{k}: migrated stream diverged"
+            assert meta["frame_id"] == f"f-{k}"
+            assert "client_id" not in meta  # hop-local, stripped at span
+        assert done["late"][0] == expect["late"]
+
+        # -- phase 2: hard-kill B mid-decode, restart over checkpoints -
+        for k in ("k0", "k1"):
+            srv_b.submit(Frame((prompts[k],), meta={
+                "req": k, "frame_id": f"f-{k}",
+            }))
+        rids_b = list(srv_b._pending)
+        assert len(rids_b) == 2
+        _pump_until(
+            srv_b,
+            lambda: all(
+                len(srv_b.cb.partials(rids_b).get(r) or ()) >= 5
+                for r in rids_b
+            ),
+            what="5 decoded tokens on every B request",
+        )
+        # hard kill: NO drain, NO extraction — only the atomic span
+        # checkpoints survive the "process"
+        srv_b.release_plane()
+        files = sorted((tmp_path / "spans").glob("req-*.span"))
+        assert len(files) == 2, (
+            "expected exactly the 2 in-flight checkpoints (finished "
+            f"requests reap theirs): {[f.name for f in files]}"
+        )
+        srv_b2 = _mk(
+            srv_id="2", checkpoint_every_tokens=2, checkpoint_dir=ckpt,
+        )
+        assert len(srv_b2._pending) == 2, "restart did not adopt both"
+        # adopted spans land in the arena directly — no prefill re-run
+        assert (srv_b2.cb.stats().get("kv_prefill_queue") or 0) == 0
+        assert srv_b2.cb.stats().get("kv_migrations_in") == 2
+
+        # chaos: a CRC-flipped span arrives over the wire mid-decode —
+        # NACKed as corrupt, and the live generations are unharmed
+        rid = next(iter(srv_b2._pending))
+        span = srv_b2.cb.extract_request(rid, remove=False)
+        wire = bytearray(encode_span(span))
+        wire[-1] ^= 0xFF
+        with pytest.raises(q.MigrationRefused, match="SpanCorruptError"):
+            q.send_migration(
+                "127.0.0.1", src_b.bound_port, bytes(wire), llm_id=2
+            )
+
+        done.update(_pop_by_req(srv_b2, 2))
+        for k in ("k0", "k1"):
+            toks, meta = done[k]
+            assert toks == expect[k], f"{k}: resumed stream diverged"
+            assert meta["frame_id"] == f"f-{k}"
+        # finished: checkpoints reaped, no ghost on a further restart
+        assert not sorted((tmp_path / "spans").glob("req-*.span"))
+
+        # chaos: a draining destination refuses spans outright — the
+        # endpoint is leaving, nothing must land on it
+        src_b.drain()
+        with pytest.raises(q.MigrationRefused, match="draining"):
+            q.probe_migration(
+                "127.0.0.1", src_b.bound_port, [1, 2, 3], llm_id=2
+            )
+    finally:
+        # release_plane is idempotent — safe for every exit path
+        srv_a.release_plane()
+        srv_b.release_plane()
+        if srv_b2 is not None:
+            srv_b2.release_plane()
+        with llm_serve._table_lock:
+            llm_serve._table.pop("soak-1", None)
+        stop.set()
+        pump_thread.join(timeout=2)
+        src_b.stop()
+
+    # the ledger: 6 submitted (3 migrated, 1 refused-then-rerouted,
+    # 2 killed-then-resumed), 6 terminal, all bitwise == solo runs
+    assert sorted(done) == sorted(prompts)
+    for k in prompts:
+        assert done[k][0] == expect[k]

@@ -1,0 +1,273 @@
+"""Element-layer migration & crash recovery (elements/llm_serve.py,
+docs/llm-serving.md "Migration & recovery"): the serversink props
+(migrate-to / checkpoint-every-tokens / checkpoint-dir), the drain
+contract (NACK ``draining``, settle prefills, migrate-or-resume), the
+CTRL handshake through a real query serversrc, and checkpoint/restart
+resume that re-runs no completed prefill work.
+
+Runtime note (same floor as tests/test_kv_migrate.py): every
+_LlmServer builds its own ContinuousBatcher — ~2.3s params init +
+~2.2s pump-program compile each on CPU. The checkpoint/restart test
+NEEDS two servers (the second construction IS the restart under
+test), so this file cannot go below two batcher builds; everything
+else shares servers or runs model-free.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.base import ElementError
+from nnstreamer_tpu.models import decode as dec
+from nnstreamer_tpu.models import transformer as tfm
+from nnstreamer_tpu.tensors.frame import Frame
+
+OPTS = {
+    "vocab": "211", "d_model": "32", "n_heads": "2", "n_layers": "1",
+    "seed": "5",
+}
+N_HEADS = 2
+
+
+def _mk(**kw):
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+
+    base = dict(
+        model="zoo:transformer_lm", options=dict(OPTS), n_slots=2,
+        max_len=64, prompt_len=16, default_new=10, kv_layout="paged",
+        block_size=16, kv_blocks=0,
+    )
+    base.update(kw)
+    return _LlmServer(**base)
+
+
+def _alone(prompt, n_new):
+    params = tfm.init_params(
+        jax.random.PRNGKey(5), vocab=211, d_model=32, n_heads=2,
+        n_layers=1,
+    )
+    toks = dec.generate(
+        params, np.asarray(prompt, np.int32)[None, :], N_HEADS, n_new
+    )
+    return [int(t) for t in np.asarray(toks)[0]]
+
+
+def _pump_until(srv, cond, timeout=120.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        srv.pump()
+
+
+def _prompt(seed, n=6):
+    return np.random.default_rng(seed).integers(1, 211, (n,)).astype(
+        np.int32
+    )
+
+
+# -- prop validation / typed plane refusal (model-free: both raise
+#    before any batcher or plane is built) ------------------------------
+
+
+def test_migration_props_need_paged_layout():
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+
+    for bad in (
+        dict(migrate_to="peer:7000"),
+        dict(checkpoint_dir="/tmp/nowhere"),
+        dict(checkpoint_every_tokens=4),
+    ):
+        with pytest.raises(ElementError, match="kv-layout=paged"):
+            _LlmServer(
+                model="zoo:transformer_lm", options={}, n_slots=1,
+                max_len=32, prompt_len=8, default_new=4,
+                kv_layout="slot", **bad,
+            )
+
+
+def test_plane_refuses_migration_surface_typed():
+    """Plane-shared batchers refuse migration/checkpointing with the
+    plane's own typed error — at element construction (before a plane
+    ref is even acquired) and on the LlmPlane surface itself."""
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+    from nnstreamer_tpu.serving_plane.llm import LlmPlane, LlmPlaneError
+
+    for bad in (
+        dict(migrate_to="peer:7000"),
+        dict(checkpoint_dir="/tmp/nowhere"),
+        dict(checkpoint_every_tokens=2),
+    ):
+        with pytest.raises(LlmPlaneError, match="refused"):
+            _LlmServer(
+                model="zoo:transformer_lm", options={}, n_slots=1,
+                max_len=32, prompt_len=8, default_new=4,
+                kv_layout="paged", plane="mig-pl", **bad,
+            )
+    pl = LlmPlane("mig-pl0", cb=None)
+    with pytest.raises(LlmPlaneError, match="private kv-layout=paged"):
+        pl.refuse_migration("migrate_span")
+
+
+# -- the CTRL handshake over a real query serversrc (model-free) --------
+
+
+class _Handler:
+    """A fake LLM server: records what the handshake delivers."""
+
+    def __init__(self):
+        self.probed, self.adopted = [], []
+        self.refuse = False
+
+    def migration_probe(self, tokens):
+        self.probed.append([int(t) for t in tokens])
+        return 32
+
+    def migration_adopt(self, span_bytes):
+        if self.refuse:
+            from nnstreamer_tpu.kv.migrate import SpanStateError
+
+            raise SpanStateError("draining")
+        self.adopted.append(bytes(span_bytes))
+        return 77
+
+
+def test_migration_ctrl_handshake_over_wire():
+    from nnstreamer_tpu.edge import query as q
+
+    h = _Handler()
+    q.register_migration_handler(9, h)
+    src = q.TensorQueryServerSrc("mig-wire-src", port=0, id="mig-w1")
+    src.start()
+    stop = threading.Event()
+
+    def _pump():
+        while not stop.is_set():
+            src.generate()
+
+    t = threading.Thread(target=_pump, daemon=True)
+    t.start()
+    try:
+        assert q.probe_migration(
+            "127.0.0.1", src.bound_port, [1, 2, 3], llm_id=9
+        ) == 32
+        assert h.probed[-1] == [1, 2, 3]
+        assert q.send_migration(
+            "127.0.0.1", src.bound_port, b"span-bytes", llm_id=9
+        ) == 77
+        assert h.adopted == [b"span-bytes"]
+        # singleton fallback: a mismatched llm_id still reaches the
+        # process's only handler (migrate-to never guesses peer ids)
+        assert q.probe_migration(
+            "127.0.0.1", src.bound_port, [5], llm_id=123
+        ) == 32
+        # a refusing handler surfaces as MigrationRefused, reason
+        # carrying the span-taxonomy type — the sender's fallback cue
+        h.refuse = True
+        with pytest.raises(q.MigrationRefused, match="SpanStateError"):
+            q.send_migration(
+                "127.0.0.1", src.bound_port, b"x", llm_id=9
+            )
+        q.unregister_migration_handler(9)
+        with pytest.raises(
+            q.MigrationRefused, match="no-migration-handler"
+        ):
+            q.probe_migration("127.0.0.1", src.bound_port, [1], llm_id=9)
+        # a DRAINING serversrc refuses before consulting any handler:
+        # spans must not land on an endpoint that is itself leaving
+        q.register_migration_handler(9, h)
+        src.drain()
+        with pytest.raises(q.MigrationRefused, match="draining"):
+            q.probe_migration("127.0.0.1", src.bound_port, [1], llm_id=9)
+    finally:
+        q.unregister_migration_handler(9)
+        stop.set()
+        t.join(timeout=2)
+        src.stop()
+
+
+# -- drain: NACK + resume fallback, finish in place ---------------------
+
+
+def test_drain_resume_fallback_and_draining_refusal():
+    """drain(migrate_to=<unreachable>) falls back to local re-prefill
+    resume — generated tokens survive, and the finished stream is
+    bitwise identical to the uninterrupted run. While draining, new
+    submits are refused with the typed ``draining`` error (the edge
+    path NACKs with retry-after instead — test_fleet soak)."""
+    srv = _mk(srv_id="mig-d1")
+    try:
+        prompt = _prompt(3)
+        srv.submit(Frame((prompt,), meta={"req": "d1", "frame_id": "f-d1"}))
+        rid = next(iter(srv._pending))
+        _pump_until(
+            srv,
+            lambda: len(srv.cb.partials([rid]).get(rid) or ()) >= 3,
+            what="3 decoded tokens",
+        )
+        # port 1: nothing listens — connection refused, instantly
+        summary = srv.drain(migrate_to="127.0.0.1:1")
+        assert summary["resumed"] == 1 and summary["migrated"] == 0
+        assert srv.draining
+        with pytest.raises(ElementError, match="draining"):
+            srv.submit(Frame((prompt,), meta={}))
+        # a second drain with no peer keeps the resumed request local
+        assert srv.drain()["kept"] == 1
+        _pump_until(srv, lambda: srv._out, what="drained generation")
+        toks, meta = srv.pop()
+        assert meta["req"] == "d1" and meta["frame_id"] == "f-d1"
+        assert [int(t) for t in toks] == _alone(prompt, 10)
+    finally:
+        srv.release_plane()
+
+
+# -- checkpoint / hard-kill / restart resume ----------------------------
+
+
+def test_checkpoint_crash_restart_resumes_bitwise(tmp_path):
+    """Periodic atomic span checkpoints: a server that vanishes without
+    drain (hard kill) is replaced by a fresh one pointing at the same
+    checkpoint-dir, which ADOPTS the in-flight generation — no prefill
+    re-run (the landed KV re-enters the arena directly) — and finishes
+    it bitwise identical to the uninterrupted run, hop-local meta
+    stripped and identity meta intact."""
+    ckpt = str(tmp_path / "spans")
+    prompt = _prompt(11)
+    srv1 = _mk(
+        srv_id="ck1", checkpoint_every_tokens=2, checkpoint_dir=ckpt,
+    )
+    try:
+        srv1.submit(Frame((prompt,), meta={
+            "req": "c1", "frame_id": "f-c1", "client_id": 42,
+        }))
+        rid = next(iter(srv1._pending))
+        _pump_until(
+            srv1,
+            lambda: len(srv1.cb.partials([rid]).get(rid) or ()) >= 5,
+            what="5 decoded tokens",
+        )
+        files = sorted((tmp_path / "spans").glob("req-*.span"))
+        assert files, "no checkpoint written by the cadence tick"
+    finally:
+        # hard kill: NO drain, no extraction — the process is simply
+        # gone; only the checkpoint files survive
+        srv1.release_plane()
+    srv2 = _mk(
+        srv_id="ck2", checkpoint_every_tokens=2, checkpoint_dir=ckpt,
+    )
+    try:
+        assert srv2._pending, "restart did not adopt the checkpoint"
+        # adopted straight into decode: nothing queued for prefill
+        assert (srv2.cb.stats().get("kv_prefill_queue") or 0) == 0
+        _pump_until(srv2, lambda: srv2._out, what="resumed generation")
+        toks, meta = srv2.pop()
+        assert meta["req"] == "c1" and meta["frame_id"] == "f-c1"
+        assert "client_id" not in meta  # hop-local: never crosses hosts
+        assert [int(t) for t in toks] == _alone(prompt, 10)
+        # finished: its checkpoint file is reaped (no ghost on restart)
+        assert not sorted((tmp_path / "spans").glob("req-*.span"))
+        assert srv2.cb.stats().get("kv_migrations_in", 0) >= 1
+    finally:
+        srv2.release_plane()
