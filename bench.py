@@ -1109,6 +1109,14 @@ def _record_measured(line: str) -> None:
                 keep_prev = (new_partial and not prev_partial) or lower_value
                 if keep_prev:
                     prev["last_run"] = last_run
+                    # evidence-stamp backfill (nns-kscope discipline):
+                    # records kept from before the platform/device/host
+                    # stamps existed gain them from the fresh capture
+                    # (same process, same backend) without losing their
+                    # better headline
+                    for stamp in ("platform", "device", "host"):
+                        if not prev.get(stamp) and data.get(stamp):
+                            prev[stamp] = data[stamp]
                     if lower_value:
                         # counts only genuinely-lower same-kind captures —
                         # a partial discarded against a full record is
@@ -1600,6 +1608,44 @@ GATED_CELLS = (
     ("plane_async_frac", _plane_async_frac_cell),
 )
 
+# cells whose headline is pallas-labelled: on a TPU capture their
+# dispatch-tally evidence (--capture-tpu `cells.<key>.dispatch`) should
+# show these ops engaging the pallas path. --gate WARNS on stderr (never
+# fails — the number is still a real measurement) when the reference
+# evidence shows only the fallback engaged: the cell measured the jnp
+# path while its label claims the kernel.
+PALLAS_CELLS = {
+    "composite_face_fps": ("crop_and_resize",),
+}
+
+
+def _pallas_tally_warnings(ref: dict) -> list:
+    """Warnings for pallas-labelled cells whose TPU evidence record
+    shows the fallback engaged instead of the kernel. Pure — reads only
+    the record (tests feed synthetic ones)."""
+    out = []
+    if str(ref.get("platform")) != "tpu":
+        return out  # CPU references legitimately run the jnp path
+    cells = ref.get("cells") or {}
+    for key, ops in PALLAS_CELLS.items():
+        disp = (cells.get(key) or {}).get("dispatch") or {}
+        if not disp:
+            continue  # pre-capture-tpu reference: no evidence either way
+        for op in ops:
+            pallas_n = disp.get(f"{op}:pallas", 0)
+            other = {
+                k: n for k, n in disp.items()
+                if k.startswith(f"{op}:") and not k.endswith(":pallas")
+            }
+            if other and not pallas_n:
+                out.append(
+                    f"[gate] {key}: TPU evidence shows {op} dispatched "
+                    f"only the fallback ({other}) — the pallas-labelled "
+                    "cell measured the jnp path (nns-kscope --engage "
+                    "diagnoses why)"
+                )
+    return out
+
 
 def _gate_reference(argv) -> tuple[str, dict] | tuple[None, None]:
     """Resolve the reference record: an explicit path after --gate, or
@@ -1658,6 +1704,8 @@ def _gate() -> int:
         ref.get("host") == _platform.node()
         or os.environ.get("BENCH_GATE_FORCE") == "1"
     )
+    for w in _pallas_tally_warnings(ref):
+        print(w, file=sys.stderr)
     try:
         chain, branched, chain_prog, chain_pernode, spreads = (
             _executor_ceilings()
@@ -1835,6 +1883,61 @@ def _capture_measured() -> int:
         f.write("\n")
     print(json.dumps(rec, indent=1))
     return 0
+
+
+def _capture_tpu() -> int:
+    """``--capture-tpu <out.json>``: TPU-evidence capture (nns-kscope
+    discipline, docs/kernel-analysis.md). The record carries the
+    platform/device fingerprint, every gated cell measured with a
+    dispatch-tally diff beside its value (which implementation each
+    dual-path op engaged WHILE the cell ran — the per-cell proof the
+    pallas label claims), and the kernel engage rows (tiny probes with
+    pallas explicitly requested). Exit 1 when any requested pallas path
+    fell back. Never run concurrently with a tier-1 measurement."""
+    import jax
+
+    from nnstreamer_tpu.ops import dispatch
+
+    tail = sys.argv[sys.argv.index("--capture-tpu") + 1:][:1]
+    if not tail or tail[0].startswith("-"):
+        print("usage: bench.py --capture-tpu <out.json>", file=sys.stderr)
+        return 2
+    path = os.path.abspath(tail[0])
+    dev = jax.devices()[0]
+    rec = {
+        "metric": "bench_tpu_evidence_capture",
+        "host": _platform.node(),
+        "platform": dev.platform,
+        "device": str(dev.device_kind),
+        "n_devices": jax.device_count(),
+        "int8_impl": "int8w",
+        "cells": {},
+    }
+    _mark("capture-tpu start")
+    for key, cell in GATED_CELLS:
+        snap = dispatch.tally.snapshot()
+        entry = {"value": None, "dispatch": {}}
+        try:
+            entry["value"] = _round(cell(), 4)
+        except Exception as exc:  # noqa: BLE001 — capture what measures
+            print(f"[capture-tpu] {key} failed: {exc!r}", file=sys.stderr)
+            entry["error"] = repr(exc)
+        now = dispatch.tally.snapshot()
+        for (op, impl), n in sorted(now.items()):
+            fresh_n = n - snap.get((op, impl), 0)
+            if fresh_n > 0:
+                entry["dispatch"][f"{op}:{impl}"] = fresh_n
+        rec["cells"][key] = entry
+        _mark(key)
+    from nnstreamer_tpu.analysis.kernels import engage
+
+    rec["kernels"] = engage()
+    _mark("kernel engage probes")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+    return 0 if all(r["ok"] for r in rec["kernels"]) else 1
 
 
 def _pipeline_batched(smoke: bool) -> None:
@@ -2648,6 +2751,8 @@ def main() -> None:
         return _gate()
     if "--capture-measured" in sys.argv:
         return _capture_measured()
+    if "--capture-tpu" in sys.argv:
+        return _capture_tpu()
     if "--pipeline" in sys.argv:
         mode = sys.argv[sys.argv.index("--pipeline") + 1 :][:1]
         if mode == ["batched"]:

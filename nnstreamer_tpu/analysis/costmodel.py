@@ -419,6 +419,60 @@ def chain_cost(chain, open_backends: bool = True) -> ChainCost:
     return cost
 
 
+# -- per-kernel roofline cost model (nns-kscope) ----------------------------
+
+#: VMEM per TensorCore on every shipping TPU generation to date; the
+#: default for :func:`configured_vmem_bound` when ``[tpu] vmem_bytes``
+#: is unset.
+DEFAULT_VMEM_BYTES = 16 << 20
+
+
+@dataclass
+class KernelCost:
+    """Static roofline row for one registered Pallas kernel × shape
+    (docs/kernel-analysis.md "Roofline columns"): HBM bytes moved (the
+    index-map transition count over the grid — what the pallas pipeline
+    actually re-fetches — not the naive operand-size sum), FLOPs from
+    the kernel's registered estimate, and their ratio. Abstract
+    arithmetic over the registered LaunchPlan; nothing is allocated."""
+
+    hbm_read_bytes: int = 0
+    hbm_write_bytes: int = 0
+    flops: int = 0
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.hbm_read_bytes + self.hbm_write_bytes
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """FLOPs per HBM byte — the roofline x-axis. Kernels below a
+        TPU's ridge point (~100s of flops/byte) are memory-bound: more
+        VMEM blocking won't help, less HBM traffic will."""
+        return self.flops / self.hbm_bytes if self.hbm_bytes else 0.0
+
+
+def configured_vmem_bound() -> int:
+    """The per-core VMEM budget the W127 kernel lint checks per-grid-
+    step residency against: ``[tpu] vmem_bytes`` (bytes, K/M/G
+    suffixes), defaulting to 16 MiB — unlike the HBM bound, a VMEM
+    ceiling always exists in hardware, so the lint never stays silent
+    for want of configuration."""
+    from nnstreamer_tpu.config import conf
+
+    raw = conf().get("tpu", "vmem_bytes", "")
+    if not raw:
+        return DEFAULT_VMEM_BYTES
+    try:
+        return parse_bytes(raw)
+    except ValueError:
+        _log.warning(
+            "[tpu] vmem_bytes=%r is not a byte size; using the %d MiB "
+            "default", raw, DEFAULT_VMEM_BYTES >> 20,
+        )
+        return DEFAULT_VMEM_BYTES
+
+
 def configured_device_bound() -> Optional[int]:
     """The per-device HBM bound the placement planner and the W124
     chain lint share: ``[plane] memory_per_device`` (bytes, K/M/G

@@ -246,6 +246,34 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "tokens, so re-routed requests pay a full re-prefill from "
         "token zero on the next endpoint",
     ),
+    # -- nns-kscope kernel analysis (analysis/kernels.py, ------------------
+    # docs/kernel-analysis.md)
+    "NNS-W127": (
+        Severity.WARNING, "kernel-vmem-over-budget",
+        "a Pallas kernel's per-grid-step VMEM residency (operand/result "
+        "blocks, double-buffered where their index map varies over the "
+        "grid, plus scratch) exceeds the configured per-core VMEM bound "
+        "([tpu] vmem_bytes, default 16 MiB): the launch OOMs or spills "
+        "on a real chip even though the HBM arrays fit",
+    ),
+    "NNS-W128": (
+        Severity.WARNING, "misaligned-tile",
+        "a Pallas block is misaligned or its index map is hazardous: a "
+        "block dim that is neither the whole axis nor a multiple of the "
+        "hardware tile (lane 128; sublane 8/16/32 for 4/2/1-byte "
+        "dtypes) pads every DMA and register, and an index map that "
+        "picks blocks outside the block grid (or a scalar-prefetch "
+        "operand whose values drift from its declared SMEM shape) reads "
+        "garbage",
+    ),
+    "NNS-W129": (
+        Severity.WARNING, "pipeline-requests-pallas-but-dispatches-jnp",
+        "an element explicitly requests a Pallas implementation "
+        "(impl=pallas / attn-impl=pallas) that would silently dispatch "
+        "the jnp/xla fallback: the input dtype is outside the kernel's "
+        "registered support, the NNS_TPU_PALLAS_DISABLE kill switch is "
+        "set, or the configured mode has no kernel at all",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

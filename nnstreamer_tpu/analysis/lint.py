@@ -1292,6 +1292,12 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     specs: Dict[str, List[Any]] = {}
     if not cyclic:
         specs = _spec_pass(pipeline, report, placeholders, skip)
+        # NNS-W129 (nns-kscope): an explicit impl=pallas request the
+        # kernel registry says would degrade to the jnp/xla path —
+        # needs the negotiated specs for the input dtypes
+        from nnstreamer_tpu.analysis.kernels import pallas_request_pass
+
+        pallas_request_pass(pipeline, report, specs)
     return LintResult(report, pipeline, specs)
 
 

@@ -80,6 +80,7 @@ def _emitted_codes() -> Set[str]:
     for name in (
         # importlib (not `import a.b as m`): analysis.__init__ re-binds
         # `lint` to the function, and the as-import would grab that
+        "nnstreamer_tpu.analysis.kernels",
         "nnstreamer_tpu.analysis.lint",
         "nnstreamer_tpu.analysis.racecheck",
         "nnstreamer_tpu.analysis.xray",
@@ -241,6 +242,93 @@ def xray_self_check() -> List[str]:
                         f"docs/chain-analysis.md mentions unknown code "
                         f"{code}"
                     )
+    return problems
+
+
+# -- nns-kscope self-check: kernel codes + registry wired both ways ---------
+
+_KSCOPE_CODES = ("NNS-W127", "NNS-W128", "NNS-W129")
+
+
+def kscope_self_check() -> List[str]:
+    """Validate the kernel-analysis wiring both ways: every W127-W129
+    code is in the catalog, has an emitter in analysis/kernels.py, and
+    is documented in docs/kernel-analysis.md AND docs/linting.md;
+    every NNS code docs/kernel-analysis.md mentions exists in the
+    catalog; every public kernel entry point in ops/pallas has a
+    registered KernelSpec of the same name (and vice versa); and the
+    union of registered dispatch ops equals ops/dispatch.KNOWN_OPS (a
+    dispatch site cannot appear without --engage coverage)."""
+    import importlib
+    import os
+
+    from nnstreamer_tpu.analysis.diagnostics import CATALOG
+
+    problems: List[str] = []
+    mod = importlib.import_module("nnstreamer_tpu.analysis.kernels")
+    emitted = set(_CODE_REF.findall(inspect.getsource(mod)))
+    for code in _KSCOPE_CODES:
+        if code not in CATALOG:
+            problems.append(f"kernel code {code} missing from the catalog")
+        if code not in emitted:
+            problems.append(
+                f"kernel code {code} has no emitter in analysis/kernels.py"
+            )
+    for doc_name in ("kernel-analysis.md", "linting.md"):
+        doc = os.path.join(_repo_root(), "docs", doc_name)
+        if not os.path.isfile(doc):  # repo checkouts only
+            continue
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        for code in _KSCOPE_CODES:
+            if code not in text:
+                problems.append(
+                    f"{code} is not documented in docs/{doc_name}"
+                )
+        if doc_name == "kernel-analysis.md":
+            for code in sorted(set(_CODE_REF.findall(text))):
+                if code not in CATALOG:
+                    problems.append(
+                        f"docs/kernel-analysis.md mentions unknown code "
+                        f"{code}"
+                    )
+    # registry completeness: public kernel entry points <-> KernelSpecs
+    import nnstreamer_tpu.ops.pallas as pallas_pkg
+    from nnstreamer_tpu.ops import dispatch
+    from nnstreamer_tpu.ops.pallas import registry as kreg
+
+    public = {
+        name for name, obj in vars(pallas_pkg).items()
+        # callable, not isfunction: the entry points are jax.jit-wrapped
+        if not name.startswith("_") and callable(obj)
+        and not inspect.ismodule(obj)
+        and getattr(obj, "__module__", "").startswith(
+            "nnstreamer_tpu.ops.pallas.")
+        and not getattr(obj, "__name__", "").endswith("_ref")
+    }
+    registered = set(kreg.names())
+    for name in sorted(public - registered):
+        problems.append(
+            f"ops/pallas exports kernel {name!r} with no registered "
+            "KernelSpec (nns-kscope cannot analyze it)"
+        )
+    for name in sorted(registered - public):
+        problems.append(
+            f"KernelSpec {name!r} is registered but ops/pallas exports "
+            "no kernel of that name"
+        )
+    covered = set()
+    for spec in kreg.all_specs():
+        covered |= set(spec.ops)
+    for op in sorted(set(dispatch.KNOWN_OPS) - covered):
+        problems.append(
+            f"dispatch op {op!r} is in KNOWN_OPS but no KernelSpec "
+            "covers it (--engage cannot prove it)"
+        )
+    for op in sorted(covered - set(dispatch.KNOWN_OPS)):
+        problems.append(
+            f"KernelSpec op {op!r} is not in ops/dispatch.KNOWN_OPS"
+        )
     return problems
 
 

@@ -136,7 +136,7 @@ def _build_batcher(model: str, options: Dict[str, str], n_slots: int,
                    max_len: int, prompt_len: int, speculate: int,
                    speculate_model: str, kv_layout: str, block_size: int,
                    kv_blocks: int, cache_dtype: str, prefill_chunks: int,
-                   kv_attn: str):
+                   kv_attn: str, attn_impl: str = "xla"):
     """Open the zoo model (+ optional draft) and build the
     ContinuousBatcher — shared by the private-server path and the
     LlmPlane opener (serving_plane/llm.py), so through-plane serving
@@ -189,6 +189,7 @@ def _build_batcher(model: str, options: Dict[str, str], n_slots: int,
     return ContinuousBatcher(
         m.params, n_heads, n_slots=n_slots, max_len=max_len,
         prompt_len=prompt_len, cache_dtype=cache_dtype,
+        attn_impl=attn_impl or "xla",
         **kv_kw, **draft_kw,
     )
 
@@ -203,6 +204,7 @@ class _LlmServer:
                  kv_layout: str = "slot", block_size: int = 16,
                  kv_blocks: int = 0, cache_dtype: str = "auto",
                  prefill_chunks: int = 1, kv_attn: str = "auto",
+                 attn_impl: str = "xla",
                  plane: str = "", plane_weight: float = 1.0,
                  srv_id: str = "0", migrate_to: str = "",
                  checkpoint_every_tokens: int = 0,
@@ -265,6 +267,7 @@ class _LlmServer:
                 model, tuple(sorted(options.items())), n_slots, max_len,
                 prompt_len, kv_layout, block_size, kv_blocks,
                 cache_dtype, prefill_chunks, kv_attn or "auto",
+                attn_impl or "xla",
                 max(1, int(pump_tokens)),
             )
             self._plane = llm_plane.acquire(
@@ -273,6 +276,7 @@ class _LlmServer:
                     model, options, n_slots, max_len, prompt_len,
                     speculate, speculate_model, kv_layout, block_size,
                     kv_blocks, cache_dtype, prefill_chunks, kv_attn,
+                    attn_impl,
                 ),
                 pump_tokens=pump_tokens,
             )
@@ -290,7 +294,7 @@ class _LlmServer:
             self.cb = _build_batcher(
                 model, options, n_slots, max_len, prompt_len, speculate,
                 speculate_model, kv_layout, block_size, kv_blocks,
-                cache_dtype, prefill_chunks, kv_attn,
+                cache_dtype, prefill_chunks, kv_attn, attn_impl,
             )
         self.default_new = default_new
         self._lock = threading.Lock()
@@ -903,6 +907,12 @@ class LlmServerSink(Sink):
         "block-size": PropSpec("int", 0, desc="tokens per KV block (paged)"),
         "kv-blocks": PropSpec("int", 0, desc="arena blocks (paged; 0=auto)"),
         "cache-dtype": PropSpec("str", "auto", desc="auto | int8"),
+        "attn-impl": PropSpec(
+            "str", "",
+            desc="decode attention kernel: xla | pallas ([llm] "
+            "attn_impl default; a pallas request the kernel registry "
+            "would degrade is flagged by nns-lint NNS-W129)",
+        ),
         "prefill-chunks": PropSpec(
             "int", 0, desc="prefill buckets per pump (paged; 0=[llm])"
         ),
@@ -1001,6 +1011,9 @@ class LlmServerSink(Sink):
             cache_dtype=str(self.get_property("cache-dtype", "auto")),
             prefill_chunks=prefill_chunks,
             kv_attn=kv_attn,
+            attn_impl=str(self.get_property("attn-impl", "")).strip() or (
+                cfg.get("llm", "attn_impl", "xla")
+            ),
             plane=str(self.get_property("plane", "") or ""),
             plane_weight=float(self.get_property("plane-weight", 1.0)),
             srv_id=self.srv_id,

@@ -80,6 +80,14 @@ class TensorTransform(TensorOp):
              "stand", "resize", "crop-resize"),
         ),
         "option": PropSpec("str", "", desc="per-mode option string"),
+        # image modes only (resize / crop-resize): which implementation
+        # the device op dispatches (ops/image.py). An explicit pallas
+        # request that would degrade (unsupported dtype, kill switch,
+        # non-image mode) is flagged by nns-lint NNS-W129.
+        "impl": PropSpec(
+            "enum", "auto", ("auto", "jnp", "pallas"),
+            desc="image-mode kernel dispatch: auto | jnp | pallas",
+        ),
         # per-frame error policy (pipeline/faults.py)
         **FAULT_PROPS,
     }
@@ -338,11 +346,12 @@ class TensorTransform(TensorOp):
 
         elif mode == "resize":
             out_h, out_w = self._parse_hw()
+            impl = str(self.get_property("impl", "auto"))
             from nnstreamer_tpu.ops.image import resize_bilinear
 
             def fn(tensors):
                 return tuple(
-                    resize_bilinear(jnp.asarray(t), out_h, out_w)
+                    resize_bilinear(jnp.asarray(t), out_h, out_w, impl=impl)
                     for t in tensors
                 )
 
@@ -357,6 +366,7 @@ class TensorTransform(TensorOp):
             bcols = box_spec.shape[-1]
             box_is_int = not box_spec.dtype.is_float
             np_dtype = img_spec.dtype.np_dtype
+            impl = str(self.get_property("impl", "auto"))
             from nnstreamer_tpu.ops.image import crop_regions
 
             def fn(tensors):
@@ -389,7 +399,7 @@ class TensorTransform(TensorOp):
                 # tensor_crop conventions (ops/image.crop_regions)
                 return (crop_regions(
                     jnp.asarray(img), xyxy, out_h, out_w,
-                    valid=valid, out_dtype=np_dtype,
+                    valid=valid, out_dtype=np_dtype, impl=impl,
                 ),)
 
         elif mode == "stand":

@@ -384,10 +384,16 @@ def block_attention(q, cache_k, cache_v, tables, pos, fresh_kv,
     if impl not in ("auto", "jnp", "pallas"):
         raise ValueError(f"block_attention impl {impl!r} not auto/jnp/pallas")
     from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+    from nnstreamer_tpu.ops.pallas._compat import pallas_ok
 
     use_pallas = impl == "pallas" or (
         impl == "auto" and jax.default_backend() == "tpu"
     )
+    if use_pallas:
+        # registry dtype gate: an unsupported arena dtype degrades to
+        # the jnp reference with a logged reason
+        payload = cache_k[0] if isinstance(cache_k, tuple) else cache_k
+        use_pallas, _ = pallas_ok("paged_decode_attention", payload.dtype)
     _record_dispatch("block_attention", "pallas" if use_pallas else "jnp")
     if use_pallas:
         from nnstreamer_tpu.ops.pallas.paged_attention import (

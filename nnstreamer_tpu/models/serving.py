@@ -1101,6 +1101,21 @@ class ContinuousBatcher:
         paged_attn_fn = None
         from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
 
+        if attn_impl == "pallas":
+            # registry dtype/env gate (_compat.pallas_ok): a request the
+            # kernels can't serve degrades to the XLA step with a logged
+            # reason instead of a trace-time error mid-construction
+            from nnstreamer_tpu.ops.pallas._compat import pallas_ok
+
+            kernel = (
+                "paged_decode_attention" if self._paged
+                else "decode_attention"
+            )
+            ok, _ = pallas_ok(
+                kernel, "int8" if quantized_cache else compute_dtype
+            )
+            if not ok:
+                attn_impl = "xla"
         _record_dispatch(
             "serving_attention",
             "pallas" if attn_impl == "pallas" else "xla",

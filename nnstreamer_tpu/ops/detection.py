@@ -96,10 +96,15 @@ def nms(
     if impl not in ("auto", "jnp", "pallas"):
         raise ValueError(f"nms impl {impl!r} not auto/jnp/pallas")
     from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+    from nnstreamer_tpu.ops.pallas._compat import pallas_ok
 
     use_pallas = impl == "pallas" or (
         impl == "auto" and jax.default_backend() == "tpu"
     )
+    if use_pallas:
+        # registry dtype gate: an unsupported score dtype degrades to
+        # the (bit-identical) jnp path with a logged reason
+        use_pallas, _ = pallas_ok("nms", scores.dtype)
     _record_dispatch("nms", "pallas" if use_pallas else "jnp")
     if use_pallas:
         from nnstreamer_tpu.ops.pallas.nms import nms as pallas_nms

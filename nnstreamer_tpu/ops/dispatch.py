@@ -48,6 +48,21 @@ class DispatchTally:
 
 tally = DispatchTally()
 
+#: every op name a dual-path dispatch site records — the closed set
+#: nns-kscope's registry↔tally agreement check (analysis/selfcheck.py
+#: kscope_self_check) and bench.py's --capture-tpu schema enumerate.
+#: Adding a dispatch site means adding its op here AND covering it from
+#: a registered KernelSpec's ``ops`` tuple (ops/pallas/registry.py).
+KNOWN_OPS = (
+    "block_attention",
+    "crop_and_resize",
+    "decode_attention",
+    "flash_attention",
+    "nms",
+    "resize_bilinear",
+    "serving_attention",
+)
+
 
 def record(op: str, impl: str) -> None:
     """One dispatch decision: ``op`` resolved to ``impl`` ("pallas" or

@@ -17,14 +17,17 @@ import jax.numpy as jnp
 from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
 
 
-def _use_pallas(impl: str, op: str = "") -> bool:
+def _use_pallas(impl: str, op: str = "", dtype=None) -> bool:
     """Implementation pick for the image ops: ``auto`` takes the Pallas
     kernel on a real TPU backend (MXU-blocked resampling,
     ops/pallas/image_kernels.py) and the jnp expression elsewhere (the
     interpreter would be a pessimization on the CPU hot path; interpret
-    mode stays a parity-test tool). A non-empty ``op`` records the
-    resolved choice in the dispatch tally (ops/dispatch.py) so
-    ``nns-xray --dispatch`` can prove which kernel engaged."""
+    mode stays a parity-test tool). A pallas pick is re-checked against
+    the kernel registry's dtype support (_compat.pallas_ok) — an
+    unsupported dtype degrades to jnp with a logged reason instead of a
+    trace-time error. A non-empty ``op`` records the resolved choice in
+    the dispatch tally (ops/dispatch.py) so ``nns-xray --dispatch`` can
+    prove which kernel engaged."""
     if impl == "pallas":
         use = True
     elif impl == "jnp":
@@ -33,6 +36,10 @@ def _use_pallas(impl: str, op: str = "") -> bool:
         raise ValueError(f"image op impl {impl!r} not auto/jnp/pallas")
     else:
         use = jax.default_backend() == "tpu"
+    if use:
+        from nnstreamer_tpu.ops.pallas._compat import pallas_ok
+
+        use, _ = pallas_ok(op or "image", dtype)
     if op:
         _record_dispatch(op, "pallas" if use else "jnp")
     return use
@@ -45,7 +52,7 @@ def crop_and_resize(image, boxes, out_h: int, out_w: int, impl: str = "auto"):
     coordinates (any float dtype; degenerate boxes clamp to edge pixels)
     → [N, out_h, out_w, C], image dtype.
     """
-    if _use_pallas(impl, op="crop_and_resize"):
+    if _use_pallas(impl, op="crop_and_resize", dtype=image.dtype):
         from nnstreamer_tpu.ops.pallas.image_kernels import (
             crop_and_resize as pallas_crop,
         )
@@ -119,7 +126,7 @@ def resize_bilinear(image, out_h: int, out_w: int, impl: str = "auto"):
     drift apart numerically."""
     squeeze = image.ndim == 3
     img = image[None] if squeeze else image
-    if _use_pallas(impl, op="resize_bilinear"):
+    if _use_pallas(impl, op="resize_bilinear", dtype=img.dtype):
         from nnstreamer_tpu.ops.pallas.image_kernels import (
             resize_bilinear as pallas_resize,
         )

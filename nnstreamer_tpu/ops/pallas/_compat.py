@@ -1,11 +1,52 @@
-"""jax-version compatibility shims for the Pallas kernels.
+"""jax-version compatibility shims and dispatch gating for the Pallas
+kernels.
 
 One home (the parallel layer's analogue is ``parallel/mesh.py
 shard_map``): the next upstream rename gets fixed once, not once per
-kernel module.
+kernel module — and every dual-path dispatch site asks the same
+:func:`pallas_ok` question before committing to a kernel.
 """
 
 from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Tuple
+
+_log = logging.getLogger("nnstreamer_tpu.ops.pallas")
+
+#: env escape hatch: force every dual-path op onto its jnp/XLA fallback
+#: (read directly, not through conf() — it must work before any config
+#: is loaded, e.g. for an --engage fallback drill)
+DISABLE_ENV = "NNS_TPU_PALLAS_DISABLE"
+
+
+def pallas_ok(kernel: str, dtype: Optional[Any] = None) -> Tuple[bool, str]:
+    """May ``kernel`` take the Pallas path for ``dtype`` inputs?
+
+    Returns ``(ok, reason)``; a False verdict is logged once per call
+    site decision so a degraded pipeline says WHY it fell back instead
+    of silently running jnp (or worse, raising a trace-time Mosaic
+    error on an unsupported dtype — the registry's per-kernel dtype
+    list is the support contract, satellite fix of PR 19).
+    """
+    if os.environ.get(DISABLE_ENV, "").strip() not in ("", "0"):
+        reason = f"{DISABLE_ENV} set: pallas disabled process-wide"
+        _log.warning("%s: %s — using jnp fallback", kernel, reason)
+        return False, reason
+    if dtype is not None:
+        from nnstreamer_tpu.ops.pallas import registry
+
+        if not registry.supports_dtype(kernel, dtype):
+            spec = registry.find(kernel)
+            supported = ", ".join(spec.dtypes) if spec else "?"
+            reason = (
+                f"dtype {str(dtype)} outside registered support"
+                f" ({supported})"
+            )
+            _log.warning("%s: %s — using jnp fallback", kernel, reason)
+            return False, reason
+    return True, ""
 
 
 def compiler_params(pltpu, **kw):

@@ -1,0 +1,80 @@
+"""Shared tiling/DMA idioms of the in-tree Pallas kernels.
+
+The three attention kernels (flash, decode, paged decode) are one
+online-softmax recurrence specialized to different cache layouts; until
+PR 19 each module carried its own copy of the init/update/finalize math.
+This module is the single home (the first piece of ROADMAP item 5's
+shared primitive layer): pure functions over values — the callers own
+their scratch refs and write-back, so the kernels keep their exact
+@pl.when predication structure.
+
+Numerics are the originals', bit-for-bit where it matters: f32
+accumulation via ``preferred_element_type``, the ``m <= NEG_INF``
+guards that keep fully-masked prefixes at weight exactly zero, and the
+``l > 0`` guard that zeroes rows nothing attended to.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def scaled_qk(q, k, scale):
+    """Scores block ``(q · kᵀ) * scale`` with f32 MXU accumulation.
+    q [m, d], k [n, d] → [m, n] float32."""
+    return jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+
+def dequant_rows(x, scales):
+    """Blockwise per-row dequant in VMEM: payload [n, d] × scales [n].
+    HBM traffic stays at the quantized byte count — the point of a
+    quantized cache."""
+    return x * scales[:, None]
+
+
+def mask_dead_columns(s, v, cols, live_len):
+    """Mask score columns at/past ``live_len`` to NEG_INF and zero the
+    matching V rows. Dead columns get softmax weight exp(NEG_INF - m)
+    = 0, but a pad/scratch block may hold arbitrary V bytes and
+    0 * NaN = NaN — zeroing keeps the weighted sum clean."""
+    s = jnp.where(cols < live_len, s, NEG_INF)
+    v = jnp.where(cols.reshape(-1, 1) < live_len, v, 0.0)
+    return s, v
+
+
+def online_softmax_init(m_ref, l_ref, acc_ref):
+    """First-k-step scratch init: running max at NEG_INF (identity of
+    max), denominator and accumulator at zero."""
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+
+def online_softmax_update(s, v, m_prev, l_prev, acc_prev):
+    """One block of the online-softmax recurrence.
+
+    s [m, n] f32 scores, v [n, d] f32 values; (m_prev [m], l_prev [m],
+    acc_prev [m, d]) the running (max, denominator, accumulator) →
+    the updated triple. The ``<= NEG_INF`` guards pin fully-masked
+    prefixes to weight exactly zero (exp(NEG_INF - NEG_INF) would be 1)."""
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+    m2 = m_new[:, None]
+    p = jnp.where(m2 <= NEG_INF, 0.0, jnp.exp(s - m2))
+    l_new = l_prev * alpha + jnp.sum(p, axis=1)
+    acc_new = acc_prev * alpha[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def online_softmax_finalize(l, acc, dtype):
+    """Normalize the accumulator by the denominator; rows nothing
+    attended to (l == 0) come out exactly zero instead of 0/0."""
+    l2 = l[:, None]
+    return jnp.where(l2 > 0, acc / jnp.maximum(l2, 1e-30), 0.0).astype(dtype)

@@ -13,9 +13,9 @@ The kernel indexes the serving cache layout [B, S, H, D] directly via
 BlockSpecs (grid (B, H, k-blocks), block (1, bk, 1, d)) — no transpose,
 no pad, no bias materialization on the host side; ``pos`` [B] rides in
 SMEM. k innermost with "arbitrary" semantics (sequential on TPU), the
-online-softmax scratch (m, l, acc) carried across k iterations — the same
-recurrence as ops/pallas/flash_attention.py specialized to one query row.
-Blocks entirely beyond a slot's fill level are predicated off with
+online-softmax scratch (m, l, acc) carried across k iterations — the
+shared recurrence of ops/pallas/_primitives.py specialized to one query
+row. Blocks entirely beyond a slot's fill level are predicated off with
 @pl.when.
 
 Int8 caches: pass ``k_scale``/``v_scale`` [B, S, KV] (per-token-per-head
@@ -34,9 +34,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas import registry as _registry
 from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
-
-NEG_INF = -1e30
+from nnstreamer_tpu.ops.pallas._primitives import (
+    NEG_INF,
+    dequant_rows,
+    mask_dead_columns,
+    online_softmax_finalize,
+    online_softmax_init,
+    online_softmax_update,
+    scaled_qk,
+)
 
 
 def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
@@ -51,9 +59,7 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
 
     @pl.when(ki == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        online_softmax_init(m_ref, l_ref, acc_ref)
 
     k_start = ki * block_k
     # positions 0..pos inclusive are attendable; a windowed ring passes
@@ -69,38 +75,18 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
         k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, d]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
         if quantized:
-            # per-row dequant in VMEM: int8 payload × f32 scale [bk]
-            k = k * ks_ref[0, :, 0][:, None]
-            v = v * vs_ref[0, :, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                  # [1, bk]
+            k = dequant_rows(k, ks_ref[0, :, 0])
+            v = dequant_rows(v, vs_ref[0, :, 0])
+        s = scaled_qk(q, k, scale)                 # [1, bk]
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(cols < live_len, s, NEG_INF)
-        # dead rows get softmax weight exp(NEG_INF - m) = 0, but a tail
-        # block past the cache length reads pad garbage for v, and
-        # 0 * NaN = NaN — zero those rows so the weighted sum stays clean
-        v = jnp.where(cols.reshape(-1, 1) < live_len, v, 0.0)
-
-        m_prev = m_ref[:]                          # [1]
-        l_prev = l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        p = jnp.where(
-            m_new[:, None] <= NEG_INF, 0.0, jnp.exp(s - m_new[:, None])
-        )
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
-        m_ref[:] = m_new
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        s, v = mask_dead_columns(s, v, cols, live_len)
+        m_ref[:], l_ref[:], acc_ref[:] = online_softmax_update(
+            s, v, m_ref[:], l_ref[:], acc_ref[:]
         )
 
     @pl.when(ki == n_k - 1)
     def _final():
-        l2 = l_ref[:][:, None]
-        o_ref[0, 0] = jnp.where(
-            l2 > 0, acc_ref[:] / jnp.maximum(l2, 1e-30), 0.0
-        ).astype(o_ref.dtype)
+        o_ref[0, 0] = online_softmax_finalize(l_ref[:], acc_ref[:], o_ref.dtype)
 
 
 def _pick_block(s_len: int, block_k: int) -> Tuple[int, int]:
@@ -112,6 +98,21 @@ def _pick_block(s_len: int, block_k: int) -> Tuple[int, int]:
     full-width blocks instead of degenerating to 1-row blocks."""
     bk = min(block_k, s_len)
     return bk, -(-s_len // bk)
+
+
+# BlockSpec index maps — module-level so the registered LaunchPlan and
+# the live pallas_call share the SAME callables (grid (b, h, k-blocks),
+# pos prefetched). GQA: query head hi reads kv head hi//group.
+def _q_index_map(bi, hi, kk, pos_ref):
+    return (bi, 0, hi, 0)
+
+
+def _kv_index_map(group):
+    return lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0)
+
+
+def _scale_index_map(group):
+    return lambda bi, hi, kk, pos_ref: (bi, kk, hi // group)
 
 
 @functools.partial(
@@ -152,28 +153,22 @@ def decode_attention(
 
     from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets
 
-    kv_spec = pl.BlockSpec(
-        (1, bk, 1, d), lambda bi, hi, kk, pos_ref: (bi, kk, hi // group, 0)
-    )
+    kv_spec = pl.BlockSpec((1, bk, 1, d), _kv_index_map(group))
     in_specs = [
-        pl.BlockSpec((1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)),
+        pl.BlockSpec((1, 1, 1, d), _q_index_map),
         kv_spec,
         kv_spec,
     ]
     operands = [pos.astype(jnp.int32), q, cache_k, cache_v]
     if quantized:
-        scale_spec = pl.BlockSpec(
-            (1, bk, 1), lambda bi, hi, kk, pos_ref: (bi, kk, hi // group)
-        )
+        scale_spec = pl.BlockSpec((1, bk, 1), _scale_index_map(group))
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, h, n_k),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, 1, d), lambda bi, hi, kk, pos_ref: (bi, 0, hi, 0)
-        ),
+        out_specs=pl.BlockSpec((1, 1, 1, d), _q_index_map),
         scratch_shapes=[
             pltpu.VMEM((1,), jnp.float32),
             pltpu.VMEM((1,), jnp.float32),
@@ -193,23 +188,199 @@ def decode_attention(
     return out
 
 
+def decode_attention_ref(q, cache_k, cache_v, pos, k_scale=None,
+                         v_scale=None, scale: Optional[float] = None):
+    """jnp masked-softmax reference of the decode kernel: q [B,1,H,D],
+    cache [B,S,KV,D] (int8 with ``k_scale``/``v_scale`` [B,S,KV]), pos
+    [B] → [B,1,H,D] float32. Same clamp as the kernel: positions
+    0..min(pos, S-1) attendable (a wrapped ring passes absolute pos).
+    GQA folds query heads over the compact KV heads, no expansion."""
+    b, _, h, d = q.shape
+    s_len = cache_k.shape[1]
+    n_kv = cache_k.shape[2]
+    g = h // n_kv
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    ck = cache_k.astype(jnp.float32)
+    cv = cache_v.astype(jnp.float32)
+    if k_scale is not None:
+        ck = ck * k_scale[..., None]
+        cv = cv * v_scale[..., None]
+    q5 = q.astype(jnp.float32)[:, 0].reshape(b, n_kv, g, d)
+    s = jnp.einsum("bkgd,bskd->bkgs", q5, ck) * sc
+    live_len = jnp.minimum(pos + 1, s_len)
+    live = jnp.arange(s_len)[None, :] < live_len[:, None]
+    s = jnp.where(live[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgs,bskd->bkgd", p, cv)
+    return o.reshape(b, 1, h, d)
+
+
 def make_decode_attention(interpret: Optional[bool] = None, **kwargs):
     """attn factory: real kernel on TPU, interpreter elsewhere.
 
     The returned ``attn(q, ck, cv, pos)`` accepts either float cache
     arrays or the serving int8 cache entries ``(ck8, k_scale)`` /
-    ``(cv8, v_scale)`` (models/serving.py quantize_kv layout)."""
+    ``(cv8, v_scale)`` (models/serving.py quantize_kv layout). Each
+    trace consults the registry's dtype support (_compat.pallas_ok) and
+    degrades to :func:`decode_attention_ref` with a logged reason
+    instead of a trace-time Mosaic error; the resolved choice lands in
+    the dispatch tally as op "decode_attention"."""
+    from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+    from nnstreamer_tpu.ops.pallas._compat import pallas_ok
+
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def attn(q, cache_k, cache_v, pos):
+        payload = cache_k[0] if isinstance(cache_k, tuple) else cache_k
+        ok, _ = pallas_ok("decode_attention", payload.dtype)
+        _record_dispatch("decode_attention", "pallas" if ok else "jnp")
         if isinstance(cache_k, tuple):
             (k8, ks), (v8, vs) = cache_k, cache_v
-            return decode_attention(
-                q, k8, v8, pos, k_scale=ks, v_scale=vs,
-                interpret=interpret, **kwargs,
+            fn = decode_attention if ok else decode_attention_ref
+            kw = dict(kwargs) if ok else {
+                k: v for k, v in kwargs.items() if k == "scale"
+            }
+            if ok:
+                kw["interpret"] = interpret
+            return fn(q, k8, v8, pos, k_scale=ks, v_scale=vs, **kw)
+        if not ok:
+            return decode_attention_ref(
+                q, cache_k, cache_v, pos, scale=kwargs.get("scale")
             )
         return decode_attention(q, cache_k, cache_v, pos,
                                 interpret=interpret, **kwargs)
 
     return attn
+
+
+# -- kernel registration (nns-kscope) ----------------------------------------
+
+
+def _plan(params):
+    b, h, d = params.get("b", 2), params.get("h", 4), params.get("d", 16)
+    n_kv = params.get("n_kv", h)
+    s_len = params["s_len"]
+    dtype = params.get("dtype", "float32")
+    group = h // n_kv
+    bk, n_k = _pick_block(s_len, params.get("block_k", 128))
+    quantized = dtype == "int8"
+    blocks = [
+        _registry.BlockDesc(
+            "q", "in", (b, 1, h, d), (1, 1, 1, d), dtype if not quantized
+            else "float32", _q_index_map,
+        ),
+        _registry.BlockDesc(
+            "cache_k", "in", (b, s_len, n_kv, d), (1, bk, 1, d), dtype,
+            _kv_index_map(group),
+        ),
+        _registry.BlockDesc(
+            "cache_v", "in", (b, s_len, n_kv, d), (1, bk, 1, d), dtype,
+            _kv_index_map(group),
+        ),
+    ]
+    if quantized:
+        for nm in ("k_scale", "v_scale"):
+            blocks.append(_registry.BlockDesc(
+                nm, "in", (b, s_len, n_kv), (1, bk, 1), "float32",
+                _scale_index_map(group),
+            ))
+    blocks.append(_registry.BlockDesc(
+        "o", "out", (b, 1, h, d), (1, 1, 1, d), "float32", _q_index_map,
+    ))
+    import numpy as np
+
+    return _registry.LaunchPlan(
+        grid=(b, h, n_k),
+        blocks=tuple(blocks),
+        scratch=(
+            _registry.ScratchDesc("m", (1,)),
+            _registry.ScratchDesc("l", (1,)),
+            _registry.ScratchDesc("acc", (1, d)),
+        ),
+        prefetch=(
+            _registry.PrefetchDesc(
+                "pos", (b,),
+                make=lambda: np.full((b,), s_len - 1, np.int32),
+            ),
+        ),
+        # q·Kᵀ + p·V: 2·s·d each per (slot, head)
+        flops=4 * b * h * s_len * d,
+        notes="memory-bound: cache streaming dominates",
+    )
+
+
+def _run_case(params):
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    b, h, d = params.get("b", 3), params.get("h", 4), params.get("d", 16)
+    n_kv = params.get("n_kv", h)
+    s_len, block_k = params["s_len"], params.get("block_k", 128)
+    dtype = params.get("dtype", "float32")
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    # default fills spread slot positions from empty to full
+    default_pos = [(i * (s_len - 1)) // max(1, b - 1) for i in range(b)]
+    pos = jnp.asarray(params.get("pos", default_pos), jnp.int32)
+    if dtype == "int8":
+        ck = jnp.asarray(rng.integers(-127, 128, (b, s_len, n_kv, d)), jnp.int8)
+        cv = jnp.asarray(rng.integers(-127, 128, (b, s_len, n_kv, d)), jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.01, 0.1, (b, s_len, n_kv)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.01, 0.1, (b, s_len, n_kv)), jnp.float32)
+        got = decode_attention(q, ck, cv, pos, k_scale=ks, v_scale=vs,
+                               block_k=block_k, interpret=True)
+        want = decode_attention_ref(q, ck, cv, pos, k_scale=ks, v_scale=vs)
+        return got, want, 2e-5
+    cast = jnp.dtype(dtype)
+    qd = q.astype(cast)
+    ck = jnp.asarray(rng.standard_normal((b, s_len, n_kv, d)), jnp.float32).astype(cast)
+    cv = jnp.asarray(rng.standard_normal((b, s_len, n_kv, d)), jnp.float32).astype(cast)
+    got = decode_attention(qd, ck, cv, pos, block_k=block_k, interpret=True)
+    want = decode_attention_ref(qd, ck, cv, pos)
+    return got, want, (2e-2 if cast == jnp.bfloat16 else 2e-5)
+
+
+def _probe():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 1, 2, 8)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((1, 16, 2, 8)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((1, 16, 2, 8)), jnp.float32)
+    pos = jnp.asarray([7], jnp.int32)
+    np.asarray(make_decode_attention(interpret=True)(q, ck, cv, pos))
+
+
+_registry.register(_registry.KernelSpec(
+    name="decode_attention",
+    module=__name__,
+    ops=("decode_attention", "serving_attention"),
+    dtypes=("float32", "bfloat16", "int8"),
+    cases=(
+        # the parity grid tests/test_pallas.py parametrizes over; the
+        # non-dividing lengths pin ceil-covered tail blocks (ADVICE r2)
+        _registry.ShapeCase("s64-bk16", {"s_len": 64, "block_k": 16}, tier1=True),
+        _registry.ShapeCase("s48-bk16", {"s_len": 48, "block_k": 16}),
+        _registry.ShapeCase("s40-bk128", {"s_len": 40, "block_k": 128}, tier1=True),
+        _registry.ShapeCase("s97-bk32", {"s_len": 97, "block_k": 32}, tier1=True),
+        _registry.ShapeCase("s130-bk128", {"s_len": 130, "block_k": 128}),
+        _registry.ShapeCase("s33-bk16", {"s_len": 33, "block_k": 16}),
+        _registry.ShapeCase(
+            "gqa-int8",
+            {"b": 2, "h": 4, "n_kv": 2, "s_len": 48, "block_k": 16,
+             "dtype": "int8", "pos": [11, 40]},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "bf16",
+            {"b": 2, "h": 2, "s_len": 32, "block_k": 16, "dtype": "bfloat16",
+             "pos": [5, 20]},
+        ),
+        _registry.ShapeCase(
+            "serve-2048", {"b": 8, "h": 8, "d": 128, "s_len": 2048},
+        ),
+    ),
+    plan=_plan,
+    run_case=_run_case,
+    probe=_probe,
+))

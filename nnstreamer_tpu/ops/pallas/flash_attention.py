@@ -16,6 +16,11 @@ float tolerance (tests/test_pallas.py); composes with ring attention by
 serving as the per-shard block math (the same online recurrence
 ring_attention_local runs per rotation).
 
+The online-softmax recurrence itself lives in ops/pallas/_primitives.py
+(shared with the decode and paged-decode kernels); this module owns the
+causal/pad masking and the [B, T, H, D] blocking, and registers the
+whole launch geometry with ops/pallas/registry.py for nns-kscope.
+
 Layout: [B, T, H, D] like the rest of the framework; internally [B*H, T, D].
 """
 
@@ -28,9 +33,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas import registry as _registry
 from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
-
-NEG_INF = -1e30
+from nnstreamer_tpu.ops.pallas._primitives import (
+    NEG_INF,
+    online_softmax_finalize,
+    online_softmax_init,
+    online_softmax_update,
+    scaled_qk,
+)
 
 
 def _kernel(
@@ -48,9 +59,7 @@ def _kernel(
 
     @pl.when(ki == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        online_softmax_init(m_ref, l_ref, acc_ref)
 
     q_start = qi * block_q
     k_start = ki * block_k
@@ -67,9 +76,7 @@ def _kernel(
         q = q_ref[0].astype(jnp.float32)  # [bq, d]
         k = k_ref[0].astype(jnp.float32)  # [bk, d]
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
+        s = scaled_qk(q, k, scale)  # [bq, bk]
         if causal or valid_len is not None:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -79,26 +86,33 @@ def _kernel(
             if valid_len is not None:
                 mask = jnp.logical_and(mask, cols < valid_len)
             s = jnp.where(mask, s, NEG_INF)
-        # mosaic note: bool vectors cannot gain a minor dim — expand the
-        # f32 operands first, compare in 2D
-        m_prev = m_ref[:]  # [bq]
-        l_prev = l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        m_new2 = m_new[:, None]
-        p = jnp.where(m_new2 <= NEG_INF, 0.0, jnp.exp(s - m_new2))
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
-        m_ref[:] = m_new
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        m_ref[:], l_ref[:], acc_ref[:] = online_softmax_update(
+            s, v, m_ref[:], l_ref[:], acc_ref[:]
         )
 
     @pl.when(ki == n_k - 1)
     def _final():
-        l2 = l_ref[:][:, None]
-        o_ref[0] = jnp.where(
-            l2 > 0, acc_ref[:] / jnp.maximum(l2, 1e-30), 0.0
-        ).astype(o_ref.dtype)
+        o_ref[0] = online_softmax_finalize(l_ref[:], acc_ref[:], o_ref.dtype)
+
+
+# BlockSpec index maps — module-level so the registered LaunchPlan and
+# the live pallas_call share the SAME callables (grid (b*h, q, k))
+def _q_index_map(i, j, kk):
+    return (i, j, 0)
+
+
+def _kv_index_map(i, j, kk):
+    return (i, kk, 0)
+
+
+def _blocking(t: int, block_q: int, block_k: int):
+    """(bq, bk, t_pad, n_q, n_k): T pads up to a block multiple; tiny
+    sequences shrink the block (16 floor keeps a sublane-full tile)."""
+    bq = min(block_q, max(t, 16))
+    bk = min(block_k, max(t, 16))
+    blk = max(bq, bk)
+    t_pad = -(-t // blk) * blk
+    return bq, bk, t_pad, t_pad // bq, t_pad // bk
 
 
 @functools.partial(
@@ -120,10 +134,7 @@ def flash_attention(
     masked to NEG_INF and padded query rows are sliced off on return."""
     b, t, h, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    bq = min(block_q, max(t, 16))
-    bk = min(block_k, max(t, 16))
-    blk = max(bq, bk)
-    t_pad = -(-t // blk) * blk
+    bq, bk, t_pad, n_q, n_k = _blocking(t, block_q, block_k)
 
     def to_bh(x):
         x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
@@ -132,7 +143,6 @@ def flash_attention(
         return x
 
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    n_q, n_k = t_pad // bq, t_pad // bk
     kernel = functools.partial(
         _kernel,
         scale=scale,
@@ -150,11 +160,11 @@ def flash_attention(
         out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), jnp.float32),
         grid=(b * h, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bq, d), _q_index_map),
+            pl.BlockSpec((1, bk, d), _kv_index_map),
+            pl.BlockSpec((1, bk, d), _kv_index_map),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), _q_index_map),
         scratch_shapes=[
             pltpu.VMEM((bq,), jnp.float32),
             pltpu.VMEM((bq,), jnp.float32),
@@ -171,11 +181,138 @@ def flash_attention(
 
 def make_flash_attention(interpret: Optional[bool] = None, **kwargs):
     """attn_fn factory matching the transformer's pluggable signature.
-    interpret=None auto-selects: real kernel on TPU, interpreter elsewhere."""
+    interpret=None auto-selects: real kernel on TPU, interpreter
+    elsewhere. Each trace consults the registry's dtype support
+    (_compat.pallas_ok) and degrades to the dense jnp reference with a
+    logged reason instead of a trace-time Mosaic error; the resolved
+    choice lands in the dispatch tally as op "flash_attention"."""
+    from nnstreamer_tpu.ops.dispatch import record as _record_dispatch
+    from nnstreamer_tpu.ops.pallas._compat import pallas_ok
+
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def attn(q, k, v, causal: bool = True):
+        ok, _ = pallas_ok("flash_attention", q.dtype)
+        _record_dispatch("flash_attention", "pallas" if ok else "jnp")
+        if not ok:
+            from nnstreamer_tpu.parallel.ring_attention import dense_attention
+
+            return dense_attention(q, k, v, causal=causal)
         return flash_attention(q, k, v, causal=causal, interpret=interpret, **kwargs)
 
     return attn
+
+
+# -- kernel registration (nns-kscope) ----------------------------------------
+
+
+def _plan(params):
+    b = params.get("b", 1)
+    t = params["t"]
+    h = params.get("h", 2)
+    d = params.get("d", 64)
+    dtype = params.get("dtype", "float32")
+    causal = params.get("causal", True)
+    bq, bk, t_pad, n_q, n_k = _blocking(
+        t, params.get("block_q", 128), params.get("block_k", 128)
+    )
+    arr = (b * h, t_pad, d)
+    # two MXU contractions (q·kᵀ, p·v), 2·m·n·k flops each; causal
+    # predication skips the strictly-above-diagonal half
+    flops = 4 * b * h * t_pad * t_pad * d
+    if causal:
+        flops //= 2
+    return _registry.LaunchPlan(
+        grid=(b * h, n_q, n_k),
+        blocks=(
+            _registry.BlockDesc("q", "in", arr, (1, bq, d), dtype, _q_index_map),
+            _registry.BlockDesc("k", "in", arr, (1, bk, d), dtype, _kv_index_map),
+            _registry.BlockDesc("v", "in", arr, (1, bk, d), dtype, _kv_index_map),
+            _registry.BlockDesc("o", "out", arr, (1, bq, d), "float32", _q_index_map),
+        ),
+        scratch=(
+            _registry.ScratchDesc("m", (bq,)),
+            _registry.ScratchDesc("l", (bq,)),
+            _registry.ScratchDesc("acc", (bq, d)),
+        ),
+        flops=flops,
+        notes="causal: ~half the k blocks predicated off" if causal else "",
+    )
+
+
+def _run_case(params):
+    import numpy as np
+
+    from nnstreamer_tpu.parallel.ring_attention import dense_attention
+
+    rng = np.random.default_rng(0)
+    b, t = params.get("b", 1), params["t"]
+    h, d = params.get("h", 2), params.get("d", 64)
+    dtype = jnp.dtype(params.get("dtype", "float32"))
+    causal = params.get("causal", True)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32).astype(dtype)
+        for _ in range(3)
+    )
+    got = flash_attention(
+        q, k, v, causal=causal,
+        block_q=params.get("block_q", 128),
+        block_k=params.get("block_k", 128),
+        interpret=True,
+    )
+    want = dense_attention(q, k, v, causal=causal)
+    return got, want, (2e-2 if dtype == jnp.bfloat16 else 2e-5)
+
+
+def _probe():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 16, 1, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    np.asarray(make_flash_attention(interpret=True, block_q=16, block_k=16)(q, k, v))
+
+
+_registry.register(_registry.KernelSpec(
+    name="flash_attention",
+    module=__name__,
+    ops=("flash_attention",),
+    dtypes=("float32", "bfloat16"),
+    cases=(
+        _registry.ShapeCase(
+            "t64-causal",
+            {"b": 2, "t": 64, "h": 4, "d": 16, "block_q": 16, "block_k": 16},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "t64-full",
+            {"b": 2, "t": 64, "h": 4, "d": 16, "block_q": 16, "block_k": 16,
+             "causal": False},
+        ),
+        _registry.ShapeCase(
+            "t100-pad-causal",
+            {"b": 2, "t": 100, "h": 2, "d": 32, "block_q": 32, "block_k": 32},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "t100-pad-full",
+            {"b": 2, "t": 100, "h": 2, "d": 32, "block_q": 32, "block_k": 32,
+             "causal": False},
+        ),
+        _registry.ShapeCase(
+            "bf16",
+            {"b": 2, "t": 64, "h": 4, "d": 16, "block_q": 16, "block_k": 16,
+             "dtype": "bfloat16"},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "serve-512", {"b": 8, "t": 512, "h": 8, "d": 128},
+        ),
+    ),
+    plan=_plan,
+    run_case=_run_case,
+    probe=_probe,
+))

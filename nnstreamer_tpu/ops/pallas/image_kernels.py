@@ -29,7 +29,29 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas import registry as _registry
 from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
+
+# BlockSpec index maps — module-level so the registered LaunchPlans and
+# the live pallas_call share the SAME callables (grid: one crop box —
+# or one batch element, for resize — per step)
+def _boxes_index_map(i):
+    return (i, 0)
+
+
+def _crop_img_index_map(i):
+    # crop grid: every step reads the whole (shared) image
+    return (0, 0, 0)
+
+
+def _resize_img_index_map(i):
+    # resize grid: one batch element per step
+    return (i, 0, 0, 0)
+
+
+def _out_index_map(i):
+    return (i, 0, 0, 0)
 
 
 def _weight_matrix(lo, hi, out_n: int, in_n: int):
@@ -110,8 +132,7 @@ def crop_and_resize(
         )
     return _launch_crop(
         image, boxes.astype(jnp.float32),
-        # crop grid: every step reads the whole (shared) image
-        pl.BlockSpec((h, w, c), lambda i: (0, 0, 0)),
+        pl.BlockSpec((h, w, c), _crop_img_index_map),
         out_h, out_w, scale, offset, out_dtype, interpret,
     )
 
@@ -145,9 +166,9 @@ def _launch_crop(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, out_h, out_w, c), out_dtype),
         grid=(n,),
-        in_specs=[pl.BlockSpec((1, 4), lambda i: (i, 0)), img_spec],
+        in_specs=[pl.BlockSpec((1, 4), _boxes_index_map), img_spec],
         out_specs=pl.BlockSpec(
-            (1, out_h, out_w, c), lambda i: (i, 0, 0, 0)
+            (1, out_h, out_w, c), _out_index_map
         ),
         interpret=interpret,
         **kw,
@@ -182,8 +203,204 @@ def resize_bilinear(
     )
     out = _launch_crop(
         img, boxes,
-        # resize grid: one batch element per step
-        pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+        pl.BlockSpec((1, h, w, c), _resize_img_index_map),
         out_h, out_w, scale, offset, out_dtype, interpret,
     )
     return out[0] if squeeze else out
+
+
+# -- kernel registration (nns-kscope) ----------------------------------------
+
+
+def _crop_flops(n, h, w, c, out_h, out_w):
+    # two MXU contractions per box: Wy·img ([out_h,h]·[h,w·c]) then
+    # ·Wxᵀ (contract the w axis), 2·m·n·k flops each
+    return n * 2 * out_h * w * c * (h + out_w)
+
+
+def _crop_plan(params):
+    n = params.get("n", 4)
+    h, w, c = params.get("h", 32), params.get("w", 48), params.get("c", 3)
+    out_h, out_w = params.get("out_h", 8), params.get("out_w", 8)
+    dtype = params.get("dtype", "float32")
+    return _registry.LaunchPlan(
+        grid=(n,),
+        blocks=(
+            _registry.BlockDesc(
+                "boxes", "in", (n, 4), (1, 4), "float32", _boxes_index_map,
+            ),
+            _registry.BlockDesc(
+                "image", "in", (h, w, c), (h, w, c), dtype,
+                _crop_img_index_map,
+            ),
+            _registry.BlockDesc(
+                "out", "out", (n, out_h, out_w, c), (1, out_h, out_w, c),
+                dtype, _out_index_map,
+            ),
+        ),
+        flops=_crop_flops(n, h, w, c, out_h, out_w),
+        notes="whole image resident across the box grid (constant index map)",
+    )
+
+
+def _resize_plan(params):
+    n = params.get("n", 2)
+    h, w, c = params.get("h", 17), params.get("w", 23), params.get("c", 3)
+    out_h, out_w = params.get("out_h", 8), params.get("out_w", 8)
+    dtype = params.get("dtype", "float32")
+    return _registry.LaunchPlan(
+        grid=(n,),
+        blocks=(
+            _registry.BlockDesc(
+                "boxes", "in", (n, 4), (1, 4), "float32", _boxes_index_map,
+            ),
+            _registry.BlockDesc(
+                "image", "in", (n, h, w, c), (1, h, w, c), dtype,
+                _resize_img_index_map,
+            ),
+            _registry.BlockDesc(
+                "out", "out", (n, out_h, out_w, c), (1, out_h, out_w, c),
+                dtype, _out_index_map,
+            ),
+        ),
+        flops=_crop_flops(n, h, w, c, out_h, out_w),
+    )
+
+
+def _interp_atol(dtype, h, w):
+    """Parity tolerance for bilinear sampling: the kernel and the jnp
+    reference round the float32 source coordinates differently, and at
+    magnitude max(h, w) one coordinate ulp (≈ max(h,w)·2⁻²³) moves an
+    O(1) interpolation weight by that much — 720p-scale cases need a
+    looser bar than thumbnails, not a sloppier kernel."""
+    if jnp.issubdtype(dtype, jnp.integer):
+        return 1.0
+    return max(1e-4, 8 * max(h, w) * 2.0 ** -23)
+
+
+def _rand_boxes(rng, n, h, w):
+    import numpy as np
+
+    x1 = rng.uniform(0, w - 1, n)
+    y1 = rng.uniform(0, h - 1, n)
+    x2 = x1 + rng.uniform(1.0, np.maximum(1.5, w - x1))
+    y2 = y1 + rng.uniform(1.0, np.maximum(1.5, h - y1))
+    return jnp.asarray(np.stack([x1, y1, x2, y2], -1), jnp.float32)
+
+
+def _crop_run_case(params):
+    import numpy as np
+
+    from nnstreamer_tpu.ops import image as image_ops
+
+    rng = np.random.default_rng(5)
+    n = params.get("n", 4)
+    h, w, c = params.get("h", 32), params.get("w", 48), params.get("c", 3)
+    out_h, out_w = params.get("out_h", 8), params.get("out_w", 8)
+    dtype = jnp.dtype(params.get("dtype", "float32"))
+    scale, offset = params.get("scale"), params.get("offset")
+    if jnp.issubdtype(dtype, jnp.integer):
+        img = jnp.asarray(rng.integers(0, 256, (h, w, c)), dtype)
+    else:
+        img = jnp.asarray(rng.standard_normal((h, w, c)), dtype)
+    boxes = _rand_boxes(rng, n, h, w)
+    got = crop_and_resize(
+        img, boxes, out_h, out_w, scale=scale, offset=offset, interpret=True,
+    )
+    want = image_ops.crop_and_resize(
+        img.astype(jnp.float32), boxes, out_h, out_w, impl="jnp"
+    )
+    if scale is not None:
+        want = want * scale
+    if offset is not None:
+        want = want + offset
+    if scale is None and offset is None:
+        want = image_ops._round_clip_cast(want, dtype)
+    return got, want, _interp_atol(dtype, h, w)
+
+
+def _resize_run_case(params):
+    import numpy as np
+
+    from nnstreamer_tpu.ops import image as image_ops
+
+    rng = np.random.default_rng(6)
+    n = params.get("n", 2)
+    h, w, c = params.get("h", 17), params.get("w", 23), params.get("c", 3)
+    out_h, out_w = params.get("out_h", 8), params.get("out_w", 8)
+    dtype = jnp.dtype(params.get("dtype", "float32"))
+    if jnp.issubdtype(dtype, jnp.integer):
+        img = jnp.asarray(rng.integers(0, 256, (n, h, w, c)), dtype)
+    else:
+        img = jnp.asarray(rng.standard_normal((n, h, w, c)), dtype)
+    got = resize_bilinear(img, out_h, out_w, interpret=True)
+    want = image_ops.resize_bilinear(img, out_h, out_w, impl="jnp")
+    return got, want, _interp_atol(dtype, h, w)
+
+
+def _crop_probe():
+    import numpy as np
+
+    from nnstreamer_tpu.ops import image as image_ops
+
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.standard_normal((8, 8, 3)), jnp.float32)
+    boxes = jnp.asarray([[1.0, 1.0, 6.0, 6.0]], jnp.float32)
+    np.asarray(image_ops.crop_and_resize(img, boxes, 4, 4, impl="pallas"))
+
+
+def _resize_probe():
+    import numpy as np
+
+    from nnstreamer_tpu.ops import image as image_ops
+
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.standard_normal((8, 8, 3)), jnp.float32)
+    np.asarray(image_ops.resize_bilinear(img, 4, 4, impl="pallas"))
+
+
+_registry.register(_registry.KernelSpec(
+    name="crop_and_resize",
+    module=__name__,
+    ops=("crop_and_resize",),
+    dtypes=("float32", "bfloat16", "uint8"),
+    cases=(
+        _registry.ShapeCase("f32", {}, tier1=True),
+        _registry.ShapeCase("uint8", {"dtype": "uint8"}, tier1=True),
+        _registry.ShapeCase(
+            "normalize-epilogue",
+            {"scale": 1.0 / 255.0, "offset": -0.5},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "cam-720p-face",
+            {"n": 8, "h": 720, "w": 1280, "out_h": 112, "out_w": 112},
+        ),
+    ),
+    plan=_crop_plan,
+    run_case=_crop_run_case,
+    probe=_crop_probe,
+))
+
+_registry.register(_registry.KernelSpec(
+    name="resize_bilinear",
+    module=__name__,
+    ops=("resize_bilinear",),
+    dtypes=("float32", "bfloat16", "uint8"),
+    cases=(
+        _registry.ShapeCase("down", {}, tier1=True),
+        _registry.ShapeCase(
+            "up",
+            {"n": 1, "h": 8, "w": 8, "out_h": 16, "out_w": 16},
+            tier1=True,
+        ),
+        _registry.ShapeCase("uint8", {"dtype": "uint8"}),
+        _registry.ShapeCase(
+            "cam-720p-to-300",
+            {"n": 1, "h": 720, "w": 1280, "out_h": 300, "out_w": 300},
+        ),
+    ),
+    plan=_resize_plan,
+    run_case=_resize_run_case,
+    probe=_resize_probe,
+))

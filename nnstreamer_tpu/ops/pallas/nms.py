@@ -26,7 +26,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas import registry as _registry
 from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
+
+
+# BlockSpec index map — module-level so the registered LaunchPlan and
+# the live pallas_call share the SAME callable (grid (1,): the whole
+# candidate list stays VMEM-resident across the greedy recurrence)
+def _whole_index_map(i):
+    return (0, 0)
 
 
 def _nms_kernel(coords_ref, scores_ref, alive_ref, *, n: int, n_pad: int,
@@ -113,10 +121,10 @@ def nms(
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
         grid=(1,),
         in_specs=[
-            pl.BlockSpec((4, n_pad), lambda i: (0, 0)),
-            pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+            pl.BlockSpec((4, n_pad), _whole_index_map),
+            pl.BlockSpec((1, n_pad), _whole_index_map),
         ],
-        out_specs=pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+        out_specs=pl.BlockSpec((1, n_pad), _whole_index_map),
         interpret=interpret,
         **kw,
     )(coords, srow)
@@ -133,3 +141,91 @@ def nms(
     # casts); match it so impl="auto" traces the same output spec on
     # every backend
     return sel_idx.astype(jnp.int32), sel_scores.astype(scores.dtype)
+
+
+# -- kernel registration (nns-kscope) ----------------------------------------
+
+
+def _pad_n(n: int) -> int:
+    return max(128, -(-n // 128) * 128)
+
+
+def _plan(params):
+    n = params.get("n", 32)
+    n_pad = _pad_n(n)
+    return _registry.LaunchPlan(
+        grid=(1,),
+        blocks=(
+            _registry.BlockDesc(
+                "coords", "in", (4, n_pad), (4, n_pad), "float32",
+                _whole_index_map,
+            ),
+            _registry.BlockDesc(
+                "scores", "in", (1, n_pad), (1, n_pad), "float32",
+                _whole_index_map,
+            ),
+            _registry.BlockDesc(
+                "alive", "out", (1, n_pad), (1, n_pad), "float32",
+                _whole_index_map,
+            ),
+        ),
+        # one masked IoU row (~12 VPU ops/column) per greedy step
+        flops=12 * n * n_pad,
+        notes="sequential greedy recurrence; VPU-only (no MXU work)",
+    )
+
+
+def _boxes_scores(params):
+    import numpy as np
+
+    rng = np.random.default_rng(9)
+    n = params.get("n", 32)
+    xy = rng.uniform(0, 60, (n, 2))
+    wh = rng.uniform(2, 30, (n, 2))
+    boxes = jnp.asarray(np.concatenate([xy, xy + wh], -1), jnp.float32)
+    scores = jnp.asarray(rng.uniform(0.05, 1.0, n), jnp.float32)
+    return boxes, scores
+
+
+def _run_case(params):
+    from nnstreamer_tpu.ops import detection
+
+    boxes, scores = _boxes_scores(params)
+    thr = params.get("thr", 0.5)
+    max_out = params.get("max_out", 8)
+    got = nms(boxes, scores, thr, max_out, interpret=True)
+    want = detection.nms(boxes, scores, thr, max_out, impl="jnp")
+    # the two implementations are pinned bit-comparable (same ranking,
+    # same suppression predicate, same packing)
+    return got, want, 0.0
+
+
+def _probe():
+    import numpy as np
+
+    from nnstreamer_tpu.ops import detection
+
+    boxes = jnp.asarray(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40], [2, 2, 9, 9]],
+        jnp.float32,
+    )
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.6], jnp.float32)
+    idx, sc = detection.nms(boxes, scores, 0.5, 2, impl="pallas")
+    np.asarray(idx), np.asarray(sc)
+
+
+_registry.register(_registry.KernelSpec(
+    name="nms",
+    module=__name__,
+    ops=("nms",),
+    dtypes=("float32", "bfloat16"),
+    cases=(
+        _registry.ShapeCase("n32", {"n": 32}, tier1=True),
+        _registry.ShapeCase("n100-pad128", {"n": 100, "max_out": 16}, tier1=True),
+        _registry.ShapeCase("n200-pad256", {"n": 200, "max_out": 32}),
+        _registry.ShapeCase("ssd-1917", {"n": 1917, "max_out": 100}),
+    ),
+    plan=_plan,
+    run_case=_run_case,
+    probe=_probe,
+))

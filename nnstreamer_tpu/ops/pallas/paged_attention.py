@@ -22,12 +22,12 @@ semantics):
   ``@pl.when``; partially-filled blocks mask their dead columns to
   softmax weight exactly zero and zero the matching V rows, so
   arbitrary scratch content can never leak into the output;
-- the online-softmax scratch (m, l, acc) carries across blocks, and
-  the pending token's OWN K/V (``fresh_k``/``fresh_v``, not yet in the
-  arena — the batcher lands it after the layer scan with one in-place
-  block write) folds in the final grid step: it is position ``pos``,
-  the highest live column, so the reduction order equals position
-  order;
+- the online-softmax scratch (m, l, acc) carries across blocks (the
+  shared recurrence of ops/pallas/_primitives.py), and the pending
+  token's OWN K/V (``fresh_k``/``fresh_v``, not yet in the arena — the
+  batcher lands it after the layer scan with one in-place block write)
+  folds in the final grid step: it is position ``pos``, the highest
+  live column, so the reduction order equals position order;
 - int8 arenas pass ``k_scale``/``v_scale`` ``[N, bs, KV]`` (the
   per-token-per-head symmetric scales of models/serving.quantize_kv)
   and dequantize per block in VMEM — HBM traffic stays at the int8
@@ -48,9 +48,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from nnstreamer_tpu.ops.pallas import registry as _registry
 from nnstreamer_tpu.ops.pallas._compat import compiler_params as _compiler_params
-
-NEG_INF = -1e30
+from nnstreamer_tpu.ops.pallas._primitives import (
+    NEG_INF,
+    dequant_rows,
+    mask_dead_columns,
+    online_softmax_finalize,
+    online_softmax_init,
+    online_softmax_update,
+    scaled_qk,
+)
 
 
 def _kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, fk_ref, fv_ref, *rest,
@@ -64,9 +72,7 @@ def _kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, fk_ref, fv_ref, *rest,
 
     @pl.when(kb == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        online_softmax_init(m_ref, l_ref, acc_ref)
 
     # history length: positions 0..pos-1 live in arena blocks (the
     # pending token's column is the separate fresh operand); clamped to
@@ -80,30 +86,13 @@ def _kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, fk_ref, fv_ref, *rest,
         k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, d]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
         if quantized:
-            # per-row dequant in VMEM: int8 payload × f32 scale [bs]
-            k = k * ks_ref[0, :, 0][:, None]
-            v = v * vs_ref[0, :, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                   # [1, bs]
+            k = dequant_rows(k, ks_ref[0, :, 0])
+            v = dequant_rows(v, vs_ref[0, :, 0])
+        s = scaled_qk(q, k, scale)                  # [1, bs]
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(cols < hist, s, NEG_INF)
-        # dead rows get weight exp(NEG_INF - m) = 0, but a scratch-mapped
-        # or partially-filled block may hold arbitrary V bytes, and
-        # 0 * NaN = NaN — zero those rows so the weighted sum stays clean
-        v = jnp.where(cols.reshape(-1, 1) < hist, v, 0.0)
-
-        m_prev = m_ref[:]                           # [1]
-        l_prev = l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        p = jnp.where(
-            m_new[:, None] <= NEG_INF, 0.0, jnp.exp(s - m_new[:, None])
-        )
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1)
-        m_ref[:] = m_new
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        s, v = mask_dead_columns(s, v, cols, hist)
+        m_ref[:], l_ref[:], acc_ref[:] = online_softmax_update(
+            s, v, m_ref[:], l_ref[:], acc_ref[:]
         )
 
     @pl.when(kb == n_b - 1)
@@ -114,23 +103,33 @@ def _kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, fk_ref, fv_ref, *rest,
         q = q_ref[0, 0].astype(jnp.float32)         # [1, d]
         fk = fk_ref[0, 0, 0].astype(jnp.float32)    # [d]
         fv = fv_ref[0, 0, 0].astype(jnp.float32)
-        s1 = jax.lax.dot_general(
-            q, fk[None, :], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                   # [1, 1]
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, s1[:, 0])
-        alpha = jnp.where(m_prev <= NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        p1 = jnp.exp(s1 - m_new[:, None])           # always live
-        l = l_ref[:] * alpha + jnp.sum(p1, axis=1)
-        acc = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p1, fv[None, :], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s1 = scaled_qk(q, fk[None, :], scale)       # [1, 1] — always live
+        _, l, acc = online_softmax_update(
+            s1, fv[None, :], m_ref[:], l_ref[:], acc_ref[:]
         )
-        l2 = l[:, None]
-        o_ref[0, 0] = jnp.where(
-            l2 > 0, acc / jnp.maximum(l2, 1e-30), 0.0
-        ).astype(o_ref.dtype)
+        o_ref[0, 0] = online_softmax_finalize(l, acc, o_ref.dtype)
+
+
+# BlockSpec index maps — module-level so the registered LaunchPlan and
+# the live pallas_call share the SAME callables (grid (b, h, nb),
+# tables + pos prefetched). The kv map is where the gather disappears:
+# the PREFETCHED table picks the physical arena block each step DMAs.
+def _q_index_map(bi, hi, kb, tab_ref, pos_ref):
+    return (bi, 0, hi, 0)
+
+
+def _kv_index_map(group):
+    return lambda bi, hi, kb, tab_ref, pos_ref: (tab_ref[bi, kb], 0,
+                                                 hi // group, 0)
+
+
+def _fresh_index_map(group):
+    return lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi // group, 0)
+
+
+def _scale_index_map(group):
+    return lambda bi, hi, kb, tab_ref, pos_ref: (tab_ref[bi, kb], 0,
+                                                 hi // group)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
@@ -172,22 +171,10 @@ def paged_decode_attention(
 
     from jax.experimental.pallas import tpu as pltpu  # lazy: CPU interprets
 
-    # the physical arena block each grid step streams is picked by the
-    # PREFETCHED table — this index map is where the gather disappears
-    kv_spec = pl.BlockSpec(
-        (1, bs, 1, d),
-        lambda bi, hi, kb, tab_ref, pos_ref: (tab_ref[bi, kb], 0,
-                                              hi // group, 0),
-    )
-    fresh_spec = pl.BlockSpec(
-        (1, 1, 1, d),
-        lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi // group, 0),
-    )
+    kv_spec = pl.BlockSpec((1, bs, 1, d), _kv_index_map(group))
+    fresh_spec = pl.BlockSpec((1, 1, 1, d), _fresh_index_map(group))
     in_specs = [
-        pl.BlockSpec(
-            (1, 1, 1, d),
-            lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi, 0),
-        ),
+        pl.BlockSpec((1, 1, 1, d), _q_index_map),
         kv_spec,
         kv_spec,
         fresh_spec,
@@ -198,21 +185,14 @@ def paged_decode_attention(
         q, arena_k, arena_v, fresh_k, fresh_v,
     ]
     if quantized:
-        scale_spec = pl.BlockSpec(
-            (1, bs, 1),
-            lambda bi, hi, kb, tab_ref, pos_ref: (tab_ref[bi, kb], 0,
-                                                  hi // group),
-        )
+        scale_spec = pl.BlockSpec((1, bs, 1), _scale_index_map(group))
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, nb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, 1, d),
-            lambda bi, hi, kb, tab_ref, pos_ref: (bi, 0, hi, 0),
-        ),
+        out_specs=pl.BlockSpec((1, 1, 1, d), _q_index_map),
         scratch_shapes=[
             pltpu.VMEM((1,), jnp.float32),
             pltpu.VMEM((1,), jnp.float32),
@@ -258,3 +238,173 @@ def make_paged_attention(interpret: Optional[bool] = None, **kwargs):
         )
 
     return attn
+
+
+# -- kernel registration (nns-kscope) ----------------------------------------
+
+
+def _plan(params):
+    b, h, d = params.get("b", 2), params.get("h", 4), params.get("d", 16)
+    n_kv = params.get("n_kv", h)
+    bs, nb = params["bs"], params["nb"]
+    n_blocks = params.get("n_blocks", b * nb)
+    dtype = params.get("dtype", "float32")
+    group = h // n_kv
+    quantized = dtype == "int8"
+    float_dtype = "float32" if quantized else dtype
+    blocks = [
+        _registry.BlockDesc(
+            "q", "in", (b, 1, h, d), (1, 1, 1, d), float_dtype, _q_index_map,
+        ),
+        _registry.BlockDesc(
+            "arena_k", "in", (n_blocks, bs, n_kv, d), (1, bs, 1, d), dtype,
+            _kv_index_map(group),
+        ),
+        _registry.BlockDesc(
+            "arena_v", "in", (n_blocks, bs, n_kv, d), (1, bs, 1, d), dtype,
+            _kv_index_map(group),
+        ),
+        _registry.BlockDesc(
+            "fresh_k", "in", (b, 1, n_kv, d), (1, 1, 1, d), float_dtype,
+            _fresh_index_map(group),
+        ),
+        _registry.BlockDesc(
+            "fresh_v", "in", (b, 1, n_kv, d), (1, 1, 1, d), float_dtype,
+            _fresh_index_map(group),
+        ),
+    ]
+    if quantized:
+        for nm in ("k_scale", "v_scale"):
+            blocks.append(_registry.BlockDesc(
+                nm, "in", (n_blocks, bs, n_kv), (1, bs, 1), "float32",
+                _scale_index_map(group),
+            ))
+    blocks.append(_registry.BlockDesc(
+        "o", "out", (b, 1, h, d), (1, 1, 1, d), "float32", _q_index_map,
+    ))
+    import numpy as np
+
+    return _registry.LaunchPlan(
+        grid=(b, h, nb),
+        blocks=tuple(blocks),
+        scratch=(
+            _registry.ScratchDesc("m", (1,)),
+            _registry.ScratchDesc("l", (1,)),
+            _registry.ScratchDesc("acc", (1, d)),
+        ),
+        prefetch=(
+            _registry.PrefetchDesc(
+                "tables", (b, nb),
+                make=lambda: np.arange(b * nb, dtype=np.int32).reshape(b, nb)
+                % n_blocks,
+            ),
+            _registry.PrefetchDesc(
+                "pos", (b,),
+                make=lambda: np.full((b,), nb * bs, np.int32),
+            ),
+        ),
+        # q·Kᵀ + p·V over nb·bs history columns plus the fresh column
+        flops=4 * b * h * (nb * bs + 1) * d,
+        notes="arena blocks picked through the prefetched table",
+    )
+
+
+def _case_arrays(params, rng):
+    import numpy as np
+
+    b, h, d = params.get("b", 2), params.get("h", 4), params.get("d", 16)
+    n_kv = params.get("n_kv", h)
+    bs, nb = params["bs"], params["nb"]
+    n_blocks = params.get("n_blocks", b * nb)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(n_blocks)[: b * nb].reshape(b, nb), jnp.int32
+    )
+    # default fills spread slot positions from empty to full
+    default_pos = [(i * nb * bs) // max(1, b - 1) for i in range(b)]
+    pos = jnp.asarray(params.get("pos", default_pos), jnp.int32)
+    fk = jnp.asarray(rng.standard_normal((b, 1, n_kv, d)), jnp.float32)
+    fv = jnp.asarray(rng.standard_normal((b, 1, n_kv, d)), jnp.float32)
+    return b, h, d, n_kv, bs, nb, n_blocks, q, tables, pos, fk, fv
+
+
+def _run_case(params):
+    import numpy as np
+
+    from nnstreamer_tpu.kv.block_attn import paged_attention_ref
+
+    rng = np.random.default_rng(3)
+    (b, h, d, n_kv, bs, nb, n_blocks,
+     q, tables, pos, fk, fv) = _case_arrays(params, rng)
+    if params.get("dtype") == "int8":
+        ak = jnp.asarray(
+            rng.integers(-127, 128, (n_blocks, bs, n_kv, d)), jnp.int8
+        )
+        av = jnp.asarray(
+            rng.integers(-127, 128, (n_blocks, bs, n_kv, d)), jnp.int8
+        )
+        ks = jnp.asarray(rng.uniform(0.01, 0.1, (n_blocks, bs, n_kv)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.01, 0.1, (n_blocks, bs, n_kv)), jnp.float32)
+        got = paged_decode_attention(
+            q, ak, av, tables, pos, fk, fv, k_scale=ks, v_scale=vs,
+            interpret=True,
+        )
+        want = paged_attention_ref(
+            q, ak, av, tables, pos, (fk, fv), k_scale=ks, v_scale=vs
+        )
+        return got, want, 2e-5
+    ak = jnp.asarray(rng.standard_normal((n_blocks, bs, n_kv, d)), jnp.float32)
+    av = jnp.asarray(rng.standard_normal((n_blocks, bs, n_kv, d)), jnp.float32)
+    got = paged_decode_attention(q, ak, av, tables, pos, fk, fv, interpret=True)
+    want = paged_attention_ref(q, ak, av, tables, pos, (fk, fv))
+    return got, want, 2e-5
+
+
+def _probe():
+    import numpy as np
+
+    from nnstreamer_tpu.kv.block_attn import block_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 1, 2, 4)), jnp.float32)
+    arena = jnp.asarray(rng.standard_normal((4, 2, 2, 4)), jnp.float32)
+    tables = jnp.asarray([[0, 1]], jnp.int32)
+    pos = jnp.asarray([3], jnp.int32)
+    fk = jnp.asarray(rng.standard_normal((1, 1, 2, 4)), jnp.float32)
+    fv = jnp.asarray(rng.standard_normal((1, 1, 2, 4)), jnp.float32)
+    np.asarray(block_attention(
+        q, arena, arena, tables, pos, (fk, fv), impl="pallas", interpret=True
+    ))
+
+
+_registry.register(_registry.KernelSpec(
+    name="paged_decode_attention",
+    module=__name__,
+    ops=("block_attention", "serving_attention"),
+    dtypes=("float32", "bfloat16", "int8"),
+    cases=(
+        _registry.ShapeCase(
+            "b2-full-and-empty", {"bs": 8, "nb": 3, "n_blocks": 8},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "gqa-partial-fill",
+            {"b": 2, "h": 4, "n_kv": 2, "bs": 8, "nb": 4, "n_blocks": 12,
+             "pos": [5, 27]},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "int8-arena",
+            {"b": 2, "h": 2, "bs": 8, "nb": 3, "n_blocks": 8,
+             "dtype": "int8", "pos": [9, 24]},
+            tier1=True,
+        ),
+        _registry.ShapeCase(
+            "serve-paged-2048",
+            {"b": 8, "h": 8, "d": 128, "bs": 128, "nb": 16, "n_blocks": 128},
+        ),
+    ),
+    plan=_plan,
+    run_case=_run_case,
+    probe=_probe,
+))

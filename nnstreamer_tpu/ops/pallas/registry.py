@@ -1,0 +1,178 @@
+"""Self-describing Pallas kernel registry (nns-kscope substrate).
+
+Tensor Processing Primitives (PAPERS.md) argues accelerator kernels
+should be compositions of a *described* primitive set — a description
+an analyzer can consume. This module is that description for the
+in-tree kernels: each kernel module registers a :class:`KernelSpec`
+carrying its grid function, BlockSpec geometry (as plain-python
+:class:`BlockDesc` rows sharing the REAL index-map callables the
+``pl.pallas_call`` uses), scratch shapes, scalar-prefetch operands,
+dtype support, jnp reference, and a representative shape grid.
+
+Consumers:
+
+- ``analysis/kernels.py`` (nns-kscope) derives per-grid-step VMEM
+  residency, lane/sublane tile alignment, index-map hazards and a
+  roofline cost row per registered kernel x shape — statically, no
+  device, nothing allocated.
+- ``ops/pallas/_compat.pallas_ok`` consults per-kernel dtype support so
+  an unsupported-dtype ``impl="pallas"`` request degrades to the jnp
+  path with a logged reason instead of a trace-time Mosaic error.
+- ``nns-kscope --self-check`` runs every kernel against its jnp
+  reference over the case grid in interpret mode (the differential
+  sweep tests/test_pallas.py parametrizes from).
+- ``nns-kscope --engage`` / ``bench.py --capture-tpu`` run each
+  kernel's tiny probe and diff the dispatch tally (ops/dispatch.py) to
+  prove the requested pallas path engaged.
+
+Everything here is abstract: no jax import, no shapes allocated. The
+kernel modules self-register at import; ``ensure_registered()`` pulls
+them in for consumers that start from the registry side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# -- geometry descriptors ----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BlockDesc:
+    """One pallas_call operand/result block: the BlockSpec geometry as
+    data. ``index_map`` is the SAME callable the kernel's BlockSpec
+    uses (grid indices first, then any scalar-prefetch arrays), so the
+    analyzer enumerates exactly what the DMA engine would fetch."""
+
+    name: str
+    kind: str                       # "in" | "out"
+    array_shape: Tuple[int, ...]    # full operand shape
+    block_shape: Tuple[int, ...]    # BlockSpec block_shape
+    dtype: str                      # numpy dtype name ("float32", ...)
+    index_map: Callable[..., Tuple[int, ...]]
+
+
+@dataclass(frozen=True)
+class ScratchDesc:
+    """One VMEM scratch allocation (``pltpu.VMEM(shape, dtype)``)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class PrefetchDesc:
+    """One scalar-prefetch operand (SMEM): declared shape plus a
+    ``make()`` producing representative values for index-map
+    enumeration (e.g. a valid block table)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str = "int32"
+    make: Optional[Callable[[], Any]] = None
+
+
+@dataclass(frozen=True)
+class LaunchPlan:
+    """The abstract launch a kernel would issue for one shape case:
+    what ``pl.pallas_call`` gets, minus the device."""
+
+    grid: Tuple[int, ...]
+    blocks: Tuple[BlockDesc, ...]
+    scratch: Tuple[ScratchDesc, ...] = ()
+    prefetch: Tuple[PrefetchDesc, ...] = ()
+    flops: int = 0
+    notes: str = ""
+
+
+@dataclass(frozen=True)
+class ShapeCase:
+    """One representative shape: ``params`` feeds ``KernelSpec.plan``
+    and ``run_case``. ``tier1`` cases ride the fast differential sweep
+    (and the tier-1 parity tests); the full grid is the `slow` sweep."""
+
+    name: str
+    params: Dict[str, Any] = field(default_factory=dict)
+    tier1: bool = False
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered kernel. ``ops`` are the dispatch-tally op names
+    (ops/dispatch.py) this kernel engages through — the first is the
+    primary the ``--engage`` probe diffs. ``plan(params)`` derives the
+    abstract launch; ``run_case(params)`` returns
+    ``(pallas_out, reference_out, atol)`` in interpret mode;
+    ``probe()`` is a tiny invocation through the public dispatching op
+    with pallas explicitly requested."""
+
+    name: str
+    module: str
+    ops: Tuple[str, ...]
+    dtypes: Tuple[str, ...]
+    cases: Tuple[ShapeCase, ...]
+    plan: Callable[[Dict[str, Any]], LaunchPlan]
+    run_case: Callable[[Dict[str, Any]], Tuple[Any, Any, float]]
+    probe: Callable[[], None]
+
+    @property
+    def dispatch_op(self) -> str:
+        return self.ops[0]
+
+    def tier1_cases(self) -> Tuple[ShapeCase, ...]:
+        return tuple(c for c in self.cases if c.tier1)
+
+
+# -- the registry ------------------------------------------------------------
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+
+
+def register(spec: KernelSpec) -> KernelSpec:
+    """Idempotent by name (modules may be re-imported under test)."""
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def ensure_registered() -> None:
+    """Import the kernel package so every in-tree kernel module has
+    self-registered (consumers that start from the registry side)."""
+    import nnstreamer_tpu.ops.pallas  # noqa: F401  (import side effect)
+
+
+def names() -> Tuple[str, ...]:
+    ensure_registered()
+    return tuple(sorted(_REGISTRY))
+
+
+def all_specs() -> Tuple[KernelSpec, ...]:
+    ensure_registered()
+    return tuple(_REGISTRY[n] for n in sorted(_REGISTRY))
+
+
+def get(name: str) -> KernelSpec:
+    ensure_registered()
+    return _REGISTRY[name]
+
+
+def find(name: str) -> Optional[KernelSpec]:
+    ensure_registered()
+    return _REGISTRY.get(name)
+
+
+def supports_dtype(kernel: str, dtype: Any) -> bool:
+    """Does the registered kernel support this input dtype? Unknown
+    kernels have no opinion (True) — the registry must never veto a
+    kernel it has not described."""
+    spec = find(kernel)
+    if spec is None:
+        return True
+    import numpy as np
+
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    return name in spec.dtypes

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from nnstreamer_tpu.ops.pallas import registry as kreg
 from nnstreamer_tpu.ops.pallas.flash_attention import flash_attention, make_flash_attention
 from nnstreamer_tpu.parallel.ring_attention import dense_attention
 
@@ -75,14 +76,21 @@ class TestDecodeAttention:
         o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
         return o
 
+    # the shape grid lives in the kernel registry (the single source of
+    # parity shapes — nns-kscope sweeps the same cases); the
+    # non-dividing lengths pin ceil-covered masked tail blocks, a prime
+    # length must keep full-width blocks (ADVICE r2). The independent
+    # masked-softmax reference above stays — registry run_case parity
+    # against the in-tree jnp reference is the sweep's job.
     @pytest.mark.parametrize(
         "s_len,block_k",
         [
-            (64, 16), (48, 16), (40, 128),
-            # non-dividing lengths: the grid ceil-covers the cache and
-            # masks the tail block — a prime length must keep full-width
-            # blocks, not degenerate to 1-row blocks (ADVICE r2)
-            (97, 32), (130, 128), (33, 16),
+            pytest.param(
+                c.params["s_len"], c.params.get("block_k", 128), id=c.name
+            )
+            for c in kreg.get("decode_attention").cases
+            if c.params.get("dtype", "float32") == "float32"
+            and c.params.get("s_len", 0) <= 256
         ],
     )
     def test_matches_masked_softmax(self, s_len, block_k):

@@ -76,6 +76,20 @@ def test_xray_chain_codes_wired_both_ways():
     assert not problems, "\n".join(problems)
 
 
+def test_kscope_kernel_codes_and_registry_wired_both_ways():
+    """nns-kscope --self-check wiring: every kernel diagnostic
+    (NNS-W127..W129) is cataloged, has an emitter in
+    analysis/kernels.py, and is documented in docs/kernel-analysis.md
+    AND docs/linting.md; every public ops/pallas kernel entry point has
+    a KernelSpec of the same name and vice versa; and the registered
+    dispatch ops equal ops/dispatch.KNOWN_OPS both ways
+    (tools/check_style.py runs the same gate on whole-tree runs)."""
+    from nnstreamer_tpu.analysis.selfcheck import kscope_self_check
+
+    problems = kscope_self_check()
+    assert not problems, "\n".join(problems)
+
+
 @pytest.mark.slow
 def test_documented_pipelines_xray_clean():
     """Every pipeline string embedded in examples/ and docs/ must xray

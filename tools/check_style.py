@@ -17,6 +17,10 @@ Rules for tracked .py files (and the C++ under native/):
   emitters<->catalog<->docs both ways) and every pipeline string in
   examples/ and docs/ xrays clean of the chain diagnostics (same
   whole-tree-only gating)
+- `nns-kscope --self-check` wiring passes (kernel diagnostics
+  W127-W129 wired emitters<->catalog<->docs, pallas registry complete
+  against the package and dispatch.KNOWN_OPS; the interpret-mode
+  parity sweep stays in the test suite, not here)
 
 Usage: python tools/check_style.py [paths...]   (default: repo tree)
 Exit 0 clean, 1 with findings listed one per line.
@@ -132,6 +136,23 @@ def run_xray_self_check() -> list:
     return [f"xray: {p}" for p in xray_self_check()]
 
 
+def run_kscope_self_check() -> list:
+    """Run nns-kscope's wiring self-check in-process: a kernel
+    diagnostic (NNS-W127..W129) missing from the catalog, without an
+    emitter, or undocumented in docs/kernel-analysis.md +
+    docs/linting.md is a style problem — as is a public ops/pallas
+    kernel without a registered KernelSpec, or a dispatch op outside
+    the registry's coverage."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.selfcheck import kscope_self_check
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"nns-kscope --self-check could not run: {exc}"]
+    return [f"kscope: {p}" for p in kscope_self_check()]
+
+
 def documented_pipeline_strings() -> list:
     """(source, description) for every pipeline launch string embedded
     in examples/*.py and docs/*.md — double-quoted launch strings plus
@@ -236,6 +257,7 @@ def main(argv=None) -> int:
         problems.extend(run_obs_self_check())
         problems.extend(run_race_lint_gate())
         problems.extend(run_xray_self_check())
+        problems.extend(run_kscope_self_check())
         problems.extend(run_xray_docs_gate())
     for p in problems:
         print(p)
