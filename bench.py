@@ -2599,6 +2599,8 @@ def _pipeline_llm(smoke: bool) -> None:
     _mark("paged tok/s measured")
     plane_cell = _llm_through_plane_cell(model_kw, rng) or {}
     _mark("through-plane measured")
+    disagg_cell = _llm_disagg_cell(model_kw, rng) or {}
+    _mark("disagg measured")
     rec = {
         "metric": "llm_paged_vs_slot_capacity_at_fixed_kv_hbm",
         "kv_budget_tokens": budget_tokens,
@@ -2632,6 +2634,7 @@ def _pipeline_llm(smoke: bool) -> None:
         "host": _platform.node(),
     }
     rec.update(plane_cell)
+    rec.update(disagg_cell)
     print(json.dumps(rec))
 
 
@@ -2737,6 +2740,128 @@ def _llm_through_plane_cell(model_kw: dict, rng) -> dict | None:
         "llm_plane_kv_attn": st.get("kv_attn"),
         # per-stream SLO ledgers: each src reports ONLY its own rows
         "llm_plane_stream_request_rows": per_stream_reqs,
+    }
+
+
+def _llm_disagg_cell(model_kw: dict, rng) -> dict | None:
+    """Disaggregated prefill/decode vs colocated serving (serving_plane/
+    disagg.py, docs/llm-serving.md "Disaggregated serving"): the same
+    request set runs once on a single colocated paged server and once
+    split across a role=prefill server handing KV spans to a
+    role=decode peer over the real CTRL channel. Two columns of
+    aggregate decode tok/s plus TTFT p50/p99 from the submitting
+    server's SLO ledger (the first token always materializes on the
+    prefill engine before extraction, so the latency rows are
+    apples-to-apples), and the decode side's ``kv_prefill_chunks``
+    counter pinned at 0 — the handoff must re-prefill nothing."""
+    import threading
+
+    import numpy as np
+
+    from nnstreamer_tpu.edge.query import TensorQueryServerSrc
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+    from nnstreamer_tpu.tensors.frame import Frame
+
+    opts = {k: str(v) for k, v in model_kw.items()}
+    opts["seed"] = "7"
+    n_reqs, budget = 6, 24
+    prompts = [
+        rng.integers(1, model_kw["vocab"], (16 + 2 * i,)).astype(np.int32)
+        for i in range(n_reqs)
+    ]
+
+    def _mk_srv(srv_id, **kw):
+        return _LlmServer(
+            model="zoo:transformer_lm", options=dict(opts), n_slots=8,
+            max_len=96, prompt_len=32, default_new=budget,
+            kv_layout="paged", block_size=16, kv_blocks=64,
+            srv_id=srv_id, **kw,
+        )
+
+    def _run(srv):
+        """Submit the request set, pump to completion; returns
+        (tok_s, sorted ttft_ms rows from the SLO ledger)."""
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            srv.submit(Frame((p,), meta={"req": f"dg{i}"}))
+        deadline = t0 + 300.0
+        n_toks = 0
+        done = 0
+        while done < n_reqs:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("llm disagg cell drained early")
+            srv.pump()
+            while srv._out:
+                toks, _meta = srv.pop()
+                n_toks += len(toks)
+                done += 1
+        dt = time.perf_counter() - t0
+        ttfts = sorted(
+            row["ttft_ms"] for row in srv.cb.requests().values()
+            if row.get("ttft_ms") is not None
+        )
+        return (n_toks / dt if dt > 0 else 0.0), ttfts
+
+    def _pct(rows, q):
+        if not rows:
+            return None
+        return _round(rows[min(len(rows) - 1, int(q * (len(rows) - 1)))], 1)
+
+    colo = _mk_srv("9300")
+    try:
+        colo_tok_s, colo_ttfts = _run(colo)
+    finally:
+        colo.release_plane()
+
+    decode = _mk_srv("9301", role="decode")
+    src = TensorQueryServerSrc("bench-disagg-d", port=0, id="bench-dg")
+    src.start()
+    stop = threading.Event()
+
+    def _ctrl():
+        while not stop.is_set():
+            src.generate()
+
+    def _pump():
+        while not stop.is_set():
+            try:
+                decode.pump()
+            except Exception:  # noqa: BLE001 — teardown race
+                pass
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=_ctrl, daemon=True),
+               threading.Thread(target=_pump, daemon=True)]
+    for t in threads:
+        t.start()
+    prefill = _mk_srv(
+        "9302", role="prefill",
+        decode_peers=f"127.0.0.1:{src.bound_port}/9301",
+    )
+    try:
+        dis_tok_s, dis_ttfts = _run(prefill)
+        decode_chunks = decode.cb.stats().get("kv_prefill_chunks", -1)
+        counts = prefill.stats().get("disagg", {}).get("counts", {})
+    finally:
+        prefill.release_plane()
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        src.stop()
+        decode.release_plane()
+    return {
+        "llm_disagg_requests": n_reqs,
+        "llm_colocated_tok_s": _round(colo_tok_s, 1),
+        "llm_disagg_tok_s": _round(dis_tok_s, 1),
+        "llm_colocated_ttft_p50_ms": _pct(colo_ttfts, 0.5),
+        "llm_colocated_ttft_p99_ms": _pct(colo_ttfts, 0.99),
+        "llm_disagg_ttft_p50_ms": _pct(dis_ttfts, 0.5),
+        "llm_disagg_ttft_p99_ms": _pct(dis_ttfts, 0.99),
+        # the zero-re-prefill pin: every span adopted whole, no chunk
+        # program ever ran on the decode peer
+        "llm_disagg_decode_prefill_chunks": decode_chunks,
+        "llm_disagg_handoffs": counts.get("handoff", 0),
+        "llm_disagg_relayed": counts.get("relayed", 0),
     }
 
 

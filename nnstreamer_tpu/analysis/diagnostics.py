@@ -274,6 +274,14 @@ CATALOG: Dict[str, Tuple[Severity, str, str]] = {
         "registered support, the NNS_TPU_PALLAS_DISABLE kill switch is "
         "set, or the configured mode has no kernel at all",
     ),
+    "NNS-W130": (
+        Severity.WARNING, "prefill-role-no-decode-peer",
+        "an LLM serversink declares role=prefill but names no "
+        "decode-peers: every request it prefills decodes locally — the "
+        "disaggregation it was configured for never happens, and with "
+        "no checkpoint-dir either, a drain abandons the in-flight "
+        "generations it was supposed to hand off",
+    ),
     # -- nns-san race lint (analysis/racecheck.py): findings over SOURCE ----
     # code, not pipelines; `element` carries file:line
     "NNS-R001": (

@@ -797,6 +797,39 @@ def _llm_drain_loss_pass(pipeline: Pipeline, report: LintReport) -> None:
         )
 
 
+def _disagg_role_pass(pipeline: Pipeline, report: LintReport) -> None:
+    """NNS-W130: prefill-role-no-decode-peer — role=prefill is a
+    promise that prefilled requests LEAVE: their KV spans ship to a
+    decode peer and this server's pool churns through prompt
+    processing only (docs/llm-serving.md "Disaggregated serving"). A
+    prefill server with no decode-peers keeps every generation local —
+    the colocated behavior the operator explicitly opted out of — and
+    with no checkpoint-dir a drain of that unexpected decode load
+    abandons it."""
+    from nnstreamer_tpu.elements.llm_serve import LlmServerSink
+
+    for e in pipeline.elements:
+        if not isinstance(e, LlmServerSink):
+            continue
+        if str(e.get_property("role") or "") != "prefill":
+            continue
+        if str(e.get_property("decode-peers") or "").strip():
+            continue
+        if e.get_property("checkpoint-dir"):
+            continue
+        report.add(
+            "NNS-W130", e.name,
+            "role=prefill with no decode-peers: every prefilled "
+            "request decodes locally, so the configured "
+            "disaggregation never happens and drains abandon the "
+            "unexpected local decode load",
+            "set decode-peers=host:port[/llm-id],... (KV-span "
+            "handoff to the decode tier) or drop role=prefill; "
+            "checkpoint-dir at least recovers drains "
+            "(docs/llm-serving.md \"Disaggregated serving\")",
+        )
+
+
 def _replica_failover_pass(pipeline: Pipeline, report: LintReport) -> None:
     """NNS-W112: replicas=N promises the stream survives a dying
     replica, but with the default on-error=stop the day EVERY replica is
@@ -1284,6 +1317,7 @@ def lint(target: Union[str, Pipeline]) -> LintResult:
     _admission_pass(pipeline, report)
     _fleet_failover_pass(pipeline, report)
     _llm_drain_loss_pass(pipeline, report)
+    _disagg_role_pass(pipeline, report)
     _replica_failover_pass(pipeline, report)
     _resident_handoff_pass(pipeline, report)
     _model_sharing_pass(pipeline, report)

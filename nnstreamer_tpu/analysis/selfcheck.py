@@ -332,6 +332,64 @@ def kscope_self_check() -> List[str]:
     return problems
 
 
+# -- nns-disagg self-check: disagg codes + metrics wired both ways ----------
+
+_DISAGG_CODES = ("NNS-W130",)
+
+
+def disagg_self_check() -> List[str]:
+    """Validate the disaggregated-serving wiring both ways: every
+    disagg lint code is in the catalog, has an emitter in
+    analysis/lint.py, and is documented in docs/linting.md AND
+    docs/llm-serving.md; and both disagg metrics
+    (``nns_disagg_handoffs_total``, ``nns_route_prefix_hits_total``)
+    are in the METRIC_CATALOG with a live emitter in the serving/edge
+    code — a renamed counter cannot silently fall out of the docs."""
+    import importlib
+    import os
+
+    from nnstreamer_tpu.analysis.diagnostics import CATALOG
+
+    problems: List[str] = []
+    mod = importlib.import_module("nnstreamer_tpu.analysis.lint")
+    emitted = set(_CODE_REF.findall(inspect.getsource(mod)))
+    for code in _DISAGG_CODES:
+        if code not in CATALOG:
+            problems.append(f"disagg code {code} missing from the catalog")
+        if code not in emitted:
+            problems.append(
+                f"disagg code {code} has no emitter in analysis/lint.py"
+            )
+    for doc_name in ("linting.md", "llm-serving.md"):
+        doc = os.path.join(_repo_root(), "docs", doc_name)
+        if not os.path.isfile(doc):  # repo checkouts only
+            continue
+        with open(doc, encoding="utf-8") as f:
+            text = f.read()
+        for code in _DISAGG_CODES:
+            if code not in text:
+                problems.append(
+                    f"{code} is not documented in docs/{doc_name}"
+                )
+    from nnstreamer_tpu.obs.metrics import METRIC_CATALOG
+
+    wanted = {
+        "nns_disagg_handoffs_total": "nnstreamer_tpu.serving_plane.disagg",
+        "nns_route_prefix_hits_total": "nnstreamer_tpu.edge.query",
+    }
+    for metric, mod_name in wanted.items():
+        if metric not in METRIC_CATALOG:
+            problems.append(
+                f"disagg metric {metric} missing from METRIC_CATALOG"
+            )
+        src = inspect.getsource(importlib.import_module(mod_name))
+        if f'"{metric}"' not in src and f"'{metric}'" not in src:
+            problems.append(
+                f"disagg metric {metric} has no emitter in {mod_name}"
+            )
+    return problems
+
+
 def main(argv=None) -> int:  # pragma: no cover - thin wrapper
     problems = self_check()
     for p in problems:

@@ -25,6 +25,13 @@ binds a :class:`FleetEndpoints` selector instead of one socket:
   earns a second send (``hedge-after-ms``; negative = adaptive, from
   :class:`RttWindow`'s observed p99). Deterministic under an injected
   clock so the tests pin the schedule exactly.
+- **prefix-aware routing** — :class:`PrefixRouter` remembers which
+  endpoint last served each rolling-CRC prompt-prefix key
+  (:func:`prefix_route_keys`, the kv/blocks.py chain at routing
+  granularity) so a repeat-prefix LLM request lands on the server whose
+  pool already holds its longest cached prefix — cluster-wide prefix
+  sharing, not just per-process (docs/llm-serving.md "Disaggregated
+  serving").
 
 Everything here is pure selection/accounting logic — no sockets — so the
 tier-1 units run with fake clocks; the client element (edge/query.py)
@@ -37,6 +44,9 @@ import random
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
+from nnstreamer_tpu.kv.blocks import roll_hash
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import metrics as obs_metrics
 
@@ -158,8 +168,12 @@ class FleetEndpoints:
         """Ordered dispatch plan for ONE request: a due benched endpoint
         is prepended as a re-probe (its request falls through to the
         healthy rotation when the probe fails — the ReplicaSet idiom),
-        then the healthy round-robin. Draining endpoints rejoin only
-        when their retry-after elapsed and nothing healthier exists."""
+        then the healthy round-robin, least-loaded first: the rotation
+        is stably re-ordered by live ``inflight`` so an endpoint
+        sitting on slow requests stops collecting new ones while its
+        idle peers exist (ties keep the round-robin order, so an idle
+        fleet still spreads). Draining endpoints rejoin only when their
+        retry-after elapsed and nothing healthier exists."""
         now = self.clock()
         healthy = [
             e for e in self.endpoints if e.healthy and not e.draining
@@ -176,7 +190,9 @@ class FleetEndpoints:
         if healthy:
             start = self._rr % len(healthy)
             self._rr += 1
-            plan.extend(healthy[start:] + healthy[:start])
+            rotation = healthy[start:] + healthy[:start]
+            # stable: equal-inflight endpoints keep the rotation order
+            plan.extend(sorted(rotation, key=lambda e: e.inflight))
         elif due:
             # nothing healthy: give every due endpoint a shot rather
             # than exhausting behind one dead probe target
@@ -323,6 +339,76 @@ class ReplyDeduper:
 
     def seen(self, frame_id) -> bool:
         return frame_id in self._seen
+
+
+#: routing granularity for prompt-prefix keys (tokens per key). Coarser
+#: than many servers' kv block-size would miss shareable prefixes; finer
+#: costs meta bytes for depth no pool can hold. 16 matches the default
+#: ``block-size`` of tensor_llm_serversink.
+ROUTE_BLOCK = 16
+
+
+def prefix_route_keys(tokens, block: int = ROUTE_BLOCK,
+                      max_blocks: int = 32) -> List[str]:
+    """Rolling-CRC keys of a prompt's block-aligned prefixes — the
+    kv/blocks.py :func:`~nnstreamer_tpu.kv.blocks.roll_hash` chain at
+    routing granularity, one 8-hex-digit key per ``block`` tokens
+    (``keys[i]`` covers ``tokens[:(i+1)*block]``). Capped at
+    ``max_blocks`` keys: past 512 tokens the routing signal is already
+    decisive and meta bytes stop paying for themselves."""
+    toks = np.ascontiguousarray(
+        list(tokens)[: int(block) * int(max_blocks)], np.int32
+    )
+    h = 0
+    keys: List[str] = []
+    for i in range(len(toks) // int(block)):
+        h = roll_hash(h, toks[i * block:(i + 1) * block])
+        keys.append(f"{h:08x}")
+    return keys
+
+
+class PrefixRouter:
+    """Client-side cluster prefix index: which endpoint last served
+    each prompt-prefix key.
+
+    ``note(keys, addr)`` records a delivered reply's keys against the
+    endpoint that answered; ``best(keys)`` returns the
+    ``(addr, depth)`` of the longest recorded prefix of a new request
+    (deepest key first), or ``None`` when no endpoint is known to hold
+    any of it. The index is advisory — the caller still routes through
+    health/draining state and falls back to the least-loaded rotation —
+    so a stale entry costs one cold prefill, never correctness. Bounded
+    FIFO like :class:`ReplyDeduper`: an unbounded stream of novel
+    prompts cannot grow it forever."""
+
+    __slots__ = ("capacity", "_owner", "_order", "prefix_hits")
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = max(16, int(capacity))
+        self._owner: Dict[str, str] = {}
+        self._order: List[str] = []
+        self.prefix_hits = 0
+
+    def note(self, keys: Sequence[str], addr: str) -> None:
+        for k in keys:
+            if k not in self._owner:
+                self._order.append(k)
+            self._owner[k] = addr  # latest server to hold it wins
+        if len(self._order) > self.capacity:
+            evicted = self._order[: len(self._order) - self.capacity]
+            del self._order[: len(self._order) - self.capacity]
+            for k in evicted:
+                self._owner.pop(k, None)
+
+    def best(self, keys: Sequence[str]) -> Optional[Tuple[str, int]]:
+        for depth in range(len(keys), 0, -1):
+            addr = self._owner.get(keys[depth - 1])
+            if addr is not None:
+                return addr, depth
+        return None
+
+    def __len__(self) -> int:
+        return len(self._owner)
 
 
 class RttWindow:

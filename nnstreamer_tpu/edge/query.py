@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from nnstreamer_tpu import registry, trace
 from nnstreamer_tpu.obs import metrics as obs_metrics
 from nnstreamer_tpu.edge.admission import (
@@ -42,11 +44,14 @@ from nnstreamer_tpu.edge.admission import (
 from nnstreamer_tpu.edge.fleet import (
     FleetEndpoints,
     HedgeTimer,
+    PrefixRouter,
     ReplyDeduper,
     RttWindow,
     parse_hosts,
+    prefix_route_keys,
 )
 from nnstreamer_tpu.edge.serialize import (
+    ROUTE_META_KEY,
     Ctrl,
     Nack,
     decode_message,
@@ -252,11 +257,15 @@ class MigrationRefused(RuntimeError):
     """The peer answered the migration handshake with ``migrate_nack``:
     the span was NOT adopted (no handler, draining, capacity, corrupt
     span...). The source keeps the request — fall back to local
-    re-prefill resume."""
+    re-prefill resume. Capacity refusals carry the peer's
+    ``retry_after_ms`` hint (the PR-18 PoolCapacityError taxonomy on
+    the wire) so a disaggregated prefill server can back off instead
+    of hammering a full decode pool."""
 
-    def __init__(self, reason: str) -> None:
+    def __init__(self, reason: str, retry_after_ms: float = 0.0) -> None:
         super().__init__(reason)
         self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
 
 
 def register_migration_handler(llm_id: int, handler) -> None:
@@ -322,6 +331,21 @@ def probe_migration(host: str, port: int, tokens, llm_id: int = 0,
     """Ask the peer how many leading ``tokens`` its LLM server's prefix
     index already covers (full blocks only) — the warm-migration diet.
     Raises :class:`MigrationRefused` if the peer cannot host spans."""
+    return probe_migration_full(
+        host, port, tokens, llm_id=llm_id, connect_type=connect_type,
+        topic=topic, timeout=timeout,
+    )[0]
+
+
+def probe_migration_full(host: str, port: int, tokens, llm_id: int = 0,
+                         connect_type: str = "TCP",
+                         topic: str = "nns-query",
+                         timeout: float = 5.0):
+    """:func:`probe_migration` plus the peer's full probe-ack meta as a
+    dict — ``(shared_tokens, advert)``. Decode-role servers advertise
+    ``role`` / ``free_slots`` / ``free_blocks`` there (pool headroom +
+    prefix depth in one roundtrip), which the disaggregated prefill
+    side uses to pick the handoff target."""
     reply = _ctrl_roundtrip(
         host, port,
         encode_ctrl("migrate_probe", llm_id=int(llm_id),
@@ -329,8 +353,11 @@ def probe_migration(host: str, port: int, tokens, llm_id: int = 0,
         connect_type, topic, timeout,
     )
     if reply.op != "migrate_probe_ack":
-        raise MigrationRefused(str(reply.meta.get("reason", reply.op)))
-    return int(reply.meta.get("shared_tokens", 0))
+        raise MigrationRefused(
+            str(reply.meta.get("reason", reply.op)),
+            retry_after_ms=float(reply.meta.get("retry_after_ms", 0) or 0),
+        )
+    return int(reply.meta.get("shared_tokens", 0)), dict(reply.meta)
 
 
 def send_migration(host: str, port: int, span_bytes: bytes,
@@ -347,8 +374,35 @@ def send_migration(host: str, port: int, span_bytes: bytes,
         connect_type, topic, timeout,
     )
     if reply.op != "migrate_span_ack":
-        raise MigrationRefused(str(reply.meta.get("reason", reply.op)))
+        raise MigrationRefused(
+            str(reply.meta.get("reason", reply.op)),
+            retry_after_ms=float(reply.meta.get("retry_after_ms", 0) or 0),
+        )
     return int(reply.meta.get("rid", -1))
+
+
+def fetch_handoff(host: str, port: int, rid: int, llm_id: int = 0,
+                  connect_type: str = "TCP", topic: str = "nns-query",
+                  timeout: float = 5.0):
+    """Poll a decode peer for the outcome of a handed-off generation:
+    ``None`` while rid is still decoding, else the full token list
+    exactly once (the peer forgets the rid on fetch, so the prefill
+    side — the only DELIVER path the client knows — cannot
+    double-emit). Raises :class:`MigrationRefused` on a nack (rid
+    unknown / peer draining): the caller's fallback ladder decides."""
+    reply = _ctrl_roundtrip(
+        host, port,
+        encode_ctrl("disagg_fetch", llm_id=int(llm_id), rid=int(rid)),
+        connect_type, topic, timeout,
+    )
+    if reply.op != "disagg_fetch_ack":
+        raise MigrationRefused(
+            str(reply.meta.get("reason", reply.op)),
+            retry_after_ms=float(reply.meta.get("retry_after_ms", 0) or 0),
+        )
+    if not int(reply.meta.get("done", 0)):
+        return None
+    return [int(t) for t in reply.meta.get("tokens", [])]
 
 
 CONNECT_TYPES = ("TCP", "MQTT", "HYBRID", "SHM")
@@ -474,6 +528,13 @@ class TensorQueryClient(HostElement):
             "second endpoint after this delay, first reply wins "
             "(0 = off, <0 = adaptive from the observed reply p99)",
         ),
+        "prefix-route": PropSpec(
+            "bool", False,
+            desc="fleet mode: stamp rolling-CRC prompt-prefix keys into "
+            "the request meta and prefer the endpoint that last served "
+            "the longest matching prefix (cluster-wide KV prefix "
+            "sharing; falls back to the least-loaded rotation)",
+        ),
         "timeout": PropSpec("float", 10.0, desc="per-request (s)"),
         "connect-type": PropSpec("enum", "TCP", CONNECT_TYPES),
         "topic": PropSpec("str", "nns-query"),
@@ -543,6 +604,9 @@ class TensorQueryClient(HostElement):
         self.stale_replies = 0     # late replies to already-terminal requests
         self._failover_ctr = None
         self._hedge_ctr = None
+        self.prefix_route = bool(self.get_property("prefix-route", False))
+        self._router: Optional[PrefixRouter] = None
+        self._pfx_hit_ctr = None
         if hosts_raw:
             try:
                 targets = parse_hosts(hosts_raw)
@@ -558,6 +622,8 @@ class TensorQueryClient(HostElement):
             )
             self._dedup = ReplyDeduper()
             self._rtts = RttWindow()
+            if self.prefix_route:
+                self._router = PrefixRouter()
         # distributed correlation (docs/observability.md): every request
         # carries a frame_id that survives the hop via the wire meta
         # blob, so client and server traces merge into one timeline
@@ -736,7 +802,25 @@ class TensorQueryClient(HostElement):
             frame = frame.with_meta(deadline_ms=self.deadline_ms)
         if self.priority is not None and "priority" not in frame.meta:
             frame = frame.with_meta(priority=self.priority)
+        if self._router is not None and ROUTE_META_KEY not in frame.meta:
+            keys = self._route_keys_of(frame)
+            if keys:
+                # _wire_meta keeps scalars only, so the key chain rides
+                # flattened as one dot-joined hex string
+                frame = frame.with_meta(**{ROUTE_META_KEY: ".".join(keys)})
         return frame, fid
+
+    @staticmethod
+    def _route_keys_of(frame: Frame) -> List[str]:
+        """Rolling-CRC prefix keys of an LLM prompt frame — the first
+        tensor when it is integer-typed (token ids); anything else
+        (images, floats) routes by load alone."""
+        if not frame.tensors:
+            return []
+        arr = np.asarray(frame.to_host().tensors[0])
+        if not np.issubdtype(arr.dtype, np.integer):
+            return []
+        return prefix_route_keys(arr.ravel())
 
     def _finish_reply(self, msg, frame: Frame, fid, t_req: float):
         """Trace + metrics + reply normalization shared by both request
@@ -891,6 +975,17 @@ class TensorQueryClient(HostElement):
         pending_hint_s = 0.0  # retry-after carried into the next round
 
         failed_eps = 0        # endpoints that failed/NACKed this request
+        # prefix-aware routing: prefer the endpoint that last served the
+        # longest recorded prefix of this prompt — advisory only, the
+        # health/draining plan still decides who is sendable at all
+        route_keys: List[str] = []
+        pref_addr = None
+        if self._router is not None:
+            pfx = frame.meta.get(ROUTE_META_KEY)
+            route_keys = str(pfx).split(".") if pfx else []
+            best = self._router.best(route_keys) if route_keys else None
+            if best is not None:
+                pref_addr = best[0]
 
         def _send_next(is_hedge: bool = False):
             """Send this request to the next endpoint the plan allows;
@@ -900,7 +995,11 @@ class TensorQueryClient(HostElement):
             socket, unresolvable) or after the request was in flight."""
             nonlocal sends, failed_eps
             last_exc = None
-            for ep in self._fleet.plan():
+            plan = self._fleet.plan()
+            if pref_addr is not None:
+                # stable: non-preferred endpoints keep the plan's order
+                plan.sort(key=lambda e: e.addr != pref_addr)
+            for ep in plan:
                 if ep.idx in tried or any(e is ep for e, _t in inflight):
                     continue
                 try:
@@ -929,6 +1028,9 @@ class TensorQueryClient(HostElement):
                     self._count_hedge()
                 elif failed_eps:
                     self._count_failover()
+                if pref_addr is not None and ep.addr == pref_addr \
+                        and not is_hedge:
+                    self._count_prefix_hit()
                 return True, None
             return False, last_exc
 
@@ -1076,6 +1178,10 @@ class TensorQueryClient(HostElement):
             for e, _t in inflight:
                 e.inflight = max(0, e.inflight - 1)
             self._fleet.record_ok(ep)
+            if self._router is not None and route_keys:
+                # the answering endpoint now holds this prompt's KV
+                # prefix — future repeat-prefix requests prefer it
+                self._router.note(route_keys, ep.addr)
             return self._finish_reply(msg, frame, fid, t_req)
 
     def _count_failover(self) -> None:
@@ -1100,11 +1206,22 @@ class TensorQueryClient(HostElement):
             )
         self._hedge_ctr.inc()
 
+    def _count_prefix_hit(self) -> None:
+        self._router.prefix_hits += 1
+        reg = self._obs_reg
+        if reg is None:
+            return
+        if self._pfx_hit_ctr is None:
+            self._pfx_hit_ctr = reg.counter(
+                "nns_route_prefix_hits_total", element=self.name
+            )
+        self._pfx_hit_ctr.inc()
+
     def fleet_stats(self) -> Dict[str, object]:
         """Executor.stats() hook (``fleet_*`` keys; nns-top --fleet)."""
         if self._fleet is None:
             return {}
-        return {
+        out = {
             "endpoints": self._fleet.snapshot(),
             "healthy": self._fleet.healthy_count(),
             "failovers": self.fleet_failovers,
@@ -1112,6 +1229,10 @@ class TensorQueryClient(HostElement):
             "duplicate_replies": self._dedup.duplicates,
             "stale_replies": self.stale_replies,
         }
+        if self._router is not None:
+            out["prefix_hits"] = self._router.prefix_hits
+            out["prefix_index"] = len(self._router)
+        return out
 
     def _count_nack(self, reason: str) -> None:
         reg = self._obs_reg
@@ -1304,10 +1425,16 @@ class TensorQueryServerSrc(Source):
         if msg.op == "drain":
             self.drain()
             return
-        if msg.op not in ("migrate_probe", "migrate_span"):
+        if msg.op not in ("migrate_probe", "migrate_span", "disagg_fetch"):
             return  # unknown ctrl: ignore (both ends live in-tree)
-        if self.state == SRV_DRAINING:
-            reply = encode_ctrl("migrate_nack", reason="draining")
+        # spans must not LAND on a draining endpoint — but disagg_fetch
+        # moves finished results OUT, and a draining decode server only
+        # quiesces once its parked handoffs are collected
+        if self.state == SRV_DRAINING and msg.op != "disagg_fetch":
+            reply = encode_ctrl(
+                "migrate_nack", reason="draining",
+                retry_after_ms=float(self._adm_cfg.retry_after_ms),
+            )
         else:
             handler = _get_migration_handler(
                 int(msg.meta.get("llm_id", 0) or 0)
@@ -1322,18 +1449,52 @@ class TensorQueryServerSrc(Source):
                         n = handler.migration_probe(
                             msg.meta.get("tokens", [])
                         )
-                        reply = encode_ctrl(
-                            "migrate_probe_ack", shared_tokens=int(n)
-                        )
+                        # decode-role servers piggyback their pool
+                        # headroom advert on the probe ack — one
+                        # roundtrip answers "how warm AND how full"
+                        advert = getattr(handler, "migration_advert",
+                                         None)
+                        extra = dict(advert()) if advert else {}
+                        extra["shared_tokens"] = int(n)
+                        reply = encode_ctrl("migrate_probe_ack", **extra)
+                    elif msg.op == "disagg_fetch":
+                        fetch = getattr(handler, "disagg_fetch", None)
+                        if fetch is None:
+                            reply = encode_ctrl(
+                                "migrate_nack", reason="no-disagg-role"
+                            )
+                        else:
+                            toks = fetch(int(msg.meta.get("rid", -1)))
+                            if toks is None:
+                                reply = encode_ctrl(
+                                    "disagg_fetch_ack", done=0
+                                )
+                            else:
+                                reply = encode_ctrl(
+                                    "disagg_fetch_ack", done=1,
+                                    tokens=[int(t) for t in toks],
+                                )
                     else:
                         rid = handler.migration_adopt(msg.payload)
                         reply = encode_ctrl(
                             "migrate_span_ack", rid=int(rid)
                         )
                 except Exception as exc:  # span taxonomy → wire reason
+                    # capacity refusals are retryable, not fatal: NACK
+                    # with the admission retry hint instead of letting
+                    # the pool error crash the serversrc service loop
+                    from nnstreamer_tpu.kv.blocks import PoolCapacityError
+                    from nnstreamer_tpu.kv.migrate import SpanCapacityError
+                    extra = {}
+                    if isinstance(exc, (PoolCapacityError,
+                                        SpanCapacityError)):
+                        extra["retry_after_ms"] = float(
+                            self._adm_cfg.retry_after_ms
+                        )
                     reply = encode_ctrl(
                         "migrate_nack",
                         reason=f"{type(exc).__name__}: {exc}",
+                        **extra,
                     )
         try:
             self._transport.send(cid, reply)

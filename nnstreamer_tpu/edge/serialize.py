@@ -67,6 +67,14 @@ _WIRE_META_SKIP = frozenset({
     "client_id", "wall_t0", "admit_t", "_nns_srv", "_nns_budget_released",
 })
 
+#: request-meta key carrying the prompt's rolling-CRC prefix keys as one
+#: dot-joined hex string (``_wire_meta`` keeps scalars only, so the list
+#: rides flattened). Stamped by the fleet client, echoed back in the
+#: reply meta like any propagatable key — the PrefixRouter learns which
+#: endpoint answered which prefix from the echo (docs/edge-serving.md
+#: "Prefix-aware routing").
+ROUTE_META_KEY = "_nns_pfx"
+
 
 class Nack:
     """A structured rejection from the serving plane (docs/
@@ -96,9 +104,13 @@ class Ctrl:
     live-migration handshake (docs/llm-serving.md "Migration &
     recovery"): ``migrate_probe`` / ``migrate_probe_ack`` (prefix
     coverage query before shipping), ``migrate_span`` /
-    ``migrate_span_ack`` (the KV span itself riding ``payload``).
-    ``payload`` is opaque trailing bytes after the meta blob — v1/v2
-    decoders ignored trailing CTRL bytes, so no version bump."""
+    ``migrate_span_ack`` (the KV span itself riding ``payload``), and
+    the disaggregated-serving poll (docs/llm-serving.md "Disaggregated
+    serving"): ``disagg_fetch`` / ``disagg_fetch_ack`` — the prefill
+    server collecting a handed-off generation's finished tokens from
+    its decode peer. ``payload`` is opaque trailing bytes after the
+    meta blob — v1/v2 decoders ignored trailing CTRL bytes, so no
+    version bump."""
 
     __slots__ = ("op", "meta", "payload")
 

@@ -208,7 +208,39 @@ class _LlmServer:
                  plane: str = "", plane_weight: float = 1.0,
                  srv_id: str = "0", migrate_to: str = "",
                  checkpoint_every_tokens: int = 0,
-                 checkpoint_dir: str = ""):
+                 checkpoint_dir: str = "",
+                 role: str = "", decode_peers: str = ""):
+        role = str(role or "")
+        decode_peers = str(decode_peers or "")
+        if role not in ("", "prefill", "decode"):
+            raise ElementError(
+                f"tensor_llm_serversink: role={role!r} must be "
+                "prefill or decode"
+            )
+        if decode_peers and role != "prefill":
+            raise ElementError(
+                "tensor_llm_serversink: decode-peers needs role=prefill "
+                "(only the prefill role ships spans to decode peers)"
+            )
+        if role:
+            # disaggregated serving moves block-table KV spans between
+            # roles (docs/llm-serving.md "Disaggregated serving") —
+            # meaningless for the contiguous slot cache, refused on a
+            # shared plane like migrate-to/checkpoint-*
+            if kv_layout != "paged":
+                raise ElementError(
+                    "tensor_llm_serversink: role=prefill/decode needs "
+                    "kv-layout=paged (handoffs are block-table spans)"
+                )
+            if plane:
+                from nnstreamer_tpu.serving_plane.llm import LlmPlaneError
+
+                raise LlmPlaneError(
+                    f"llm plane {plane!r}: role= refused — plane-shared "
+                    "batchers cannot extract or adopt request spans; "
+                    "serve the role with a private kv-layout=paged "
+                    "batcher instead"
+                )
         if (migrate_to or checkpoint_dir or checkpoint_every_tokens):
             # migration + crash recovery (docs/llm-serving.md
             # "Migration & recovery") move block-table KV spans — they
@@ -230,6 +262,23 @@ class _LlmServer:
                     "or checkpoint requests; serve with a private "
                     "kv-layout=paged batcher instead"
                 )
+        self.role = role
+        self._disagg = None  # DisaggController (prefill role with peers)
+        self._disagg_done: Dict[int, list] = {}  # decode role: rid→tokens
+        if role == "prefill" and decode_peers:
+            # built BEFORE the batcher so a malformed decode-peers spec
+            # fails loudly without paying the model load
+            from nnstreamer_tpu.serving_plane.disagg import DisaggController
+
+            try:
+                self._disagg = DisaggController(
+                    decode_peers,
+                    llm_id=int(srv_id) if str(srv_id).isdigit() else 0,
+                )
+            except ValueError as exc:
+                raise ElementError(
+                    f"tensor_llm_serversink: {exc}"
+                ) from exc
         if speculate_model and speculate != -1 and speculate < 2:
             # a draft model exists ONLY to propose speculate=k chunks;
             # without this, every request would pay the draft prefill
@@ -461,12 +510,18 @@ class _LlmServer:
                     toks = parts.get(rid)
                     if toks is None:
                         continue
+                    if self.role == "decode" and meta.get("_nns_disagg"):
+                        continue  # fetched whole by the prefill side
                     harvested |= self._stream_new_locked(rid, meta, toks)
             for rid in list(self._pending):
                 toks = self.cb.result(rid)
                 if toks is not None:
                     meta = self._pending.pop(rid)
-                    if self.stream:
+                    park = (
+                        self.role == "decode"
+                        and bool(meta.get("_nns_disagg"))
+                    )
+                    if self.stream and not park:
                         # a concurrent pump's step may have finished the
                         # request AFTER our catch-up pass above — emit the
                         # tail tokens per-frame before the done frame so
@@ -474,7 +529,14 @@ class _LlmServer:
                         self._stream_new_locked(rid, meta, toks)
                         meta = {**meta, "stream": True, "done": True}
                     self._sent.pop(rid, None)
-                    self._out.append((toks, meta))
+                    if park:
+                        # a handed-off generation finished HERE, but the
+                        # prefill side owns DELIVER (at-most-once rides
+                        # its unchanged frame_id): park the tokens for
+                        # its disagg_fetch instead of emitting
+                        self._disagg_done[rid] = list(toks)
+                    else:
+                        self._out.append((toks, meta))
                     finished.append(rid)
                     harvested = True
         if self._ckpt_dir:
@@ -482,6 +544,10 @@ class _LlmServer:
                 self._ckpt_drop(rid)
             if self._ckpt_every:
                 self._checkpoint_tick()
+        if self._disagg is not None and not self.stopped:
+            # prefill role: offload freshly-extractable requests to the
+            # decode peers and relay finished handoffs into _out
+            harvested |= self._disagg.tick(self)
         return bool(emitted) or harvested
 
     def _stream_new_locked(self, rid: int, meta: dict, toks) -> bool:
@@ -556,6 +622,49 @@ class _LlmServer:
         with self._lock:
             self._pending[rid] = dict(span.meta)
         return rid
+
+    # the disagg controller stamps surviving frame meta onto spans it
+    # extracts — the same propagation filter drain()/checkpointing use
+    span_meta = staticmethod(_span_meta)
+
+    def migration_advert(self) -> Dict:
+        """Piggybacked on every ``migrate_probe_ack`` (docs/
+        llm-serving.md "Disaggregated serving"): one probe roundtrip
+        tells the prefill side how WARM this server is (shared_tokens,
+        from the probe itself) and how FULL (pool headroom, from this
+        advert) — enough to pick the best decode peer without a second
+        exchange."""
+        out: Dict = {"role": self.role or ""}
+        if self.role != "decode":
+            return out
+        st = self.cb.stats()
+        out["free_slots"] = int(st.get("slots_free", 0) or 0)
+        # cached blocks are evictable on demand, so they count as
+        # headroom for an incoming span's unshared suffix
+        out["free_blocks"] = (
+            int(st.get("kv_blocks_free", 0) or 0)
+            + int(st.get("kv_blocks_cached", 0) or 0)
+        )
+        return out
+
+    def disagg_fetch(self, rid: int):
+        """Answer a ``disagg_fetch`` CTRL from the prefill peer that
+        handed rid off here: finished tokens (popped — exactly-once,
+        the prefill side owns DELIVER), ``None`` while still decoding,
+        or SpanStateError for an rid this server has never seen (the
+        peer stops polling and resubmits the prompt)."""
+        from nnstreamer_tpu.kv.migrate import SpanStateError
+
+        rid = int(rid)
+        with self._lock:
+            toks = self._disagg_done.pop(rid, None)
+            if toks is not None:
+                return toks
+            if rid in self._pending:
+                return None
+        raise SpanStateError(
+            f"tensor_llm_server id={self.srv_id}: rid {rid} unknown"
+        )
 
     def drain(self, migrate_to: Optional[str] = None) -> Dict[str, int]:
         """Graceful drain with live migration: stop admitting (new
@@ -794,6 +903,13 @@ class _LlmServer:
         st["requests"] = {
             str(rid): row for rid, row in self.cb.requests().items()
         }
+        if self.role:
+            st["disagg_role"] = self.role
+        if self._disagg is not None:
+            st["disagg"] = self._disagg.stats()
+        if self.role == "decode":
+            with self._lock:
+                st["disagg_done_waiting"] = len(self._disagg_done)
         if self.speculate == -1:
             st["spec_k"] = self._spec_k
             # the EMA is the auto controller's state — in fixed-k mode
@@ -815,8 +931,13 @@ class _LlmServer:
     def drained(self) -> bool:
         if self._plane is not None:
             return self.eos and self._plane.idle_for(self._stream)
+        if self._disagg is not None and not self._disagg.idle():
+            return False  # handed-off generations still in flight
         with self._lock:
-            return self.eos and not self._pending and not self._out
+            return (
+                self.eos and not self._pending and not self._out
+                and not self._disagg_done
+            )
 
     def release_plane(self) -> None:
         """Detach from (and drop one ref of) the shared LLM plane —
@@ -876,7 +997,13 @@ class LlmServerSink(Sink):
     atomic span checkpoints; a restarted server adopts the files and
     resumes without re-running completed prefill chunks — docs/
     llm-serving.md "Migration & recovery"; all three require
-    kv-layout=paged and are refused on plane= with a typed error)."""
+    kv-layout=paged and are refused on plane= with a typed error),
+    role/decode-peers (disaggregated prefill/decode serving — a
+    role=prefill server runs chunked prefill then hands each KV span
+    to the warmest decode peer, a role=decode server advertises pool
+    headroom in probe acks and parks finished handoffs for the
+    prefill side's fetch — docs/llm-serving.md "Disaggregated
+    serving"; same kv-layout=paged / no-plane constraints)."""
 
     FACTORY_NAME = "tensor_llm_serversink"
 
@@ -951,6 +1078,22 @@ class LlmServerSink(Sink):
             desc="span checkpoint directory — in-flight generations "
             "found here resume at startup (crash recovery)",
         ),
+        # disaggregated prefill/decode serving (serving_plane/disagg.py,
+        # docs/llm-serving.md "Disaggregated serving"): paged private
+        # batchers only, same refusal taxonomy as migrate-to
+        "role": PropSpec(
+            "enum", "", ("", "prefill", "decode"),
+            desc="disaggregated serving role: prefill runs chunked "
+            "prefill then hands the KV span to a decode peer; decode "
+            "advertises pool headroom and adopts handed-off spans "
+            "(requires kv-layout=paged)",
+        ),
+        "decode-peers": PropSpec(
+            "str", "",
+            desc="comma-separated decode peers host:port[/llm-id] for "
+            "role=prefill handoffs (refusal or unreachable peers fall "
+            "back to local decode — tokens are never lost)",
+        ),
     }
 
     def __init__(self, name=None, **props):
@@ -1024,6 +1167,8 @@ class LlmServerSink(Sink):
             checkpoint_dir=str(
                 self.get_property("checkpoint-dir", "") or ""
             ),
+            role=str(self.get_property("role", "") or ""),
+            decode_peers=str(self.get_property("decode-peers", "") or ""),
         )
         self._server: Optional[_LlmServer] = None
 

@@ -1226,6 +1226,7 @@ class ContinuousBatcher:
             self._n_migrations_out = 0
             self._n_migrations_in = 0
             self._n_resumes = 0
+            self._n_prefill_chunk_programs = 0
             self._prefill_q: deque = deque()
             self._prefill_chunks = max(1, int(prefill_chunks))
             self._prefixes_paged: Dict[int, Tuple[np.ndarray, List[int]]] = {}
@@ -2526,6 +2527,7 @@ class ContinuousBatcher:
     def _prefill_chunk_one(self, job) -> None:
         """One ``prompt_len`` bucket of chunked prefill for ``job``
         (device work — caller holds _step_lock only)."""
+        self._n_prefill_chunk_programs += 1
         P = self.prompt_len
         ctx = job.tokens
         t = job.fill
@@ -3804,6 +3806,7 @@ class ContinuousBatcher:
                 st["kv_gather_dispatches"] = self._n_gather_dispatch
                 st["kv_migrations_out"] = self._n_migrations_out
                 st["kv_migrations_in"] = self._n_migrations_in
+                st["kv_prefill_chunks"] = self._n_prefill_chunk_programs
                 st["request_resumes"] = self._n_resumes
             return st
 

@@ -167,6 +167,21 @@ METRIC_CATALOG: Dict[str, str] = {
         "resident KV the receiver reconstructs (counter; "
         "docs/llm-serving.md)"
     ),
+    "nns_disagg_handoffs_total": (
+        "disaggregated prefill→decode request handoffs, by outcome "
+        "label: handoff (span shipped to a decode peer) / local "
+        "(every peer refused or was unreachable — decoded locally, "
+        "tokens never lost) / relayed (finished tokens fetched back "
+        "from the peer and delivered) / recovered (peer lost the "
+        "handoff — prompt resubmitted locally) (counter; "
+        "docs/llm-serving.md Disaggregated serving)"
+    ),
+    "nns_route_prefix_hits_total": (
+        "fleet-client requests routed to the endpoint holding the "
+        "longest matching prompt prefix (prefix-route=true) — the "
+        "cache-affinity win over plain least-loaded rotation "
+        "(counter; docs/edge-serving.md Prefix-aware routing)"
+    ),
     "nns_request_resumes_total": (
         "in-flight requests resumed after a disruption, by kind "
         "label: reprefill (no peer accepted the span — deadline-aware "

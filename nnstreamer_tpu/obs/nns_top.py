@@ -216,9 +216,11 @@ def render_fleet(snap: dict) -> str:
     """The ``--fleet`` view: one row per (fleet client, endpoint) from
     the client's health scorer (``fleet_endpoints`` in its stats row —
     docs/edge-serving.md "Running a fleet"), plus a per-client footer
-    with the failover/hedge/duplicate totals — and a row per query
-    SERVER advertising its drain readiness flag. Empty when nothing in
-    the snapshot serves a fleet."""
+    with the failover/hedge/duplicate totals (plus prefix-route hit/
+    index counts when the client routes by prompt prefix) — and a row
+    per query SERVER advertising its drain readiness flag or its
+    disaggregated-serving role with handoff-outcome counts. Empty when
+    nothing in the snapshot serves a fleet."""
     nodes: Dict[str, dict] = snap.get("nodes", {})
     lines = []
     head = "".join(
@@ -254,6 +256,11 @@ def render_fleet(snap: dict) -> str:
         ]
         if row.get("fleet_stale_replies"):
             footer.append(f"stale={row['fleet_stale_replies']}")
+        if row.get("fleet_prefix_hits") is not None:
+            # prefix-route=true clients: cache-affinity routing wins
+            # and how many prompt prefixes the router currently maps
+            footer.append(f"prefix-hits={row['fleet_prefix_hits']}")
+            footer.append(f"prefix-index={row.get('fleet_prefix_index', 0)}")
         lines.append(f"  {name}: " + " ".join(footer))
     # server half: the drain/rolling-restart readiness flags
     for name, row in nodes.items():
@@ -265,6 +272,25 @@ def render_fleet(snap: dict) -> str:
             if row.get("adm_drain_nacked") else ""
         )
         lines.append(f"  server {name}: {readiness}{extra}")
+    # disaggregated-serving roles (docs/llm-serving.md "Disaggregated
+    # serving"): a prefill server's handoff outcomes / a decode
+    # server's parked finished handoffs
+    for name, row in nodes.items():
+        role = row.get("serving_disagg_role")
+        if not role:
+            continue
+        parts = [f"role={role}"]
+        counts = (row.get("serving_disagg") or {}).get("counts") or {}
+        parts.extend(f"{k}={v}" for k, v in sorted(counts.items()))
+        if (row.get("serving_disagg") or {}).get("outstanding"):
+            parts.append(
+                f"outstanding={row['serving_disagg']['outstanding']}"
+            )
+        if row.get("serving_disagg_done_waiting"):
+            parts.append(
+                f"done-waiting={row['serving_disagg_done_waiting']}"
+            )
+        lines.append(f"  server {name}: " + " ".join(parts))
     if not lines:
         return "(no fleet client in this snapshot)"
     return "\n".join(lines)

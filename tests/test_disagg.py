@@ -1,0 +1,544 @@
+"""Disaggregated prefill/decode serving (serving_plane/disagg.py,
+docs/llm-serving.md "Disaggregated serving") and prefix-aware fleet
+routing (edge/fleet.py, docs/edge-serving.md "Prefix-aware routing").
+
+The headline invariants: a request prefilled on a ``role=prefill``
+server and decoded on its ``role=decode`` peer finishes **bitwise
+identical** to the solo run with **zero decode-side re-prefill** (the
+``kv_prefill_chunks`` counter pins it), delivery stays at-most-once
+(the decode server parks finished handoffs instead of emitting — the
+prefill side owns DELIVER under the unchanged ``frame_id``), and a
+refusing peer falls back to local decode with no token lost. On the
+client: repeat-prefix requests route to the endpoint that last served
+the longest matching prompt prefix, falling back to the least-loaded
+healthy rotation.
+
+Budget note: each _LlmServer builds its own ContinuousBatcher (~4.5 s
+params init + pump compile on CPU). The fp handoff test and the int8
+warm-handoff test each need exactly the two-build floor (prefill +
+decode ARE the subject); everything else is model-free. The
+2-prefill x 2-decode soak with a mid-traffic decode drain (4 builds)
+is marked ``slow``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.edge.fleet import (
+    FleetEndpoints,
+    PrefixRouter,
+    ReplyDeduper,
+    prefix_route_keys,
+)
+from nnstreamer_tpu.edge.serialize import ROUTE_META_KEY
+from nnstreamer_tpu.elements.base import ElementError
+from nnstreamer_tpu.serving_plane.disagg import parse_decode_peers
+from nnstreamer_tpu.tensors.frame import Frame
+
+OPTS = {
+    "vocab": "211", "d_model": "32", "n_heads": "2", "n_layers": "1",
+    "seed": "5",
+}
+N_HEADS = 2
+
+
+def _mk(**kw):
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+
+    base = dict(
+        model="zoo:transformer_lm", options=dict(OPTS), n_slots=2,
+        max_len=64, prompt_len=16, default_new=10, kv_layout="paged",
+        block_size=16, kv_blocks=0,
+    )
+    base.update(kw)
+    return _LlmServer(**base)
+
+
+def _alone(prompt, n_new):
+    import jax
+
+    from nnstreamer_tpu.models import decode as dec
+    from nnstreamer_tpu.models import transformer as tfm
+
+    params = tfm.init_params(
+        jax.random.PRNGKey(5), vocab=211, d_model=32, n_heads=2,
+        n_layers=1,
+    )
+    toks = dec.generate(
+        params, np.asarray(prompt, np.int32)[None, :], N_HEADS, n_new
+    )
+    return [int(t) for t in np.asarray(toks)[0]]
+
+
+def _pump_until(srv, cond, timeout=120.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        srv.pump()
+
+
+def _prompt(seed, n=6):
+    return np.random.default_rng(seed).integers(1, 211, (n,)).astype(
+        np.int32
+    )
+
+
+# -- decode-peers grammar / role prop validation (model-free) -----------
+
+
+def test_parse_decode_peers():
+    assert parse_decode_peers("h1:9001,h2:9002/3") == [
+        ("h1", 9001, 0), ("h2", 9002, 3),
+    ]
+    assert parse_decode_peers(" h:5001 , ", default_llm_id=7) == [
+        ("h", 5001, 7),
+    ]
+    for bad in ("", "noport", "h:", "h:0", "h:x", "h:1/abc", "a:1,a:1"):
+        with pytest.raises(ValueError):
+            parse_decode_peers(bad)
+
+
+def test_role_props_validation():
+    """role= fails loudly at construction — before any model load."""
+    from nnstreamer_tpu.elements.llm_serve import _LlmServer
+    from nnstreamer_tpu.serving_plane.llm import LlmPlaneError
+
+    def mk(**kw):
+        base = dict(
+            model="zoo:transformer_lm", options={}, n_slots=1,
+            max_len=32, prompt_len=8, default_new=4, kv_layout="paged",
+        )
+        base.update(kw)
+        return _LlmServer(**base)
+
+    with pytest.raises(ElementError, match="prefill or decode"):
+        mk(role="both")
+    with pytest.raises(ElementError, match="role=prefill"):
+        mk(role="decode", decode_peers="h:1")
+    with pytest.raises(ElementError, match="kv-layout=paged"):
+        mk(role="prefill", kv_layout="slot")
+    with pytest.raises(LlmPlaneError, match="role= refused"):
+        mk(role="decode", plane="dg-pl")
+    with pytest.raises(ElementError, match="decode-peers"):
+        mk(role="prefill", decode_peers="nonsense")
+
+
+# -- prefix keys + router (model-free units) ----------------------------
+
+
+def test_prefix_route_keys_block_math():
+    toks = list(range(40))
+    keys = prefix_route_keys(toks)  # 40 tokens / block 16 -> 2 full
+    assert len(keys) == 2 and all(len(k) == 8 for k in keys)
+    # keys are a rolling chain: a shared prefix shares its key prefix
+    assert prefix_route_keys(toks[:32]) == keys
+    assert prefix_route_keys(toks[:16]) == keys[:1]
+    assert prefix_route_keys(toks[:15]) == []  # no full block
+    # a differing token in block 0 changes EVERY key downstream
+    other = [99] + toks[1:]
+    assert prefix_route_keys(other)[0] != keys[0]
+
+
+def test_prefix_router_longest_match_wins():
+    r = PrefixRouter(capacity=16)
+    deep = prefix_route_keys(list(range(48)))   # 3 keys
+    r.note(deep[:1], "a:1")                     # a holds 1 block
+    r.note(deep, "b:2")                         # b holds all 3
+    assert r.best(deep) == ("b:2", 3)
+    # a prompt matching only the first block routes to the deepest
+    # holder OF THAT PREFIX (b recorded the chain, latest depth wins)
+    assert r.best(deep[:1])[1] == 1
+    # unknown prefix: no preference
+    assert r.best(prefix_route_keys([7] * 32)) is None
+    assert r.best([]) is None
+    # latest note wins for the same depth
+    r.note(deep, "c:3")
+    assert r.best(deep) == ("c:3", 3)
+    # bounded: FIFO eviction keeps the index from growing forever
+    small = PrefixRouter(capacity=16)
+    for i in range(40):
+        small.note([f"{i:08x}"], "x:1")
+    assert len(small) <= 16
+
+
+def test_plan_least_loaded_fallback():
+    """With no prefix preference the healthy rotation is stably
+    re-ordered by live inflight depth — ties keep round-robin."""
+    f = FleetEndpoints([("a", 1), ("b", 2), ("c", 3)], clock=lambda: 0.0)
+    a, b, c = f.endpoints
+    assert [e.addr for e in f.plan()] == ["a:1", "b:2", "c:3"]
+    b.inflight = 3
+    a.inflight = 1
+    # rotation starts at b this turn, but load reorders: c (0), a (1),
+    # b (3) — the loaded endpoint stops collecting new requests
+    assert [e.addr for e in f.plan()] == ["c:3", "a:1", "b:2"]
+    b.inflight = a.inflight = c.inflight = 0
+    # idle fleet: pure round-robin again (stable sort keeps rotation)
+    assert [e.addr for e in f.plan()][0] == "c:3"
+
+
+def test_reply_dedup_at_most_once():
+    """The PR-15 deduper delivers each frame_id exactly once — the
+    invariant the disagg DELIVER-ownership design leans on."""
+    d = ReplyDeduper(capacity=16)
+    assert d.claim("f-1") and not d.claim("f-1")
+    assert d.duplicates == 1
+
+
+# -- the CTRL wire: advert piggyback, capacity NACK, fetch (model-free) --
+
+
+class _FakeDecode:
+    """A fake decode-role LLM server behind a real serversrc."""
+
+    def __init__(self):
+        self.adopt_exc = None
+        self.done = {7: [1, 2, 3]}
+        self.pending = {8}
+
+    def migration_probe(self, tokens):
+        return 16
+
+    def migration_advert(self):
+        return {"role": "decode", "free_slots": 2, "free_blocks": 40}
+
+    def migration_adopt(self, span_bytes):
+        if self.adopt_exc is not None:
+            raise self.adopt_exc
+        return 7
+
+    def disagg_fetch(self, rid):
+        from nnstreamer_tpu.kv.migrate import SpanStateError
+
+        if rid in self.done:
+            return self.done.pop(rid)
+        if rid in self.pending:
+            return None
+        raise SpanStateError(f"rid {rid} unknown")
+
+
+def test_disagg_ctrl_wire_roundtrip():
+    from nnstreamer_tpu.edge import query as q
+
+    h = _FakeDecode()
+    q.register_migration_handler(31, h)
+    src = q.TensorQueryServerSrc("dg-wire-src", port=0, id="dg-w1")
+    src.start()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: [src.generate() for _ in iter(stop.is_set, True)],
+        daemon=True,
+    )
+    t.start()
+    try:
+        # probe ack piggybacks the decode advert: one roundtrip answers
+        # "how warm" (shared_tokens) AND "how full" (the advert)
+        shared, advert = q.probe_migration_full(
+            "127.0.0.1", src.bound_port, [1, 2, 3], llm_id=31
+        )
+        assert shared == 16
+        assert advert["role"] == "decode"
+        assert advert["free_blocks"] == 40 and advert["free_slots"] == 2
+        # capacity refusal rides the wire as a typed retry-after NACK
+        # instead of raising through the serversrc service thread
+        from nnstreamer_tpu.kv.blocks import PoolCapacityError
+
+        h.adopt_exc = PoolCapacityError("pool full", 8, 2)
+        with pytest.raises(
+            q.MigrationRefused, match="PoolCapacityError"
+        ) as ei:
+            q.send_migration("127.0.0.1", src.bound_port, b"x", llm_id=31)
+        assert ei.value.retry_after_ms > 0  # the admission retry hint
+        # fetch: finished tokens exactly once, None while decoding,
+        # refused for an rid the peer never saw
+        assert q.fetch_handoff(
+            "127.0.0.1", src.bound_port, 7, llm_id=31
+        ) == [1, 2, 3]
+        with pytest.raises(q.MigrationRefused, match="SpanStateError"):
+            q.fetch_handoff("127.0.0.1", src.bound_port, 7, llm_id=31)
+        assert q.fetch_handoff(
+            "127.0.0.1", src.bound_port, 8, llm_id=31
+        ) is None
+        # a DRAINING serversrc refuses new spans but still serves
+        # fetches: results must LEAVE a draining decode server
+        src.drain()
+        with pytest.raises(q.MigrationRefused, match="draining"):
+            q.probe_migration_full(
+                "127.0.0.1", src.bound_port, [1], llm_id=31
+            )
+        h.done[9] = [4, 5]
+        assert q.fetch_handoff(
+            "127.0.0.1", src.bound_port, 9, llm_id=31
+        ) == [4, 5]
+    finally:
+        q.unregister_migration_handler(31)
+        stop.set()
+        t.join(timeout=2)
+        src.stop()
+
+
+# -- prefix-aware routing end to end (sockets, no model) ----------------
+
+
+class _EchoServer:
+    """serversrc/serversink pair echoing tensors (and meta) back."""
+
+    def __init__(self, name: str, srv_id: str):
+        from nnstreamer_tpu.edge.query import (
+            TensorQueryServerSink,
+            TensorQueryServerSrc,
+        )
+
+        self.src = TensorQueryServerSrc(name, port=0, id=srv_id)
+        self.sink = TensorQueryServerSink(f"{name}k", id=srv_id)
+        self.src.start()
+        self.port = self.src.bound_port
+        self.served = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            f = self.src.generate()
+            if f is None:
+                continue
+            self.served += 1
+            self.sink.render(f)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=2)
+        self.src.stop()
+
+
+def test_prefix_route_client_prefers_prefix_holder():
+    from nnstreamer_tpu.edge.query import TensorQueryClient
+
+    a = _EchoServer("pfx-a", "pfxa")
+    b = _EchoServer("pfx-b", "pfxb")
+    client = TensorQueryClient(
+        "pfx-c1",
+        **{"hosts": f"127.0.0.1:{a.port},127.0.0.1:{b.port}",
+           "timeout": 3, "retry-max": 3, "retry-backoff-ms": 5,
+           "prefix-route": True},
+    )
+    prompt = np.arange(1, 33, dtype=np.int32)  # two full route blocks
+    try:
+        client.start()
+        r = client.process(Frame((prompt,), meta={"req": "warmup"}))
+        # the prefix keys rode the wire and echoed back (scalar meta)
+        assert ROUTE_META_KEY in r.meta
+        assert r.meta[ROUTE_META_KEY] == ".".join(
+            prefix_route_keys(prompt)
+        )
+        st = client.fleet_stats()
+        assert st["prefix_index"] >= 1
+        owner = a if a.served else b
+        base = owner.served
+        # repeats of the same prompt stick to the learned endpoint
+        # even as round-robin would have alternated
+        for _ in range(4):
+            client.process(Frame((prompt,), meta={}))
+        st = client.fleet_stats()
+        assert st["prefix_hits"] >= 4
+        assert owner.served == base + 4
+        # a float frame has no prompt: routes by load alone, no stamp
+        r2 = client.process(Frame((np.ones(4, np.float32),), meta={}))
+        assert ROUTE_META_KEY not in r2.meta
+    finally:
+        client.stop()
+        a.stop()
+        b.stop()
+
+
+# -- prefill -> decode handoff: bitwise, zero re-prefill, fallback ------
+
+
+class _DecodeHost:
+    """A decode-role _LlmServer behind a real query serversrc, with a
+    CTRL pump thread and a batcher pump thread (the real deployment
+    shape, minus the client-facing data path)."""
+
+    def __init__(self, name: str, srv_id: str, **kw):
+        from nnstreamer_tpu.edge.query import TensorQueryServerSrc
+
+        self.srv = _mk(srv_id=srv_id, role="decode", **kw)
+        self.src = TensorQueryServerSrc(name, port=0, id=f"dg-{name}")
+        self.src.start()
+        self.port = self.src.bound_port
+        self._stop = threading.Event()
+        self._tc = threading.Thread(target=self._ctrl, daemon=True)
+        self._tp = threading.Thread(target=self._pump, daemon=True)
+        self._tc.start()
+        self._tp.start()
+
+    def _ctrl(self):
+        while not self._stop.is_set():
+            self.src.generate()
+
+    def _pump(self):
+        while not self._stop.is_set():
+            try:
+                self.srv.pump()
+            except Exception:  # noqa: BLE001 — teardown race
+                pass
+            time.sleep(0.001)
+
+    def stop(self):
+        self._stop.set()
+        self._tc.join(timeout=2)
+        self._tp.join(timeout=2)
+        self.src.stop()
+        self.srv.release_plane()
+
+
+def test_disagg_fp_handoff_bitwise_zero_reprefill():
+    """The tentpole pin: prefill on A, decode on B, bitwise == solo,
+    B's prefill-chunk counter NEVER moves (zero re-prefill), delivery
+    stays with A under the original frame_id — and when B refuses
+    (draining), A decodes locally with no token lost."""
+    host = _DecodeHost("dg-b1", "52")
+    A = _mk(
+        srv_id="51", role="prefill",
+        decode_peers=f"127.0.0.1:{host.port}/52",
+    )
+    try:
+        p1, p2 = _prompt(31), _prompt(32)
+        A.submit(Frame((p1,), meta={"req": "r1", "frame_id": "f-1"}))
+        A.submit(Frame((p2,), meta={"req": "r2", "frame_id": "f-2"}))
+        _pump_until(A, lambda: len(A._out) >= 2, what="2 relayed")
+        got = {}
+        for _ in range(2):
+            toks, meta = A.pop()
+            got[meta["req"]] = ([int(t) for t in toks], meta)
+        assert got["r1"][0] == _alone(p1, 10)
+        assert got["r2"][0] == _alone(p2, 10)
+        # DELIVER ownership: original identity meta, emitted by A only
+        assert got["r1"][1]["frame_id"] == "f-1"
+        assert got["r2"][1]["frame_id"] == "f-2"
+        assert not host.srv._out and not host.srv._disagg_done
+        bst = host.srv.cb.stats()
+        assert bst["kv_prefill_chunks"] == 0  # the zero-re-prefill pin
+        assert bst["kv_migrations_in"] == 2
+        ast = A.stats()
+        assert ast["disagg_role"] == "prefill"
+        assert ast["disagg"]["counts"]["handoff"] == 2
+        assert ast["disagg"]["counts"]["relayed"] == 2
+        # refusal fallback: a draining decode serversrc NACKs the
+        # probe; the span re-enters A's OWN arena and finishes locally
+        host.src.drain()
+        p3 = _prompt(33)
+        A.submit(Frame((p3,), meta={"req": "r3", "frame_id": "f-3"}))
+        _pump_until(A, lambda: A._out, what="local-fallback generation")
+        toks, meta = A.pop()
+        assert [int(t) for t in toks] == _alone(p3, 10)
+        assert meta["frame_id"] == "f-3"
+        assert A.stats()["disagg"]["counts"].get("local", 0) >= 1
+        # terminal: nothing outstanding anywhere, A drains clean
+        assert A._disagg.idle()
+        A.eos = True
+        assert A.drained
+    finally:
+        A.release_plane()
+        host.stop()
+
+
+def test_disagg_int8_warm_handoff_bitwise():
+    """int8 arenas hand off bitwise too — and a decode peer already
+    holding the prompt's blocks (the solo oracle ran THERE) makes it a
+    warm handoff: the span ships stripped, the peer still re-prefills
+    nothing."""
+    host = _DecodeHost("dg-b2", "62", cache_dtype="int8")
+    A = _mk(
+        srv_id="61", role="prefill", cache_dtype="int8",
+        decode_peers=f"127.0.0.1:{host.port}/62",
+    )
+    try:
+        prompt = _prompt(41, n=16)  # one full KV block: warm-shareable
+        # solo oracle on the decode server itself (its pump thread
+        # drives it) — this also seeds its prefix cache
+        host.srv.submit(Frame((prompt,), meta={"req": "ref"}))
+        deadline = time.monotonic() + 120.0
+        while not host.srv._out:
+            assert time.monotonic() < deadline, "solo oracle timed out"
+            time.sleep(0.005)
+        ref_toks, _ = host.srv.pop()
+        assert host.srv.cb.probe_prefix([int(t) for t in prompt]) == 16
+        base_chunks = host.srv.cb.stats()["kv_prefill_chunks"]
+        A.submit(Frame((prompt,), meta={"req": "h1", "frame_id": "f-h"}))
+        _pump_until(A, lambda: A._out, what="relayed int8 generation")
+        toks, meta = A.pop()
+        assert [int(t) for t in toks] == [int(t) for t in ref_toks]
+        assert meta["frame_id"] == "f-h"
+        bst = host.srv.cb.stats()
+        assert bst["kv_prefill_chunks"] == base_chunks  # warm: no chunk
+        assert bst["kv_migrations_in"] == 1
+        assert A.stats()["disagg"]["counts"]["handoff"] == 1
+    finally:
+        A.release_plane()
+        host.stop()
+
+
+# -- the 2x2 soak with a mid-traffic decode drain (slow) ----------------
+
+
+@pytest.mark.slow
+def test_disagg_soak_two_by_two_mid_drain():
+    """2 prefill x 2 decode under rolling traffic while one decode
+    server drains mid-stream: every request terminates, bitwise == the
+    solo run, nothing outstanding at the end."""
+    d1 = _DecodeHost("dgs-d1", "71")
+    d2 = _DecodeHost("dgs-d2", "72")
+    peers = f"127.0.0.1:{d1.port}/71,127.0.0.1:{d2.port}/72"
+    a1 = _mk(srv_id="73", role="prefill", decode_peers=peers)
+    a2 = _mk(srv_id="74", role="prefill", decode_peers=peers)
+    prefills = [a1, a2]
+    try:
+        expect = {}
+        for i in range(4):
+            p = _prompt(100 + i)
+            expect[f"s-{i}"] = _alone(p, 10)
+            prefills[i % 2].submit(
+                Frame((p,), meta={"req": f"s-{i}", "frame_id": f"sf-{i}"})
+            )
+        deadline = time.monotonic() + 240.0
+
+        def _pump_all_until(n):
+            while sum(len(a._out) for a in prefills) < n:
+                assert time.monotonic() < deadline, "soak timed out"
+                for a in prefills:
+                    a.pump()
+
+        _pump_all_until(2)
+        # mid-traffic drain: d1 refuses new spans but keeps serving
+        # fetches for handoffs already decoding there
+        d1.src.drain()
+        for i in range(4, 8):
+            p = _prompt(100 + i)
+            expect[f"s-{i}"] = _alone(p, 10)
+            prefills[i % 2].submit(
+                Frame((p,), meta={"req": f"s-{i}", "frame_id": f"sf-{i}"})
+            )
+        _pump_all_until(8)
+        got = {}
+        for a in prefills:
+            while a._out:
+                toks, meta = a.pop()
+                got[meta["req"]] = [int(t) for t in toks]
+        assert got == expect  # all terminal, all bitwise == solo
+        for a in prefills:
+            assert a._disagg.idle()
+            a.eos = True
+            assert a.drained
+        # the drained decode server kept serving its in-flight: its
+        # parked queue is empty once every fetch landed
+        assert not d1.srv._disagg_done
+    finally:
+        a1.release_plane()
+        a2.release_plane()
+        d1.stop()
+        d2.stop()

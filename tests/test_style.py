@@ -90,6 +90,18 @@ def test_kscope_kernel_codes_and_registry_wired_both_ways():
     assert not problems, "\n".join(problems)
 
 
+def test_disagg_codes_wired_both_ways():
+    """nns-disagg --self-check wiring: NNS-W130 is cataloged, has an
+    emitter in analysis/lint.py, and is documented in docs/linting.md
+    AND docs/llm-serving.md; both disagg metrics are in METRIC_CATALOG
+    with live emitters (tools/check_style.py runs the same gate on
+    whole-tree runs)."""
+    from nnstreamer_tpu.analysis.selfcheck import disagg_self_check
+
+    problems = disagg_self_check()
+    assert not problems, "\n".join(problems)
+
+
 @pytest.mark.slow
 def test_documented_pipelines_xray_clean():
     """Every pipeline string embedded in examples/ and docs/ must xray

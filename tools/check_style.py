@@ -153,6 +153,22 @@ def run_kscope_self_check() -> list:
     return [f"kscope: {p}" for p in kscope_self_check()]
 
 
+def run_disagg_self_check() -> list:
+    """Run nns-disagg's wiring self-check in-process: the disagg lint
+    code (NNS-W130) missing from the catalog, without an emitter, or
+    undocumented in docs/linting.md + docs/llm-serving.md is a style
+    problem — as is either disagg metric missing from METRIC_CATALOG
+    or without a live emitter."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from nnstreamer_tpu.analysis.selfcheck import disagg_self_check
+    except Exception as exc:  # pragma: no cover - broken tree
+        return [f"nns-disagg --self-check could not run: {exc}"]
+    return [f"disagg: {p}" for p in disagg_self_check()]
+
+
 def documented_pipeline_strings() -> list:
     """(source, description) for every pipeline launch string embedded
     in examples/*.py and docs/*.md — double-quoted launch strings plus
@@ -258,6 +274,7 @@ def main(argv=None) -> int:
         problems.extend(run_race_lint_gate())
         problems.extend(run_xray_self_check())
         problems.extend(run_kscope_self_check())
+        problems.extend(run_disagg_self_check())
         problems.extend(run_xray_docs_gate())
     for p in problems:
         print(p)
